@@ -1,0 +1,68 @@
+"""ompi_tpu — a TPU-native communication framework with MPI semantics.
+
+A brand-new design with the capabilities of Open MPI (reference:
+``lukebest/ompi``): communicators, groups, datatypes, reduction ops,
+blocking/nonblocking collectives, point-to-point and one-sided
+communication — whose operations on TPU-resident (HBM) buffers lower to
+XLA collective ops (``psum``, ``all_gather``, ``all_to_all``,
+``ppermute``) executed over the ICI mesh, instead of being staged to host
+and pushed through a byte-transport stack.
+
+Architecture (conceptual boundaries mirrored from the reference's MCA,
+re-designed TPU-first):
+
+- ``ompi_tpu.mca``      — framework/component machinery + typed config
+  ("MCA vars": env < file < CLI precedence, source tracking), mirroring
+  ``opal/mca/base`` (reference ``opal/mca/base/mca_base_var.c``).
+- ``ompi_tpu.core``     — communicators/groups/datatypes/ops/requests,
+  mirroring ``ompi/{communicator,group,datatype,op,request}``.
+- ``ompi_tpu.coll``     — collective framework with priority-selected
+  components (xla-native, basic/host, tuned decision layer), mirroring
+  ``ompi/mca/coll``.
+- ``ompi_tpu.accelerator`` — device-memory abstraction (buffer locus,
+  H2D/D2H staging, async events), mirroring ``opal/mca/accelerator``.
+- ``ompi_tpu.runtime``  — init/finalize, device-mesh world binding,
+  progress engine, SPC counters, mirroring ``ompi/runtime`` + ``opal/runtime``.
+
+Execution model: single-controller SPMD. An MPI "rank" is a coordinate on
+a ``jax.sharding.Mesh``; a rank's local buffer is one shard of a stacked
+``jax.Array`` of shape ``(nranks, *local_shape)`` sharded along axis 0.
+Collectives compile (once, cached) to one SPMD program over the
+communicator's mesh — data moves over ICI, never through host.
+"""
+
+from ompi_tpu.api.mpi import (  # noqa: F401
+    # constants
+    IN_PLACE, UNDEFINED, ANY_SOURCE, ANY_TAG, PROC_NULL, ROOT, KEYVAL_INVALID,
+    SUCCESS, ERR_COMM, ERR_TYPE, ERR_OP, ERR_ARG, ERR_COUNT, ERR_BUFFER,
+    ERR_RANK, ERR_ROOT, ERR_TRUNCATE, ERR_PENDING, ERR_REVOKED, ERR_PROC_FAILED,
+    CONGRUENT, IDENT, SIMILAR, UNEQUAL,
+    THREAD_SINGLE, THREAD_FUNNELED, THREAD_SERIALIZED, THREAD_MULTIPLE,
+    COMM_TYPE_SHARED, COMM_TYPE_HWTHREAD, COMM_TYPE_NUMA,
+    MAX_ERROR_STRING, MAX_PROCESSOR_NAME,
+    # datatypes
+    FLOAT, DOUBLE, INT, LONG, CHAR, BYTE, SHORT, UNSIGNED, UNSIGNED_LONG,
+    INT8_T, INT16_T, INT32_T, INT64_T, UINT8_T, UINT16_T, UINT32_T, UINT64_T,
+    C_BOOL, FLOAT16, BFLOAT16, C_FLOAT_COMPLEX, C_DOUBLE_COMPLEX,
+    FLOAT_INT, DOUBLE_INT, LONG_INT, SHORT_INT, TWOINT,
+    Datatype,
+    # ops
+    SUM, PROD, MAX, MIN, LAND, LOR, LXOR, BAND, BOR, BXOR, MAXLOC, MINLOC,
+    REPLACE, NO_OP, Op,
+    # objects
+    Communicator, Group, Request, Status, Errhandler, Info, Win,
+    ERRORS_ARE_FATAL, ERRORS_RETURN, ERRORS_ABORT,
+    MPIError,
+    # lifecycle
+    Init, Init_thread, Finalize, Initialized, Finalized, Abort,
+    Query_thread, Get_processor_name, Wtime, Wtick, Get_version,
+    get_comm_world, get_comm_self, COMM_NULL,
+    # request completion
+    Wait, Test, Waitall, Waitany, Waitsome, Testall, Testany, Testsome,
+    # helpers
+    op_create, create_keyval, free_keyval, error_string, from_numpy_dtype,
+    Grequest, INFO_ENV, INFO_NULL,
+    Get_library_version,
+)
+
+__version__ = "0.1.0"

@@ -1,0 +1,3 @@
+from ompi_tpu.accelerator.framework import (  # noqa: F401
+    LOCUS_DEVICE, LOCUS_HOST, check_addr, to_device, to_host, accel_framework,
+)

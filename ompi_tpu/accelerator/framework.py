@@ -1,0 +1,114 @@
+"""Accelerator framework — the device-memory abstraction.
+
+Behavioral spec: ``opal/mca/accelerator/accelerator.h`` — ``check_addr``
+:176 (is this buffer device memory?), async memcpy :280, streams/events
+:189-258, device alloc :364. The CUDA component detects device pointers
+via ``cuPointerGetAttributes`` (``accelerator_cuda.c:304-360``).
+
+TPU-native re-design: there are no raw pointers. A buffer *is* either a
+``jax.Array`` (device-resident: HBM shards committed to mesh devices) or a
+NumPy array (host). ``check_addr`` is a type/placement test; staging is
+``jax.device_put`` / ``np.asarray``; events collapse into JAX's async
+dispatch (``block_until_ready``). Components: ``tpu`` (live PJRT
+backend), ``null`` (host-only, mirrors ``accelerator/null``).
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ompi_tpu.mca.base import Component, register_framework
+
+LOCUS_DEVICE = "device"
+LOCUS_HOST = "host"
+
+accel_framework = register_framework("accelerator")
+
+
+class TpuAccelComponent(Component):
+    """Live PJRT-backed device memory (peer of accelerator/cuda|rocm|ze)."""
+
+    name = "tpu"
+
+    def comm_query(self, comm):
+        return (50, self)
+
+    def check_addr(self, buf: Any) -> Optional[str]:
+        if isinstance(buf, jax.Array):
+            return LOCUS_DEVICE
+        if isinstance(buf, (np.ndarray, np.generic)):
+            return LOCUS_HOST
+        return None
+
+    def mem_copy_h2d(self, host_buf, sharding=None):
+        return jax.device_put(np.asarray(host_buf), sharding)
+
+    def mem_copy_d2h(self, dev_buf):
+        return np.asarray(dev_buf)
+
+    def event_synchronize(self, bufs):
+        jax.block_until_ready(bufs)
+
+    def get_device_info(self) -> Tuple[str, int]:
+        devs = jax.devices()
+        return (devs[0].platform, len(devs))
+
+
+class NullAccelComponent(Component):
+    """Host-only component (mirrors accelerator/null): every buffer is
+    host memory; device copies degrade to numpy."""
+
+    name = "null"
+
+    def comm_query(self, comm):
+        return (0, self)
+
+    def check_addr(self, buf: Any) -> Optional[str]:
+        if isinstance(buf, (np.ndarray, np.generic, jax.Array)):
+            return LOCUS_HOST
+        return None
+
+    def mem_copy_h2d(self, host_buf, sharding=None):
+        return np.asarray(host_buf)
+
+    def mem_copy_d2h(self, dev_buf):
+        return np.asarray(dev_buf)
+
+    def event_synchronize(self, bufs):
+        pass
+
+
+accel_framework.register(TpuAccelComponent())
+accel_framework.register(NullAccelComponent())
+
+_module: Optional[Component] = None
+
+
+def _mod() -> Component:
+    global _module
+    if _module is None:
+        accel_framework.open()
+        sel = accel_framework.comm_select(None)
+        _module = sel[0][2]
+    return _module
+
+
+def check_addr(buf: Any) -> Optional[str]:
+    """Locus of a buffer: LOCUS_DEVICE, LOCUS_HOST, or None (not a
+    buffer). The re-designed ``accelerator.check_addr`` (:176)."""
+    return _mod().check_addr(buf)
+
+
+def to_device(buf: Any, sharding=None):
+    return _mod().mem_copy_h2d(buf, sharding)
+
+
+def to_host(buf: Any):
+    return _mod().mem_copy_d2h(buf)
+
+
+def _reset_for_tests():
+    global _module
+    _module = None

@@ -1,0 +1,1 @@
+"""Public API surface (the ``ompi/mpi/c`` equivalent)."""

@@ -1,0 +1,162 @@
+"""The MPI-flavored public API (the ``ompi/mpi/c`` binding layer).
+
+The reference generates 468 ``MPI_*`` C bindings from templates
+(``ompi/mpi/bindings/bindings.py``); each checks args and dispatches into
+the core (``allreduce.c.in:115-117``). Here the binding layer is this
+module: MPI-style names over the core objects, plus the profiling
+interposition hook (``PMPI``-equivalent, see ``ompi_tpu.tools.pmpi``).
+
+Single-controller note: buffer arguments are *stacked* arrays — leading
+axis is the rank — and results are returned functionally (device arrays
+are immutable). ``IN_PLACE`` keeps its MPI meaning: "use recvbuf as the
+send buffer".
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+# constants ---------------------------------------------------------------
+from ompi_tpu.core.communicator import (IN_PLACE, Communicator,  # noqa: F401
+                                        create_keyval, free_keyval)
+from ompi_tpu.core.datatype import (  # noqa: F401
+    BFLOAT16, BYTE, C_BOOL, C_DOUBLE_COMPLEX, C_FLOAT_COMPLEX, CHAR, DOUBLE,
+    DOUBLE_INT, Datatype, FLOAT, FLOAT16, FLOAT_INT, INT, INT8_T, INT16_T,
+    INT32_T, INT64_T, LONG, LONG_INT, SHORT, SHORT_INT, TWOINT, UINT8_T,
+    UINT16_T, UINT32_T, UINT64_T, UNSIGNED, UNSIGNED_LONG,
+    from_numpy_dtype)
+from ompi_tpu.core.errhandler import (  # noqa: F401
+    ERR_ARG, ERR_BUFFER, ERR_COMM, ERR_COUNT, ERR_OP, ERR_PENDING,
+    ERR_PROC_FAILED, ERR_RANK, ERR_REVOKED, ERR_ROOT, ERR_TRUNCATE, ERR_TYPE,
+    ERRORS_ABORT, ERRORS_ARE_FATAL, ERRORS_RETURN, Errhandler, MPIError,
+    SUCCESS, error_string)
+from ompi_tpu.core.group import (CONGRUENT, Group, IDENT, SIMILAR,  # noqa: F401
+                                 UNDEFINED, UNEQUAL)
+from ompi_tpu.core.info import INFO_ENV, INFO_NULL, Info  # noqa: F401
+from ompi_tpu.core.op import (BAND, BOR, BXOR, LAND, LOR, LXOR, MAX,  # noqa: F401
+                              MAXLOC, MIN, MINLOC, NO_OP, Op, PROD, REPLACE,
+                              SUM, op_create)
+from ompi_tpu.core.request import (Grequest, Request, Status,  # noqa: F401
+                                   testall, testany, testsome, waitall,
+                                   waitany, waitsome)
+from ompi_tpu.runtime import init as _rt
+
+ANY_SOURCE = -1
+ANY_TAG = -1
+PROC_NULL = -2
+ROOT = -4
+KEYVAL_INVALID = -1
+MAX_ERROR_STRING = 256
+MAX_PROCESSOR_NAME = 256
+
+THREAD_SINGLE = _rt.THREAD_SINGLE
+THREAD_FUNNELED = _rt.THREAD_FUNNELED
+THREAD_SERIALIZED = _rt.THREAD_SERIALIZED
+THREAD_MULTIPLE = _rt.THREAD_MULTIPLE
+
+COMM_TYPE_SHARED = 1
+COMM_TYPE_HWTHREAD = 2
+COMM_TYPE_NUMA = 3
+
+COMM_NULL = None
+
+# One-sided (filled by ompi_tpu.osc; imported lazily to avoid cycles).
+Win = None
+
+
+def _load_win():
+    global Win
+    if Win is None:
+        from ompi_tpu.osc.framework import Win as _W
+        Win = _W
+    return Win
+
+
+# lifecycle ---------------------------------------------------------------
+def Init(devices=None) -> None:
+    _rt.init(THREAD_SINGLE, devices=devices)
+
+
+def Init_thread(required: int = THREAD_SINGLE, devices=None) -> int:
+    return _rt.init(required, devices=devices)
+
+
+def Finalize() -> None:
+    _rt.finalize()
+
+
+def Initialized() -> bool:
+    return _rt.initialized()
+
+
+def Finalized() -> bool:
+    return _rt.finalized()
+
+
+def Query_thread() -> int:
+    return _rt.query_thread()
+
+
+def Abort(comm: Optional[Communicator] = None, errorcode: int = 1):
+    (comm or _rt.comm_world()).abort(errorcode)
+
+
+def Get_processor_name() -> str:
+    return _rt.processor_name()
+
+
+def Wtime() -> float:
+    return _rt.wtime()
+
+
+def Wtick() -> float:
+    return _rt.wtick()
+
+
+def Get_version():
+    return (4, 0)      # MPI standard level this surface tracks
+
+
+def Get_library_version() -> str:
+    from ompi_tpu import __version__
+    return f"ompi_tpu {__version__} (TPU-native, XLA/ICI data plane)"
+
+
+def get_comm_world() -> Communicator:
+    return _rt.comm_world()
+
+
+def get_comm_self() -> Communicator:
+    return _rt.comm_self()
+
+
+# request completion (MPI_Wait/Test families) -----------------------------
+def Wait(request: Request) -> Status:
+    return request.wait()
+
+
+def Test(request: Request):
+    return request.test()
+
+
+def Waitall(requests) -> list:
+    return waitall(requests)
+
+
+def Waitany(requests):
+    return waitany(requests)
+
+
+def Waitsome(requests):
+    return waitsome(requests)
+
+
+def Testall(requests):
+    return testall(requests)
+
+
+def Testany(requests):
+    return testany(requests)
+
+
+def Testsome(requests):
+    return testsome(requests)

@@ -1,0 +1,12 @@
+"""Collective framework — mirrors ``ompi/mca/coll``.
+
+Components:
+- ``xla``   — TPU-native lowering to XLA collectives over the
+              communicator's mesh (the reason this framework exists).
+- ``basic`` — host/NumPy linear algorithms (fallback + correctness
+              oracle, mirrors coll/basic).
+- ``self``  — size-1 communicators (mirrors coll/self).
+- ``tuned`` — decision layer: per-call locus/size-based dispatch between
+              native device path and host path, with staging (mirrors
+              coll/tuned decision functions + coll/accelerator staging).
+"""

@@ -1,0 +1,46 @@
+"""Coll framework: per-communicator, per-function module selection.
+
+Mirrors ``ompi/mca/coll/base/coll_base_comm_select.c:234-273`` — query
+every component, keep priority >= 0, sort descending, then enable winners
+*per function* into the communicator's ``c_coll`` vtable (a component may
+provide only some collectives; the next-priority component backfills the
+rest — exactly how the reference composes e.g. coll/tuned over coll/basic,
+and how the fork's switch_barrier intercepts only ``coll_barrier``).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from ompi_tpu.mca.base import register_framework
+
+COLL_FUNCS = (
+    "allreduce", "reduce", "bcast", "allgather", "gather", "scatter",
+    "alltoall", "reduce_scatter_block", "scan", "exscan", "barrier",
+)
+
+coll_framework = register_framework("coll")
+
+_components_loaded = False
+
+
+def _ensure_components() -> None:
+    global _components_loaded
+    if _components_loaded:
+        return
+    # Importing registers each component with the framework.
+    from ompi_tpu.coll import basic, self_, tuned, xla  # noqa: F401
+    _components_loaded = True
+
+
+def comm_select_coll(comm) -> Dict[str, Any]:
+    """Build the c_coll vtable for ``comm``: highest-priority provider per
+    collective function."""
+    _ensure_components()
+    selected = coll_framework.comm_select(comm)   # descending priority
+    vtable: Dict[str, Any] = {}
+    for func in COLL_FUNCS:
+        for _prio, _comp, module in selected:
+            if getattr(module, func, None) is not None:
+                vtable[func] = module
+                break
+    return vtable

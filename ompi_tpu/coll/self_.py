@@ -1,0 +1,62 @@
+"""coll/self — trivial implementations for size-1 communicators
+(mirrors ``ompi/mca/coll/self``, priority-selected only for COMM_SELF
+and other single-rank communicators)."""
+from __future__ import annotations
+
+from ompi_tpu.coll.framework import coll_framework
+from ompi_tpu.mca import var
+from ompi_tpu.mca.base import Component
+
+
+class SelfCollModule:
+    def __init__(self, comm):
+        self.comm = comm
+
+    def allreduce(self, x, op):
+        return x
+
+    def reduce(self, x, op, root):
+        return x
+
+    def bcast(self, x, root):
+        return x
+
+    def allgather(self, x):
+        return x[:, None]
+
+    def gather(self, x, root):
+        return x[:, None]
+
+    def scatter(self, x, root):
+        return x[:, 0]
+
+    def alltoall(self, x):
+        return x
+
+    def reduce_scatter_block(self, x, op):
+        return x[:, 0]
+
+    def scan(self, x, op):
+        return x
+
+    def exscan(self, x, op):
+        return x                            # rank 0 recvbuf is undefined
+
+    def barrier(self) -> None:
+        pass
+
+
+class SelfCollComponent(Component):
+    name = "self"
+
+    def register_params(self):
+        var.var_register("coll", "self", "priority", vtype="int", default=75,
+                         help="Selection priority for single-rank comms")
+
+    def comm_query(self, comm):
+        if comm is None or comm.size != 1:
+            return None
+        return (var.var_get("coll_self_priority", 75), SelfCollModule(comm))
+
+
+coll_framework.register(SelfCollComponent())

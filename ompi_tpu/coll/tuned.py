@@ -1,0 +1,131 @@
+"""coll/tuned — the decision layer.
+
+Mirrors two reference components at once, because on TPU they collapse
+into one decision: (a) coll/tuned's per-collective decision functions
+choosing an algorithm from message size (``coll_tuned_decision_fixed.c``),
+and (b) coll/accelerator's device-buffer staging shim
+(``coll_accelerator_allreduce.c:55-80``) — except *inverted*: the
+reference stages device buffers to host to run CPU algorithms; here the
+native path IS the device path, and the decision is whether a
+*host*-resident buffer is large enough to be worth staging to HBM to ride
+ICI, or small enough to run with host NumPy.
+
+The switch point is an MCA var (``coll_tuned_stage_min_bytes``) with an
+optional JSON dynamic-rules file (``coll_tuned_dynamic_rules``) that can
+override it per collective — the re-design of tuned's dynamic rule file
+(``coll_tuned_component.c:187-191``).
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, Optional
+
+from ompi_tpu.accelerator import (LOCUS_DEVICE, check_addr, to_device,
+                                  to_host)
+from ompi_tpu.coll.basic import BasicCollModule
+from ompi_tpu.coll.framework import coll_framework
+from ompi_tpu.coll.xla import XlaCollModule
+from ompi_tpu.mca import var
+from ompi_tpu.mca.base import Component
+
+
+def _load_rules(path: str) -> Dict[str, Dict]:
+    if not path:
+        return {}
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        return data if isinstance(data, dict) else {}
+    except (OSError, ValueError):
+        return {}
+
+
+class TunedCollModule:
+    def __init__(self, comm, rules: Dict[str, Dict]):
+        self.comm = comm
+        self.device = XlaCollModule(comm)
+        self.host = BasicCollModule(comm)
+        self.rules = rules
+        self.stage_min = var.var_get("coll_tuned_stage_min_bytes", 1 << 20)
+
+    def _decide(self, func: str, buf):
+        """Return (module, stage_back: bool) for this call."""
+        if check_addr(buf) == LOCUS_DEVICE:
+            return self.device, False
+        nbytes = getattr(buf, "nbytes", 0)
+        threshold = self.rules.get(func, {}).get(
+            "stage_min_bytes", self.stage_min)
+        if nbytes >= threshold:
+            return self.device, True      # stage host->HBM, ride ICI
+        return self.host, False
+
+    def _run(self, func: str, buf, *args):
+        mod, stage = self._decide(func, buf)
+        if stage:
+            y = getattr(mod, func)(to_device(buf, self.comm.sharding), *args)
+            return to_host(y)
+        return getattr(mod, func)(buf, *args)
+
+    # Per-function entry points (the vtable winners).
+    def allreduce(self, x, op):
+        return self._run("allreduce", x, op)
+
+    def reduce(self, x, op, root):
+        return self._run("reduce", x, op, root)
+
+    def bcast(self, x, root):
+        return self._run("bcast", x, root)
+
+    def allgather(self, x):
+        return self._run("allgather", x)
+
+    def gather(self, x, root):
+        return self._run("gather", x, root)
+
+    def scatter(self, x, root):
+        return self._run("scatter", x, root)
+
+    def alltoall(self, x):
+        return self._run("alltoall", x)
+
+    def reduce_scatter_block(self, x, op):
+        return self._run("reduce_scatter_block", x, op)
+
+    def scan(self, x, op):
+        return self._run("scan", x, op)
+
+    def exscan(self, x, op):
+        return self._run("exscan", x, op)
+
+    def barrier(self) -> None:
+        self.device.barrier()
+
+    def ibarrier(self):
+        return self.device.ibarrier()
+
+
+class TunedCollComponent(Component):
+    name = "tuned"
+
+    def register_params(self):
+        var.var_register(
+            "coll", "tuned", "priority", vtype="int", default=60,
+            help="Selection priority of the tuned decision component")
+        var.var_register(
+            "coll", "tuned", "stage_min_bytes", vtype="int", default=1 << 20,
+            help="Host buffers at least this large are staged to HBM and "
+                 "run on the ICI-native path; smaller ones run host-side")
+        var.var_register(
+            "coll", "tuned", "dynamic_rules", vtype="str", default="",
+            help="Path to a JSON per-collective decision-rule override "
+                 "file (re-design of coll/tuned dynamic rules)")
+
+    def comm_query(self, comm):
+        if comm is None or not getattr(comm, "mesh", None):
+            return None
+        rules = _load_rules(var.var_get("coll_tuned_dynamic_rules", ""))
+        prio = var.var_get("coll_tuned_priority", 60)
+        return (prio, TunedCollModule(comm, rules))
+
+
+coll_framework.register(TunedCollComponent())

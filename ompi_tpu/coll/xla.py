@@ -1,0 +1,252 @@
+"""coll/xla — the TPU-native collective component.
+
+This is the component the whole framework exists for: MPI collectives on
+HBM-resident stacked buffers lower to XLA collective ops over the
+communicator's private mesh axis, compiled once per
+(collective, op, dtype, shape, root) and cached — the compiled-executable
+cache plays the role the reference's per-communicator module state and
+ob1 endpoint caches play (``SURVEY.md §5`` distributed-backend mapping).
+
+Algorithm mapping (reference algorithm registry
+``coll_base_functions.h:185-320`` -> XLA):
+
+- allreduce ring / recursive-doubling / Rabenseifner -> ``lax.psum``
+  (XLA picks the ICI-optimal schedule; reduction order is fixed by XLA's
+  deterministic schedule — the analogue of the reference's documented
+  commutativity constraint, ``coll_base_allreduce.c:291-294``).
+- allgather ring/bruck/...      -> ``lax.all_gather(tiled)``
+- reduce_scatter ring/butterfly -> ``lax.psum_scatter(tiled)``
+- alltoall pairwise/bruck       -> ``lax.all_to_all``
+- bcast binomial/pipeline       -> masked ``psum`` (arithmetic dtypes) or
+  all_gather+select; root is a compile-time constant.
+- scan/exscan                   -> ``all_gather`` + on-device prefix
+  (``cumsum``/``associative_scan``) + own-row slice.
+- barrier                       -> scalar ``psum`` + readiness.
+
+Ops without a fused XLA collective (PROD, bitwise/logical, MINLOC/MAXLOC,
+user ops) lower to ``all_gather`` + an on-device ordered fold
+(``Op.reduce_tree``) — the general path the reference implements as
+basic_linear, here fully on-device and XLA-fused.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ompi_tpu.core.communicator import AXIS
+from ompi_tpu.coll.framework import coll_framework
+from ompi_tpu.mca import var
+from ompi_tpu.mca.base import Component
+
+try:                                    # jax >= 0.4.35 public API
+    _shard_map = jax.shard_map
+except AttributeError:                  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+P = jax.sharding.PartitionSpec
+
+_ARITH_KINDS = frozenset("fiuc")        # dtypes psum/pmax/pmin accept
+
+
+def _spec(ndim: int) -> P:
+    return P(AXIS, *([None] * (ndim - 1)))
+
+
+class XlaCollModule:
+    def __init__(self, comm):
+        self.comm = comm
+        self._cache: Dict[Tuple, Callable] = {}
+
+    # -- executable cache ------------------------------------------------
+    def _compiled(self, key: Tuple, build: Callable[[], Callable]) -> Callable:
+        fn = self._cache.get(key)
+        if fn is None:
+            fn = build()
+            self._cache[key] = fn
+        return fn
+
+    def _smap(self, inner: Callable, ndim_in: int, ndim_out: int) -> Callable:
+        f = _shard_map(inner, mesh=self.comm.mesh,
+                       in_specs=_spec(ndim_in), out_specs=_spec(ndim_out))
+        return jax.jit(f)
+
+    def _to_mesh(self, x):
+        sh = self.comm.sharding
+        if isinstance(x, jax.Array):
+            try:
+                if x.sharding.is_equivalent_to(sh, x.ndim):
+                    return x
+            except Exception:
+                pass
+        return jax.device_put(x, sh)
+
+    def _key(self, func: str, x, *extra) -> Tuple:
+        return (func, x.shape, str(x.dtype), *extra)
+
+    # -- collectives -----------------------------------------------------
+    def allreduce(self, x, op):
+        x = self._to_mesh(x)
+        n = self.comm.size
+
+        def build():
+            if op.xla_prim == "sum":
+                inner = lambda b: jax.lax.psum(b, AXIS)
+            elif op.xla_prim == "max":
+                inner = lambda b: jax.lax.pmax(b, AXIS)
+            elif op.xla_prim == "min":
+                inner = lambda b: jax.lax.pmin(b, AXIS)
+            else:
+                def inner(b):
+                    g = jax.lax.all_gather(b, AXIS, axis=0, tiled=True)
+                    return op.reduce_tree(g, axis=0)[None]
+            return self._smap(inner, x.ndim, x.ndim)
+        return self._compiled(self._key("allreduce", x, op.name, n), build)(x)
+
+    def reduce(self, x, op, root: int):
+        # All-ranks result satisfies "recvbuf significant only at root";
+        # an XLA reduce-to-root would not be cheaper on a symmetric ICI
+        # ring, so this shares the allreduce executable (and its cache).
+        return self.allreduce(x, op)
+
+    def bcast(self, x, root: int):
+        x = self._to_mesh(x)
+
+        def build():
+            if np.dtype(x.dtype).kind in _ARITH_KINDS:
+                def inner(b):
+                    r = jax.lax.axis_index(AXIS)
+                    masked = jnp.where(r == root, b, jnp.zeros_like(b))
+                    return jax.lax.psum(masked, AXIS)
+            else:
+                def inner(b):
+                    g = jax.lax.all_gather(b, AXIS, axis=0, tiled=True)
+                    return jax.lax.dynamic_slice_in_dim(g, root, 1, 0)
+            return self._smap(inner, x.ndim, x.ndim)
+        return self._compiled(self._key("bcast", x, root), build)(x)
+
+    def allgather(self, x):
+        x = self._to_mesh(x)
+
+        def build():
+            def inner(b):                       # (1, *s) -> (1, N, *s)
+                g = jax.lax.all_gather(b[0], AXIS, axis=0, tiled=False)
+                return g[None]
+            return self._smap(inner, x.ndim, x.ndim + 1)
+        return self._compiled(self._key("allgather", x), build)(x)
+
+    def gather(self, x, root: int):
+        # Symmetric-ICI design choice: gather lowers to all_gather (every
+        # rank receives; root semantics are a superset). See module doc.
+        return self.allgather(x)
+
+    def scatter(self, x, root: int):
+        x = self._to_mesh(x)
+
+        def build():
+            def inner(b):                       # (1, N, *s) -> (1, *s)
+                y = jax.lax.all_to_all(b[0], AXIS, split_axis=0,
+                                       concat_axis=0, tiled=True)
+                return jax.lax.dynamic_slice_in_dim(y, root, 1, 0)
+            return self._smap(inner, x.ndim, x.ndim - 1)
+        return self._compiled(self._key("scatter", x, root), build)(x)
+
+    def alltoall(self, x):
+        x = self._to_mesh(x)
+
+        def build():
+            def inner(b):                       # (1, N, *s) -> (1, N, *s)
+                y = jax.lax.all_to_all(b[0], AXIS, split_axis=0,
+                                       concat_axis=0, tiled=True)
+                return y[None]
+            return self._smap(inner, x.ndim, x.ndim)
+        return self._compiled(self._key("alltoall", x), build)(x)
+
+    def reduce_scatter_block(self, x, op):
+        x = self._to_mesh(x)
+
+        def build():
+            if op.xla_prim == "sum":
+                def inner(b):                   # (1, N, *s) -> (1, *s)
+                    return jax.lax.psum_scatter(b[0], AXIS,
+                                                scatter_dimension=0,
+                                                tiled=True)
+            else:
+                def inner(b):
+                    y = jax.lax.all_to_all(b[0], AXIS, split_axis=0,
+                                           concat_axis=0, tiled=True)
+                    return op.reduce_tree(y, axis=0)[None]
+            return self._smap(inner, x.ndim, x.ndim - 1)
+        return self._compiled(
+            self._key("reduce_scatter_block", x, op.name), build)(x)
+
+    def _prefix(self, g, op):
+        if op.name == "sum":
+            return jnp.cumsum(g, axis=0)
+        if op.name == "prod":
+            return jnp.cumprod(g, axis=0)
+        if op.name == "max":
+            return jax.lax.cummax(g, axis=0)
+        if op.name == "min":
+            return jax.lax.cummin(g, axis=0)
+        return jax.lax.associative_scan(op.fn, g, axis=0)
+
+    def scan(self, x, op):
+        x = self._to_mesh(x)
+
+        def build():
+            def inner(b):                       # (1, *s) -> (1, *s)
+                g = jax.lax.all_gather(b[0], AXIS, axis=0, tiled=False)
+                pre = self._prefix(g, op)
+                idx = jax.lax.axis_index(AXIS)
+                return jax.lax.dynamic_slice_in_dim(pre, idx, 1, 0)
+            return self._smap(inner, x.ndim, x.ndim)
+        return self._compiled(self._key("scan", x, op.name), build)(x)
+
+    def exscan(self, x, op):
+        x = self._to_mesh(x)
+
+        def build():
+            def inner(b):
+                g = jax.lax.all_gather(b[0], AXIS, axis=0, tiled=False)
+                pre = self._prefix(g, op)
+                idx = jax.lax.axis_index(AXIS)
+                # Rank 0's recvbuf is undefined per MPI; clamp to row 0.
+                row = jnp.maximum(idx - 1, 0)
+                return jax.lax.dynamic_slice_in_dim(pre, row, 1, 0)
+            return self._smap(inner, x.ndim, x.ndim)
+        return self._compiled(self._key("exscan", x, op.name), build)(x)
+
+    def _barrier_arrays(self):
+        x = self._to_mesh(jnp.ones((self.comm.size,), jnp.int32))
+
+        def build():
+            return self._smap(lambda b: jax.lax.psum(b, AXIS), 1, 1)
+        y = self._compiled(("barrier", self.comm.size), build)(x)
+        return [y]
+
+    def barrier(self) -> None:
+        jax.block_until_ready(self._barrier_arrays())
+
+    def ibarrier(self):
+        return self._barrier_arrays()
+
+
+class XlaCollComponent(Component):
+    name = "xla"
+
+    def register_params(self):
+        var.var_register("coll", "xla", "priority", vtype="int", default=40,
+                         help="Selection priority of the XLA-native "
+                              "collective component")
+
+    def comm_query(self, comm):
+        if comm is None or not getattr(comm, "mesh", None):
+            return None
+        prio = var.var_get("coll_xla_priority", 40)
+        return (prio, XlaCollModule(comm))
+
+
+coll_framework.register(XlaCollComponent())

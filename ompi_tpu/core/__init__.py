@@ -1,0 +1,2 @@
+"""Core MPI objects: ops, datatypes, groups, communicators, requests,
+buffers — mirroring ``ompi/{op,datatype,group,communicator,request}``."""

@@ -1,0 +1,527 @@
+"""Communicators — rank groups bound to device-mesh subsets.
+
+Behavioral spec: ``ompi/communicator`` — ``ompi_communicator_t`` holds a
+group, a CID, and the ``c_coll`` vtable of selected collective modules;
+``ompi_comm_split`` (``comm.c:749``), split_type, dup; CID allocation is a
+distributed agreement (``comm_cid.c:61-109``).
+
+TPU-native re-design (single-controller SPMD): an MPI rank is a coordinate
+on a ``jax.sharding.Mesh``. A communicator of size N owns N devices and a
+private 1-D mesh over them (axis ``"mpi_r"``); a rank's local buffer is
+one shard of a stacked ``jax.Array`` of shape ``(N, *local)`` sharded on
+axis 0. ``MPI_Comm_split`` therefore *is* mesh subsetting: the child
+communicator's mesh is built from the parent devices of its members, so
+collectives on sub-communicators ride the same ICI links with no
+re-wiring. CID agreement collapses to a deterministic controller-side
+counter (every rank observes the same allocation order by construction —
+the property the reference's iterative allreduce establishes).
+
+Collectives here are the *framework-level* entry points: argument/locus
+validation, datatype pack/unpack around the wire format, errhandler
+invocation, SPC counters — then dispatch through the per-communicator
+``c_coll`` vtable populated by priority selection
+(``coll_base_comm_select.c:234-273``).
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ompi_tpu.accelerator import LOCUS_DEVICE, LOCUS_HOST, check_addr, to_device
+from ompi_tpu.core import convertor
+from ompi_tpu.core import op as op_mod
+from ompi_tpu.core.datatype import Datatype, from_numpy_dtype
+from ompi_tpu.core.errhandler import (ERR_ARG, ERR_COMM, ERR_COUNT, ERR_OP,
+                                      ERR_RANK, ERR_ROOT, ERR_TYPE,
+                                      ERRORS_ARE_FATAL, Errhandler, MPIError)
+from ompi_tpu.core.group import Group, UNDEFINED
+from ompi_tpu.core.info import Info
+from ompi_tpu.core.request import Request, Status
+
+AXIS = "mpi_r"          # the private mesh axis name every communicator uses
+
+# Sentinel mirroring MPI_IN_PLACE: "sendbuf is recvbuf".
+class _InPlaceType:
+    def __repr__(self):
+        return "MPI_IN_PLACE"
+
+
+IN_PLACE = _InPlaceType()
+
+_cid_lock = threading.Lock()
+_cid_counter = itertools.count(0)
+
+
+def _next_cid() -> int:
+    """CID agreement (comm_cid.c:61-109). Single-controller: allocation
+    order is globally observed by construction, so the iterative
+    allreduce over available CIDs reduces to a monotone counter."""
+    with _cid_lock:
+        return next(_cid_counter)
+
+
+class Communicator:
+    def __init__(self, group: Group, devices: Sequence[Any], *,
+                 name: str = "", parent: Optional["Communicator"] = None,
+                 info: Optional[Info] = None,
+                 errhandler: Optional[Errhandler] = None):
+        if len(devices) != group.size:
+            raise MPIError(ERR_ARG, "devices must match group size")
+        self.group = group
+        self.devices = tuple(devices)
+        self.cid = _next_cid()
+        self.name = name or f"comm#{self.cid}"
+        self.info = info.dup() if info else Info()
+        self.errhandler = errhandler or parent_errh(parent)
+        self.attributes: Dict[int, Any] = {}
+        self.topo = None               # set by topo layer (cart/graph)
+        self._freed = False
+        self._revoked = False          # ULFM
+        # The communicator's data plane: a private 1-D mesh over its
+        # devices. Stacked rank buffers shard along this axis.
+        self.mesh = Mesh(np.array(self.devices, dtype=object), (AXIS,))
+        self.sharding = NamedSharding(self.mesh, P(AXIS))
+        self.c_coll: Dict[str, Any] = {}
+        self._select_coll()
+
+    # ------------------------------------------------------------------
+    @property
+    def size(self) -> int:
+        return self.group.size
+
+    def rank(self) -> int:
+        """Single-controller: the controller drives all ranks; per-rank
+        identity lives in the stacked axis. Returns 0 for API parity."""
+        return 0
+
+    def _select_coll(self) -> None:
+        from ompi_tpu.coll.framework import comm_select_coll
+        self.c_coll = comm_select_coll(self)
+
+    def _err(self, error_class: int, msg: str = ""):
+        return self.errhandler.invoke(self, error_class, msg)
+
+    def _check(self) -> None:
+        if self._freed:
+            raise MPIError(ERR_COMM, "communicator has been freed")
+        if self._revoked:
+            from ompi_tpu.core.errhandler import ERR_REVOKED
+            raise MPIError(ERR_REVOKED, "communicator has been revoked")
+
+    # -- buffer helpers -------------------------------------------------
+    def alloc(self, local_shape: Tuple[int, ...], dtype=np.float32,
+              fill: Optional[float] = None):
+        """Allocate a stacked device buffer (size, *local_shape) sharded
+        one-shard-per-rank over this communicator's mesh."""
+        shape = (self.size,) + tuple(local_shape)
+        if fill is None:
+            arr = jax.numpy.zeros(shape, dtype=dtype)
+        else:
+            arr = jax.numpy.full(shape, fill, dtype=dtype)
+        return jax.device_put(arr, self.sharding)
+
+    def stack(self, per_rank: Sequence[Any]):
+        """Build a stacked device buffer from per-rank host/device arrays."""
+        if len(per_rank) != self.size:
+            self._err(ERR_COUNT, "need one array per rank")
+        arr = np.stack([np.asarray(a) for a in per_rank])
+        return jax.device_put(arr, self.sharding)
+
+    def shard(self, stacked, rank: int):
+        """Rank ``rank``'s view of a stacked buffer (host copy)."""
+        return np.asarray(stacked[rank])
+
+    # -- validation + dispatch -----------------------------------------
+    def _coll(self, func: str):
+        self._check()
+        m = self.c_coll.get(func)
+        if m is None:
+            self._err(ERR_ARG, f"no coll component provides {func} "
+                               f"for {self.name}")
+        from ompi_tpu.runtime import spc
+        spc.record(f"coll_{func}", 1)
+        return m
+
+    def _validate_op(self, op, pair_expected: bool = False):
+        if not isinstance(op, op_mod.Op) or op.fn is None:
+            self._err(ERR_OP, "invalid reduction op")
+        return op
+
+    def _validate_root(self, root: int):
+        if not (0 <= root < self.size):
+            self._err(ERR_ROOT, f"root {root} out of range")
+        return root
+
+    def _validate_stacked(self, buf, lead: int = 1):
+        if check_addr(buf) is None:
+            self._err(ERR_ARG, "buffer must be a jax or numpy array")
+        if buf.ndim < lead or buf.shape[0] != self.size:
+            self._err(ERR_COUNT,
+                      f"stacked buffer must have leading axis {self.size}, "
+                      f"got {getattr(buf, 'shape', None)}")
+        return buf
+
+    def _wire(self, buf, datatype: Optional[Datatype], count: Optional[int]):
+        """Pack a stacked buffer to wire (contiguous) form; return
+        (packed, unpack_fn)."""
+        if datatype is None or datatype.is_contiguous:
+            return buf, (lambda y, out=None: y)
+        if count is None:
+            count = buf.shape[-1] // max(datatype.extent, 1)
+        packed = convertor.pack(buf, datatype, count)
+
+        def unpack_fn(y, out=None):
+            if out is None:
+                if check_addr(y) == LOCUS_DEVICE:
+                    out = jax.numpy.zeros(y.shape[:-1]
+                                          + (count * datatype.extent,),
+                                          dtype=y.dtype)
+                else:
+                    out = np.zeros(y.shape[:-1]
+                                   + (count * datatype.extent,), dtype=y.dtype)
+            return convertor.unpack(out, y, datatype, count)
+        return packed, unpack_fn
+
+    # ==================================================================
+    # Collectives (blocking). Stacked-array functional API:
+    # input leading axis = rank, result returned (device path is purely
+    # functional; MPI_IN_PLACE is expressed by passing recvbuf as input).
+    # ==================================================================
+    def allreduce(self, sendbuf, op=op_mod.SUM, *,
+                  datatype: Optional[Datatype] = None,
+                  count: Optional[int] = None, recvbuf=None):
+        if sendbuf is IN_PLACE:
+            sendbuf = recvbuf       # MPI_IN_PLACE (allreduce.c.in:54,78-79)
+        self._validate_stacked(sendbuf)
+        self._validate_op(op)
+        x, unpack_fn = self._wire(sendbuf, datatype, count)
+        y = self._coll("allreduce").allreduce(x, op)
+        return unpack_fn(y, recvbuf if recvbuf is not sendbuf else None)
+
+    def reduce(self, sendbuf, op=op_mod.SUM, root: int = 0, *,
+               datatype: Optional[Datatype] = None,
+               count: Optional[int] = None, recvbuf=None):
+        if sendbuf is IN_PLACE:
+            sendbuf = recvbuf
+        self._validate_stacked(sendbuf)
+        self._validate_op(op)
+        self._validate_root(root)
+        x, unpack_fn = self._wire(sendbuf, datatype, count)
+        y = self._coll("reduce").reduce(x, op, root)
+        return unpack_fn(y, recvbuf if recvbuf is not sendbuf else None)
+
+    def bcast(self, buf, root: int = 0, *,
+              datatype: Optional[Datatype] = None,
+              count: Optional[int] = None):
+        self._validate_stacked(buf)
+        self._validate_root(root)
+        x, unpack_fn = self._wire(buf, datatype, count)
+        y = self._coll("bcast").bcast(x, root)
+        return unpack_fn(y)
+
+    def allgather(self, sendbuf, *, datatype: Optional[Datatype] = None,
+                  count: Optional[int] = None):
+        """in (N, *s) -> out (N, N, *s): out[r, j] = rank j's sendbuf."""
+        self._validate_stacked(sendbuf)
+        x, _ = self._wire(sendbuf, datatype, count)
+        return self._coll("allgather").allgather(x)
+
+    def gather(self, sendbuf, root: int = 0, *,
+               datatype: Optional[Datatype] = None,
+               count: Optional[int] = None):
+        """in (N, *s) -> out (N, N, *s), rows valid at root only."""
+        self._validate_stacked(sendbuf)
+        self._validate_root(root)
+        x, _ = self._wire(sendbuf, datatype, count)
+        return self._coll("gather").gather(x, root)
+
+    def scatter(self, sendbuf, root: int = 0, *,
+                datatype: Optional[Datatype] = None,
+                count: Optional[int] = None):
+        """in (N, N, *s) (root's row of chunks) -> out (N, *s)."""
+        self._validate_stacked(sendbuf, lead=2)
+        self._validate_root(root)
+        x, _ = self._wire(sendbuf, datatype, count)
+        return self._coll("scatter").scatter(x, root)
+
+    def alltoall(self, sendbuf, *, datatype: Optional[Datatype] = None,
+                 count: Optional[int] = None):
+        """in (N, N, *s) -> out (N, N, *s): out[j, i] = in[i, j]."""
+        self._validate_stacked(sendbuf, lead=2)
+        if sendbuf.shape[1] != self.size:
+            self._err(ERR_COUNT, "alltoall needs one chunk per peer")
+        x, _ = self._wire(sendbuf, datatype, count)
+        return self._coll("alltoall").alltoall(x)
+
+    def reduce_scatter_block(self, sendbuf, op=op_mod.SUM, *,
+                             datatype: Optional[Datatype] = None,
+                             count: Optional[int] = None):
+        """in (N, N, *s) -> out (N, *s): out[r] = reduce_i in[i, r]."""
+        self._validate_stacked(sendbuf, lead=2)
+        self._validate_op(op)
+        x, _ = self._wire(sendbuf, datatype, count)
+        return self._coll("reduce_scatter_block").reduce_scatter_block(x, op)
+
+    def reduce_scatter(self, sendbuf, recvcounts: Sequence[int],
+                       op=op_mod.SUM):
+        """MPI_Reduce_scatter with per-rank counts. in (N, total) where
+        total = sum(recvcounts); returns list of per-rank host arrays (the
+        variable-length result cannot be one stacked array)."""
+        self._validate_stacked(sendbuf)
+        self._validate_op(op)
+        if len(recvcounts) != self.size:
+            self._err(ERR_COUNT, "recvcounts must have comm-size entries")
+        total = int(sum(recvcounts))
+        if sendbuf.shape[-1] != total:
+            self._err(ERR_COUNT, f"sendbuf last axis must be {total}")
+        red = self.allreduce(sendbuf, op)
+        outs, off = [], 0
+        for r, c in enumerate(recvcounts):
+            outs.append(red[r, ..., off:off + c])
+            off += c
+        return outs
+
+    def scan(self, sendbuf, op=op_mod.SUM):
+        self._validate_stacked(sendbuf)
+        self._validate_op(op)
+        return self._coll("scan").scan(sendbuf, op)
+
+    def exscan(self, sendbuf, op=op_mod.SUM):
+        self._validate_stacked(sendbuf)
+        self._validate_op(op)
+        return self._coll("exscan").exscan(sendbuf, op)
+
+    def barrier(self) -> None:
+        self._coll("barrier").barrier()
+
+    # -- v-variants (variable counts): pad to max, run fixed, slice ----
+    def allgatherv(self, per_rank: Sequence[Any]):
+        """Takes per-rank arrays (ragged); returns list of host arrays =
+        concatenation every rank receives. Pads to max count on the wire
+        (the TPU analogue of the reference's per-peer count headers)."""
+        if len(per_rank) != self.size:
+            self._err(ERR_COUNT, "need one array per rank")
+        arrs = [np.asarray(a).ravel() for a in per_rank]
+        counts = [a.size for a in arrs]
+        m = max(counts) if counts else 0
+        padded = np.zeros((self.size, m), dtype=arrs[0].dtype)
+        for i, a in enumerate(arrs):
+            padded[i, :a.size] = a
+        g = self.allgather(to_device(padded, self.sharding))
+        g = np.asarray(g[0])           # all rows identical
+        cat = np.concatenate([g[j, :counts[j]] for j in range(self.size)])
+        return [cat.copy() for _ in range(self.size)]
+
+    # ==================================================================
+    # Nonblocking variants: JAX async dispatch makes these natural — the
+    # compiled collective is enqueued and a Request wraps the output.
+    # ==================================================================
+    def _nb(self, fn: Callable, *args, **kw) -> Request:
+        out = fn(*args, **kw)
+        arrays = [a for a in jax.tree_util.tree_leaves(out)
+                  if isinstance(a, jax.Array)]
+        return Request(result=out, arrays=arrays or None)
+
+    def iallreduce(self, sendbuf, op=op_mod.SUM, **kw) -> Request:
+        return self._nb(self.allreduce, sendbuf, op, **kw)
+
+    def ibcast(self, buf, root: int = 0, **kw) -> Request:
+        return self._nb(self.bcast, buf, root, **kw)
+
+    def ireduce(self, sendbuf, op=op_mod.SUM, root: int = 0, **kw) -> Request:
+        return self._nb(self.reduce, sendbuf, op, root, **kw)
+
+    def iallgather(self, sendbuf, **kw) -> Request:
+        return self._nb(self.allgather, sendbuf, **kw)
+
+    def igather(self, sendbuf, root: int = 0, **kw) -> Request:
+        return self._nb(self.gather, sendbuf, root, **kw)
+
+    def iscatter(self, sendbuf, root: int = 0, **kw) -> Request:
+        return self._nb(self.scatter, sendbuf, root, **kw)
+
+    def ialltoall(self, sendbuf, **kw) -> Request:
+        return self._nb(self.alltoall, sendbuf, **kw)
+
+    def ireduce_scatter_block(self, sendbuf, op=op_mod.SUM, **kw) -> Request:
+        return self._nb(self.reduce_scatter_block, sendbuf, op, **kw)
+
+    def iscan(self, sendbuf, op=op_mod.SUM) -> Request:
+        return self._nb(self.scan, sendbuf, op)
+
+    def iexscan(self, sendbuf, op=op_mod.SUM) -> Request:
+        return self._nb(self.exscan, sendbuf, op)
+
+    def ibarrier(self) -> Request:
+        m = self._coll("barrier")
+        arrays = m.ibarrier() if hasattr(m, "ibarrier") else None
+        return Request(arrays=arrays)
+
+    # -- persistent collectives (MPI-4 MPI_Allreduce_init etc.) --------
+    def allreduce_init(self, sendbuf, op=op_mod.SUM, **kw) -> Request:
+        return Request(persistent_start=lambda: self.iallreduce(
+            sendbuf, op, **kw))
+
+    def bcast_init(self, buf, root: int = 0, **kw) -> Request:
+        return Request(persistent_start=lambda: self.ibcast(buf, root, **kw))
+
+    # ==================================================================
+    # Communicator algebra
+    # ==================================================================
+    def dup(self, info: Optional[Info] = None) -> "Communicator":
+        self._check()
+        c = Communicator(Group(self.group.world_ranks), self.devices,
+                         name=f"{self.name}.dup", parent=self,
+                         info=info or self.info,
+                         errhandler=self.errhandler)
+        c.attributes = dict(self.attributes)
+        return c
+
+    def split(self, colors: Sequence[int], keys: Optional[Sequence[int]] = None
+              ) -> List[Optional["Communicator"]]:
+        """MPI_Comm_split (comm.c:749). ``colors[r]``/``keys[r]`` are rank
+        r's arguments; returns one entry per rank — the new communicator
+        containing that rank (shared object) or None (MPI_COMM_NULL) for
+        color == UNDEFINED. Children's meshes are parent-device subsets."""
+        self._check()
+        if keys is None:
+            keys = [0] * self.size
+        if len(colors) != self.size or len(keys) != self.size:
+            self._err(ERR_ARG, "need color/key per rank")
+        by_color: Dict[int, List[int]] = {}
+        for r, c in enumerate(colors):
+            if c != UNDEFINED:
+                by_color.setdefault(c, []).append(r)
+        out: List[Optional[Communicator]] = [None] * self.size
+        # Deterministic order over colors = identical CID allocation on
+        # every rank (the agreement property of comm_cid.c).
+        for c in sorted(by_color):
+            members = sorted(by_color[c], key=lambda r: (keys[r], r))
+            g = Group([self.group.world_ranks[r] for r in members])
+            devs = [self.devices[r] for r in members]
+            newc = Communicator(g, devs, name=f"{self.name}.split({c})",
+                                parent=self, errhandler=self.errhandler)
+            for r in members:
+                out[r] = newc
+        return out
+
+    def split_type(self, split_type: int,
+                   keys: Optional[Sequence[int]] = None):
+        """MPI_Comm_split_type: group ranks by hardware locality. TPU
+        concretization: COMM_TYPE_SHARED groups ranks whose devices share
+        a host process (``device.process_index``)."""
+        colors = [int(getattr(d, "process_index", 0)) for d in self.devices]
+        return self.split(colors, keys)
+
+    def create(self, group: Group) -> Optional["Communicator"]:
+        """MPI_Comm_create: new communicator over a subgroup."""
+        self._check()
+        ranks = []
+        for wr in group.world_ranks:
+            lr = self.group.rank_of(wr)
+            if lr == UNDEFINED:
+                self._err(ERR_RANK, "group not a subset of communicator")
+            ranks.append(lr)
+        devs = [self.devices[r] for r in ranks]
+        return Communicator(group, devs, name=f"{self.name}.create",
+                            parent=self, errhandler=self.errhandler)
+
+    def compare(self, other: "Communicator") -> int:
+        from ompi_tpu.core.group import CONGRUENT, IDENT, SIMILAR, UNEQUAL
+        if self is other:
+            return IDENT
+        g = self.group.compare(other.group)
+        if g == IDENT:
+            return CONGRUENT
+        return SIMILAR if g == SIMILAR else UNEQUAL
+
+    def free(self) -> None:
+        for kv, val in list(self.attributes.items()):
+            cb = _keyvals.get(kv)
+            if cb and cb[1]:
+                cb[1](self, kv, val)
+        self.attributes.clear()
+        self._freed = True
+
+    # -- attributes (keyvals) ------------------------------------------
+    def set_attr(self, keyval: int, value: Any) -> None:
+        self.attributes[keyval] = value
+
+    def get_attr(self, keyval: int) -> Tuple[bool, Any]:
+        if keyval in self.attributes:
+            return True, self.attributes[keyval]
+        return False, None
+
+    def delete_attr(self, keyval: int) -> None:
+        val = self.attributes.pop(keyval, None)
+        cb = _keyvals.get(keyval)
+        if cb and cb[1] and val is not None:
+            cb[1](self, keyval, val)
+
+    def set_errhandler(self, errh: Errhandler) -> None:
+        self.errhandler = errh
+
+    def get_errhandler(self) -> Errhandler:
+        return self.errhandler
+
+    def set_name(self, name: str) -> None:
+        self.name = name
+
+    def get_name(self) -> str:
+        return self.name
+
+    def abort(self, errorcode: int = 1):
+        import sys
+        sys.stderr.write(f"MPI_Abort on {self.name} errorcode={errorcode}\n")
+        raise SystemExit(errorcode)
+
+    # -- ULFM-lite (mpiext/ftmpi semantics) ----------------------------
+    def revoke(self) -> None:
+        self._revoked = True
+
+    def is_revoked(self) -> bool:
+        return self._revoked
+
+    def shrink(self, failed_ranks: Sequence[int]) -> "Communicator":
+        alive = [r for r in range(self.size) if r not in set(failed_ranks)]
+        g = Group([self.group.world_ranks[r] for r in alive])
+        devs = [self.devices[r] for r in alive]
+        return Communicator(g, devs, name=f"{self.name}.shrink",
+                            errhandler=self.errhandler)
+
+    def agree(self, flags: Sequence[int]) -> int:
+        """MPIX_Comm_agree: bitwise AND agreement over contributed flags
+        (coll/ftagree semantics, minus failure detection)."""
+        v = ~0
+        for f in flags:
+            v &= int(f)
+        return v
+
+    def __repr__(self):
+        return (f"Communicator({self.name}, size={self.size}, "
+                f"cid={self.cid})")
+
+
+def parent_errh(parent: Optional[Communicator]) -> Errhandler:
+    return parent.errhandler if parent is not None else ERRORS_ARE_FATAL
+
+
+# -- keyval registry (MPI_Comm_create_keyval) ------------------------------
+_keyvals: Dict[int, Tuple[Optional[Callable], Optional[Callable]]] = {}
+_keyval_counter = itertools.count(100)
+
+
+def create_keyval(copy_fn: Optional[Callable] = None,
+                  delete_fn: Optional[Callable] = None) -> int:
+    kv = next(_keyval_counter)
+    _keyvals[kv] = (copy_fn, delete_fn)
+    return kv
+
+
+def free_keyval(keyval: int) -> None:
+    _keyvals.pop(keyval, None)

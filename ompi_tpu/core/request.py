@@ -1,0 +1,194 @@
+"""Request lifecycle — test/wait{,any,all,some}, persistent and
+generalized requests.
+
+Behavioral spec: ``ompi/request/request.h`` (:311-430 wait/test family,
+:451-470 completion sync). TPU-native re-design: there is no progress
+engine to spin. JAX dispatch is asynchronous — a collective/pt2pt call
+returns immediately with output arrays whose values materialize when the
+device stream reaches them. A Request therefore wraps those arrays:
+``wait`` is ``jax.block_until_ready``; ``test`` polls readiness without
+blocking. Host-side components complete synchronously (requests are born
+complete), which matches the reference's self/sm fast path.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+
+
+class Status:
+    """MPI_Status: source, tag, error, element count."""
+
+    __slots__ = ("source", "tag", "error", "count", "cancelled")
+
+    ANY_SOURCE = -1
+    ANY_TAG = -1
+
+    def __init__(self, source: int = -1, tag: int = -1, error: int = 0,
+                 count: int = 0):
+        self.source = source
+        self.tag = tag
+        self.error = error
+        self.count = count
+        self.cancelled = False
+
+    def get_count(self, datatype=None) -> int:
+        if datatype is None or datatype.count == 0:
+            return self.count
+        return self.count // datatype.count
+
+    def is_cancelled(self) -> bool:
+        return self.cancelled
+
+
+def _is_ready(arr) -> bool:
+    f = getattr(arr, "is_ready", None)
+    if callable(f):
+        try:
+            return bool(f())
+        except Exception:
+            return True
+    return True                      # host values are always ready
+
+
+class Request:
+    """A pending operation. ``result`` is the operation's output (stacked
+    arrays); ``on_complete`` runs exactly once at completion."""
+
+    def __init__(self, result: Any = None,
+                 arrays: Optional[Sequence[Any]] = None,
+                 on_complete: Optional[Callable[[Any], Any]] = None,
+                 status: Optional[Status] = None,
+                 persistent_start: Optional[Callable[[], "Request"]] = None):
+        self._result = result
+        self._arrays = list(arrays) if arrays is not None else None
+        self._on_complete = on_complete
+        self._complete = arrays is None
+        self._freed = False
+        self.status = status or Status()
+        self._persistent_start = persistent_start
+        self._active = persistent_start is None
+
+    # -- completion --------------------------------------------------------
+    def _finish(self):
+        if self._on_complete is not None:
+            cb, self._on_complete = self._on_complete, None
+            self._result = cb(self._result)
+        self._complete = True
+
+    def test(self) -> Tuple[bool, Optional[Status]]:
+        """MPI_Test: non-blocking completion check."""
+        if self._complete:
+            return True, self.status
+        if self._arrays is None or all(_is_ready(a) for a in self._arrays):
+            self._finish()
+            return True, self.status
+        return False, None
+
+    def wait(self) -> Status:
+        """MPI_Wait: block until complete; returns the Status."""
+        if not self._complete:
+            if self._arrays is not None:
+                jax.block_until_ready(self._arrays)
+            self._finish()
+        return self.status
+
+    def get(self) -> Any:
+        """Wait and return the operation's result value (framework
+        extension — the functional-API analogue of reading recvbuf)."""
+        self.wait()
+        return self._result
+
+    def cancel(self) -> None:
+        # XLA execution cannot be cancelled post-dispatch; mirror the
+        # reference's behavior for already-started requests: no-op.
+        if not self._complete:
+            self.status.cancelled = False
+
+    def free(self) -> None:
+        self._freed = True
+
+    # -- persistent requests (MPI_Send_init / MPI_Start) -------------------
+    def start(self) -> "Request":
+        if self._persistent_start is None:
+            raise ValueError("not a persistent request")
+        inner = self._persistent_start()
+        self._arrays = inner._arrays
+        self._result = inner._result
+        self._on_complete = inner._on_complete
+        self._complete = inner._complete
+        self._active = True
+        return self
+
+    @staticmethod
+    def completed(result: Any = None, status: Optional[Status] = None):
+        return Request(result=result, status=status)
+
+
+# -- generalized requests (MPI_Grequest_start) -----------------------------
+class Grequest(Request):
+    def __init__(self, query_fn=None, free_fn=None, cancel_fn=None):
+        super().__init__(arrays=[])
+        self._complete = False
+        self._q, self._f, self._c = query_fn, free_fn, cancel_fn
+
+    def complete(self, result: Any = None) -> None:     # MPI_Grequest_complete
+        self._result = result
+        self._complete = True
+        if self._q:
+            self._q(self.status)
+
+    def test(self):
+        return (True, self.status) if self._complete else (False, None)
+
+    def wait(self):
+        while not self._complete:
+            time.sleep(0)            # yield; completion is external
+        return self.status
+
+    def cancel(self):
+        if self._c:
+            self._c(self._complete)
+
+
+# -- wait/test families (request.h:311-430) --------------------------------
+def waitall(requests: Sequence[Request]) -> List[Status]:
+    return [r.wait() for r in requests]
+
+
+def waitany(requests: Sequence[Request]) -> Tuple[int, Status]:
+    while True:
+        for i, r in enumerate(requests):
+            ok, st = r.test()
+            if ok:
+                return i, st
+        time.sleep(0)
+
+
+def waitsome(requests: Sequence[Request]) -> Tuple[List[int], List[Status]]:
+    while True:
+        idx = [i for i, r in enumerate(requests) if r.test()[0]]
+        if idx:
+            return idx, [requests[i].status for i in idx]
+        time.sleep(0)
+
+
+def testall(requests: Sequence[Request]) -> Tuple[bool, Optional[List[Status]]]:
+    if all(r.test()[0] for r in requests):
+        return True, [r.status for r in requests]
+    return False, None
+
+
+def testany(requests: Sequence[Request]) -> Tuple[bool, int, Optional[Status]]:
+    for i, r in enumerate(requests):
+        ok, st = r.test()
+        if ok:
+            return True, i, st
+    return False, -1, None
+
+
+def testsome(requests: Sequence[Request]) -> Tuple[List[int], List[Status]]:
+    idx = [i for i, r in enumerate(requests) if r.test()[0]]
+    return idx, [requests[i].status for i in idx]

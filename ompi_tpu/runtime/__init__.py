@@ -1,0 +1,1 @@
+"""Runtime: init/finalize, world binding, SPC counters, progress."""

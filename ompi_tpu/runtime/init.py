@@ -1,0 +1,155 @@
+"""ompi_mpi_init / finalize — world bring-up.
+
+Behavioral spec: ``ompi/runtime/ompi_mpi_init.c:397`` through
+``ompi/instance/instance.c:361-720``: OPAL up -> PMIx/coordination init ->
+peer table -> transport selection -> modex/fence -> COMM_WORLD/SELF
+creation -> per-communicator coll selection.
+
+TPU-native re-design: the "transport" is the ICI mesh itself, reached
+only through XLA; wire-up collapses to PJRT device enumeration. On a
+multi-host deployment ``jax.distributed.initialize`` (the JAX
+coordination service: distributed KV + barrier) stands in for PMIx
+modex/fence — controlled here by MCA vars; single-host needs none. MPI
+ranks bind 1:1 to mesh devices at init, exactly the north-star
+requirement (rank topology bound to the device mesh).
+"""
+from __future__ import annotations
+
+import os
+import socket
+import time
+from typing import List, Optional
+
+import jax
+
+from ompi_tpu.core.communicator import Communicator
+from ompi_tpu.core.errhandler import MPIError, ERR_OTHER
+from ompi_tpu.core.group import Group
+from ompi_tpu.core.info import INFO_ENV
+from ompi_tpu.mca import var
+
+THREAD_SINGLE = 0
+THREAD_FUNNELED = 1
+THREAD_SERIALIZED = 2
+THREAD_MULTIPLE = 3
+
+_state = {
+    "initialized": False,
+    "finalized": False,
+    "world": None,
+    "self": None,
+    "thread_level": THREAD_SINGLE,
+    "t0": 0.0,
+}
+
+
+def _register_base_vars() -> None:
+    var.var_register("mpi", "base", "num_ranks", vtype="int", default=0,
+                     help="Number of MPI ranks (0 = one per local device)")
+    var.var_register("mpi", "base", "distributed", vtype="bool", default=False,
+                     help="Call jax.distributed.initialize (multi-host "
+                          "coordination service, the PMIx equivalent)")
+    var.var_register("mpi", "base", "coordinator", vtype="str", default="",
+                     help="coordinator_address for jax.distributed")
+    var.var_register("mpi", "base", "process_id", vtype="int", default=-1,
+                     help="process_id for jax.distributed (-1 = from env)")
+    var.var_register("mpi", "base", "num_processes", vtype="int", default=0,
+                     help="num_processes for jax.distributed (0 = from env)")
+
+
+def init(requested: int = THREAD_SINGLE,
+         devices: Optional[List] = None) -> int:
+    """MPI_Init / MPI_Init_thread. Returns the provided thread level."""
+    if _state["initialized"]:
+        raise MPIError(ERR_OTHER, "MPI already initialized")
+    _register_base_vars()
+
+    if var.var_get("mpi_base_distributed", False):
+        kw = {}
+        coord = var.var_get("mpi_base_coordinator", "")
+        if coord:
+            kw["coordinator_address"] = coord
+        pid = var.var_get("mpi_base_process_id", -1)
+        if pid >= 0:
+            kw["process_id"] = pid
+        nproc = var.var_get("mpi_base_num_processes", 0)
+        if nproc > 0:
+            kw["num_processes"] = nproc
+        jax.distributed.initialize(**kw)       # PMIx-equivalent wire-up
+
+    if devices is None:
+        devices = list(jax.devices())
+        nr = var.var_get("mpi_base_num_ranks", 0)
+        if nr and nr <= len(devices):
+            devices = devices[:nr]
+    n = len(devices)
+
+    world = Communicator(Group(range(n)), devices, name="MPI_COMM_WORLD")
+    self_comm = Communicator(Group([0]), [devices[0]], name="MPI_COMM_SELF")
+
+    INFO_ENV.set("command", os.environ.get("_", ""))
+    INFO_ENV.set("maxprocs", str(n))
+    INFO_ENV.set("soft", str(n))
+    INFO_ENV.set("host", socket.gethostname())
+    INFO_ENV.set("arch", jax.devices()[0].platform)
+
+    _state.update(initialized=True, finalized=False, world=world,
+                  self=self_comm, t0=time.perf_counter(),
+                  thread_level=min(requested, THREAD_MULTIPLE))
+    return _state["thread_level"]
+
+
+def finalize() -> None:
+    if not _state["initialized"] or _state["finalized"]:
+        raise MPIError(ERR_OTHER, "MPI not initialized or already finalized")
+    # Drain async work so "all communication is complete at finalize".
+    try:
+        w = _state["world"]
+        if w is not None and not w._freed:
+            w.barrier()
+    except Exception:
+        pass
+    _state["finalized"] = True
+    _state["world"] = None
+    _state["self"] = None
+
+
+def initialized() -> bool:
+    return _state["initialized"]
+
+
+def finalized() -> bool:
+    return _state["finalized"]
+
+
+def query_thread() -> int:
+    return _state["thread_level"]
+
+
+def comm_world() -> Communicator:
+    if not _state["initialized"] or _state["finalized"]:
+        raise MPIError(ERR_OTHER, "MPI is not active (call Init first)")
+    return _state["world"]
+
+
+def comm_self() -> Communicator:
+    if not _state["initialized"] or _state["finalized"]:
+        raise MPIError(ERR_OTHER, "MPI is not active (call Init first)")
+    return _state["self"]
+
+
+def wtime() -> float:
+    return time.perf_counter()
+
+
+def wtick() -> float:
+    return 1e-9
+
+
+def processor_name() -> str:
+    d = jax.devices()[0]
+    return f"{socket.gethostname()}/{d.platform}:{d.id}"
+
+
+def _reset_for_tests() -> None:
+    _state.update(initialized=False, finalized=False, world=None, self=None)

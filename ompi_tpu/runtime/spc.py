@@ -1,0 +1,51 @@
+"""SPC — software performance counters.
+
+Mirrors ``ompi/runtime/ompi_spc.h:47-159`` (~110 counters recorded via
+SPC_RECORD macros in hot paths, surfaced as MPI_T pvars). Here: a flat
+counter table keyed by name, recorded from the collective/pt2pt entry
+points, surfaced through ``ompi_tpu.mca.pvar`` and the info tool.
+"""
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Dict
+
+from ompi_tpu.mca import var
+
+_lock = threading.Lock()
+_counters: Dict[str, int] = defaultdict(int)
+_enabled = None
+
+
+def _on() -> bool:
+    global _enabled
+    if _enabled is None:
+        _enabled = bool(var.var_register(
+            "mpi", "base", "spc_enable", vtype="bool", default=True,
+            help="Enable software performance counters"))
+    return _enabled
+
+
+def record(name: str, value: int = 1) -> None:
+    if not _on():
+        return
+    with _lock:
+        _counters[name] += value
+
+
+def read(name: str) -> int:
+    with _lock:
+        return _counters.get(name, 0)
+
+
+def snapshot() -> Dict[str, int]:
+    with _lock:
+        return dict(_counters)
+
+
+def reset() -> None:
+    global _enabled
+    with _lock:
+        _counters.clear()
+    _enabled = None

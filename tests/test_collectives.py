@@ -1,0 +1,140 @@
+"""The rest of the blocking collective surface vs NumPy oracles."""
+import numpy as np
+import pytest
+
+import ompi_tpu as MPI
+
+
+def test_bcast(world, rng):
+    n = world.size
+    x = rng.standard_normal((n, 12)).astype(np.float32)
+    for root in (0, n - 1):
+        y = world.bcast(world.stack(list(x)), root)
+        for r in range(n):
+            np.testing.assert_allclose(world.shard(y, r), x[root], rtol=1e-6)
+
+
+def test_bcast_bool(world):
+    n = world.size
+    x = np.zeros((n, 5), dtype=np.bool_)
+    x[1] = [True, False, True, True, False]
+    y = world.bcast(world.stack(list(x)), 1)
+    np.testing.assert_array_equal(np.asarray(y)[0], x[1])
+
+
+def test_reduce(world, rng):
+    n = world.size
+    x = rng.standard_normal((n, 7)).astype(np.float32)
+    y = world.reduce(world.stack(list(x)), MPI.SUM, root=2 % n)
+    np.testing.assert_allclose(world.shard(y, 2 % n), x.sum(0), rtol=1e-5)
+
+
+def test_allgather(world, rng):
+    n = world.size
+    x = rng.standard_normal((n, 3)).astype(np.float32)
+    y = world.allgather(world.stack(list(x)))       # (n, n, 3)
+    assert y.shape == (n, n, 3)
+    for r in range(n):
+        np.testing.assert_allclose(world.shard(y, r), x, rtol=1e-6)
+
+
+def test_gather(world, rng):
+    n = world.size
+    x = rng.standard_normal((n, 3)).astype(np.float32)
+    root = n - 1
+    y = world.gather(world.stack(list(x)), root)
+    np.testing.assert_allclose(world.shard(y, root), x, rtol=1e-6)
+
+
+def test_scatter(world, rng):
+    n = world.size
+    chunks = rng.standard_normal((n, 5)).astype(np.float32)
+    root = 1 % n
+    # stacked sendbuf (n, n, 5): only root's row meaningful
+    send = np.zeros((n, n, 5), dtype=np.float32)
+    send[root] = chunks
+    y = world.scatter(world.stack(list(send)), root)
+    for r in range(n):
+        np.testing.assert_allclose(world.shard(y, r), chunks[r], rtol=1e-6)
+
+
+def test_alltoall(world, rng):
+    n = world.size
+    x = rng.standard_normal((n, n, 2)).astype(np.float32)
+    y = world.alltoall(world.stack(list(x)))
+    got = np.asarray(y)
+    # MPI semantics: recv[j][i] = send[i][j]
+    np.testing.assert_allclose(got, np.swapaxes(x, 0, 1), rtol=1e-6)
+
+
+def test_reduce_scatter_block(world, rng):
+    n = world.size
+    x = rng.standard_normal((n, n, 4)).astype(np.float32)
+    y = world.reduce_scatter_block(world.stack(list(x)), MPI.SUM)
+    expect = x.sum(axis=0)          # (n, 4): chunk r from all ranks
+    for r in range(n):
+        np.testing.assert_allclose(world.shard(y, r), expect[r], rtol=1e-5)
+
+
+def test_reduce_scatter_block_min(world, rng):
+    n = world.size
+    x = rng.standard_normal((n, n, 4)).astype(np.float32)
+    y = world.reduce_scatter_block(world.stack(list(x)), MPI.MIN)
+    np.testing.assert_allclose(np.asarray(y), x.min(axis=0), rtol=1e-6)
+
+
+def test_reduce_scatter_variable_counts(world, rng):
+    n = world.size
+    counts = [(r % 3) + 1 for r in range(n)]
+    total = sum(counts)
+    x = rng.standard_normal((n, total)).astype(np.float32)
+    outs = world.reduce_scatter(world.stack(list(x)), counts, MPI.SUM)
+    red = x.sum(0)
+    off = 0
+    for r, c in enumerate(counts):
+        np.testing.assert_allclose(np.asarray(outs[r]), red[off:off + c],
+                                   rtol=1e-5)
+        off += c
+
+
+def test_scan_exscan(world):
+    n = world.size
+    x = np.arange(n * 3, dtype=np.float32).reshape(n, 3) + 1
+    y = world.scan(world.stack(list(x)), MPI.SUM)
+    np.testing.assert_allclose(np.asarray(y), np.cumsum(x, axis=0), rtol=1e-5)
+    z = world.exscan(world.stack(list(x)), MPI.SUM)
+    got = np.asarray(z)[1:]          # rank 0 recvbuf undefined
+    np.testing.assert_allclose(got, np.cumsum(x, axis=0)[:-1], rtol=1e-5)
+
+
+def test_scan_non_sum(world):
+    n = world.size
+    x = np.arange(n * 2, dtype=np.int32).reshape(n, 2) + 1
+    y = world.scan(world.stack(list(x)), MPI.PROD)
+    np.testing.assert_array_equal(np.asarray(y), np.cumprod(x, axis=0))
+
+
+def test_barrier_and_ibarrier(world):
+    world.barrier()
+    req = world.ibarrier()
+    assert req.wait() is not None
+
+
+def test_allgatherv(world, rng):
+    n = world.size
+    per_rank = [rng.standard_normal((r % 3) + 1).astype(np.float32)
+                for r in range(n)]
+    outs = world.allgatherv(per_rank)
+    expect = np.concatenate([p.ravel() for p in per_rank])
+    for r in range(n):
+        np.testing.assert_allclose(outs[r], expect, rtol=1e-6)
+
+
+def test_comm_self_collectives(mpi):
+    cself = mpi.get_comm_self()
+    x = cself.alloc((6,), np.float32, fill=3.0)
+    y = cself.allreduce(x, MPI.SUM)
+    np.testing.assert_allclose(np.asarray(y), 3.0 * np.ones((1, 6)))
+    g = cself.allgather(x)
+    assert g.shape == (1, 1, 6)
+    cself.barrier()

@@ -1,0 +1,143 @@
+"""Communicator algebra, groups, attributes, errhandlers, MCA selection."""
+import numpy as np
+import pytest
+
+import ompi_tpu as MPI
+from ompi_tpu.core.group import Group, IDENT, SIMILAR, UNDEFINED, UNEQUAL
+
+
+def test_world_basics(world):
+    assert world.size >= 2
+    assert world.get_name() == "MPI_COMM_WORLD"
+
+
+def test_group_algebra():
+    g = Group(range(8))
+    assert g.incl([1, 3, 5]).world_ranks == (1, 3, 5)
+    assert g.excl([0, 7]).world_ranks == tuple(range(1, 7))
+    assert g.range_incl([(0, 6, 2)]).world_ranks == (0, 2, 4, 6)
+    a, b = Group([0, 1, 2]), Group([2, 3])
+    assert a.union(b).world_ranks == (0, 1, 2, 3)
+    assert a.intersection(b).world_ranks == (2,)
+    assert a.difference(b).world_ranks == (0, 1)
+    assert a.compare(Group([0, 1, 2])) == IDENT
+    assert a.compare(Group([2, 1, 0])) == SIMILAR
+    assert a.compare(b) == UNEQUAL
+    assert a.translate_ranks([0, 2], Group([2, 0, 1])) == (1, 0)
+
+
+def test_comm_split_even_odd(world, rng):
+    n = world.size
+    colors = [r % 2 for r in range(n)]
+    subs = world.split(colors)
+    even = subs[0]
+    assert even is subs[2]
+    assert even.size == (n + 1) // 2
+    # Collectives on the sub-communicator (sub-mesh) work.
+    x = rng.standard_normal((even.size, 4)).astype(np.float32)
+    y = even.allreduce(even.stack(list(x)), MPI.SUM)
+    np.testing.assert_allclose(np.asarray(y)[0], x.sum(0), rtol=1e-5)
+    # world ranks recorded correctly
+    assert even.group.world_ranks == tuple(r for r in range(n) if r % 2 == 0)
+
+
+def test_comm_split_undefined_and_keys(world):
+    n = world.size
+    colors = [0 if r == 0 else UNDEFINED for r in range(n)]
+    subs = world.split(colors)
+    assert subs[0] is not None and subs[0].size == 1
+    assert all(s is None for s in subs[1:])
+    # keys reverse the order
+    subs2 = world.split([0] * n, keys=list(range(n, 0, -1)))
+    assert subs2[0].group.world_ranks == tuple(range(n - 1, -1, -1))
+
+
+def test_comm_dup_and_compare(world):
+    d = world.dup()
+    from ompi_tpu.core.group import CONGRUENT
+    assert world.compare(d) == CONGRUENT
+    assert world.compare(world) == IDENT
+    assert d.cid != world.cid
+    d.free()
+    with pytest.raises(MPI.MPIError):
+        d.set_errhandler(MPI.ERRORS_RETURN) or d.barrier()
+
+
+def test_comm_create_subgroup(world):
+    g = world.group.incl([0, 1])
+    sub = world.create(g)
+    assert sub.size == 2
+    y = sub.allreduce(sub.alloc((3,), np.float32, fill=1.0), MPI.SUM)
+    np.testing.assert_allclose(np.asarray(y)[0], 2.0 * np.ones(3))
+
+
+def test_split_type_shared(world):
+    subs = world.split_type(MPI.COMM_TYPE_SHARED)
+    # single host: every rank lands in one shared communicator
+    assert subs[0].size == world.size
+
+
+def test_attributes_keyvals(world):
+    calls = []
+    kv = MPI.create_keyval(delete_fn=lambda c, k, v: calls.append(v))
+    world.set_attr(kv, "hello")
+    found, val = world.get_attr(kv)
+    assert found and val == "hello"
+    world.delete_attr(kv)
+    assert calls == ["hello"]
+    assert world.get_attr(kv) == (False, None)
+    MPI.free_keyval(kv)
+
+
+def test_errhandler_return(world):
+    world.set_errhandler(MPI.ERRORS_RETURN)
+    try:
+        with pytest.raises(MPI.MPIError):
+            world.bcast(world.alloc((2,), np.float32), root=world.size + 5)
+    finally:
+        world.set_errhandler(MPI.ERRORS_ARE_FATAL)
+
+
+def test_ulfm_revoke_shrink_agree(world):
+    d = world.dup()
+    d.revoke()
+    d.set_errhandler(MPI.ERRORS_RETURN)
+    with pytest.raises(MPI.MPIError):
+        d.barrier()
+    s = d.shrink([0])
+    assert s.size == world.size - 1
+    assert s.agree([0b1110, 0b0111]) == 0b0110
+
+
+def test_mca_var_system(monkeypatch):
+    from ompi_tpu.mca import var
+    v = var.var_register("test", "unit", "alpha", vtype="int", default=7,
+                         help="test var")
+    assert v == 7
+    assert var.var_source("test_unit_alpha") == var.SOURCE_DEFAULT
+    monkeypatch.setenv("OMPI_TPU_MCA_test_unit_beta", "42")
+    v2 = var.var_register("test", "unit", "beta", vtype="int", default=0)
+    assert v2 == 42
+    assert var.var_source("test_unit_beta") == var.SOURCE_ENV
+    var.var_set("test_unit_alpha", 9)
+    assert var.var_get("test_unit_alpha") == 9
+    assert var.var_source("test_unit_alpha") == var.SOURCE_SET
+    dump = var.var_dump()
+    assert any(d["name"] == "test_unit_alpha" for d in dump)
+
+
+def test_coll_selection_vtable(world):
+    # tuned (prio 60) should win every function on a multi-rank comm;
+    # COMM_SELF should select coll/self.
+    from ompi_tpu.coll.tuned import TunedCollModule
+    from ompi_tpu.coll.self_ import SelfCollModule
+    assert isinstance(world.c_coll["allreduce"], TunedCollModule)
+    import ompi_tpu.runtime.init as rt
+    assert isinstance(rt.comm_self().c_coll["allreduce"], SelfCollModule)
+
+
+def test_spc_snapshot(world):
+    from ompi_tpu.runtime import spc
+    world.barrier()
+    snap = spc.snapshot()
+    assert snap.get("coll_barrier", 0) >= 1

@@ -1,0 +1,72 @@
+"""Nonblocking collectives + request semantics (request.h:311-430)."""
+import numpy as np
+
+import ompi_tpu as MPI
+
+
+def test_iallreduce_wait_get(world, rng):
+    n = world.size
+    x = rng.standard_normal((n, 64)).astype(np.float32)
+    req = world.iallreduce(world.stack(list(x)), MPI.SUM)
+    st = req.wait()
+    assert st is not None
+    np.testing.assert_allclose(np.asarray(req.get())[0], x.sum(0), rtol=1e-5)
+
+
+def test_ibcast_test_loop(world, rng):
+    n = world.size
+    x = rng.standard_normal((n, 32)).astype(np.float32)
+    req = world.ibcast(world.stack(list(x)), root=0)
+    while not req.test()[0]:
+        pass
+    np.testing.assert_allclose(np.asarray(req.get())[n - 1], x[0], rtol=1e-6)
+
+
+def test_waitall_mixed(world, rng):
+    n = world.size
+    a = rng.standard_normal((n, 8)).astype(np.float32)
+    b = rng.standard_normal((n, 8)).astype(np.float32)
+    reqs = [world.iallreduce(world.stack(list(a)), MPI.SUM),
+            world.ibcast(world.stack(list(b)), root=0),
+            world.ibarrier()]
+    sts = MPI.Waitall(reqs)
+    assert len(sts) == 3
+    np.testing.assert_allclose(np.asarray(reqs[0].get())[0], a.sum(0),
+                               rtol=1e-5)
+
+
+def test_waitany_testall(world, rng):
+    n = world.size
+    x = rng.standard_normal((n, 4)).astype(np.float32)
+    reqs = [world.iallreduce(world.stack(list(x)), MPI.SUM) for _ in range(3)]
+    i, st = MPI.Waitany(reqs)
+    assert 0 <= i < 3
+    MPI.Waitall(reqs)
+    ok, sts = MPI.Testall(reqs)
+    assert ok and len(sts) == 3
+
+
+def test_persistent_collective(world, rng):
+    n = world.size
+    x = rng.standard_normal((n, 16)).astype(np.float32)
+    buf = world.stack(list(x))
+    req = world.allreduce_init(buf, MPI.SUM)
+    for _ in range(2):
+        req.start()
+        req.wait()
+    np.testing.assert_allclose(np.asarray(req.get())[0], x.sum(0), rtol=1e-5)
+
+
+def test_completed_request():
+    r = MPI.Request.completed("value")
+    ok, st = r.test()
+    assert ok
+    assert r.get() == "value"
+
+
+def test_grequest():
+    g = MPI.Grequest()
+    assert g.test() == (False, None)
+    g.complete(123)
+    ok, _ = g.test()
+    assert ok and g.get() == 123
