@@ -1,0 +1,150 @@
+"""Benchmark driver — OSU-style allreduce on the framework's native path.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "us", "vs_baseline": N, ...}
+
+Headline metric: **osu_allreduce p50 latency @ 8 B** (BASELINE.md config
+2) — dispatch-to-completion of the cached compiled XLA collective. This
+is the quantity that is real and meaningful on any rank count including
+the driver's single-chip world (SURVEY.md §7 calls 8-byte latency out as
+a hard part: XLA dispatch >> NCCL LL protocols; tracking it across
+rounds measures exactly that gap). ``vs_baseline`` is the speedup over
+the reference architecture's device-buffer strategy for the same call:
+coll/accelerator-style staging (D2H -> host reduce -> H2D,
+``coll_accelerator_allreduce.c:55-80``) on the same hardware.
+
+Secondary fields report the 256 MB bandwidth config. Caveat recorded in
+the output: on a size-1 world an allreduce is semantically the identity,
+so XLA aliases the large-message path (algbw is then an upper bound, not
+a transfer measurement); bus bandwidth is only nonzero for >1 rank.
+Compile/warm-up is excluded and reported separately.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+import numpy as np
+
+# Measure the real compiled XLA collective, not coll/self's identity
+# shortcut (which wins selection on a size-1 world and returns the input
+# buffer untouched — a meaningless 0-cost "collective").
+os.environ.setdefault("OMPI_TPU_MCA_coll_self_priority", "1")
+
+
+def _fetch(y):
+    """Force true completion: a tiny host read-back. On tunneled device
+    transports ``block_until_ready`` can ack at dispatch; only a fetch
+    observes execution completion."""
+    return np.asarray(y).ravel()[:1]
+
+
+def _osu_time(fn, iters, fetch_baseline_s):
+    """OSU methodology: run ``iters`` back-to-back operations (device
+    executes them serially), observe completion once, amortize."""
+    t0 = time.perf_counter()
+    y = None
+    for _ in range(iters):
+        y = fn()
+    _fetch(y)
+    total = time.perf_counter() - t0
+    return max((total - fetch_baseline_s) / iters, 1e-9)
+
+
+def _measure_fetch_baseline(world):
+    import numpy as _np
+    z = world.alloc((2,), _np.float32, fill=0.0)
+    _fetch(z)
+    ts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        _fetch(z)
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--size-mb", type=float, default=256.0,
+                    help="large-message size per rank (MB)")
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--lat-iters", type=int, default=100)
+    ap.add_argument("--baseline-iters", type=int, default=3)
+    args = ap.parse_args()
+
+    import jax
+    import ompi_tpu as MPI
+    from ompi_tpu.accelerator import to_device, to_host
+
+    MPI.Init()
+    world = MPI.get_comm_world()
+    n = world.size
+    platform = world.devices[0].platform
+    if platform == "cpu" and args.size_mb > 64:
+        args.size_mb = 64.0                    # keep CI-host runs sane
+
+    def staged_allreduce(buf):
+        host = to_host(buf)                          # D2H
+        red = host.sum(axis=0, dtype=np.float32)     # host CPU reduction
+        out = np.broadcast_to(red, host.shape)
+        return to_device(np.ascontiguousarray(out), world.sharding)  # H2D
+
+    fetch_s = _measure_fetch_baseline(world)
+
+    def _staged_time(buf, iters):
+        _fetch(staged_allreduce(buf))                # warm
+        ts = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            _fetch(staged_allreduce(buf))            # inherently synced
+            ts.append(time.perf_counter() - t0)
+        return float(np.median(ts))
+
+    # ---- headline: 8 B latency --------------------------------------
+    small = world.alloc((2,), np.float32, fill=1.0)  # 8 B per rank
+    _fetch(world.allreduce(small, MPI.SUM))          # compile
+    lat_native_s = _osu_time(lambda: world.allreduce(small, MPI.SUM),
+                             args.lat_iters, fetch_s)
+    lat_staged_s = _staged_time(small, max(args.baseline_iters, 9))
+
+    # ---- secondary: large-message bandwidth -------------------------
+    elems = int(args.size_mb * (1 << 20) // 4)
+    bytes_per_rank = elems * 4
+    x = world.alloc((elems,), np.float32, fill=1.0)
+    t0 = time.perf_counter()
+    y = world.allreduce(x, MPI.SUM)
+    _fetch(y)
+    warmup_s = time.perf_counter() - t0
+    big_native_s = _osu_time(lambda: world.allreduce(x, MPI.SUM),
+                             args.iters, fetch_s)
+    big_staged_s = _staged_time(x, args.baseline_iters)
+
+    algbw = bytes_per_rank / big_native_s / 1e9
+    busbw = algbw * (2 * (n - 1) / n) if n > 1 else 0.0
+    correct = bool(np.asarray(y[0, :1])[0] == float(n))
+
+    print(json.dumps({
+        "metric": "osu_allreduce_p50_latency_8B",
+        "value": round(lat_native_s * 1e6, 2),
+        "unit": "us",
+        "vs_baseline": round(lat_staged_s / lat_native_s, 2),
+        "ranks": n,
+        "platform": platform,
+        "staged_p50_8B_us": round(lat_staged_s * 1e6, 2),
+        "large_msg_mb": int(args.size_mb),
+        "large_algbw_gbps": round(algbw, 2),
+        "large_busbw_gbps": round(busbw, 2),
+        "large_native_ms": round(big_native_s * 1e3, 3),
+        "large_staged_ms": round(big_staged_s * 1e3, 3),
+        "warmup_compile_s": round(warmup_s, 3),
+        "correct": correct,
+        "caveat": ("size-1 world: large-message path is identity-aliased "
+                   "by XLA; algbw is an upper bound" if n == 1 else ""),
+    }))
+    MPI.Finalize()
+
+
+if __name__ == "__main__":
+    main()

@@ -59,16 +59,8 @@ COMM_TYPE_NUMA = 3
 
 COMM_NULL = None
 
-# One-sided (filled by ompi_tpu.osc; imported lazily to avoid cycles).
-Win = None
-
-
-def _load_win():
-    global Win
-    if Win is None:
-        from ompi_tpu.osc.framework import Win as _W
-        Win = _W
-    return Win
+from ompi_tpu.osc.framework import (LOCK_EXCLUSIVE, LOCK_SHARED,  # noqa: F401,E402
+                                    Win)
 
 
 # lifecycle ---------------------------------------------------------------

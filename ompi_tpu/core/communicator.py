@@ -201,7 +201,10 @@ class Communicator:
         self._validate_op(op)
         x, unpack_fn = self._wire(sendbuf, datatype, count)
         y = self._coll("allreduce").allreduce(x, op)
-        return unpack_fn(y, recvbuf if recvbuf is not sendbuf else None)
+        # Unpack into recvbuf (even for IN_PLACE, where recvbuf is the
+        # send buffer): MPI guarantees gap elements outside the
+        # datatype's map are left untouched.
+        return unpack_fn(y, recvbuf)
 
     def reduce(self, sendbuf, op=op_mod.SUM, root: int = 0, *,
                datatype: Optional[Datatype] = None,
@@ -213,7 +216,7 @@ class Communicator:
         self._validate_root(root)
         x, unpack_fn = self._wire(sendbuf, datatype, count)
         y = self._coll("reduce").reduce(x, op, root)
-        return unpack_fn(y, recvbuf if recvbuf is not sendbuf else None)
+        return unpack_fn(y, recvbuf)
 
     def bcast(self, buf, root: int = 0, *,
               datatype: Optional[Datatype] = None,
@@ -379,7 +382,17 @@ class Communicator:
                          name=f"{self.name}.dup", parent=self,
                          info=info or self.info,
                          errhandler=self.errhandler)
-        c.attributes = dict(self.attributes)
+        # MPI attribute-copy semantics: an attribute propagates to the dup
+        # only if its keyval registered a copy callback, which may veto or
+        # transform the value (MPI_Comm_dup + COMM_DUP_FN behavior).
+        for kv, val in self.attributes.items():
+            cb = _keyvals.get(kv)
+            copy_fn = cb[0] if cb else None
+            if copy_fn is None:
+                continue
+            keep, newval = copy_fn(self, kv, val)
+            if keep:
+                c.attributes[kv] = newval
         return c
 
     def split(self, colors: Sequence[int], keys: Optional[Sequence[int]] = None
@@ -414,8 +427,25 @@ class Communicator:
                    keys: Optional[Sequence[int]] = None):
         """MPI_Comm_split_type: group ranks by hardware locality. TPU
         concretization: COMM_TYPE_SHARED groups ranks whose devices share
-        a host process (``device.process_index``)."""
-        colors = [int(getattr(d, "process_index", 0)) for d in self.devices]
+        a host process (``device.process_index``); COMM_TYPE_NUMA uses
+        the device's NUMA/slice index when exposed (falls back to the
+        process); COMM_TYPE_HWTHREAD is one rank = one device, so every
+        rank gets its own communicator; UNDEFINED yields MPI_COMM_NULL
+        everywhere."""
+        if split_type == UNDEFINED:
+            return [None] * self.size
+        if split_type == 2:           # COMM_TYPE_HWTHREAD
+            colors = list(range(self.size))
+        elif split_type == 3:         # COMM_TYPE_NUMA
+            colors = [int(getattr(d, "numa_node",
+                                  getattr(d, "process_index", 0)) or 0)
+                      for d in self.devices]
+        elif split_type == 1:         # COMM_TYPE_SHARED
+            colors = [int(getattr(d, "process_index", 0))
+                      for d in self.devices]
+        else:
+            self._err(ERR_ARG, f"unknown split_type {split_type}")
+            return [None] * self.size
         return self.split(colors, keys)
 
     def create(self, group: Group) -> Optional["Communicator"]:
@@ -518,6 +548,10 @@ _keyval_counter = itertools.count(100)
 
 def create_keyval(copy_fn: Optional[Callable] = None,
                   delete_fn: Optional[Callable] = None) -> int:
+    """MPI_Comm_create_keyval. ``copy_fn(comm, keyval, value) ->
+    (keep: bool, new_value)`` runs at Comm_dup (no copy_fn => the
+    attribute is not propagated, per MPI); ``delete_fn(comm, keyval,
+    value)`` runs at attribute deletion / communicator free."""
     kv = next(_keyval_counter)
     _keyvals[kv] = (copy_fn, delete_fn)
     return kv

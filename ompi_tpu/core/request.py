@@ -158,7 +158,12 @@ def waitall(requests: Sequence[Request]) -> List[Status]:
     return [r.wait() for r in requests]
 
 
-def waitany(requests: Sequence[Request]) -> Tuple[int, Status]:
+UNDEFINED = -32766
+
+
+def waitany(requests: Sequence[Request]) -> Tuple[int, Optional[Status]]:
+    if not requests:
+        return UNDEFINED, None       # MPI: empty list returns immediately
     while True:
         for i, r in enumerate(requests):
             ok, st = r.test()
@@ -168,6 +173,8 @@ def waitany(requests: Sequence[Request]) -> Tuple[int, Status]:
 
 
 def waitsome(requests: Sequence[Request]) -> Tuple[List[int], List[Status]]:
+    if not requests:
+        return [], []
     while True:
         idx = [i for i, r in enumerate(requests) if r.test()[0]]
         if idx:
@@ -182,6 +189,8 @@ def testall(requests: Sequence[Request]) -> Tuple[bool, Optional[List[Status]]]:
 
 
 def testany(requests: Sequence[Request]) -> Tuple[bool, int, Optional[Status]]:
+    if not requests:
+        return True, UNDEFINED, None
     for i, r in enumerate(requests):
         ok, st = r.test()
         if ok:

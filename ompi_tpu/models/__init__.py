@@ -1,0 +1,2 @@
+"""Flagship demo models built ON the framework — the workload proof that
+the communication stack supports real DP/TP training (SURVEY.md §2.6)."""

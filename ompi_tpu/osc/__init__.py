@@ -1,0 +1,1 @@
+from ompi_tpu.osc.framework import Win  # noqa: F401

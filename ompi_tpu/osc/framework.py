@@ -1,0 +1,196 @@
+"""OSC — one-sided communication (RMA windows).
+
+Behavioral spec: ``ompi/mca/osc/osc.h:373`` (module interface; put :210,
+get :220, request-based rput/rget :269/:279), osc/rdma's active-target
+(``osc_rdma_active_target.c``) and passive-target (lock/unlock via btl
+atomics, ``osc_rdma_lock.h``) synchronization.
+
+TPU-native re-design (single-controller SPMD): a window is a stacked
+device buffer ``(nranks, win_size)`` sharded one shard per rank over the
+communicator's mesh. ``put``/``get``/``accumulate`` become functional
+shard updates (XLA dynamic-update-slice on the target's shard — data
+moves over ICI, never through host); epochs map to JAX's async dispatch:
+``fence`` drains outstanding updates (the analogue of the btl-atomic
+fence), passive-target ``lock/unlock`` serialize controller-side access.
+Accumulate honors MPI_REPLACE / MPI_NO_OP / predefined ops
+(``ompi/op/op.c`` accumulate semantics).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ompi_tpu.accelerator import LOCUS_DEVICE, check_addr
+from ompi_tpu.core import op as op_mod
+from ompi_tpu.core.errhandler import ERR_ARG, ERR_RANK, MPIError
+from ompi_tpu.core.request import Request
+
+LOCK_EXCLUSIVE = 1
+LOCK_SHARED = 2
+
+
+class Win:
+    """An RMA window over per-rank buffers of ``comm``.
+
+    ``win = Win(comm, size)`` or ``Win.create(comm, stacked_buffer)``.
+    All offsets/counts are in elements of the window's dtype.
+    """
+
+    def __init__(self, comm, size: int, dtype=np.float32,
+                 buffer: Optional[Any] = None, name: str = ""):
+        self.comm = comm
+        if buffer is not None:
+            if buffer.ndim < 2 or buffer.shape[0] != comm.size:
+                raise MPIError(ERR_ARG,
+                               "window buffer must be stacked (nranks, n)")
+            self._buf = buffer
+            self.size = int(buffer.shape[-1])
+            self.dtype = buffer.dtype
+        else:
+            self._buf = comm.alloc((size,), dtype)
+            self.size = size
+            self.dtype = np.dtype(dtype)
+        self.name = name or f"win#{comm.cid}"
+        self._lock = threading.RLock()
+        self._lock_state = {}           # rank -> lock type
+        self.attributes = {}
+        self._freed = False
+
+    @classmethod
+    def create(cls, comm, buffer, name: str = "") -> "Win":
+        return cls(comm, 0, buffer=buffer, name=name)
+
+    @classmethod
+    def allocate(cls, comm, size: int, dtype=np.float32) -> "Win":
+        return cls(comm, size, dtype=dtype)
+
+    # -- access ---------------------------------------------------------
+    def _check_rank(self, rank: int):
+        if not (0 <= rank < self.comm.size):
+            raise MPIError(ERR_RANK, f"target rank {rank} out of range")
+
+    def _update(self, target_rank: int, target_disp: int, data,
+                combine=None):
+        self._check_rank(target_rank)
+        data = jnp.asarray(data) if check_addr(self._buf) == LOCUS_DEVICE \
+            else np.asarray(data)
+        n = data.shape[-1]
+        if target_disp + n > self.size:
+            raise MPIError(ERR_ARG, "RMA access beyond window bounds")
+        with self._lock:
+            if check_addr(self._buf) == LOCUS_DEVICE:
+                cur = jax.lax.dynamic_slice(
+                    self._buf, (target_rank, target_disp), (1, n))[0]
+                new = combine(cur, data) if combine else data
+                self._buf = jax.lax.dynamic_update_slice(
+                    self._buf, new[None].astype(self._buf.dtype),
+                    (target_rank, target_disp))
+            else:
+                cur = self._buf[target_rank, target_disp:target_disp + n]
+                new = combine(cur, data) if combine else data
+                self._buf[target_rank, target_disp:target_disp + n] = new
+
+    def put(self, origin_data, target_rank: int, target_disp: int = 0):
+        """MPI_Put (osc.h:210)."""
+        self._update(target_rank, target_disp, origin_data)
+
+    def get(self, target_rank: int, target_disp: int = 0,
+            count: Optional[int] = None):
+        """MPI_Get (osc.h:220): returns a host copy of the target region
+        (functional API: recvbuf is the return value)."""
+        self._check_rank(target_rank)
+        count = count if count is not None else self.size - target_disp
+        with self._lock:
+            return np.asarray(
+                self._buf[target_rank, target_disp:target_disp + count])
+
+    def accumulate(self, origin_data, target_rank: int,
+                   op: op_mod.Op = op_mod.SUM, target_disp: int = 0):
+        """MPI_Accumulate: REPLACE overwrites, NO_OP leaves target."""
+        if op is op_mod.NO_OP:
+            return
+        comb = (None if op is op_mod.REPLACE
+                else (lambda cur, d: op.fn(cur, d.astype(cur.dtype))))
+        self._update(target_rank, target_disp, origin_data, combine=comb)
+
+    def get_accumulate(self, origin_data, target_rank: int,
+                       op: op_mod.Op = op_mod.SUM, target_disp: int = 0):
+        """MPI_Get_accumulate: fetch-then-accumulate, atomic under the
+        window lock."""
+        with self._lock:
+            n = np.asarray(origin_data).shape[-1]
+            old = self.get(target_rank, target_disp, n)
+            self.accumulate(origin_data, target_rank, op, target_disp)
+        return old
+
+    def fetch_and_op(self, value, target_rank: int,
+                     op: op_mod.Op = op_mod.SUM, target_disp: int = 0):
+        return self.get_accumulate(np.asarray([value]), target_rank, op,
+                                   target_disp)[0]
+
+    def compare_and_swap(self, value, compare, target_rank: int,
+                         target_disp: int = 0):
+        with self._lock:
+            old = self.get(target_rank, target_disp, 1)[0]
+            if old == compare:
+                self.put(np.asarray([value]), target_rank, target_disp)
+        return old
+
+    def rput(self, origin_data, target_rank: int,
+             target_disp: int = 0) -> Request:
+        self.put(origin_data, target_rank, target_disp)
+        arrays = [self._buf] if isinstance(self._buf, jax.Array) else None
+        return Request(arrays=arrays)
+
+    def rget(self, target_rank: int, target_disp: int = 0,
+             count: Optional[int] = None) -> Request:
+        return Request.completed(self.get(target_rank, target_disp, count))
+
+    # -- synchronization ------------------------------------------------
+    def fence(self) -> None:
+        """MPI_Win_fence: drain outstanding device updates (active
+        target epoch boundary)."""
+        if isinstance(self._buf, jax.Array):
+            jax.block_until_ready(self._buf)
+        self.comm.barrier()
+
+    def lock(self, target_rank: int, lock_type: int = LOCK_EXCLUSIVE):
+        self._lock.acquire()
+        self._lock_state[target_rank] = lock_type
+
+    def unlock(self, target_rank: int):
+        self._lock_state.pop(target_rank, None)
+        self._lock.release()
+
+    def lock_all(self):
+        self.lock(-1)
+
+    def unlock_all(self):
+        self.unlock(-1)
+
+    def flush(self, target_rank: int = -1) -> None:
+        if isinstance(self._buf, jax.Array):
+            jax.block_until_ready(self._buf)
+
+    def flush_all(self) -> None:
+        self.flush()
+
+    def sync(self) -> None:
+        self.flush()
+
+    # -- introspection ---------------------------------------------------
+    @property
+    def buffer(self):
+        """The stacked window contents (rank-major)."""
+        return self._buf
+
+    def free(self) -> None:
+        self._freed = True
+        self._buf = None
+
+    def __repr__(self):
+        return f"Win({self.name}, size={self.size}, dtype={self.dtype})"

@@ -1,0 +1,1 @@
+from ompi_tpu.parallel.ingraph import InGraphComm  # noqa: F401

@@ -1,0 +1,173 @@
+"""In-graph communicators: MPI collective semantics *inside* a compiled
+SPMD program.
+
+This is the TPU-native analogue of the reference's §2.6 mapping
+(SURVEY.md): the communication primitives that DP/TP/PP/SP/EP parallel
+strategies are built from, bound to a *mesh axis* instead of a process
+group. An ``InGraphComm`` is used inside ``jax.shard_map`` (or ``pjit``)
+bodies; its collectives are ``lax`` collective ops that XLA schedules on
+ICI — zero dispatch overhead, fusable with surrounding compute. The
+controller-level ``Communicator`` (ompi_tpu.core) and this class expose
+the same operation set; ``coll/xla`` is in fact implemented on these
+primitives.
+
+Reference lineage per op: ring/segmented allreduce
+(``coll_base_allreduce.c:281,345``) -> psum; ring pipelines & chain bcast
+(``coll_base_bcast.c``) -> ``ring_shift``/``ppermute`` schedules (the
+ancestor of ring-attention / context parallelism); sub-communicators
+(``comm.c:749``) -> distinct mesh axes.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ompi_tpu.core import op as op_mod
+
+
+# Megatron-style f/g operators: the pair that makes tensor-parallel AD
+# produce exactly-correct gradients for replicated parameters without any
+# post-hoc gradient allreduce. ``copy_in`` (f) is identity forward /
+# psum backward — placed where a replicated activation enters a
+# tp-sharded computation. ``reduce_out`` (g) is psum forward / identity
+# backward — placed on row-parallel partial outputs.
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _megatron_f(x, axis):
+    return x
+
+
+def _f_fwd(x, axis):
+    return x, None
+
+
+def _f_bwd(axis, _res, ct):
+    return (jax.lax.psum(ct, axis),)
+
+
+_megatron_f.defvjp(_f_fwd, _f_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _megatron_g(x, axis):
+    return jax.lax.psum(x, axis)
+
+
+def _g_fwd(x, axis):
+    return jax.lax.psum(x, axis), None
+
+
+def _g_bwd(axis, _res, ct):
+    return (ct,)
+
+
+_megatron_g.defvjp(_g_fwd, _g_bwd)
+
+
+class InGraphComm:
+    """MPI-style collectives over one mesh axis, callable only inside a
+    traced SPMD region (shard_map / pjit body) over that axis."""
+
+    def __init__(self, axis_name: str, axis_size: Optional[int] = None):
+        self.axis = axis_name
+        self._size = axis_size
+
+    # -- identity ------------------------------------------------------
+    def size(self):
+        if self._size is not None:
+            return self._size
+        return jax.lax.axis_size(self.axis)
+
+    def rank(self):
+        return jax.lax.axis_index(self.axis)
+
+    # -- collectives ---------------------------------------------------
+    def allreduce(self, x, op: op_mod.Op = op_mod.SUM):
+        if op.xla_prim == "sum":
+            return jax.lax.psum(x, self.axis)
+        if op.xla_prim == "max":
+            return jax.lax.pmax(x, self.axis)
+        if op.xla_prim == "min":
+            return jax.lax.pmin(x, self.axis)
+        g = jax.lax.all_gather(x, self.axis, axis=0, tiled=False)
+        return op.reduce_tree(g, axis=0)
+
+    def pmean(self, x):
+        return jax.lax.pmean(x, self.axis)
+
+    def reduce(self, x, op: op_mod.Op = op_mod.SUM, root: int = 0):
+        return self.allreduce(x, op)       # symmetric-ICI design choice
+
+    def bcast(self, x, root: int = 0):
+        masked = jnp.where(self.rank() == root, x, jnp.zeros_like(x))
+        return jax.lax.psum(masked, self.axis)
+
+    def allgather(self, x, *, axis: int = 0, tiled: bool = False):
+        return jax.lax.all_gather(x, self.axis, axis=axis, tiled=tiled)
+
+    def reduce_scatter(self, x, op: op_mod.Op = op_mod.SUM, *,
+                       scatter_axis: int = 0):
+        if op.xla_prim == "sum":
+            return jax.lax.psum_scatter(x, self.axis,
+                                        scatter_dimension=scatter_axis,
+                                        tiled=True)
+        y = self.alltoall(x, split_axis=scatter_axis,
+                          concat_axis=scatter_axis)
+        # fold the received contributions (now stacked along scatter_axis)
+        n = self.size()
+        parts = jnp.split(y, n, axis=scatter_axis) if isinstance(n, int) \
+            else None
+        if parts is None:
+            raise ValueError("generic-op reduce_scatter needs static size")
+        acc = parts[0]
+        for p in parts[1:]:
+            acc = op.fn(acc, p)
+        return acc
+
+    def alltoall(self, x, *, split_axis: int = 0, concat_axis: int = 0):
+        return jax.lax.all_to_all(x, self.axis, split_axis=split_axis,
+                                  concat_axis=concat_axis, tiled=True)
+
+    # -- point-to-point patterns (pml building blocks) ----------------
+    def ppermute(self, x, perm: Sequence[Tuple[int, int]]):
+        return jax.lax.ppermute(x, self.axis, perm=list(perm))
+
+    def ring_shift(self, x, shift: int = 1):
+        """Shift shards around the ring: rank r's data goes to rank
+        (r+shift) mod n — the primitive under ring allreduce/bcast and
+        ring attention."""
+        n = self._size
+        if n is None:
+            raise ValueError("ring_shift needs static axis_size")
+        perm = [(i, (i + shift) % n) for i in range(n)]
+        return jax.lax.ppermute(x, self.axis, perm=perm)
+
+    def sendrecv(self, x, dest: int, source: int):
+        """Route rank ``source``'s shard to rank ``dest`` (one edge of a
+        permutation); every other rank receives ppermute's fill value
+        (zeros). SPMD arguments are uniform across ranks, so per-rank
+        shift patterns belong to ``ring_shift``/``ppermute`` instead."""
+        return jax.lax.ppermute(x, self.axis, perm=[(source, dest)])
+
+    # -- tensor-parallel AD operators ---------------------------------
+    def copy_in(self, x):
+        """Identity forward, psum backward (Megatron 'f'): use where a
+        replicated activation feeds a tp-sharded computation."""
+        return _megatron_f(x, self.axis)
+
+    def reduce_out(self, x):
+        """psum forward, identity backward (Megatron 'g'): use on
+        row-parallel partial outputs."""
+        return _megatron_g(x, self.axis)
+
+    # -- prefix ops ----------------------------------------------------
+    def scan(self, x, op: op_mod.Op = op_mod.SUM):
+        g = jax.lax.all_gather(x, self.axis, axis=0, tiled=False)
+        if op.name == "sum":
+            pre = jnp.cumsum(g, axis=0)
+        else:
+            pre = jax.lax.associative_scan(op.fn, g, axis=0)
+        return jax.lax.dynamic_index_in_dim(pre, self.rank(), 0,
+                                            keepdims=False)

@@ -141,3 +141,26 @@ def test_spc_snapshot(world):
     world.barrier()
     snap = spc.snapshot()
     assert snap.get("coll_barrier", 0) >= 1
+
+
+def test_split_type_undefined_and_hwthread(world):
+    from ompi_tpu.core.group import UNDEFINED as UNDEF
+    assert world.split_type(UNDEF) == [None] * world.size
+    subs = world.split_type(MPI.COMM_TYPE_HWTHREAD)
+    assert all(s.size == 1 for s in subs)
+
+
+def test_dup_attribute_copy_semantics(world):
+    kv_nocopy = MPI.create_keyval()
+    kv_copy = MPI.create_keyval(copy_fn=lambda c, k, v: (True, v + 1))
+    kv_veto = MPI.create_keyval(copy_fn=lambda c, k, v: (False, None))
+    world.set_attr(kv_nocopy, 10)
+    world.set_attr(kv_copy, 20)
+    world.set_attr(kv_veto, 30)
+    d = world.dup()
+    assert d.get_attr(kv_nocopy) == (False, None)   # no copy_fn: dropped
+    assert d.get_attr(kv_copy) == (True, 21)        # transformed
+    assert d.get_attr(kv_veto) == (False, None)     # vetoed
+    for kv in (kv_nocopy, kv_copy, kv_veto):
+        world.delete_attr(kv)
+        MPI.free_keyval(kv)

@@ -104,3 +104,18 @@ def test_bcast_derived_datatype(world, rng):
     for r in range(n):
         np.testing.assert_allclose(got[r][[0, 2, 3]], host[1][[0, 2, 3]],
                                    rtol=1e-6)
+
+
+def test_allreduce_in_place_derived_preserves_holes(world, rng):
+    """MPI_IN_PLACE + strided datatype: gap elements of recvbuf must be
+    left untouched (not zeroed)."""
+    t = FLOAT.create_vector(2, 1, 2).commit()       # indices 0, 2; extent 3
+    n = world.size
+    host = rng.standard_normal((n, 3)).astype(np.float32)
+    buf = world.stack(list(host))
+    y = world.allreduce(MPI.IN_PLACE, MPI.SUM, datatype=t, count=1,
+                        recvbuf=buf)
+    got = np.asarray(y)
+    np.testing.assert_allclose(got[0][[0, 2]], host[:, [0, 2]].sum(0),
+                               rtol=1e-5)
+    np.testing.assert_allclose(got[:, 1], host[:, 1], rtol=1e-6)  # holes

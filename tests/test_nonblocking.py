@@ -70,3 +70,11 @@ def test_grequest():
     g.complete(123)
     ok, _ = g.test()
     assert ok and g.get() == 123
+
+
+def test_empty_request_lists():
+    from ompi_tpu.core.request import UNDEFINED
+    assert MPI.Waitany([]) == (UNDEFINED, None)
+    assert MPI.Waitsome([]) == ([], [])
+    assert MPI.Testany([]) == (True, UNDEFINED, None)
+    assert MPI.Waitall([]) == []

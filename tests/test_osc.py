@@ -1,0 +1,65 @@
+"""One-sided (RMA) windows — put/get/accumulate/fence + atomics."""
+import numpy as np
+import pytest
+
+import ompi_tpu as MPI
+
+
+def test_win_put_get_fence(world):
+    win = MPI.Win.allocate(world, 8, np.float32)
+    win.put(np.arange(4, dtype=np.float32), target_rank=2, target_disp=1)
+    win.fence()
+    got = win.get(2, 1, 4)
+    np.testing.assert_allclose(got, np.arange(4))
+    assert win.get(2, 0, 1)[0] == 0.0            # untouched
+    assert win.get(0, 0, 8).sum() == 0.0         # other ranks untouched
+    win.free()
+
+
+def test_win_accumulate_ops(world):
+    win = MPI.Win.allocate(world, 4, np.float32)
+    win.accumulate(np.ones(4, np.float32), 1, MPI.SUM)
+    win.accumulate(2 * np.ones(4, np.float32), 1, MPI.SUM)
+    win.fence()
+    np.testing.assert_allclose(win.get(1), 3.0)
+    win.accumulate(9 * np.ones(4, np.float32), 1, MPI.REPLACE)
+    np.testing.assert_allclose(win.get(1), 9.0)
+    win.accumulate(5 * np.ones(4, np.float32), 1, MPI.NO_OP)
+    np.testing.assert_allclose(win.get(1), 9.0)
+
+
+def test_win_get_accumulate_and_cas(world):
+    win = MPI.Win.allocate(world, 2, np.float32)
+    old = win.get_accumulate(np.asarray([7.0, 7.0], np.float32), 0, MPI.SUM)
+    np.testing.assert_allclose(old, 0.0)
+    np.testing.assert_allclose(win.get(0), 7.0)
+    v = win.fetch_and_op(3.0, 0, MPI.SUM, target_disp=0)
+    assert v == 7.0 and win.get(0, 0, 1)[0] == 10.0
+    old = win.compare_and_swap(42.0, compare=10.0, target_rank=0)
+    assert old == 10.0 and win.get(0, 0, 1)[0] == 42.0
+    old = win.compare_and_swap(0.0, compare=999.0, target_rank=0)
+    assert old == 42.0 and win.get(0, 0, 1)[0] == 42.0   # no swap
+
+
+def test_win_create_from_buffer_and_bounds(world):
+    buf = world.alloc((4,), np.float32, fill=1.0)
+    win = MPI.Win.create(world, buf)
+    win.lock(0)
+    win.put(np.asarray([5.0], np.float32), 0, 3)
+    win.unlock(0)
+    np.testing.assert_allclose(win.get(0), [1, 1, 1, 5])
+    world.set_errhandler(MPI.ERRORS_RETURN)
+    try:
+        with pytest.raises(MPI.MPIError):
+            win.put(np.ones(3, np.float32), 0, 2)    # beyond bounds
+        with pytest.raises(MPI.MPIError):
+            win.put(np.ones(1, np.float32), world.size + 1, 0)
+    finally:
+        world.set_errhandler(MPI.ERRORS_ARE_FATAL)
+
+
+def test_win_rput_request(world):
+    win = MPI.Win.allocate(world, 2, np.float32)
+    req = win.rput(np.asarray([1.0, 2.0], np.float32), 1)
+    req.wait()
+    np.testing.assert_allclose(win.get(1), [1.0, 2.0])
