@@ -1,0 +1,89 @@
+// ompi_tpu native convertor — host-side pack/unpack hot loops.
+//
+// Re-design of the reference's OPAL convertor pack/unpack engines
+// (opal/datatype/opal_datatype_pack.c / _unpack.c): instead of an
+// iovec-walking interpreter, Python precomputes the datatype's layout as
+// *runs* — (element_offset, element_count) pairs of contiguous spans
+// within one extent — and these loops do one memcpy per run per
+// instance. This is the optimized "contiguous with gaps" path the
+// reference special-cases, applied universally.
+//
+// Built as a plain shared library (no Python headers); loaded via
+// ctypes. All sizes are in BYTES at this boundary; the Python layer
+// converts element units.
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// Pack: gather `nruns` runs per instance, `count` instances, from a
+// strided source (extent_bytes apart) into a dense destination.
+void ompi_tpu_pack_runs(char *dst, const char *src,
+                        const int64_t *run_off_bytes,
+                        const int64_t *run_len_bytes, int64_t nruns,
+                        int64_t count, int64_t extent_bytes,
+                        int64_t packed_bytes) {
+    for (int64_t inst = 0; inst < count; ++inst) {
+        const char *s = src + inst * extent_bytes;
+        char *d = dst + inst * packed_bytes;
+        for (int64_t r = 0; r < nruns; ++r) {
+            std::memcpy(d, s + run_off_bytes[r],
+                        static_cast<size_t>(run_len_bytes[r]));
+            d += run_len_bytes[r];
+        }
+    }
+}
+
+// Unpack: scatter dense source back into the strided destination.
+void ompi_tpu_unpack_runs(char *dst, const char *src,
+                          const int64_t *run_off_bytes,
+                          const int64_t *run_len_bytes, int64_t nruns,
+                          int64_t count, int64_t extent_bytes,
+                          int64_t packed_bytes) {
+    for (int64_t inst = 0; inst < count; ++inst) {
+        char *d = dst + inst * extent_bytes;
+        const char *s = src + inst * packed_bytes;
+        for (int64_t r = 0; r < nruns; ++r) {
+            std::memcpy(d + run_off_bytes[r], s,
+                        static_cast<size_t>(run_len_bytes[r]));
+            s += run_len_bytes[r];
+        }
+    }
+}
+
+// Rowwise variants: `nrows` independent buffers (the stacked rank axis),
+// row strides given separately so (N, L) arrays pack in one call.
+void ompi_tpu_pack_runs_rows(char *dst, const char *src,
+                             const int64_t *run_off_bytes,
+                             const int64_t *run_len_bytes, int64_t nruns,
+                             int64_t count, int64_t extent_bytes,
+                             int64_t packed_bytes, int64_t nrows,
+                             int64_t src_row_stride,
+                             int64_t dst_row_stride) {
+    for (int64_t row = 0; row < nrows; ++row) {
+        ompi_tpu_pack_runs(dst + row * dst_row_stride,
+                           src + row * src_row_stride, run_off_bytes,
+                           run_len_bytes, nruns, count, extent_bytes,
+                           packed_bytes);
+    }
+}
+
+void ompi_tpu_unpack_runs_rows(char *dst, const char *src,
+                               const int64_t *run_off_bytes,
+                               const int64_t *run_len_bytes,
+                               int64_t nruns, int64_t count,
+                               int64_t extent_bytes, int64_t packed_bytes,
+                               int64_t nrows, int64_t dst_row_stride,
+                               int64_t src_row_stride) {
+    for (int64_t row = 0; row < nrows; ++row) {
+        ompi_tpu_unpack_runs(dst + row * dst_row_stride,
+                             src + row * src_row_stride, run_off_bytes,
+                             run_len_bytes, nruns, count, extent_bytes,
+                             packed_bytes);
+    }
+}
+
+int ompi_tpu_native_abi(void) { return 1; }
+
+}  // extern "C"

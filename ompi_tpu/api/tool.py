@@ -1,0 +1,57 @@
+"""MPI_T — the tool information interface (mirrors ``ompi/mpi/tool``).
+
+Control variables (cvars) are the MCA vars; performance variables
+(pvars) surface SPC counters and monitoring tables. Shapes follow the
+MPI_T C API loosely (enumerate / get_info / read / write), Pythonized.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from ompi_tpu.mca import pvar as _pvar
+from ompi_tpu.mca import var as _var
+
+
+def init_thread() -> None:            # MPI_T_init_thread
+    _pvar.refresh()
+
+
+def finalize() -> None:               # MPI_T_finalize
+    pass
+
+
+# -- control variables -----------------------------------------------------
+def cvar_get_num() -> int:
+    return len(_var.var_dump())
+
+
+def cvar_get_info(index: int) -> Dict[str, Any]:
+    return _var.var_dump()[index]
+
+
+def cvar_read(name: str) -> Any:
+    return _var.var_get(name)
+
+
+def cvar_write(name: str, value: Any) -> None:
+    _var.var_set(name, value)
+
+
+def cvar_list() -> List[Dict[str, Any]]:
+    return _var.var_dump()
+
+
+# -- performance variables -------------------------------------------------
+def pvar_get_num() -> int:
+    _pvar.refresh()
+    return len(_pvar.pvar_list())
+
+
+def pvar_list() -> List[Dict[str, Any]]:
+    _pvar.refresh()
+    return _pvar.pvar_list()
+
+
+def pvar_read(name: str) -> Any:
+    _pvar.refresh()
+    return _pvar.pvar_read(name)

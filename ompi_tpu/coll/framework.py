@@ -28,7 +28,7 @@ def _ensure_components() -> None:
     if _components_loaded:
         return
     # Importing registers each component with the framework.
-    from ompi_tpu.coll import basic, self_, tuned, xla  # noqa: F401
+    from ompi_tpu.coll import basic, monitoring, self_, tuned, xla  # noqa: F401
     _components_loaded = True
 
 

@@ -1,0 +1,100 @@
+"""coll/monitoring — interposition component counting operations and
+bytes per collective per communicator.
+
+Mirrors the reference's monitoring stack (pml/coll/osc ``monitoring``
+components aggregated by ``ompi/mca/common/monitoring``): when enabled
+(MCA var ``coll_monitoring_enable``), it wins selection at high priority,
+wraps the real decision module (tuned), counts every call's payload
+bytes, and passes through. Results are read through pvars / the info
+tool (the MPI_T path the reference uses)."""
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Dict, Tuple
+
+from ompi_tpu.coll.framework import COLL_FUNCS, coll_framework
+from ompi_tpu.coll.tuned import TunedCollModule, _load_rules
+from ompi_tpu.mca import var
+from ompi_tpu.mca.base import Component
+
+_lock = threading.Lock()
+# (comm_cid, func) -> [calls, bytes]
+_table: Dict[Tuple[int, str], list] = defaultdict(lambda: [0, 0])
+
+
+def record(cid: int, func: str, nbytes: int) -> None:
+    with _lock:
+        e = _table[(cid, func)]
+        e[0] += 1
+        e[1] += nbytes
+
+
+def snapshot() -> Dict[Tuple[int, str], Tuple[int, int]]:
+    with _lock:
+        return {k: tuple(v) for k, v in _table.items()}
+
+
+def reset() -> None:
+    with _lock:
+        _table.clear()
+
+
+class MonitoringCollModule:
+    """Pass-through wrapper over the tuned decision module."""
+
+    def __init__(self, comm, inner: TunedCollModule):
+        self.comm = comm
+        self.inner = inner
+
+    def _wrap(self, func: str):
+        inner_fn = getattr(self.inner, func)
+
+        def wrapped(buf, *args):
+            record(self.comm.cid, func, int(getattr(buf, "nbytes", 0)))
+            return inner_fn(buf, *args)
+        return wrapped
+
+    def barrier(self) -> None:
+        record(self.comm.cid, "barrier", 0)
+        self.inner.barrier()
+
+    def ibarrier(self):
+        record(self.comm.cid, "barrier", 0)
+        return self.inner.ibarrier()
+
+
+for _f in COLL_FUNCS:
+    if _f != "barrier":
+        def _mk(f):
+            def method(self, buf, *args):
+                record(self.comm.cid, f, int(getattr(buf, "nbytes", 0)))
+                return getattr(self.inner, f)(buf, *args)
+            method.__name__ = f
+            return method
+        setattr(MonitoringCollModule, _f, _mk(_f))
+
+
+class MonitoringCollComponent(Component):
+    name = "monitoring"
+
+    def register_params(self):
+        var.var_register("coll", "monitoring", "enable", vtype="bool",
+                         default=False,
+                         help="Interpose byte/call counters on every "
+                              "collective (reference: coll/monitoring)")
+        var.var_register("coll", "monitoring", "priority", vtype="int",
+                         default=90, help="Selection priority when enabled")
+
+    def comm_query(self, comm):
+        if comm is None or not var.var_get("coll_monitoring_enable", False):
+            return None
+        if not getattr(comm, "mesh", None):
+            return None
+        rules = _load_rules(var.var_get("coll_tuned_dynamic_rules", ""))
+        inner = TunedCollModule(comm, rules)
+        prio = var.var_get("coll_monitoring_priority", 90)
+        return (prio, MonitoringCollModule(comm, inner))
+
+
+coll_framework.register(MonitoringCollComponent())

@@ -144,7 +144,9 @@ class Communicator:
             self._err(ERR_ARG, f"no coll component provides {func} "
                                f"for {self.name}")
         from ompi_tpu.runtime import spc
+        from ompi_tpu.utils import hooks
         spc.record(f"coll_{func}", 1)
+        hooks.fire(f"coll_{func}", self, {})
         return m
 
     def _validate_op(self, op, pair_expected: bool = False):
@@ -374,6 +376,105 @@ class Communicator:
         return Request(persistent_start=lambda: self.ibcast(buf, root, **kw))
 
     # ==================================================================
+    # Point-to-point (pml framework; matching spec pml_ob1_recvfrag.c)
+    # ==================================================================
+    @property
+    def _pml(self):
+        eng = getattr(self, "_pml_engine", None)
+        if eng is None:
+            from ompi_tpu.pml.stacked import MatchingEngine
+            eng = self._pml_engine = MatchingEngine(self)
+        return eng
+
+    def send(self, data, src: int, dest: int, tag: int = 0) -> None:
+        """MPI_Send from rank ``src`` to ``dest`` (single-controller: the
+        sender rank is explicit; ``data`` is that rank's local buffer)."""
+        self._check()
+        from ompi_tpu.runtime import spc
+        spc.record("pml_send", 1)
+        self._pml.send(data, src, dest, tag)
+
+    def isend(self, data, src: int, dest: int, tag: int = 0) -> Request:
+        self._check()
+        return self._pml.send(data, src, dest, tag)
+
+    def ssend(self, data, src: int, dest: int, tag: int = 0) -> None:
+        """MPI_Ssend: completes only if the receive has started; raises
+        the deadlock otherwise (single-controller semantics)."""
+        self._check()
+        self._pml.send(data, src, dest, tag, synchronous=True)
+
+    def bsend(self, data, src: int, dest: int, tag: int = 0) -> None:
+        """MPI_Bsend: the payload is buffered (copied) at send time."""
+        self._check()
+        buffered = (np.array(data, copy=True)
+                    if isinstance(data, np.ndarray) else data)
+        self._pml.send(buffered, src, dest, tag)
+
+    def recv(self, source: int, tag: int = -1, *, dst: int = 0):
+        """MPI_Recv executed by rank ``dst``: returns (data, Status).
+        Raises instead of deadlocking if no matching send was posted."""
+        self._check()
+        from ompi_tpu.runtime import spc
+        spc.record("pml_recv", 1)
+        return self._pml.recv(dst, source, tag)
+
+    def irecv(self, source: int, tag: int = -1, *, dst: int = 0) -> Request:
+        self._check()
+        return self._pml.irecv(dst, source, tag)
+
+    def sendrecv(self, senddata, src: int, dest: int, recvsource: int,
+                 sendtag: int = 0, recvtag: int = -1):
+        """MPI_Sendrecv executed by rank ``src``: post the send, then
+        receive (deadlock-free by construction, as in the reference)."""
+        self._check()
+        self._pml.send(senddata, src, dest, sendtag)
+        return self._pml.recv(src, recvsource, recvtag)
+
+    def probe(self, source: int, tag: int = -1, *, dst: int = 0) -> Status:
+        self._check()
+        return self._pml.probe(dst, source, tag)
+
+    def iprobe(self, source: int, tag: int = -1, *, dst: int = 0):
+        self._check()
+        return self._pml.iprobe(dst, source, tag)
+
+    def mprobe(self, source: int, tag: int = -1, *, dst: int = 0):
+        self._check()
+        return self._pml.mprobe(dst, source, tag)
+
+    def mrecv(self, message):
+        self._check()
+        return self._pml.mrecv(message)
+
+    def send_init(self, data, src: int, dest: int, tag: int = 0) -> Request:
+        """MPI_Send_init (persistent)."""
+        self._check()
+        return Request(persistent_start=lambda: self._pml.send(
+            data, src, dest, tag))
+
+    def recv_init(self, source: int, tag: int = -1, *,
+                  dst: int = 0) -> Request:
+        self._check()
+        return Request(persistent_start=lambda: self._pml.irecv(
+            dst, source, tag))
+
+    # -- partitioned pt2pt (MPI-4, mirrors ompi/mca/part/persist) ------
+    def psend_init(self, parts: Sequence[Any], dest: int, tag: int = 0,
+                   src: int = 0):
+        """MPI_Psend_init: ``parts`` is the partition list; ``pready(i)``
+        marks partition i; the message is sent when all are ready."""
+        self._check()
+        from ompi_tpu.pml.partitioned import PartitionedSend
+        return PartitionedSend(self, parts, src, dest, tag)
+
+    def precv_init(self, source: int, tag: int = 0, partitions: int = 1,
+                   *, dst: int = 0):
+        self._check()
+        from ompi_tpu.pml.partitioned import PartitionedRecv
+        return PartitionedRecv(self, source, tag, partitions, dst=dst)
+
+    # ==================================================================
     # Communicator algebra
     # ==================================================================
     def dup(self, info: Optional[Info] = None) -> "Communicator":
@@ -477,6 +578,143 @@ class Communicator:
                 cb[1](self, kv, val)
         self.attributes.clear()
         self._freed = True
+
+    # -- process topologies (topo framework) ---------------------------
+    def create_cart(self, dims: Sequence[int],
+                    periods: Optional[Sequence[bool]] = None,
+                    reorder: bool = False) -> "Communicator":
+        """MPI_Cart_create. ``reorder=True`` maps logical cart coords to
+        physical device coords when the backend exposes them (the ICI
+        mesh), so cart neighbors are physical neighbors — the TPU
+        re-design of topo/treematch rank reordering."""
+        import math
+        from ompi_tpu.topo import CartTopology
+        dims = list(dims)
+        if periods is None:
+            periods = [False] * len(dims)
+        n = math.prod(dims)
+        if n > self.size:
+            self._err(ERR_ARG, f"cart size {n} exceeds comm size")
+        devices = list(self.devices[:n])
+        ranks = list(range(n))
+        if reorder:
+            def devkey(i):
+                d = self.devices[i]
+                return tuple(getattr(d, "coords", None) or (d.id,))
+            ranks = sorted(range(n), key=devkey)
+            devices = [self.devices[r] for r in ranks]
+        g = Group([self.group.world_ranks[r] for r in ranks])
+        c = Communicator(g, devices, name=f"{self.name}.cart",
+                         parent=self, errhandler=self.errhandler)
+        c.topo = CartTopology(dims, periods)
+        return c
+
+    def _cart(self):
+        from ompi_tpu.topo import CartTopology
+        if not isinstance(self.topo, CartTopology):
+            from ompi_tpu.core.errhandler import ERR_TOPOLOGY
+            self._err(ERR_TOPOLOGY, "communicator has no cartesian topology")
+        return self.topo
+
+    def cart_rank(self, coords: Sequence[int]) -> int:
+        return self._cart().rank(coords)
+
+    def cart_coords(self, rank: int) -> Tuple[int, ...]:
+        return self._cart().coords(rank)
+
+    def cart_shift(self, rank: int, direction: int,
+                   disp: int = 1) -> Tuple[int, int]:
+        return self._cart().shift(rank, direction, disp)
+
+    def cart_sub(self, remain: Sequence[bool]) -> List["Communicator"]:
+        """MPI_Cart_sub: split into sub-cart communicators along kept
+        dims; returns one entry per rank."""
+        topo = self._cart()
+        colors, new_topo = topo.sub_keep(remain)
+        subs = self.split(colors)
+        for s in subs:
+            if s is not None and s.topo is None:
+                from ompi_tpu.topo import CartTopology
+                s.topo = CartTopology(new_topo.dims, new_topo.periods)
+        return subs
+
+    def create_graph(self, index: Sequence[int], edges: Sequence[int],
+                     reorder: bool = False) -> "Communicator":
+        from ompi_tpu.topo import GraphTopology
+        topo = GraphTopology(index, edges)
+        if topo.size > self.size:
+            self._err(ERR_ARG, "graph larger than communicator")
+        g = Group(self.group.world_ranks[:topo.size])
+        c = Communicator(g, self.devices[:topo.size],
+                         name=f"{self.name}.graph", parent=self,
+                         errhandler=self.errhandler)
+        c.topo = topo
+        return c
+
+    def create_dist_graph_adjacent(self, sources, destinations
+                                   ) -> "Communicator":
+        from ompi_tpu.topo import DistGraphTopology
+        c = self.dup()
+        c.topo = DistGraphTopology(sources, destinations)
+        c.name = f"{self.name}.dist_graph"
+        return c
+
+    def graph_neighbors(self, rank: int) -> List[int]:
+        if self.topo is None:
+            from ompi_tpu.core.errhandler import ERR_TOPOLOGY
+            self._err(ERR_TOPOLOGY, "no topology attached")
+        return self.topo.neighbors(rank)
+
+    def neighbor_allgather(self, sendbuf) -> List[Any]:
+        """MPI_Neighbor_allgather: each rank receives its neighbors'
+        buffers (in neighbor order). Returns a per-rank list of host
+        arrays (neighbor counts may differ across ranks)."""
+        self._validate_stacked(sendbuf)
+        if self.topo is None:
+            from ompi_tpu.core.errhandler import ERR_TOPOLOGY
+            self._err(ERR_TOPOLOGY, "no topology attached")
+        host = np.asarray(sendbuf)
+        out = []
+        for r in range(self.size):
+            nb = [n for n in self.topo.neighbors(r) if n >= 0]
+            out.append(np.stack([host[n] for n in nb])
+                       if nb else np.empty((0,) + host.shape[1:],
+                                           host.dtype))
+        return out
+
+    def neighbor_alltoall(self, sendbuf) -> List[Any]:
+        """MPI_Neighbor_alltoall: sendbuf (N, max_out_deg, *s); rank r's
+        j-th chunk goes to its j-th out-neighbor; each rank receives one
+        chunk per in-neighbor (in neighbor order)."""
+        self._validate_stacked(sendbuf, lead=2)
+        if self.topo is None:
+            from ompi_tpu.core.errhandler import ERR_TOPOLOGY
+            self._err(ERR_TOPOLOGY, "no topology attached")
+        from collections import deque
+        host = np.asarray(sendbuf)
+        out_nb = getattr(self.topo, "out_neighbors", self.topo.neighbors)
+        in_nb = self.topo.neighbors
+        # chunk sent from s to its j-th out-neighbor d lands at d at the
+        # position of the matching occurrence of s in d's in-neighbor
+        # list; FIFO per (sender, receiver) pair handles duplicate edges
+        # (periodic dims of size <= 2, multigraph dist-graphs).
+        recv = {}
+        for s in range(self.size):
+            for j, d in enumerate(out_nb(s)):
+                if 0 <= d < self.size:
+                    recv.setdefault((d, s), deque()).append(host[s, j])
+        out = []
+        for r in range(self.size):
+            chunks = []
+            for n in in_nb(r):
+                if n < 0:
+                    continue
+                q = recv.get((r, n))
+                chunks.append(q.popleft() if q
+                              else np.zeros(host.shape[2:], host.dtype))
+            out.append(np.stack(chunks) if chunks
+                       else np.empty((0,) + host.shape[2:], host.dtype))
+        return out
 
     # -- attributes (keyvals) ------------------------------------------
     def set_attr(self, keyval: int, value: Any) -> None:

@@ -33,6 +33,64 @@ def _scatter_last(buf, idx, packed, _tag):
     return buf.at[..., idx].set(packed)
 
 
+def _native_args(buf, datatype: Datatype, count: int):
+    """Common byte-unit geometry for the native run-copy loops; None when
+    the native path doesn't apply (no lib, exotic dtype, bad layout)."""
+    from ompi_tpu.native import get_lib
+    lib = get_lib()
+    if lib is None or buf.dtype.hasobject:
+        return None
+    if buf.shape[-1] < count * datatype.extent:
+        # Undersized strided buffer: fall back to the NumPy path, whose
+        # fancy indexing raises a proper IndexError instead of letting
+        # the native memcpy loops run out of bounds.
+        return None
+    offs, lens = datatype.runs()
+    if offs.size == 0:
+        return None
+    item = buf.dtype.itemsize
+    lead = int(np.prod(buf.shape[:-1])) if buf.ndim > 1 else 1
+    return (lib, (offs * item).astype(np.int64),
+            (lens * item).astype(np.int64), int(offs.size), count,
+            datatype.extent * item, datatype.count * item, lead,
+            buf.shape[-1] * item, count * datatype.count * item)
+
+
+def _native_pack(buf, datatype: Datatype, count: int):
+    geo = _native_args(buf, datatype, count)
+    if geo is None:
+        return None
+    (lib, offb, lenb, nruns, cnt, extent_b, packed_b, lead,
+     src_row_b, dst_row_b) = geo
+    src = np.ascontiguousarray(buf)
+    out = np.empty(buf.shape[:-1] + (count * datatype.count,), buf.dtype)
+    lib.ompi_tpu_pack_runs_rows(
+        out.ctypes.data, src.ctypes.data, offb.ctypes.data,
+        lenb.ctypes.data, nruns, cnt, extent_b, packed_b, lead,
+        src_row_b, dst_row_b)
+    return out
+
+
+def _native_unpack(out_buf, packed, datatype: Datatype, count: int) -> bool:
+    if not (isinstance(out_buf, np.ndarray)
+            and out_buf.flags["C_CONTIGUOUS"]):
+        return False
+    if (getattr(packed, "shape", (0,))[-1] != count * datatype.count
+            or packed.shape[:-1] != out_buf.shape[:-1]):
+        return False            # let the NumPy path raise the shape error
+    geo = _native_args(out_buf, datatype, count)
+    if geo is None:
+        return False
+    (lib, offb, lenb, nruns, cnt, extent_b, packed_b, lead,
+     dst_row_b, src_row_b) = geo
+    src = np.ascontiguousarray(packed, dtype=out_buf.dtype)
+    lib.ompi_tpu_unpack_runs_rows(
+        out_buf.ctypes.data, src.ctypes.data, offb.ctypes.data,
+        lenb.ctypes.data, nruns, cnt, extent_b, packed_b, lead,
+        dst_row_b, src_row_b)
+    return True
+
+
 def pack(buf, datatype: Optional[Datatype], count: int):
     """Pack ``count`` instances of ``datatype`` from ``buf`` (…, extent*count
     flat elements on the last axis) into a contiguous (…, count*dt.count)
@@ -45,6 +103,9 @@ def pack(buf, datatype: Optional[Datatype], count: int):
     idx = datatype.flat_indices(count)
     if check_addr(buf) == LOCUS_DEVICE:
         return _take_last(buf, jnp.asarray(idx), datatype.name)
+    out = _native_pack(buf, datatype, count)
+    if out is not None:
+        return out
     return np.ascontiguousarray(buf[..., idx])
 
 
@@ -68,5 +129,7 @@ def unpack(out_buf, packed, datatype: Optional[Datatype], count: int):
                          "output buffer (extent holes are preserved)")
     if check_addr(out_buf) == LOCUS_DEVICE:
         return _scatter_last(out_buf, jnp.asarray(idx), packed, datatype.name)
+    if _native_unpack(out_buf, packed, datatype, count):
+        return out_buf
     out_buf[..., idx] = packed
     return out_buf

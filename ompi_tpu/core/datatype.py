@@ -163,6 +163,25 @@ class Datatype:
                       zip(displacements, blocklengths, types)), default=0)
         return Datatype(base_t.base, idx, extent, name="struct")
 
+    def runs(self):
+        """Coalesce the element-index map into contiguous runs
+        (offset, length) — the native convertor's unit of work (the
+        re-design of the reference convertor's contiguous-with-gaps
+        fast path). Cached after first call."""
+        r = getattr(self, "_runs", None)
+        if r is None:
+            idx = self.indices
+            if idx.size == 0:
+                r = (np.empty(0, np.int64), np.empty(0, np.int64))
+            else:
+                breaks = np.where(np.diff(idx) != 1)[0]
+                starts = np.concatenate(([0], breaks + 1))
+                ends = np.concatenate((breaks, [idx.size - 1]))
+                r = (idx[starts].astype(np.int64),
+                     (ends - starts + 1).astype(np.int64))
+            self._runs = r
+        return r
+
     def flat_indices(self, count: int) -> np.ndarray:
         """Flat element indices for ``count`` consecutive instances."""
         return (np.arange(count)[:, None] * self.extent

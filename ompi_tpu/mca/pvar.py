@@ -1,0 +1,56 @@
+"""Performance variables (pvars) — the MPI_T performance-variable
+backend, mirroring ``opal/mca/base/mca_base_pvar.c``.
+
+Pvars are read-only named counters/levels sourced from SPC counters and
+component-registered callables; ``ompi_tpu.api.tool`` exposes them with
+MPI_T-shaped calls, and the info tool dumps them.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List
+
+_lock = threading.Lock()
+_pvars: Dict[str, Dict[str, Any]] = {}
+
+
+def pvar_register(name: str, read_fn: Callable[[], Any], *,
+                  unit: str = "count", help: str = "",
+                  var_class: str = "counter") -> None:
+    with _lock:
+        _pvars[name] = {"read": read_fn, "unit": unit, "help": help,
+                        "class": var_class}
+
+
+def pvar_read(name: str) -> Any:
+    with _lock:
+        v = _pvars.get(name)
+    if v is None:
+        raise KeyError(f"no such pvar: {name}")
+    return v["read"]()
+
+
+def pvar_list() -> List[Dict[str, Any]]:
+    with _lock:
+        items = list(_pvars.items())
+    return [{"name": n, "unit": v["unit"], "class": v["class"],
+             "help": v["help"], "value": v["read"]()}
+            for n, v in sorted(items)]
+
+
+def _install_spc_pvars() -> None:
+    """Surface every SPC counter as a pvar (the reference surfaces its
+    ~110 SPC counters as MPI_T pvars, ompi_spc.c)."""
+    from ompi_tpu.runtime import spc
+
+    def make_reader(key):
+        return lambda: spc.read(key)
+
+    for key in spc.snapshot():
+        if f"spc_{key}" not in _pvars:
+            pvar_register(f"spc_{key}", make_reader(key),
+                          help=f"SPC counter {key}")
+
+
+def refresh() -> None:
+    _install_spc_pvars()

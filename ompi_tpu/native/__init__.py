@@ -1,0 +1,7 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The shared library is built from ``native/*.cpp`` with g++ on first use
+(cached next to the sources); everything here degrades gracefully to the
+pure-NumPy paths when no compiler is available.
+"""
+from ompi_tpu.native.loader import get_lib, native_available  # noqa: F401

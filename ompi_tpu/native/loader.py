@@ -1,0 +1,65 @@
+"""Build-on-demand loader for the native library (ctypes, no Python
+headers needed — mirrors how the reference ships optional SIMD
+components that fall back to base kernels when unavailable)."""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_REPO_DIR = os.path.dirname(_PKG_DIR)
+_SRC = os.path.join(_REPO_DIR, "native", "convertor.cpp")
+_SO = os.path.join(_REPO_DIR, "native", "libompi_tpu_native.so")
+
+
+def _build() -> Optional[str]:
+    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+        return _SO
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC,
+             "-o", _SO],
+            check=True, capture_output=True, timeout=120)
+        return _SO
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("OMPI_TPU_DISABLE_NATIVE"):
+            return None
+        so = _build()
+        if so is None:
+            return None
+        try:
+            lib = ctypes.CDLL(so)
+            if lib.ompi_tpu_native_abi() != 1:
+                return None
+            i64 = ctypes.c_int64
+            lib.ompi_tpu_pack_runs_rows.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, i64, i64, i64, i64, i64, i64, i64]
+            lib.ompi_tpu_unpack_runs_rows.argtypes = \
+                lib.ompi_tpu_pack_runs_rows.argtypes
+            _lib = lib
+        except OSError:
+            _lib = None
+    return _lib
+
+
+def native_available() -> bool:
+    return get_lib() is not None

@@ -1,0 +1,1 @@
+"""PML — point-to-point messaging layer (mirrors ``ompi/mca/pml``)."""

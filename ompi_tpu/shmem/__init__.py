@@ -1,0 +1,1 @@
+from ompi_tpu.shmem.api import ShmemCtx  # noqa: F401

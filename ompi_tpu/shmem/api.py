@@ -1,0 +1,110 @@
+"""OSHMEM-lite: the OpenSHMEM programming model over the framework.
+
+Behavioral spec: ``oshmem/`` — symmetric heap (memheap), put/get with
+remote completion (spml, ``oshmem/mca/spml/spml.h:229-330``), atomics,
+and collectives (scoll; scoll/mpi delegates to the MPI coll stack, which
+is exactly what this does).
+
+TPU-native re-design: the symmetric heap is one RMA window per context —
+every PE's heap is a shard row, so a "symmetric address" is a plain
+offset valid on all PEs (symmetry by construction, no address exchange
+needed). ``put``/``get``/atomics are window ops (HBM shard updates);
+``barrier_all``/``broadcast``/``collect``/reductions delegate to the
+coll framework like scoll/mpi.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+from ompi_tpu.core import op as op_mod
+from ompi_tpu.core.errhandler import ERR_ARG, MPIError
+from ompi_tpu.osc.framework import Win
+
+
+class ShmemCtx:
+    """A SHMEM context: ``n_pes`` processing elements over a
+    communicator, one symmetric heap of ``heap_size`` elements."""
+
+    def __init__(self, comm, heap_size: int = 1 << 16, dtype=np.float32):
+        self.comm = comm
+        self.heap = Win(comm, heap_size, dtype=dtype, name="symheap")
+        self._brk = 0
+        self.heap_size = heap_size
+
+    # -- setup (shmem_init / shmem_my_pe / shmem_n_pes) ----------------
+    @property
+    def n_pes(self) -> int:
+        return self.comm.size
+
+    def malloc(self, nelems: int) -> int:
+        """shmem_malloc: symmetric allocation — returns the symmetric
+        offset, identical on every PE (memheap buddy allocator's job;
+        a bump allocator suffices for the controller)."""
+        if self._brk + nelems > self.heap_size:
+            raise MPIError(ERR_ARG, "symmetric heap exhausted")
+        addr = self._brk
+        self._brk += nelems
+        return addr
+
+    def free(self, addr: int) -> None:
+        pass                        # bump allocator: no-op (like reset-free)
+
+    # -- RMA (spml put/get) --------------------------------------------
+    def put(self, dest_pe: int, addr: int, data) -> None:
+        """shmem_put: deliver ``data`` into dest_pe's heap at ``addr``."""
+        self.heap.put(np.asarray(data), dest_pe, addr)
+
+    def get(self, src_pe: int, addr: int, nelems: int):
+        return self.heap.get(src_pe, addr, nelems)
+
+    def p(self, dest_pe: int, addr: int, value) -> None:
+        self.put(dest_pe, addr, np.asarray([value]))
+
+    def g(self, src_pe: int, addr: int):
+        return self.get(src_pe, addr, 1)[0]
+
+    # -- atomics (oshmem/mca/atomic) -----------------------------------
+    def atomic_add(self, dest_pe: int, addr: int, value) -> None:
+        self.heap.accumulate(np.asarray([value]), dest_pe, op_mod.SUM, addr)
+
+    def atomic_fetch_add(self, dest_pe: int, addr: int, value):
+        return self.heap.fetch_and_op(value, dest_pe, op_mod.SUM, addr)
+
+    def atomic_compare_swap(self, dest_pe: int, addr: int, cond, value):
+        return self.heap.compare_and_swap(value, cond, dest_pe, addr)
+
+    # -- ordering / completion -----------------------------------------
+    def fence(self) -> None:
+        self.heap.flush_all()
+
+    def quiet(self) -> None:
+        self.heap.flush_all()
+
+    # -- collectives (scoll; delegate to coll like scoll/mpi) ----------
+    def barrier_all(self) -> None:
+        self.comm.barrier()
+
+    def broadcast(self, addr: int, nelems: int, root_pe: int) -> None:
+        data = self.get(root_pe, addr, nelems)
+        for pe in range(self.n_pes):
+            if pe != root_pe:
+                self.put(pe, addr, data)
+
+    def collect(self, addr: int, nelems: int):
+        """fcollect: concatenation of every PE's segment, symmetric
+        result returned (host array)."""
+        return np.concatenate([self.get(pe, addr, nelems)
+                               for pe in range(self.n_pes)])
+
+    def reduce(self, addr: int, nelems: int,
+               op: op_mod.Op = op_mod.SUM) -> None:
+        """to_all reduction over all PEs' segments; result written back
+        symmetrically."""
+        acc: Optional[Any] = None
+        for pe in range(self.n_pes):
+            seg = self.get(pe, addr, nelems)
+            acc = seg if acc is None else np.asarray(op.fn(acc, seg))
+        for pe in range(self.n_pes):
+            self.put(pe, addr, acc)

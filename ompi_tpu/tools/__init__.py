@@ -1,0 +1,1 @@
+"""Tools (mirrors ``ompi/tools``): info (ompi_info), mpirun."""

@@ -1,0 +1,65 @@
+"""``ompi_info`` equivalent: dump version, devices, components,
+MCA vars, pvars. Run as ``python -m ompi_tpu.tools.info [-a]``."""
+from __future__ import annotations
+
+import argparse
+import json
+
+
+def collect(all_vars: bool = False) -> dict:
+    import jax
+    import ompi_tpu as MPI
+    from ompi_tpu.api import tool
+    from ompi_tpu.coll.framework import _ensure_components, coll_framework
+    from ompi_tpu.accelerator.framework import accel_framework
+    from ompi_tpu.native import native_available
+
+    _ensure_components()
+    coll_framework.open()
+    accel_framework.open()
+
+    out = {
+        "library": MPI.Get_library_version(),
+        "mpi_standard": ".".join(map(str, MPI.Get_version())),
+        "platform": [f"{d.platform}:{d.id}" for d in jax.devices()],
+        "native_convertor": native_available(),
+        "frameworks": {
+            "coll": sorted(coll_framework.components),
+            "accelerator": sorted(accel_framework.components),
+            "pml": ["stacked"],
+            "osc": ["xla_window"],
+            "topo": ["cart", "graph", "dist_graph"],
+        },
+    }
+    if all_vars:
+        out["mca_vars"] = tool.cvar_list()
+        out["pvars"] = tool.pvar_list()
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(prog="ompi_tpu_info")
+    ap.add_argument("-a", "--all", action="store_true",
+                    help="include every MCA var and pvar")
+    ap.add_argument("--json", action="store_true", help="JSON output")
+    args = ap.parse_args()
+    data = collect(all_vars=args.all)
+    if args.json:
+        print(json.dumps(data, indent=2, default=str))
+        return
+    print(data["library"])
+    print(f"MPI standard: {data['mpi_standard']}")
+    print(f"Devices: {', '.join(data['platform'])}")
+    print(f"Native convertor: {data['native_convertor']}")
+    for fw, comps in data["frameworks"].items():
+        print(f"MCA {fw}: {', '.join(comps)}")
+    if args.all:
+        for v in data["mca_vars"]:
+            print(f"  cvar {v['name']} = {v['value']!r} "
+                  f"(source: {v['source']}) {v['help']}")
+        for p in data["pvars"]:
+            print(f"  pvar {p['name']} = {p['value']} [{p['class']}]")
+
+
+if __name__ == "__main__":
+    main()

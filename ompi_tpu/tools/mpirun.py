@@ -1,0 +1,78 @@
+"""``mpirun`` equivalent — a thin argv-translating launcher.
+
+Behavioral spec: the reference's mpirun is an exec shim that finds
+prterun, translates argv, and execs it (``ompi/tools/mpirun/main.c:32-48,
+157-180``); the runtime (PRRTE) owns process placement.
+
+TPU-native re-design: placement is device binding.
+- Single-controller (default): ``mpirun -n N prog.py`` sets
+  ``OMPI_TPU_MCA_mpi_base_num_ranks=N`` and execs ``python prog.py``
+  once — the controller binds N mesh devices as ranks.
+- Multi-host: ``--coordinator host:port --num-hosts H --host-id I``
+  populate the jax.distributed coordination-service vars (the PMIx
+  stand-in); one controller per host, each contributing its local
+  devices.
+``--mca k v`` translates to ``OMPI_TPU_MCA_<k>`` exactly like the
+reference's ``--mca`` -> ``OMPI_MCA_*`` env translation.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def build_env(args, base_env) -> dict:
+    env = dict(base_env)
+    # The launched program must find the library regardless of cwd (the
+    # reference's mpirun prepends its own libdir the same way).
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    parts = [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p]
+    if pkg_root not in parts:
+        env["PYTHONPATH"] = os.pathsep.join([pkg_root] + parts)
+    if args.n:
+        env["OMPI_TPU_MCA_mpi_base_num_ranks"] = str(args.n)
+    for k, v in args.mca or []:
+        env[f"OMPI_TPU_MCA_{k}"] = v
+    if args.coordinator:
+        env["OMPI_TPU_MCA_mpi_base_distributed"] = "1"
+        env["OMPI_TPU_MCA_mpi_base_coordinator"] = args.coordinator
+        if args.num_hosts:
+            env["OMPI_TPU_MCA_mpi_base_num_processes"] = str(args.num_hosts)
+        if args.host_id is not None:
+            env["OMPI_TPU_MCA_mpi_base_process_id"] = str(args.host_id)
+    return env
+
+
+def parse(argv):
+    ap = argparse.ArgumentParser(prog="mpirun (ompi_tpu)")
+    ap.add_argument("-n", "-np", type=int, default=0,
+                    help="number of ranks (0 = all local devices)")
+    ap.add_argument("--mca", nargs=2, action="append",
+                    metavar=("VAR", "VALUE"),
+                    help="set an MCA variable (e.g. coll_base_include xla)")
+    ap.add_argument("--coordinator", default="",
+                    help="host:port of the coordination service "
+                         "(multi-host)")
+    ap.add_argument("--num-hosts", type=int, default=0)
+    ap.add_argument("--host-id", type=int, default=None)
+    ap.add_argument("program", nargs=argparse.REMAINDER,
+                    help="program and its args")
+    return ap.parse_args(argv)
+
+
+def main(argv=None) -> None:
+    args = parse(argv if argv is not None else sys.argv[1:])
+    if not args.program:
+        sys.stderr.write("mpirun: no program given\n")
+        raise SystemExit(2)
+    env = build_env(args, os.environ)
+    prog = args.program
+    if prog[0].endswith(".py"):
+        prog = [sys.executable] + prog
+    os.execvpe(prog[0], prog, env)      # exec shim, like mpirun->prterun
+
+
+if __name__ == "__main__":
+    main()

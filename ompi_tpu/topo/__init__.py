@@ -1,0 +1,2 @@
+from ompi_tpu.topo.cart import (CartTopology, GraphTopology,  # noqa: F401
+                                DistGraphTopology, dims_create)

@@ -1,0 +1,2 @@
+"""Utility layer (mirrors ``opal/util``): output streams, help
+catalogs, profiling hooks."""

@@ -1,0 +1,42 @@
+"""Profiling interposition — the PMPI re-design.
+
+The reference generates every binding twice (``MPI_*`` weak-aliased over
+``PMPI_*``, ``ompi/mpi/c/Makefile.am:43,522-533``) so tools interpose by
+defining ``MPI_*``. In Python the same capability is an explicit hook
+chain: ``register_profiler(fn)`` installs ``fn(event, comm, info)``
+callbacks fired at every collective/pt2pt entry — the MPI_T events /
+PERUSE instrumentation point (``ompi/peruse``)."""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List
+
+_lock = threading.Lock()
+_hooks: List[Callable[[str, Any, Dict[str, Any]], None]] = []
+
+
+def register_profiler(fn: Callable[[str, Any, Dict[str, Any]], None]):
+    """Install a profiling hook; returns a handle for unregister."""
+    with _lock:
+        _hooks.append(fn)
+    return fn
+
+
+def unregister_profiler(handle) -> None:
+    with _lock:
+        try:
+            _hooks.remove(handle)
+        except ValueError:
+            pass
+
+
+def fire(event: str, comm, info: Dict[str, Any]) -> None:
+    if not _hooks:
+        return
+    with _lock:
+        hooks = list(_hooks)
+    for h in hooks:
+        try:
+            h(event, comm, info)
+        except Exception:
+            pass          # profiler bugs must not break communication

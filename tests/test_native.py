@@ -1,0 +1,56 @@
+"""Native C++ convertor vs NumPy reference (the role test/datatype's
+pack/unpack suite plays in the reference)."""
+import numpy as np
+import pytest
+
+from ompi_tpu.core import convertor
+from ompi_tpu.core.datatype import FLOAT, INT8_T
+from ompi_tpu.native import native_available
+
+
+def test_native_builds():
+    assert native_available(), "g++ toolchain present; native must build"
+
+
+@pytest.mark.parametrize("dt_maker,extent", [
+    (lambda: FLOAT.create_vector(4, 3, 5), 20),       # runs of 3
+    (lambda: FLOAT.create_indexed([2, 1, 4], [0, 3, 6]), 10),
+    (lambda: INT8_T.create_vector(3, 2, 4), 10),      # 1-byte elements
+])
+def test_native_pack_unpack_matches_numpy(rng, dt_maker, extent):
+    t = dt_maker().commit()
+    count = 3
+    rows = 4
+    if t.base == np.int8:
+        buf = rng.integers(-100, 100,
+                           (rows, count * t.extent)).astype(np.int8)
+    else:
+        buf = rng.standard_normal((rows, count * t.extent)).astype(
+            np.float32)
+    idx = t.flat_indices(count)
+
+    packed = convertor.pack(buf, t, count)
+    np.testing.assert_array_equal(packed, buf[..., idx])
+
+    out = np.zeros_like(buf)
+    out = convertor.unpack(out, packed, t, count)
+    expect = np.zeros_like(buf)
+    expect[..., idx] = buf[..., idx]
+    np.testing.assert_array_equal(out, expect)
+
+
+def test_runs_coalescing():
+    t = FLOAT.create_vector(2, 3, 5).commit()     # idx 0,1,2,5,6,7
+    offs, lens = t.runs()
+    np.testing.assert_array_equal(offs, [0, 5])
+    np.testing.assert_array_equal(lens, [3, 3])
+
+
+def test_fallback_without_native(rng, monkeypatch):
+    import ompi_tpu.native.loader as L
+    monkeypatch.setattr(L, "_lib", None)
+    monkeypatch.setattr(L, "_tried", True)       # pretend build failed
+    t = FLOAT.create_vector(3, 2, 4).commit()
+    buf = rng.standard_normal((2, 2 * t.extent)).astype(np.float32)
+    packed = convertor.pack(buf, t, 2)
+    np.testing.assert_array_equal(packed, buf[..., t.flat_indices(2)])

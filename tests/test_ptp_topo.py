@@ -1,0 +1,178 @@
+"""Point-to-point matching engine + process topologies."""
+import numpy as np
+import pytest
+
+import ompi_tpu as MPI
+from ompi_tpu.topo import dims_create
+
+
+def test_send_recv_basic(world):
+    data = np.arange(4, dtype=np.float32)
+    world.send(data, src=0, dest=3, tag=7)
+    got, st = world.recv(source=0, tag=7, dst=3)
+    np.testing.assert_array_equal(got, data)
+    assert st.source == 0 and st.tag == 7
+
+
+def test_matching_any_source_any_tag(world):
+    world.send(np.float32(1.0), src=2, dest=0, tag=5)
+    world.send(np.float32(2.0), src=1, dest=0, tag=9)
+    got, st = world.recv(source=MPI.ANY_SOURCE, tag=9)
+    assert got == 2.0 and st.source == 1
+    got, st = world.recv(source=MPI.ANY_SOURCE, tag=MPI.ANY_TAG)
+    assert got == 1.0 and st.source == 2 and st.tag == 5
+
+
+def test_non_overtaking_order(world):
+    for i in range(3):
+        world.send(np.int32(i), src=4, dest=0, tag=1)
+    for i in range(3):
+        got, _ = world.recv(source=4, tag=1)
+        assert got == i                      # FIFO per (src, tag)
+
+
+def test_irecv_then_send(world):
+    req = world.irecv(source=5, tag=3)
+    assert req.test() == (False, None)
+    world.send(np.float32(42.0), src=5, dest=0, tag=3)
+    ok, st = req.test()
+    assert ok and req.get() == 42.0
+
+
+def test_recv_deadlock_detected(world):
+    with pytest.raises(MPI.MPIError):
+        world.recv(source=6, tag=123)
+
+
+def test_probe_iprobe_mprobe(world):
+    assert world.iprobe(source=1, tag=2) == (False, None)
+    world.send(np.arange(3), src=1, dest=0, tag=2)
+    ok, st = world.iprobe(source=1, tag=2)
+    assert ok and st.count == 3
+    msg = world.mprobe(source=1, tag=2)
+    assert world.iprobe(source=1, tag=2) == (False, None)  # removed
+    data, st = world.mrecv(msg)
+    np.testing.assert_array_equal(data, np.arange(3))
+
+
+def test_sendrecv_and_proc_null(world):
+    got, st = world.sendrecv(np.float32(5.0), src=0, dest=0,
+                             recvsource=0, sendtag=4, recvtag=4)
+    assert got == 5.0
+    world.send(np.float32(1.0), src=0, dest=MPI.PROC_NULL)  # no-op
+    req = world.irecv(source=MPI.PROC_NULL)
+    assert req.test()[0] and req.get() is None
+
+
+def test_device_row_transfer(world):
+    buf = world.alloc((4,), np.float32, fill=3.0)
+    world.send(buf[2], src=2, dest=0, tag=11)
+    got, _ = world.recv(source=2, tag=11)
+    np.testing.assert_allclose(np.asarray(got), 3.0)
+
+
+def test_partitioned_ptp(world):
+    parts = [np.full(2, i, np.float32) for i in range(3)]
+    sreq = world.psend_init(parts, dest=1, tag=6)
+    rreq = world.precv_init(source=0, tag=6, partitions=3, dst=1)
+    sreq.start()
+    rreq.start()
+    assert rreq.test() == (False, None)
+    sreq.pready(0)
+    sreq.pready_range(1, 2)
+    assert sreq.test()[0]
+    assert rreq.parrived(2)
+    ok, _ = rreq.test()
+    assert ok
+    np.testing.assert_array_equal(rreq.get()[1], parts[1])
+
+
+def test_dims_create():
+    assert sorted(dims_create(12, 2)) == [3, 4]
+    assert dims_create(8, 3) == [2, 2, 2]
+    assert dims_create(6, 2, [3, 0]) == [3, 2]
+
+
+def test_cart_topology(world):
+    cart = world.create_cart([2, 4], periods=[True, False])
+    assert cart.size == 8
+    assert cart.cart_rank([1, 2]) == 6
+    assert cart.cart_coords(6) == (1, 2)
+    # periodic dim 0 wraps, non-periodic dim 1 hits PROC_NULL
+    src, dst = cart.cart_shift(0, 0, 1)
+    assert (src, dst) == (4, 4)
+    src, dst = cart.cart_shift(0, 1, 1)
+    assert src == -2 and dst == 1
+    subs = cart.cart_sub([False, True])     # keep dim 1 -> rows of 4
+    assert subs[0].size == 4
+    assert subs[0] is subs[1]
+
+
+def test_cart_neighbor_allgather(world):
+    cart = world.create_cart([8], periods=[True])
+    x = np.arange(8, dtype=np.float32)[:, None]
+    outs = cart.neighbor_allgather(cart.stack(list(x)))
+    # rank 0 neighbors on a periodic ring: [7, 1]
+    np.testing.assert_array_equal(outs[0].ravel(), [7.0, 1.0])
+
+
+def test_graph_topology_neighbor_alltoall(world):
+    # 3-node graph: 0<->1, 1<->2 (undirected, CSR index/edges)
+    g = world.create_graph(index=[1, 3, 4], edges=[1, 0, 2, 1])
+    assert g.graph_neighbors(1) == [0, 2]
+    send = np.zeros((3, 2, 1), np.float32)
+    send[0, 0] = 10          # 0 -> its neighbor 1
+    send[1, 0] = 21          # 1 -> 0
+    send[1, 1] = 22          # 1 -> 2
+    send[2, 0] = 32          # 2 -> 1
+    outs = g.neighbor_alltoall(g.stack(list(send)))
+    np.testing.assert_array_equal(outs[0].ravel(), [21.0])
+    np.testing.assert_array_equal(outs[1].ravel(), [10.0, 32.0])
+    np.testing.assert_array_equal(outs[2].ravel(), [22.0])
+
+
+def test_matching_isolated_by_destination(world):
+    """A recv by one rank must never consume a message addressed to
+    another rank (FIFO per (source, dest))."""
+    world.send(np.float32(10.0), src=0, dest=1, tag=0)
+    world.send(np.float32(20.0), src=0, dest=2, tag=0)
+    got, _ = world.recv(source=0, tag=0, dst=2)
+    assert got == 20.0
+    got, _ = world.recv(source=0, tag=0, dst=1)
+    assert got == 10.0
+
+
+def test_ssend_semantics(world):
+    with pytest.raises(MPI.MPIError):
+        world.ssend(np.float32(1.0), src=0, dest=1, tag=2)  # no recv
+    req = world.irecv(source=0, tag=2, dst=1)
+    world.ssend(np.float32(5.0), src=0, dest=1, tag=2)      # recv posted
+    assert req.test()[0] and req.get() == 5.0
+
+
+def test_partitioned_no_collision_with_user_tags(world):
+    """Partitioned fragments ride a separate channel: user sends with
+    any int tag can never satisfy a partition, and ANY_TAG recvs never
+    see partition fragments."""
+    sreq = world.psend_init([np.float32(1.0)], dest=1, tag=0)
+    rreq = world.precv_init(source=0, tag=0, partitions=1, dst=1)
+    rreq.start()
+    world.send(np.float32(99.0), src=0, dest=1, tag=0)      # user traffic
+    assert rreq.test() == (False, None)                     # not matched
+    sreq.start()
+    sreq.pready(0)
+    assert rreq.test()[0] and rreq.get()[0] == 1.0
+    got, _ = world.recv(source=0, tag=MPI.ANY_TAG, dst=1)   # user msg
+    assert got == 99.0
+
+
+def test_neighbor_alltoall_duplicate_edges(world):
+    """Periodic ring of size 2: both neighbors of each rank are the same
+    rank — chunks must not overwrite each other."""
+    cart2 = world.create_cart([2], periods=[True])
+    send = np.zeros((2, 2, 1), np.float32)
+    send[0, 0], send[0, 1] = 1, 2     # rank 0 -> rank 1 twice
+    send[1, 0], send[1, 1] = 3, 4     # rank 1 -> rank 0 twice
+    outs = cart2.neighbor_alltoall(cart2.stack(list(send)))
+    np.testing.assert_array_equal(outs[0].ravel(), [3.0, 4.0])
+    np.testing.assert_array_equal(outs[1].ravel(), [1.0, 2.0])
