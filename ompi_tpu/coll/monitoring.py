@@ -14,7 +14,6 @@ from collections import defaultdict
 from typing import Dict, Tuple
 
 from ompi_tpu.coll.framework import COLL_FUNCS, coll_framework
-from ompi_tpu.coll.tuned import TunedCollModule, _load_rules
 from ompi_tpu.mca import var
 from ompi_tpu.mca.base import Component
 
@@ -41,19 +40,12 @@ def reset() -> None:
 
 
 class MonitoringCollModule:
-    """Pass-through wrapper over the tuned decision module."""
+    """Pass-through wrapper over whatever module selection actually
+    chose (next-highest priority after monitoring itself)."""
 
-    def __init__(self, comm, inner: TunedCollModule):
+    def __init__(self, comm, inner):
         self.comm = comm
         self.inner = inner
-
-    def _wrap(self, func: str):
-        inner_fn = getattr(self.inner, func)
-
-        def wrapped(buf, *args):
-            record(self.comm.cid, func, int(getattr(buf, "nbytes", 0)))
-            return inner_fn(buf, *args)
-        return wrapped
 
     def barrier(self) -> None:
         record(self.comm.cid, "barrier", 0)
@@ -61,7 +53,11 @@ class MonitoringCollModule:
 
     def ibarrier(self):
         record(self.comm.cid, "barrier", 0)
-        return self.inner.ibarrier()
+        inner_ib = getattr(self.inner, "ibarrier", None)
+        if inner_ib is not None:
+            return inner_ib()
+        self.inner.barrier()
+        return None
 
 
 for _f in COLL_FUNCS:
@@ -91,10 +87,23 @@ class MonitoringCollComponent(Component):
             return None
         if not getattr(comm, "mesh", None):
             return None
-        rules = _load_rules(var.var_get("coll_tuned_dynamic_rules", ""))
-        inner = TunedCollModule(comm, rules)
+        # Interpose over the module selection would otherwise pick: query
+        # every other allowed component and take the priority winner —
+        # this respects coll_base_include exactly as the reference's
+        # monitoring interposition respects normal selection.
+        best = None
+        for c in coll_framework._allowed():
+            if c.name == self.name:
+                continue
+            res = c.comm_query(comm)
+            if res is None or res[0] < 0:
+                continue
+            if best is None or res[0] > best[0]:
+                best = res
+        if best is None:
+            return None
         prio = var.var_get("coll_monitoring_priority", 90)
-        return (prio, MonitoringCollModule(comm, inner))
+        return (prio, MonitoringCollModule(comm, best[1]))
 
 
 coll_framework.register(MonitoringCollComponent())

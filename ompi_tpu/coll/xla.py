@@ -86,13 +86,149 @@ class XlaCollModule:
     def _key(self, func: str, x, *extra) -> Tuple:
         return (func, x.shape, str(x.dtype), *extra)
 
+    # -- algorithm registry (re-design of coll_base_functions.h:185-320
+    # + tuned decision functions): the MCA var coll_xla_allreduce_algorithm
+    # picks {auto, direct, ring, hier}. 'direct' is one fused XLA
+    # collective (XLA schedules its own ICI-optimal ring/tree). 'ring' is
+    # an explicit segmented ring over ppermute — reduce-scatter phase then
+    # allgather phase, the classic coll_base_allreduce_intra_ring
+    # (:345) expressed as a lax.scan of shifts. 'hier' is the han-style
+    # two-level composition (coll_han.h:180-195): reduce_scatter within
+    # a group, allreduce across groups, allgather within — implemented
+    # with axis_index_groups so intra-group traffic stays on the fast
+    # tier (ICI) and only the scattered chunk crosses the slow tier
+    # (DCN), for multi-host meshes.
+    def _algorithm(self) -> str:
+        alg = var.var_get("coll_xla_allreduce_algorithm", "auto")
+        if alg != "auto":
+            return alg
+        procs = {getattr(d, "process_index", 0) for d in self.comm.devices}
+        return "hier" if len(procs) > 1 else "direct"
+
+    def _groups(self):
+        """(low, high) axis_index_groups: low = ranks sharing a process
+        (ICI tier), high = one rank per process (DCN tier). Falls back to
+        a balanced factorization on single-host meshes (for testing and
+        for multi-NUMA boards)."""
+        n = self.comm.size
+        by_proc = {}
+        for r, d in enumerate(self.comm.devices):
+            by_proc.setdefault(getattr(d, "process_index", 0), []).append(r)
+        groups = list(by_proc.values())
+        if len(groups) == 1:
+            g = 1
+            for f in range(int(n ** 0.5), 0, -1):
+                if n % f == 0:
+                    g = f
+                    break
+            groups = [list(range(i, i + g)) for i in range(0, n, g)]
+        size = len(groups[0])
+        if any(len(gr) != size for gr in groups):
+            return None, None            # ragged: hier not applicable
+        low = groups
+        high = [[gr[i] for gr in groups] for i in range(size)]
+        return low, high
+
+    def _ring_allreduce_inner(self, op, n, shape, dtype):
+        """Explicit segmented ring (2(n-1) ppermute steps). Operates on
+        the flattened buffer padded to n chunks; supports any op (the
+        chunk combine is op.fn)."""
+        total = int(np.prod(shape))
+        chunk = -(-total // n)           # ceil
+        perm = [(i, (i + 1) % n) for i in range(n)]
+
+        def inner(b):                    # block (1, *s)
+            x = b.reshape(-1)
+            x = jnp.pad(x, (0, n * chunk - total))
+            buf = x.reshape(n, chunk)
+            r = jax.lax.axis_index(AXIS)
+
+            def rs_step(buf, t):
+                send_idx = jnp.mod(r - t, n)
+                send = jax.lax.dynamic_index_in_dim(buf, send_idx, 0,
+                                                    keepdims=False)
+                recvd = jax.lax.ppermute(send, AXIS, perm=perm)
+                tgt = jnp.mod(r - t - 1, n)
+                cur = jax.lax.dynamic_index_in_dim(buf, tgt, 0,
+                                                   keepdims=False)
+                buf = jax.lax.dynamic_update_index_in_dim(
+                    buf, op.fn(cur, recvd), tgt, 0)
+                return buf, None
+
+            buf, _ = jax.lax.scan(rs_step, buf, jnp.arange(n - 1))
+            # rank r now owns the fully reduced chunk (r+1) mod n
+            own = jnp.mod(r + 1, n)
+            cur = jax.lax.dynamic_index_in_dim(buf, own, 0, keepdims=False)
+
+            def ag_step(carry, t):
+                buf, cur = carry
+                cur = jax.lax.ppermute(cur, AXIS, perm=perm)
+                idx = jnp.mod(r - t, n)
+                buf = jax.lax.dynamic_update_index_in_dim(buf, cur, idx, 0)
+                return (buf, cur), None
+
+            buf = jax.lax.dynamic_update_index_in_dim(buf, cur, own, 0)
+            (buf, _), _ = jax.lax.scan(ag_step, (buf, cur),
+                                       jnp.arange(n - 1))
+            return buf.reshape(-1)[:total].reshape(b.shape)
+        return inner
+
+    def _hier_allreduce_inner(self, op, low, high):
+        """han-style two-level: rs(low) -> ar(high) -> ag(low). Only the
+        sum path uses psum_scatter; other ops go through the generic
+        gather+fold on each tier."""
+        glen = len(low[0])
+
+        def inner(b):                    # block (1, *s)
+            x = b[0]
+            shape = x.shape
+            total = x.size
+            chunk = -(-total // glen)
+            flat = jnp.pad(x.reshape(-1), (0, glen * chunk - total))
+            if op.xla_prim == "sum":
+                part = jax.lax.psum_scatter(
+                    flat.reshape(glen, chunk), AXIS, scatter_dimension=0,
+                    tiled=True, axis_index_groups=low)[0]
+                # cross-tier allreduce (psum+groups lacks a shard_map
+                # lowering; gather+local-sum compiles to the same ICI
+                # schedule for the small scattered chunk)
+                g_hi = jax.lax.all_gather(part, AXIS,
+                                          axis_index_groups=high)
+                part = jnp.sum(g_hi, axis=0)
+                out = jax.lax.all_gather(part, AXIS, tiled=True,
+                                         axis_index_groups=low)
+            else:
+                g1 = jax.lax.all_gather(flat, AXIS,
+                                        axis_index_groups=low)
+                red = op.reduce_tree(g1, axis=0)
+                g2 = jax.lax.all_gather(red, AXIS,
+                                        axis_index_groups=high)
+                out = op.reduce_tree(g2, axis=0)
+            return out.reshape(-1)[:total].reshape(shape)[None]
+        return inner
+
     # -- collectives -----------------------------------------------------
     def allreduce(self, x, op):
         x = self._to_mesh(x)
         n = self.comm.size
+        alg = self._algorithm()
+        if alg == "ring" and not op.commute:
+            # The ring reorders combines; the reference documents the
+            # same commutativity constraint (coll_base_allreduce.c:291).
+            alg = "direct"
+        low = high = None
+        if alg == "hier":
+            low, high = self._groups()
+            if low is None:
+                alg = "direct"
 
         def build():
-            if op.xla_prim == "sum":
+            if alg == "ring":
+                inner = self._ring_allreduce_inner(op, n, x.shape[1:],
+                                                   x.dtype)
+            elif alg == "hier":
+                inner = self._hier_allreduce_inner(op, low, high)
+            elif op.xla_prim == "sum":
                 inner = lambda b: jax.lax.psum(b, AXIS)
             elif op.xla_prim == "max":
                 inner = lambda b: jax.lax.pmax(b, AXIS)
@@ -103,7 +239,8 @@ class XlaCollModule:
                     g = jax.lax.all_gather(b, AXIS, axis=0, tiled=True)
                     return op.reduce_tree(g, axis=0)[None]
             return self._smap(inner, x.ndim, x.ndim)
-        return self._compiled(self._key("allreduce", x, op.name, n), build)(x)
+        return self._compiled(
+            self._key("allreduce", x, op.name, n, alg), build)(x)
 
     def reduce(self, x, op, root: int):
         # All-ranks result satisfies "recvbuf significant only at root";
@@ -241,6 +378,13 @@ class XlaCollComponent(Component):
         var.var_register("coll", "xla", "priority", vtype="int", default=40,
                          help="Selection priority of the XLA-native "
                               "collective component")
+        var.var_register(
+            "coll", "xla", "allreduce_algorithm", vtype="str",
+            default="auto", enumerator=["auto", "direct", "ring", "hier"],
+            help="Allreduce lowering: direct fused XLA collective, "
+                 "explicit ppermute segmented ring, or han-style "
+                 "two-level hierarchy (auto: hier on multi-host, else "
+                 "direct)")
 
     def comm_query(self, comm):
         if comm is None or not getattr(comm, "mesh", None):

@@ -386,41 +386,47 @@ class Communicator:
             eng = self._pml_engine = MatchingEngine(self)
         return eng
 
+    def _record_pml(self, event: str) -> None:
+        from ompi_tpu.runtime import spc
+        from ompi_tpu.utils import hooks
+        spc.record(event, 1)
+        hooks.fire(event, self, {})
+
     def send(self, data, src: int, dest: int, tag: int = 0) -> None:
         """MPI_Send from rank ``src`` to ``dest`` (single-controller: the
         sender rank is explicit; ``data`` is that rank's local buffer)."""
         self._check()
-        from ompi_tpu.runtime import spc
-        spc.record("pml_send", 1)
+        self._record_pml("pml_send")
         self._pml.send(data, src, dest, tag)
 
     def isend(self, data, src: int, dest: int, tag: int = 0) -> Request:
         self._check()
+        self._record_pml("pml_send")
         return self._pml.send(data, src, dest, tag)
 
     def ssend(self, data, src: int, dest: int, tag: int = 0) -> None:
         """MPI_Ssend: completes only if the receive has started; raises
         the deadlock otherwise (single-controller semantics)."""
         self._check()
+        self._record_pml("pml_send")
         self._pml.send(data, src, dest, tag, synchronous=True)
 
     def bsend(self, data, src: int, dest: int, tag: int = 0) -> None:
         """MPI_Bsend: the payload is buffered (copied) at send time."""
         self._check()
-        buffered = (np.array(data, copy=True)
-                    if isinstance(data, np.ndarray) else data)
-        self._pml.send(buffered, src, dest, tag)
+        self._record_pml("pml_send")
+        self._pml.send(data, src, dest, tag)
 
     def recv(self, source: int, tag: int = -1, *, dst: int = 0):
         """MPI_Recv executed by rank ``dst``: returns (data, Status).
         Raises instead of deadlocking if no matching send was posted."""
         self._check()
-        from ompi_tpu.runtime import spc
-        spc.record("pml_recv", 1)
+        self._record_pml("pml_recv")
         return self._pml.recv(dst, source, tag)
 
     def irecv(self, source: int, tag: int = -1, *, dst: int = 0) -> Request:
         self._check()
+        self._record_pml("pml_recv")
         return self._pml.irecv(dst, source, tag)
 
     def sendrecv(self, senddata, src: int, dest: int, recvsource: int,
@@ -428,6 +434,8 @@ class Communicator:
         """MPI_Sendrecv executed by rank ``src``: post the send, then
         receive (deadlock-free by construction, as in the reference)."""
         self._check()
+        self._record_pml("pml_send")
+        self._record_pml("pml_recv")
         self._pml.send(senddata, src, dest, sendtag)
         return self._pml.recv(src, recvsource, recvtag)
 

@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 
 from ompi_tpu.parallel import InGraphComm
+from ompi_tpu.parallel.ring_attention import ring_attention
 
 
 @dataclass(frozen=True)
@@ -80,9 +81,13 @@ def _rmsnorm(x, g):
 
 
 def forward(params: Dict, tokens, cfg: Config,
-            tp_comm: Optional[InGraphComm] = None):
+            tp_comm: Optional[InGraphComm] = None,
+            sp_comm: Optional[InGraphComm] = None):
     """Causal LM forward. ``tp_comm`` set => heads/d_ff leaves are local
-    tp shards and row-parallel outputs are psum'ed over the tp axis."""
+    tp shards and row-parallel outputs are psum'ed over the tp axis.
+    ``sp_comm`` set => ``tokens`` is this rank's sequence block and
+    attention runs as ring attention over the sp axis (K/V circulate by
+    ppermute) — long-context via sequence parallelism."""
     rep, tpp = params["rep"], params["tp"]
     x = rep["emb"][tokens].astype(cfg.dtype)          # (B, S, D)
     B, S, D = x.shape
@@ -95,12 +100,15 @@ def forward(params: Dict, tokens, cfg: Config,
         qkv = jnp.einsum("bsd,dchk->bcshk", h,
                          lt["wqkv"].astype(cfg.dtype))  # (B,3,S,hl,dh)
         q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
-        att = jnp.einsum("bshk,bthk->bhst", q, k) / jnp.sqrt(
-            jnp.asarray(cfg.d_head, cfg.dtype))
-        att = jnp.where(causal[None, None], att, -1e9)
-        att = jax.nn.softmax(att.astype(jnp.float32), axis=-1).astype(
-            cfg.dtype)
-        o = jnp.einsum("bhst,bthk->bshk", att, v)      # (B,S,hl,dh)
+        if sp_comm is not None:
+            o = ring_attention(q, k, v, sp_comm, causal=True)
+        else:
+            att = jnp.einsum("bshk,bthk->bhst", q, k) / jnp.sqrt(
+                jnp.asarray(cfg.d_head, cfg.dtype))
+            att = jnp.where(causal[None, None], att, -1e9)
+            att = jax.nn.softmax(att.astype(jnp.float32), axis=-1).astype(
+                cfg.dtype)
+            o = jnp.einsum("bhst,bthk->bshk", att, v)  # (B,S,hl,dh)
         o = jnp.einsum("bshk,hkd->bsd", o, lt["wo"].astype(cfg.dtype))
         if tp_comm is not None:
             o = tp_comm.reduce_out(o)                  # row-parallel sum
@@ -119,27 +127,34 @@ def forward(params: Dict, tokens, cfg: Config,
     return logits
 
 
-def loss_fn(params, tokens, cfg: Config,
-            tp_comm: Optional[InGraphComm] = None):
-    """Next-token cross-entropy (mean over local batch shard)."""
-    logits = forward(params, tokens[:, :-1], cfg, tp_comm)
-    targets = tokens[:, 1:]
+def loss_fn(params, inputs, targets, cfg: Config,
+            tp_comm: Optional[InGraphComm] = None,
+            sp_comm: Optional[InGraphComm] = None):
+    """Next-token cross-entropy (mean over the local batch/sequence
+    shard). Callers pre-shift: inputs = tokens[:, :-1], targets =
+    tokens[:, 1:] — pre-shifting keeps sequence-parallel blocks aligned
+    (each sp rank's targets are its own block of the shifted stream)."""
+    logits = forward(params, inputs, cfg, tp_comm, sp_comm)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
     return jnp.mean(nll)
 
 
-def sgd_train_step(params, tokens, cfg: Config, lr: float,
+def sgd_train_step(params, batch, cfg: Config, lr: float,
                    dp_comm: Optional[InGraphComm] = None,
-                   tp_comm: Optional[InGraphComm] = None):
-    """One DP x TP training step. Gradient synchronization follows the
-    strategy table (SURVEY.md §2.6): grads allreduced (mean) over dp;
-    tp correctness comes from the f/g operators inside ``forward``."""
-    loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg, tp_comm)
-    # No explicit tp gradient sync needed: the Megatron f/g operators in
-    # ``forward`` make replicated-leaf grads exact per shard.
-    if dp_comm is not None:
-        grads = jax.tree_util.tree_map(lambda g: dp_comm.pmean(g), grads)
-        loss = dp_comm.pmean(loss)
+                   tp_comm: Optional[InGraphComm] = None,
+                   sp_comm: Optional[InGraphComm] = None):
+    """One DP x TP x SP training step. Gradient synchronization follows
+    the strategy table (SURVEY.md §2.6): grads allreduced (mean) over dp
+    and over sp (each sp rank saw 1/n of the sequence); tp correctness
+    comes from the Megatron f/g operators inside ``forward``.
+    ``batch`` = (inputs, targets), pre-shifted."""
+    inputs, targets = batch
+    loss, grads = jax.value_and_grad(loss_fn)(params, inputs, targets,
+                                              cfg, tp_comm, sp_comm)
+    for comm in (sp_comm, dp_comm):
+        if comm is not None:
+            grads = jax.tree_util.tree_map(lambda g: comm.pmean(g), grads)
+            loss = comm.pmean(loss)
     params = jax.tree_util.tree_map(lambda p, g: p - lr * g, params, grads)
     return params, loss

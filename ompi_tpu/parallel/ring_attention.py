@@ -1,0 +1,87 @@
+"""Ring attention — sequence/context parallelism over a mesh axis.
+
+The reference's machinery for scaling one operation beyond a single
+buffer is message segmentation with pipelined ring rounds and
+double-buffered ring steps (SURVEY.md §5 long-context:
+``coll_base_allreduce.c:351-357``, pipeline/chain bcast). Ring attention
+is exactly that schedule applied to attention: each sequence-parallel
+rank holds one block of Q/K/V; K/V blocks circulate around the ring
+(one ``ppermute`` per step — ICI neighbor traffic only, overlapped by
+XLA with the local attention compute), while a flash-style online
+softmax (running max/denominator) accumulates exact results blockwise.
+
+Causality is handled per step from the circulating block's origin index:
+blocks from later positions are fully masked, the diagonal block gets
+the triangular mask, earlier blocks attend fully. The result is
+numerically exact full attention over the global sequence with O(S/n)
+memory per rank.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ompi_tpu.parallel.ingraph import InGraphComm
+
+_NEG = -1e30
+
+
+def ring_attention(q, k, v, sp: InGraphComm, *, causal: bool = True,
+                   scale: float | None = None):
+    """Blockwise-exact attention with K/V ring rotation.
+
+    Args:
+      q, k, v: local blocks ``(B, S_local, H, D)`` on the ``sp`` axis
+        (rank i holds global positions [i*S_local, (i+1)*S_local)).
+      sp: the sequence-parallel in-graph communicator (static size).
+      causal: apply the global causal mask.
+    Returns the local output block ``(B, S_local, H, D)``.
+    """
+    n = sp._size
+    if n is None:
+        raise ValueError("ring_attention needs InGraphComm(axis, size)")
+    B, S, H, D = q.shape
+    if scale is None:
+        scale = D ** -0.5
+    r = sp.rank()
+    q32 = q.astype(jnp.float32) * scale
+
+    def block(acc, k_cur, v_cur, src):
+        """One online-softmax update of the accumulators against the
+        K/V block whose global origin is block ``src``."""
+        o, m, l = acc
+        s = jnp.einsum("bqhd,bkhd->bhqk", q32,
+                       k_cur.astype(jnp.float32))
+        if causal:
+            tri = jnp.tril(jnp.ones((S, S), jnp.bool_))[None, None]
+            allow = jnp.where(src < r, jnp.bool_(True),
+                              jnp.where(src == r, tri, jnp.bool_(False)))
+            s = jnp.where(allow, s, _NEG)
+        m_new = jnp.maximum(m, s.max(axis=-1))            # (B,H,S)
+        p = jnp.exp(s - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + p.sum(axis=-1)
+        o_new = (o * corr[..., None]
+                 + jnp.einsum("bhqk,bkhd->bhqd", p,
+                              v_cur.astype(jnp.float32)))
+        return (o_new, m_new, l_new)
+
+    # Resident diagonal block first, then n-1 rotate-then-attend steps —
+    # no wasted final rotation (scan bodies are not DCE'd by XLA).
+    acc0 = block((jnp.zeros((B, H, S, D), jnp.float32),
+                  jnp.full((B, H, S), _NEG, jnp.float32),
+                  jnp.zeros((B, H, S), jnp.float32)), k, v, r)
+
+    def step(carry, t):
+        o, m, l, k_cur, v_cur = carry
+        k_cur = sp.ring_shift(k_cur, 1)       # double-buffered ring step
+        v_cur = sp.ring_shift(v_cur, 1)
+        src = jnp.mod(r - t - 1, n)           # origin block after t+1 hops
+        o, m, l = block((o, m, l), k_cur, v_cur, src)
+        return (o, m, l, k_cur, v_cur), None
+
+    (o, m, l, _, _), _ = jax.lax.scan(step, acc0 + (k, v),
+                                      jnp.arange(n - 1))
+    l = jnp.where(l == 0.0, 1.0, l)          # fully-masked rows (none
+    o = o / l[..., None]                     # in causal ring, but safe)
+    return jnp.transpose(o, (0, 2, 1, 3)).astype(q.dtype)

@@ -128,6 +128,12 @@ class MatchingEngine:
         if channel == CH_P2P and (not isinstance(tag, int) or tag < 0):
             raise MPIError(ERR_TAG, f"send tag must be an int >= 0, "
                                     f"got {tag!r}")
+        import numpy as _np
+        if isinstance(data, _np.ndarray):
+            # MPI guarantees the send buffer is reusable the moment send
+            # returns; mutable host arrays are snapshotted (the eager
+            # copy). Device arrays are immutable — reference suffices.
+            data = data.copy()
         msg = _Msg(src, dest, tag, data, synchronous, channel)
         for i, pr in enumerate(self.posted):
             if pr.matches(msg):
@@ -136,16 +142,15 @@ class MatchingEngine:
                 req = Request.completed()
                 req.status.count = 1
                 return req
-        self._q(dest, src).append(msg)
         if synchronous:
             # MPI_Ssend completes only once the receive has started; in a
             # single-controller world an unmatched synchronous send can
             # never complete — surface the deadlock.
-            self._q(dest, src).pop()
             raise MPIError(
                 ERR_PENDING,
                 "ssend would deadlock: no matching receive posted "
                 "(post irecv first)")
+        self._q(dest, src).append(msg)
         return Request.completed()
 
     # -- receive side --------------------------------------------------

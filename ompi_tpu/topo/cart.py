@@ -47,8 +47,10 @@ def dims_create(nnodes: int, ndims: int,
         if i is None:
             break
         vals[i] *= f
-    for i in free:
-        out[i] = vals[i]
+    # MPI mandates the computed dimensions appear in non-increasing
+    # order across the free slots.
+    for i, v in zip(free, sorted(vals.values(), reverse=True)):
+        out[i] = v
     return out
 
 

@@ -48,6 +48,9 @@ def test_monitoring_component(world, monkeypatch):
         snap = monitoring.snapshot()
         calls, nbytes = snap[(d.cid, "allreduce")]
         assert calls == 2 and nbytes == 2 * x.nbytes
+        # interposes over whatever selection would otherwise pick
+        from ompi_tpu.coll.tuned import TunedCollModule
+        assert isinstance(d.c_coll["allreduce"].inner, TunedCollModule)
     finally:
         var.var_set("coll_monitoring_enable", False)
 

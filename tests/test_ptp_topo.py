@@ -88,7 +88,8 @@ def test_partitioned_ptp(world):
 
 
 def test_dims_create():
-    assert sorted(dims_create(12, 2)) == [3, 4]
+    assert dims_create(12, 2) == [4, 3]        # MPI: non-increasing
+    assert dims_create(24, 3) == [4, 3, 2]
     assert dims_create(8, 3) == [2, 2, 2]
     assert dims_create(6, 2, [3, 0]) == [3, 2]
 
@@ -176,3 +177,12 @@ def test_neighbor_alltoall_duplicate_edges(world):
     outs = cart2.neighbor_alltoall(cart2.stack(list(send)))
     np.testing.assert_array_equal(outs[0].ravel(), [3.0, 4.0])
     np.testing.assert_array_equal(outs[1].ravel(), [1.0, 2.0])
+
+
+def test_send_buffer_reusable_after_send(world):
+    """MPI guarantees the send buffer may be reused once send returns."""
+    a = np.arange(4, dtype=np.float32)
+    world.send(a, src=0, dest=1, tag=33)
+    a[:] = -1.0
+    got, _ = world.recv(source=0, tag=33, dst=1)
+    np.testing.assert_array_equal(got, [0, 1, 2, 3])
