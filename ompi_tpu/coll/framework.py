@@ -34,7 +34,8 @@ def _ensure_components() -> None:
 
 def comm_select_coll(comm) -> Dict[str, Any]:
     """Build the c_coll vtable for ``comm``: highest-priority provider per
-    collective function."""
+    collective function; when monitoring is enabled, wrap every slot in
+    the counting shim (which delegates to the slot's real winner)."""
     _ensure_components()
     selected = coll_framework.comm_select(comm)   # descending priority
     vtable: Dict[str, Any] = {}
@@ -43,4 +44,7 @@ def comm_select_coll(comm) -> Dict[str, Any]:
             if getattr(module, func, None) is not None:
                 vtable[func] = module
                 break
+    from ompi_tpu.coll import monitoring
+    if vtable and monitoring.enabled():
+        vtable = monitoring.wrap_vtable(comm, vtable)
     return vtable

@@ -1,17 +1,19 @@
-"""coll/monitoring — interposition component counting operations and
-bytes per collective per communicator.
+"""coll/monitoring — interposition counting operations and bytes per
+collective per communicator.
 
 Mirrors the reference's monitoring stack (pml/coll/osc ``monitoring``
 components aggregated by ``ompi/mca/common/monitoring``): when enabled
-(MCA var ``coll_monitoring_enable``), it wins selection at high priority,
-wraps the real decision module (tuned), counts every call's payload
-bytes, and passes through. Results are read through pvars / the info
-tool (the MPI_T path the reference uses)."""
+(MCA var ``coll_monitoring_enable``), the selection composer wraps the
+communicator's *per-function vtable* — each call is counted and passed
+through to the function's actual priority winner, preserving the
+framework's per-function backfill (a component providing only
+``barrier`` keeps its slot, monitored). Results are read through pvars /
+the info tool (the MPI_T path the reference uses)."""
 from __future__ import annotations
 
 import threading
 from collections import defaultdict
-from typing import Dict, Tuple
+from typing import Any, Dict, Tuple
 
 from ompi_tpu.coll.framework import COLL_FUNCS, coll_framework
 from ompi_tpu.mca import var
@@ -40,23 +42,24 @@ def reset() -> None:
 
 
 class MonitoringCollModule:
-    """Pass-through wrapper over whatever module selection actually
-    chose (next-highest priority after monitoring itself)."""
+    """Counting shim over a communicator's selected per-function vtable
+    (``vtable``: func name -> the real winning module)."""
 
-    def __init__(self, comm, inner):
+    def __init__(self, comm, vtable: Dict[str, Any]):
         self.comm = comm
-        self.inner = inner
+        self.vtable = vtable
 
     def barrier(self) -> None:
         record(self.comm.cid, "barrier", 0)
-        self.inner.barrier()
+        self.vtable["barrier"].barrier()
 
     def ibarrier(self):
         record(self.comm.cid, "barrier", 0)
-        inner_ib = getattr(self.inner, "ibarrier", None)
+        inner = self.vtable["barrier"]
+        inner_ib = getattr(inner, "ibarrier", None)
         if inner_ib is not None:
             return inner_ib()
-        self.inner.barrier()
+        inner.barrier()
         return None
 
 
@@ -65,13 +68,29 @@ for _f in COLL_FUNCS:
         def _mk(f):
             def method(self, buf, *args):
                 record(self.comm.cid, f, int(getattr(buf, "nbytes", 0)))
-                return getattr(self.inner, f)(buf, *args)
+                return getattr(self.vtable[f], f)(buf, *args)
             method.__name__ = f
             return method
         setattr(MonitoringCollModule, _f, _mk(_f))
 
 
+def wrap_vtable(comm, vtable: Dict[str, Any]) -> Dict[str, Any]:
+    """Called by the selection composer when monitoring is enabled:
+    every selected slot is served by one counting shim that delegates to
+    that slot's winner."""
+    mon = MonitoringCollModule(comm, vtable)
+    return {f: mon for f in vtable}
+
+
+def enabled() -> bool:
+    return bool(var.var_get("coll_monitoring_enable", False))
+
+
 class MonitoringCollComponent(Component):
+    """Registers the MCA vars; the interposition itself happens in the
+    selection composer (coll/framework.py) so per-function backfill is
+    preserved — this component never claims a slot directly."""
+
     name = "monitoring"
 
     def register_params(self):
@@ -79,31 +98,9 @@ class MonitoringCollComponent(Component):
                          default=False,
                          help="Interpose byte/call counters on every "
                               "collective (reference: coll/monitoring)")
-        var.var_register("coll", "monitoring", "priority", vtype="int",
-                         default=90, help="Selection priority when enabled")
 
     def comm_query(self, comm):
-        if comm is None or not var.var_get("coll_monitoring_enable", False):
-            return None
-        if not getattr(comm, "mesh", None):
-            return None
-        # Interpose over the module selection would otherwise pick: query
-        # every other allowed component and take the priority winner —
-        # this respects coll_base_include exactly as the reference's
-        # monitoring interposition respects normal selection.
-        best = None
-        for c in coll_framework._allowed():
-            if c.name == self.name:
-                continue
-            res = c.comm_query(comm)
-            if res is None or res[0] < 0:
-                continue
-            if best is None or res[0] > best[0]:
-                best = res
-        if best is None:
-            return None
-        prio = var.var_get("coll_monitoring_priority", 90)
-        return (prio, MonitoringCollModule(comm, best[1]))
+        return None
 
 
 coll_framework.register(MonitoringCollComponent())

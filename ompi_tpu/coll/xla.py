@@ -129,7 +129,7 @@ class XlaCollModule:
         high = [[gr[i] for gr in groups] for i in range(size)]
         return low, high
 
-    def _ring_allreduce_inner(self, op, n, shape, dtype):
+    def _ring_allreduce_inner(self, op, n, shape):
         """Explicit segmented ring (2(n-1) ppermute steps). Operates on
         the flattened buffer padded to n chunks; supports any op (the
         chunk combine is op.fn)."""
@@ -212,9 +212,10 @@ class XlaCollModule:
         x = self._to_mesh(x)
         n = self.comm.size
         alg = self._algorithm()
-        if alg == "ring" and not op.commute:
-            # The ring reorders combines; the reference documents the
-            # same commutativity constraint (coll_base_allreduce.c:291).
+        if alg in ("ring", "hier") and not op.commute:
+            # Ring and the two-level hierarchy both reorder combines;
+            # the reference documents the same commutativity constraint
+            # (coll_base_allreduce.c:291). 'direct' keeps rank order.
             alg = "direct"
         low = high = None
         if alg == "hier":
@@ -224,8 +225,7 @@ class XlaCollModule:
 
         def build():
             if alg == "ring":
-                inner = self._ring_allreduce_inner(op, n, x.shape[1:],
-                                                   x.dtype)
+                inner = self._ring_allreduce_inner(op, n, x.shape[1:])
             elif alg == "hier":
                 inner = self._hier_allreduce_inner(op, low, high)
             elif op.xla_prim == "sum":

@@ -22,6 +22,18 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 
 
+def coalesce_runs(idx: np.ndarray):
+    """Coalesce a sorted-or-not element-index array into (offsets,
+    lengths) of runs of consecutive indices, preserving order."""
+    idx = np.asarray(idx, dtype=np.int64)
+    if idx.size == 0:
+        return np.empty(0, np.int64), np.empty(0, np.int64)
+    breaks = np.where(np.diff(idx) != 1)[0]
+    starts = np.concatenate(([0], breaks + 1))
+    ends = np.concatenate((breaks, [idx.size - 1]))
+    return idx[starts], (ends - starts + 1).astype(np.int64)
+
+
 class Datatype:
     """An MPI datatype.
 
@@ -170,16 +182,7 @@ class Datatype:
         fast path). Cached after first call."""
         r = getattr(self, "_runs", None)
         if r is None:
-            idx = self.indices
-            if idx.size == 0:
-                r = (np.empty(0, np.int64), np.empty(0, np.int64))
-            else:
-                breaks = np.where(np.diff(idx) != 1)[0]
-                starts = np.concatenate(([0], breaks + 1))
-                ends = np.concatenate((breaks, [idx.size - 1]))
-                r = (idx[starts].astype(np.int64),
-                     (ends - starts + 1).astype(np.int64))
-            self._runs = r
+            r = self._runs = coalesce_runs(self.indices)
         return r
 
     def flat_indices(self, count: int) -> np.ndarray:

@@ -1,0 +1,137 @@
+"""Intercommunicators — two disjoint rank groups communicating
+(mirrors ``ompi/communicator`` intercomm create/merge + ``coll/inter``).
+
+MPI intercomm collective semantics: operations are *between* groups —
+allreduce reduces group A's contributions and delivers the result to
+group B (and vice versa); bcast has a root in one group and receivers in
+the other; alltoall sends local rank i's chunk j to remote rank j.
+
+TPU-native realization: both groups live on one union mesh, so
+inter-group data movement is shard movement on the same ICI fabric —
+each side's reduction runs as a native intracomm collective on its
+sub-mesh and the handoff is a device-to-device restack.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ompi_tpu.core import op as op_mod
+from ompi_tpu.core.communicator import Communicator
+from ompi_tpu.core.errhandler import ERR_ARG, ERR_ROOT, MPIError
+from ompi_tpu.core.group import Group
+
+
+class Intercomm:
+    def __init__(self, local: Communicator, remote: Communicator,
+                 tag: int = 0):
+        overlap = (set(local.group.world_ranks)
+                   & set(remote.group.world_ranks))
+        if overlap:
+            raise MPIError(ERR_ARG,
+                           f"intercomm groups must be disjoint: {overlap}")
+        self.local_comm = local
+        self.remote_comm = remote
+        self.tag = tag
+
+    # -- introspection (MPI_Comm_remote_size / _remote_group) ----------
+    @property
+    def size(self) -> int:
+        return self.local_comm.size
+
+    @property
+    def remote_size(self) -> int:
+        return self.remote_comm.size
+
+    @property
+    def group(self) -> Group:
+        return self.local_comm.group
+
+    @property
+    def remote_group(self) -> Group:
+        return self.remote_comm.group
+
+    def is_inter(self) -> bool:
+        return True
+
+    # -- merge (MPI_Intercomm_merge) -----------------------------------
+    def merge(self, high: bool = False) -> Communicator:
+        """Union intracomm; ``high`` orders the local group last."""
+        a, b = ((self.remote_comm, self.local_comm) if high
+                else (self.local_comm, self.remote_comm))
+        g = Group(a.group.world_ranks + b.group.world_ranks)
+        return Communicator(g, a.devices + b.devices,
+                            name="intercomm.merge",
+                            errhandler=self.local_comm.errhandler)
+
+    # -- collectives (coll/inter semantics) ----------------------------
+    def bcast(self, sendbuf_root, root: int = 0, *,
+              root_side: str = "local"):
+        """Root (rank ``root`` of ``root_side`` group) broadcasts its
+        buffer to every rank of the *other* group; returns the receiving
+        group's stacked buffer."""
+        src_comm = (self.local_comm if root_side == "local"
+                    else self.remote_comm)
+        dst_comm = (self.remote_comm if root_side == "local"
+                    else self.local_comm)
+        if not (0 <= root < src_comm.size):
+            src_comm._err(ERR_ROOT, f"root {root} out of range")
+        data = np.asarray(sendbuf_root)
+        return dst_comm.stack([data] * dst_comm.size)
+
+    def allreduce(self, local_stacked, remote_stacked,
+                  op: op_mod.Op = op_mod.SUM) -> Tuple[Any, Any]:
+        """Each group receives the reduction of the *other* group's
+        contributions: returns (local_out, remote_out)."""
+        lred = self.local_comm.allreduce(local_stacked, op)
+        rred = self.remote_comm.allreduce(remote_stacked, op)
+        lrow = np.asarray(lred)[0]
+        rrow = np.asarray(rred)[0]
+        local_out = self.local_comm.stack([rrow] * self.size)
+        remote_out = self.remote_comm.stack([lrow] * self.remote_size)
+        return local_out, remote_out
+
+    def allgather(self, local_stacked, remote_stacked) -> Tuple[Any, Any]:
+        """Each group receives the concatenation of the other group's
+        buffers."""
+        lh = np.asarray(local_stacked)
+        rh = np.asarray(remote_stacked)
+        local_out = self.local_comm.stack([rh] * self.size)
+        remote_out = self.remote_comm.stack([lh] * self.remote_size)
+        return local_out, remote_out
+
+    def alltoall(self, local_stacked, remote_stacked) -> Tuple[Any, Any]:
+        """Local rank i's chunk j goes to remote rank j (and vice
+        versa). local_stacked: (lsize, rsize, *s); remote: (rsize,
+        lsize, *s)."""
+        lh = np.asarray(local_stacked)
+        rh = np.asarray(remote_stacked)
+        if lh.shape[1] != self.remote_size or rh.shape[1] != self.size:
+            raise MPIError(ERR_ARG, "alltoall chunk counts must match "
+                                    "the remote group size")
+        local_out = self.local_comm.stack(
+            [np.stack([rh[j, i] for j in range(self.remote_size)])
+             for i in range(self.size)])
+        remote_out = self.remote_comm.stack(
+            [np.stack([lh[i, j] for i in range(self.size)])
+             for j in range(self.remote_size)])
+        return local_out, remote_out
+
+    def barrier(self) -> None:
+        self.local_comm.barrier()
+        self.remote_comm.barrier()
+
+    def free(self) -> None:
+        pass
+
+    def __repr__(self):
+        return (f"Intercomm(local={self.size}, "
+                f"remote={self.remote_size})")
+
+
+def intercomm_create(local: Communicator, remote: Communicator,
+                     tag: int = 0) -> Intercomm:
+    """MPI_Intercomm_create (leaders collapse in single-controller)."""
+    return Intercomm(local, remote, tag)

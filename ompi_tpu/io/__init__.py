@@ -1,0 +1,3 @@
+from ompi_tpu.io.file import File, MODE_APPEND  # noqa: F401
+from ompi_tpu.io.file import (MODE_CREATE, MODE_RDONLY, MODE_RDWR,  # noqa: F401
+                              MODE_WRONLY, MODE_EXCL)

@@ -1,0 +1,203 @@
+"""MPI-IO — parallel file I/O (mirrors ``ompi/mca/io/ompio`` +
+``ompi/mca/common/ompio`` orchestration, with the sub-framework roles
+collapsed where the TPU runtime makes them trivial):
+
+- fs (filesystem glue: ufs/lustre/gpfs)  -> plain POSIX here; the locus
+  that matters on TPU hosts is HBM<->host, handled by the accelerator
+  framework before bytes reach the filesystem.
+- fbtl (individual byte transfer: posix) -> ``pread``/``pwrite`` on the
+  shared file descriptor, offsets in elements x etype.
+- fcoll (collective algorithms: two-phase dynamic/vulcan) ->
+  ``write_at_all``/``read_at_all`` aggregate the stacked rank buffers in
+  the controller (which *is* the aggregator — the two-phase exchange
+  degenerates to one gather/scatter over the mesh) and issue one large
+  contiguous request, the same optimization two-phase IO exists for.
+- sharedfp (shared file pointer: sm/lockedfile) -> a controller-side
+  shared offset under a lock.
+
+File views (etype + filetype displacement maps) reuse the datatype
+engine's index maps, so a strided view is the same object as a derived
+datatype (``opal/datatype`` heritage).
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Optional
+
+import numpy as np
+
+from ompi_tpu.accelerator import to_host
+from ompi_tpu.core.datatype import Datatype
+from ompi_tpu.core.errhandler import ERR_ARG, MPIError
+from ompi_tpu.core.request import Request
+
+MODE_RDONLY = os.O_RDONLY
+MODE_WRONLY = os.O_WRONLY
+MODE_RDWR = os.O_RDWR
+MODE_CREATE = os.O_CREAT
+MODE_EXCL = os.O_EXCL
+MODE_APPEND = os.O_APPEND
+
+
+class File:
+    """An MPI file handle over a communicator."""
+
+    def __init__(self, comm, path: str, amode: int = MODE_RDWR | MODE_CREATE,
+                 etype: Optional[np.dtype] = None):
+        self.comm = comm
+        self.path = path
+        self.amode = amode
+        self.etype = np.dtype(etype or np.uint8)
+        self._fd = os.open(path, amode, 0o644)
+        self._lock = threading.RLock()
+        self._shared_ptr = 0                 # sharedfp: element offset
+        self._view_disp = 0                  # view displacement, elements
+        self._view_type: Optional[Datatype] = None
+        self.atomicity = False
+
+    @classmethod
+    def open(cls, comm, path: str,
+             amode: int = MODE_RDWR | MODE_CREATE) -> "File":
+        return cls(comm, path, amode)
+
+    # -- geometry -------------------------------------------------------
+    def _ebytes(self) -> int:
+        return self.etype.itemsize
+
+    def get_size(self) -> int:
+        return os.fstat(self._fd).st_size // self._ebytes()
+
+    def set_size(self, nelems: int) -> None:
+        os.ftruncate(self._fd, nelems * self._ebytes())
+
+    def preallocate(self, nelems: int) -> None:
+        if self.get_size() < nelems:
+            self.set_size(nelems)
+
+    # -- views (MPI_File_set_view) -------------------------------------
+    def set_view(self, disp: int = 0, etype=None,
+                 filetype: Optional[Datatype] = None) -> None:
+        """disp in elements of ``etype``; ``filetype`` selects visible
+        elements per extent window (the datatype engine's index map)."""
+        if etype is not None:
+            self.etype = np.dtype(etype if not isinstance(etype, Datatype)
+                                  else etype.base)
+        self._view_disp = int(disp)
+        self._view_type = filetype
+
+    def _map_offset(self, offset: int, count: int) -> np.ndarray:
+        """Element file-offsets for ``count`` elements starting at view
+        element ``offset`` (applying the filetype's index map)."""
+        if self._view_type is None:
+            return np.arange(offset, offset + count) + self._view_disp
+        ft = self._view_type
+        inst0, within = divmod(offset, ft.count)
+        n_inst = -(-(within + count) // ft.count)
+        idx = ft.flat_indices(inst0 + n_inst)[inst0 * ft.count:]
+        return idx[within:within + count] + self._view_disp
+
+    # -- individual I/O (fbtl/posix role) ------------------------------
+    def write_at(self, offset: int, data) -> int:
+        """Write ``data`` (any array; device buffers are fetched D2H by
+        the accelerator framework) at view offset (elements)."""
+        arr = np.ascontiguousarray(to_host(data)).astype(self.etype,
+                                                         copy=False).ravel()
+        offs = self._map_offset(offset, arr.size)
+        with self._lock:
+            return self._pwrite_elems(offs, arr)
+
+    def read_at(self, offset: int, count: int) -> np.ndarray:
+        offs = self._map_offset(offset, count)
+        with self._lock:
+            return self._pread_elems(offs)
+
+    def _runs(self, offs: np.ndarray):
+        from ompi_tpu.core.datatype import coalesce_runs
+        starts, lens = coalesce_runs(offs)
+        return list(zip(starts.tolist(), lens.tolist()))
+
+    def _pwrite_elems(self, offs: np.ndarray, arr: np.ndarray) -> int:
+        eb = self._ebytes()
+        pos = 0
+        for off, ln in self._runs(offs):
+            os.pwrite(self._fd, arr[pos:pos + ln].tobytes(), off * eb)
+            pos += ln
+        return arr.size
+
+    def _pread_elems(self, offs: np.ndarray) -> np.ndarray:
+        eb = self._ebytes()
+        out = np.empty(offs.size, self.etype)
+        pos = 0
+        for off, ln in self._runs(offs):
+            raw = os.pread(self._fd, ln * eb, off * eb)
+            out[pos:pos + ln] = np.frombuffer(raw, self.etype, count=ln)
+            pos += ln
+        return out
+
+    # -- nonblocking ----------------------------------------------------
+    def iwrite_at(self, offset: int, data) -> Request:
+        return Request.completed(self.write_at(offset, data))
+
+    def iread_at(self, offset: int, count: int) -> Request:
+        return Request.completed(self.read_at(offset, count))
+
+    # -- collective I/O (fcoll role) -----------------------------------
+    def write_at_all(self, offset: int, stacked) -> int:
+        """Collective write: rank r's block (stacked axis 0) lands at
+        view offset ``offset + r*block``. The controller is the two-phase
+        aggregator: one contiguous pwrite when the view allows."""
+        host = np.asarray(to_host(stacked))
+        if host.shape[0] != self.comm.size:
+            raise MPIError(ERR_ARG, "stacked buffer must have one block "
+                                    "per rank")
+        flat = np.ascontiguousarray(host).astype(self.etype,
+                                                 copy=False).ravel()
+        offs = self._map_offset(offset, flat.size)
+        with self._lock:
+            return self._pwrite_elems(offs, flat)
+
+    def read_at_all(self, offset: int, count_per_rank: int) -> np.ndarray:
+        """Collective read: returns stacked (nranks, count_per_rank)."""
+        n = self.comm.size
+        offs = self._map_offset(offset, count_per_rank * n)
+        with self._lock:
+            flat = self._pread_elems(offs)
+        return flat.reshape(n, count_per_rank)
+
+    # -- shared file pointer (sharedfp role) ---------------------------
+    def write_shared(self, data) -> int:
+        arr = np.ascontiguousarray(to_host(data)).ravel()
+        with self._lock:
+            off = self._shared_ptr
+            self._shared_ptr += arr.size
+        return self.write_at(off, arr)
+
+    def read_shared(self, count: int) -> np.ndarray:
+        with self._lock:
+            off = self._shared_ptr
+            self._shared_ptr += count
+        return self.read_at(off, count)
+
+    def seek_shared(self, offset: int) -> None:
+        with self._lock:
+            self._shared_ptr = offset
+
+    def get_position_shared(self) -> int:
+        return self._shared_ptr
+
+    # -- sync/close ----------------------------------------------------
+    def sync(self) -> None:
+        os.fsync(self._fd)
+
+    def close(self) -> None:
+        if self._fd >= 0:
+            os.close(self._fd)
+            self._fd = -1
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -1,0 +1,84 @@
+"""Expert parallelism — Mixture-of-Experts token dispatch over a mesh
+axis, built on ``InGraphComm.alltoall`` (the reference's alltoall family
+— pairwise/bruck, ``coll_base_functions.h`` — is exactly the dispatch
+primitive EP training uses; SURVEY.md §2.6 maps it to ``all_to_all``).
+
+Switch-style top-1 routing with fixed expert capacity: each ep rank
+hosts one expert; tokens are gathered into per-expert capacity slots,
+exchanged with one ``all_to_all``, processed by the local expert, and
+returned by a second ``all_to_all``; gate probabilities weight the
+combine. Tokens over capacity are dropped (standard Switch semantics) —
+capacity is the EP analogue of the reference's segment-size tuning knob.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from ompi_tpu.parallel.ingraph import InGraphComm
+
+
+def moe_apply(x, params: Dict[str, Any], ep: InGraphComm,
+              capacity: int):
+    """Top-1 MoE layer over the ``ep`` axis (1 expert per rank).
+
+    Args:
+      x: local tokens ``(T, D)`` (flatten batch x seq upstream).
+      params: ``gate`` (D, E) replicated; ``w1`` (D, F), ``w2`` (F, D) —
+        THIS rank's expert.
+      ep: expert-parallel in-graph communicator (static size = E).
+      capacity: per-(source rank, expert) token slots.
+    Returns ``(T, D)`` combined expert outputs (dropped tokens get 0 —
+    callers typically add a residual connection).
+    """
+    n = ep._size
+    if n is None:
+        raise ValueError("moe_apply needs InGraphComm(axis, size)")
+    T, D = x.shape
+    gate_logits = x @ params["gate"]                  # (T, E)
+    gate_p = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+    expert = jnp.argmax(gate_p, axis=-1)              # (T,)
+    prob = jnp.max(gate_p, axis=-1)                   # (T,)
+
+    # Capacity slots: position of each token within its expert's queue.
+    onehot = jax.nn.one_hot(expert, n, dtype=jnp.int32)      # (T, E)
+    pos = (jnp.cumsum(onehot, axis=0) - 1) * onehot          # (T, E)
+    slot = jnp.sum(pos, axis=-1)                             # (T,)
+    keep = slot < capacity
+
+    # dispatch[e, c, :] = the token routed to expert e at slot c
+    disp_mask = (onehot.astype(jnp.bool_)
+                 & keep[:, None])                            # (T, E)
+    dispatch = jnp.zeros((n, capacity, D), x.dtype)
+    scatter_e = jnp.where(disp_mask.any(-1), expert, 0)
+    scatter_c = jnp.clip(slot, 0, capacity - 1)
+    dispatch = dispatch.at[scatter_e, scatter_c].add(
+        jnp.where(keep[:, None], x, 0))
+
+    # Exchange: expert e receives its slots from every source rank.
+    recv = ep.alltoall(dispatch, split_axis=0, concat_axis=0)
+    # (n, capacity, D): n source-rank blocks for THIS rank's expert
+    h = jax.nn.gelu(recv @ params["w1"])
+    y = h @ params["w2"]                                     # (n, C, D)
+    back = ep.alltoall(y, split_axis=0, concat_axis=0)       # (n, C, D)
+
+    # Combine: token t reads back[expert[t], slot[t]] * prob[t].
+    gathered = back[scatter_e, scatter_c]                    # (T, D)
+    out = jnp.where(keep[:, None], gathered, 0.0)
+    return (out * prob[:, None].astype(x.dtype)).astype(x.dtype)
+
+
+def init_moe_params(key, d_model: int, d_ff: int, n_experts: int,
+                    ep_rank_count: int = 1):
+    """Replicated gate + this rank's expert weights."""
+    k1, k2, k3 = jax.random.split(key, 3)
+    return {
+        "gate": jax.random.normal(k1, (d_model, n_experts),
+                                  jnp.float32) * 0.02,
+        "w1": jax.random.normal(k2, (d_model, d_ff), jnp.float32)
+        * (d_model ** -0.5),
+        "w2": jax.random.normal(k3, (d_ff, d_model), jnp.float32)
+        * (d_ff ** -0.5),
+    }

@@ -1,0 +1,70 @@
+"""Pipeline parallelism — GPipe-style microbatch pipelining over a mesh
+axis, built on ``InGraphComm.ring_shift`` (the chain/pipeline schedule of
+the reference's bcast/reduce algorithms — ``coll_base_bcast.c`` pipeline/
+chain — applied to activations instead of message segments).
+
+Each ``pp`` rank owns one *stage* (a contiguous slice of the model);
+microbatches flow through the ring: at tick t, rank r works on
+microbatch t - r (bubble ticks are masked out). The schedule runs as a
+``lax.scan`` inside shard_map, so XLA overlaps each tick's stage compute
+with the next activation shift on ICI. Backward is JAX AD through the
+scan (activation stashing; rematerialize with ``jax.checkpoint`` on the
+stage function for long pipelines).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from ompi_tpu.parallel.ingraph import InGraphComm
+
+
+def pipeline_apply(stage_fn: Callable, stage_params: Any, x_micro,
+                   pp: InGraphComm):
+    """Run ``n_micro`` microbatches through an ``n_pp``-stage pipeline.
+
+    Args:
+      stage_fn: ``(stage_params, activation) -> activation`` — this
+        rank's slice of the model (shapes uniform across stages).
+      stage_params: this pp rank's stage parameters (shard_map-local).
+      x_micro: ``(n_micro, B_m, ...)`` input microbatches. Only stage
+        0's value is read; other ranks may pass zeros of equal shape.
+      pp: the pipeline in-graph communicator (static size).
+
+    Returns ``(n_micro, B_m, ...)`` outputs, valid on the LAST stage
+    (other ranks hold garbage — the caller broadcasts or reduces as
+    needed, exactly like rooted-collective semantics).
+    """
+    n = pp._size
+    if n is None:
+        raise ValueError("pipeline_apply needs InGraphComm(axis, size)")
+    r = pp.rank()
+    n_micro = x_micro.shape[0]
+    act_shape = x_micro.shape[1:]
+    n_ticks = n_micro + n - 1
+
+    def tick(carry, t):
+        prev_out, outputs = carry
+        # Activation handoff: stage r receives stage r-1's last output.
+        recv = pp.ring_shift(prev_out, 1)
+        # Stage 0 injects microbatch t (while valid); others consume.
+        m = t - r                          # microbatch index at this rank
+        inject = jax.lax.dynamic_index_in_dim(
+            x_micro, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False)
+        a_in = jnp.where(r == 0, inject, recv)
+        a_out = stage_fn(stage_params, a_in)
+        # Only ticks with 0 <= m < n_micro carry real work for rank r;
+        # masked lanes still compute (SPMD) but write nothing.
+        valid_out = (r == n - 1) & (m >= 0) & (m < n_micro)
+        updated = jax.lax.dynamic_update_index_in_dim(
+            outputs, a_out, jnp.clip(m, 0, n_micro - 1), 0)
+        outputs = jnp.where(valid_out, updated, outputs)
+        return (a_out, outputs), None
+
+    out0 = jnp.zeros((n_micro,) + act_shape, x_micro.dtype)
+    (last, outputs), _ = jax.lax.scan(
+        tick, (jnp.zeros(act_shape, x_micro.dtype), out0),
+        jnp.arange(n_ticks))
+    return outputs

@@ -48,9 +48,11 @@ def test_monitoring_component(world, monkeypatch):
         snap = monitoring.snapshot()
         calls, nbytes = snap[(d.cid, "allreduce")]
         assert calls == 2 and nbytes == 2 * x.nbytes
-        # interposes over whatever selection would otherwise pick
+        # interposes over whatever selection would otherwise pick,
+        # per function (backfill preserved)
         from ompi_tpu.coll.tuned import TunedCollModule
-        assert isinstance(d.c_coll["allreduce"].inner, TunedCollModule)
+        assert isinstance(d.c_coll["allreduce"].vtable["allreduce"],
+                          TunedCollModule)
     finally:
         var.var_set("coll_monitoring_enable", False)
 
