@@ -63,6 +63,8 @@ from ompi_tpu.api.mpi import (  # noqa: F401
     op_create, create_keyval, free_keyval, error_string, from_numpy_dtype,
     Grequest, INFO_ENV, INFO_NULL,
     Get_library_version,
+    # local reduction + pack/external32
+    reduce_local, Pack, Unpack, Pack_external, Unpack_external, Pack_size,
 )
 
 __version__ = "0.1.0"

@@ -34,7 +34,10 @@ from ompi_tpu.core.group import (CONGRUENT, Group, IDENT, SIMILAR,  # noqa: F401
 from ompi_tpu.core.info import INFO_ENV, INFO_NULL, Info  # noqa: F401
 from ompi_tpu.core.op import (BAND, BOR, BXOR, LAND, LOR, LXOR, MAX,  # noqa: F401
                               MAXLOC, MIN, MINLOC, NO_OP, Op, PROD, REPLACE,
-                              SUM, op_create)
+                              SUM, op_create, reduce_local)
+from ompi_tpu.core.convertor import (  # noqa: F401
+    mpi_pack as Pack, mpi_unpack as Unpack, pack_external as Pack_external,
+    unpack_external as Unpack_external, pack_size as Pack_size)
 from ompi_tpu.core.request import (Grequest, Request, Status,  # noqa: F401
                                    testall, testany, testsome, waitall,
                                    waitany, waitsome)

@@ -10,6 +10,7 @@ from typing import Any, Dict, List
 
 from ompi_tpu.mca import pvar as _pvar
 from ompi_tpu.mca import var as _var
+from ompi_tpu.utils import hooks as _hooks
 
 
 def init_thread() -> None:            # MPI_T_init_thread
@@ -55,3 +56,46 @@ def pvar_list() -> List[Dict[str, Any]]:
 def pvar_read(name: str) -> Any:
     _pvar.refresh()
     return _pvar.pvar_read(name)
+
+
+# -- events (MPI_T_event_*, ompi/mpi/tool/events.c shape) -------------------
+# An event handle binds a callback to one event type; the backend is the
+# profiling hook chain (the PMPI/PERUSE instrumentation point), filtered
+# by event name.
+class _EventHandle:
+    def __init__(self, name: str, cb):
+        self.name = name
+        self.dropped = 0
+        def _shim(event, comm, info):
+            if event == name:
+                cb(event, comm, info)
+        self._shim = _hooks.register_profiler(_shim)
+
+    def free(self) -> None:
+        _hooks.unregister_profiler(self._shim)
+
+
+def event_get_num() -> int:
+    return len(_hooks.known_events())
+
+
+def event_list() -> List[str]:
+    return _hooks.known_events()
+
+
+def event_get_info(index: int) -> Dict[str, Any]:
+    name = _hooks.known_events()[index]
+    return {"name": name, "verbosity": 1,
+            "desc": f"framework event {name}"}
+
+
+def event_handle_alloc(name: str, cb) -> _EventHandle:
+    """MPI_T_event_handle_alloc: ``cb(event, comm, info)`` fires on
+    every occurrence of event type ``name``."""
+    if name not in _hooks.known_events():
+        _hooks.declare_event(name)
+    return _EventHandle(name, cb)
+
+
+def event_handle_free(handle: _EventHandle) -> None:
+    handle.free()

@@ -305,14 +305,22 @@ class Communicator:
         self._coll("barrier").barrier()
 
     # -- v-variants (variable counts): pad to max, run fixed, slice ----
+    # The wire strategy for every *v collective is the same: pad ragged
+    # per-peer chunks to the max count, ride the fixed-count device
+    # collective over ICI, slice the valid prefixes off on the way out —
+    # the TPU analogue of the reference's per-peer count headers
+    # (ompi/mca/coll/base alltoallv/allgatherv pairwise exchanges).
+    def _ragged(self, per_rank: Sequence[Any], what: str):
+        if len(per_rank) != self.size:
+            self._err(ERR_COUNT, f"{what} needs one entry per rank")
+        arrs = [np.asarray(a).ravel() for a in per_rank]
+        return arrs, [a.size for a in arrs]
+
     def allgatherv(self, per_rank: Sequence[Any]):
         """Takes per-rank arrays (ragged); returns list of host arrays =
         concatenation every rank receives. Pads to max count on the wire
         (the TPU analogue of the reference's per-peer count headers)."""
-        if len(per_rank) != self.size:
-            self._err(ERR_COUNT, "need one array per rank")
-        arrs = [np.asarray(a).ravel() for a in per_rank]
-        counts = [a.size for a in arrs]
+        arrs, counts = self._ragged(per_rank, "allgatherv")
         m = max(counts) if counts else 0
         padded = np.zeros((self.size, m), dtype=arrs[0].dtype)
         for i, a in enumerate(arrs):
@@ -321,6 +329,72 @@ class Communicator:
         g = np.asarray(g[0])           # all rows identical
         cat = np.concatenate([g[j, :counts[j]] for j in range(self.size)])
         return [cat.copy() for _ in range(self.size)]
+
+    def gatherv(self, per_rank: Sequence[Any], root: int = 0):
+        """MPI_Gatherv: ragged per-rank contributions; returns the
+        concatenation (valid at root)."""
+        self._validate_root(root)
+        arrs, counts = self._ragged(per_rank, "gatherv")
+        m = max(counts) if counts else 0
+        padded = np.zeros((self.size, m), dtype=arrs[0].dtype)
+        for i, a in enumerate(arrs):
+            padded[i, :a.size] = a
+        g = self.gather(to_device(padded, self.sharding), root)
+        g = np.asarray(g[root])
+        return np.concatenate([g[j, :counts[j]] for j in range(self.size)])
+
+    def scatterv(self, chunks: Sequence[Any], root: int = 0):
+        """MPI_Scatterv: ``chunks`` is root's ragged per-destination list;
+        returns a per-rank list of host arrays."""
+        self._validate_root(root)
+        arrs, counts = self._ragged(chunks, "scatterv")
+        m = max(counts) if counts else 0
+        padded = np.zeros((self.size, self.size, m), dtype=arrs[0].dtype)
+        for j, a in enumerate(arrs):
+            padded[root, j, :a.size] = a
+        s = self.scatter(to_device(padded, self.sharding), root)
+        s = np.asarray(s)
+        return [s[r, :counts[r]].copy() for r in range(self.size)]
+
+    def alltoallv(self, send_chunks: Sequence[Sequence[Any]]):
+        """MPI_Alltoallv: ``send_chunks[i][j]`` is rank i's (ragged)
+        chunk for rank j; returns ``recv`` with ``recv[j][i]`` = the
+        chunk i sent to j (per-rank lists of host arrays)."""
+        if len(send_chunks) != self.size:
+            self._err(ERR_COUNT, "alltoallv needs one row per rank")
+        rows = [[np.asarray(c).ravel() for c in row] for row in send_chunks]
+        for row in rows:
+            if len(row) != self.size:
+                self._err(ERR_COUNT, "alltoallv needs one chunk per peer")
+        counts = [[c.size for c in row] for row in rows]
+        m = max((c for row in counts for c in row), default=0)
+        dt = rows[0][0].dtype if m else np.float32
+        padded = np.zeros((self.size, self.size, m), dtype=dt)
+        for i, row in enumerate(rows):
+            for j, c in enumerate(row):
+                padded[i, j, :c.size] = c
+        t = np.asarray(self.alltoall(to_device(padded, self.sharding)))
+        # out[j, i] = in[i, j]; slice each to the sender's count.
+        return [[t[j, i, :counts[i][j]].copy() for i in range(self.size)]
+                for j in range(self.size)]
+
+    def alltoallw(self, send_chunks: Sequence[Sequence[Any]],
+                  send_types: Sequence[Sequence[Optional[Datatype]]]):
+        """MPI_Alltoallw: per-(src,dst) datatypes. Each chunk is packed
+        with its own datatype before the exchange (host pack — the w
+        variant's per-pair layouts preclude one device index map), then
+        rides the padded alltoall."""
+        packed = []
+        for row, trow in zip(send_chunks, send_types):
+            prow = []
+            for c, t in zip(row, trow):
+                a = np.asarray(c)
+                if t is not None and not t.is_contiguous:
+                    cnt = a.shape[-1] // max(t.extent, 1)
+                    a = np.asarray(convertor.pack(a, t, cnt))
+                prow.append(a.ravel())
+            packed.append(prow)
+        return self.alltoallv(packed)
 
     # ==================================================================
     # Nonblocking variants: JAX async dispatch makes these natural — the
@@ -361,6 +435,18 @@ class Communicator:
 
     def iexscan(self, sendbuf, op=op_mod.SUM) -> Request:
         return self._nb(self.exscan, sendbuf, op)
+
+    def iallgatherv(self, per_rank: Sequence[Any]) -> Request:
+        return self._nb(self.allgatherv, per_rank)
+
+    def igatherv(self, per_rank: Sequence[Any], root: int = 0) -> Request:
+        return self._nb(self.gatherv, per_rank, root)
+
+    def iscatterv(self, chunks: Sequence[Any], root: int = 0) -> Request:
+        return self._nb(self.scatterv, chunks, root)
+
+    def ialltoallv(self, send_chunks: Sequence[Sequence[Any]]) -> Request:
+        return self._nb(self.alltoallv, send_chunks)
 
     def ibarrier(self) -> Request:
         m = self._coll("barrier")
@@ -450,6 +536,15 @@ class Communicator:
     def mprobe(self, source: int, tag: int = -1, *, dst: int = 0):
         self._check()
         return self._pml.mprobe(dst, source, tag)
+
+    def improbe(self, source: int, tag: int = -1, *, dst: int = 0):
+        """MPI_Improbe: nonblocking matched probe — (flag, message,
+        Status); on no match returns (False, None, None)."""
+        self._check()
+        flag, status = self._pml.iprobe(dst, source, tag)
+        if not flag:
+            return False, None, None
+        return True, self._pml.mprobe(dst, source, tag), status
 
     def mrecv(self, message):
         self._check()
@@ -722,6 +817,52 @@ class Communicator:
                               else np.zeros(host.shape[2:], host.dtype))
             out.append(np.stack(chunks) if chunks
                        else np.empty((0,) + host.shape[2:], host.dtype))
+        return out
+
+    def neighbor_allgatherv(self, per_rank: Sequence[Any]) -> List[Any]:
+        """MPI_Neighbor_allgatherv: ragged contributions; rank r receives
+        the concatenation of its neighbors' (variable-size) buffers in
+        neighbor order."""
+        if self.topo is None:
+            from ompi_tpu.core.errhandler import ERR_TOPOLOGY
+            self._err(ERR_TOPOLOGY, "no topology attached")
+        arrs, _ = self._ragged(per_rank, "neighbor_allgatherv")
+        out = []
+        for r in range(self.size):
+            nb = [n for n in self.topo.neighbors(r) if n >= 0]
+            out.append(np.concatenate([arrs[n] for n in nb]) if nb
+                       else np.empty((0,), arrs[0].dtype))
+        return out
+
+    def neighbor_alltoallv(self,
+                           send_chunks: Sequence[Sequence[Any]]) -> List[Any]:
+        """MPI_Neighbor_alltoallv: ``send_chunks[r][j]`` is rank r's
+        ragged chunk for its j-th out-neighbor; rank r receives one chunk
+        per in-neighbor (in order), concatenated."""
+        if self.topo is None:
+            from ompi_tpu.core.errhandler import ERR_TOPOLOGY
+            self._err(ERR_TOPOLOGY, "no topology attached")
+        if len(send_chunks) != self.size:
+            self._err(ERR_COUNT, "need one chunk row per rank")
+        from collections import deque
+        out_nb = getattr(self.topo, "out_neighbors", self.topo.neighbors)
+        recv: Dict[Tuple[int, int], Any] = {}
+        for s in range(self.size):
+            for j, d in enumerate(out_nb(s)):
+                if 0 <= d < self.size and j < len(send_chunks[s]):
+                    recv.setdefault((d, s), deque()).append(
+                        np.asarray(send_chunks[s][j]).ravel())
+        out = []
+        for r in range(self.size):
+            chunks = []
+            for n in self.topo.neighbors(r):
+                if n < 0:
+                    continue
+                q = recv.get((r, n))
+                if q:
+                    chunks.append(q.popleft())
+            out.append(np.concatenate(chunks) if chunks
+                       else np.empty((0,), np.float32))
         return out
 
     # -- attributes (keyvals) ------------------------------------------

@@ -133,3 +133,82 @@ def unpack(out_buf, packed, datatype: Optional[Datatype], count: int):
         return out_buf
     out_buf[..., idx] = packed
     return out_buf
+
+
+# ---------------------------------------------------------------------------
+# MPI_Pack / MPI_Unpack with explicit position, and the external32
+# canonical representation (MPI_Pack_external). Behavioral spec:
+# ``ompi/datatype/ompi_datatype_pack_external.c`` and the convertor's
+# resumable positioning (``opal_datatype_fake_stack.c``); external32 is
+# the big-endian fixed-size wire format of MPI-3.1 §13.5.2 (reference
+# tables in ``opal/datatype/opal_copy_functions_heterogeneous.c``).
+# ---------------------------------------------------------------------------
+
+def pack_size(datatype: Optional[Datatype], count: int,
+              dtype=None) -> int:
+    """MPI_Pack_size: bytes needed to pack ``count`` instances. With
+    ``datatype=None`` the element width comes from ``dtype`` (the
+    buffer's numpy dtype), defaulting to raw bytes."""
+    if datatype is None:
+        return count * (np.dtype(dtype).itemsize if dtype is not None else 1)
+    return count * datatype.get_size()
+
+
+def mpi_pack(buf, datatype: Optional[Datatype], count: int,
+             outbuf: bytearray, position: int) -> int:
+    """MPI_Pack: append ``count`` instances of ``datatype`` from ``buf``
+    into ``outbuf`` at byte offset ``position``; returns the new
+    position. Successive calls with the returned position concatenate
+    (the reference convertor's resumable-positioning contract)."""
+    packed = np.ascontiguousarray(np.asarray(pack(buf, datatype, count)))
+    raw = packed.tobytes()
+    end = position + len(raw)
+    if len(outbuf) < end:
+        outbuf.extend(b"\0" * (end - len(outbuf)))
+    outbuf[position:end] = raw
+    return end
+
+
+def _base_dtype(datatype: Optional[Datatype], out_buf) -> np.dtype:
+    """Element dtype for raw-byte APIs: the datatype's base, else the
+    output buffer's dtype (datatype=None means "typed raw elements" of
+    whatever the destination holds), else bytes."""
+    if datatype is not None and datatype.base is not None:
+        return datatype.base
+    if out_buf is not None and hasattr(out_buf, "dtype"):
+        return np.dtype(out_buf.dtype)
+    return np.dtype(np.uint8)
+
+
+def mpi_unpack(inbuf, position: int, out_buf, datatype: Optional[Datatype],
+               count: int):
+    """MPI_Unpack: read ``count`` instances from ``inbuf`` at byte offset
+    ``position`` into ``out_buf``; returns (out, new_position)."""
+    base = _base_dtype(datatype, out_buf)
+    n = count * (datatype.count if datatype is not None else 1)
+    raw = bytes(inbuf[position:position + n * base.itemsize])
+    packed = np.frombuffer(raw, dtype=base).copy()
+    if out_buf is not None and hasattr(out_buf, "shape"):
+        packed = packed.reshape(out_buf.shape[:-1] + (n,))
+    out = unpack(out_buf, packed, datatype, count)
+    return out, position + n * base.itemsize
+
+
+def pack_external(datatype: Optional[Datatype], buf, count: int) -> bytes:
+    """MPI_Pack_external("external32"): canonical big-endian fixed-size
+    representation, portable across architectures."""
+    packed = np.ascontiguousarray(np.asarray(pack(buf, datatype, count)))
+    return packed.astype(packed.dtype.newbyteorder(">"), copy=False).tobytes()
+
+
+def unpack_external(datatype: Optional[Datatype], data: bytes, count: int,
+                    out_buf=None):
+    """MPI_Unpack_external: decode external32 bytes back to native
+    layout (scattering into ``out_buf`` for non-contiguous types)."""
+    base = _base_dtype(datatype, out_buf)
+    n = count * (datatype.count if datatype is not None else 1)
+    be = np.frombuffer(data, dtype=base.newbyteorder(">"), count=n)
+    packed = be.astype(base)
+    if out_buf is not None and hasattr(out_buf, "shape"):
+        packed = packed.reshape(out_buf.shape[:-1] + (n,))
+    return unpack(out_buf, packed, datatype, count)

@@ -144,3 +144,14 @@ NO_OP = Op(lambda a, b: a, name="no_op", commute=False, predefined=True)
 def op_create(fn: Callable, commute: bool = True, name: str = "user_op") -> Op:
     """MPI_Op_create equivalent: ``fn`` is a JAX-traceable binary combiner."""
     return Op(fn, commute=commute, name=name)
+
+
+def reduce_local(inbuf, inoutbuf, op: Op):
+    """MPI_Reduce_local: combine ``inbuf`` into ``inoutbuf`` with ``op``
+    (no communication — the entry point the reference's
+    ``test/datatype/check_op.sh`` matrix drives to validate the SIMD
+    reduction kernels; here it exercises the same combiner the
+    collectives use). Functional: returns the combined array."""
+    if not isinstance(op, Op) or op.fn is None:
+        raise TypeError("invalid reduction op")
+    return op.fn(inbuf, inoutbuf)      # inoutbuf = inbuf op inoutbuf

@@ -150,6 +150,20 @@ class Win:
              count: Optional[int] = None) -> Request:
         return Request.completed(self.get(target_rank, target_disp, count))
 
+    def raccumulate(self, origin_data, target_rank: int,
+                    op: op_mod.Op = op_mod.SUM,
+                    target_disp: int = 0) -> Request:
+        """MPI_Raccumulate (osc.h request-based variants)."""
+        self.accumulate(origin_data, target_rank, op, target_disp)
+        arrays = [self._buf] if isinstance(self._buf, jax.Array) else None
+        return Request(arrays=arrays)
+
+    def rget_accumulate(self, origin_data, target_rank: int,
+                        op: op_mod.Op = op_mod.SUM,
+                        target_disp: int = 0) -> Request:
+        return Request.completed(
+            self.get_accumulate(origin_data, target_rank, op, target_disp))
+
     # -- synchronization ------------------------------------------------
     def fence(self) -> None:
         """MPI_Win_fence: drain outstanding device updates (active
@@ -181,6 +195,80 @@ class Win:
 
     def sync(self) -> None:
         self.flush()
+
+    # -- PSCW active-target (MPI_Win_post/start/complete/wait;
+    #    osc_rdma_active_target.c generalized-sync semantics) -----------
+    def post(self, group) -> None:
+        """Expose this window to an access epoch by ``group``'s ranks."""
+        self._exposure = tuple(group.world_ranks)
+
+    def start(self, group) -> None:
+        """Begin an access epoch targeting ``group``'s ranks; must pair
+        with a matching ``post`` (checked at ``complete``)."""
+        self._access = tuple(group.world_ranks)
+
+    def complete(self) -> None:
+        """End the access epoch: drain origin-side updates."""
+        if getattr(self, "_access", None) is None:
+            raise MPIError(ERR_ARG, "Win.complete without Win.start")
+        self.flush()
+        self._access = None
+
+    def wait(self) -> None:
+        """End the exposure epoch (blocks until accesses drained — in
+        dispatch order that is a flush here)."""
+        if getattr(self, "_exposure", None) is None:
+            raise MPIError(ERR_ARG, "Win.wait without Win.post")
+        self.flush()
+        self._exposure = None
+
+    def test(self) -> bool:
+        """MPI_Win_test: nonblocking ``wait`` — exposure always drains
+        in one flush here, so report completion and end the epoch."""
+        if getattr(self, "_exposure", None) is None:
+            return True
+        self.wait()
+        return True
+
+    # -- dynamic windows (MPI_Win_create_dynamic / attach / detach) ----
+    @classmethod
+    def create_dynamic(cls, comm, dtype=np.float32) -> "Win":
+        """A zero-size window that memory is attached to later."""
+        w = cls(comm, 0, dtype=dtype, name=f"win_dyn#{comm.cid}")
+        w._dynamic = True
+        return w
+
+    def attach(self, size: int) -> int:
+        """Attach ``size`` elements (symmetrically, every rank) and
+        return the base displacement of the new region — the analogue of
+        the address the reference exchanges out-of-band after
+        MPI_Win_attach."""
+        if not getattr(self, "_dynamic", False):
+            raise MPIError(ERR_ARG, "attach on a non-dynamic window")
+        base = self.size
+        grown_shape = (self.comm.size, base + size)
+        if check_addr(self._buf) == LOCUS_DEVICE:
+            pad = jnp.zeros((self.comm.size, size), dtype=self.dtype)
+            self._buf = jax.device_put(
+                jnp.concatenate([self._buf, pad], axis=1),
+                self.comm.sharding)
+        else:
+            buf = np.zeros(grown_shape, dtype=self.dtype)
+            if base:
+                buf[:, :base] = self._buf
+            self._buf = buf
+        self.size = base + size
+        return base
+
+    def detach(self, base: int) -> None:
+        """Detach a region; the displacement range becomes invalid (the
+        storage is kept — displacement validity is the MPI contract)."""
+        if not getattr(self, "_dynamic", False):
+            raise MPIError(ERR_ARG, "detach on a non-dynamic window")
+
+    def get_group(self):
+        """MPI_Win_get_group: the group of the window's communicator."""
+        return self.comm.group
 
     # -- introspection ---------------------------------------------------
     @property

@@ -66,6 +66,15 @@ class ShmemCtx:
         return self.get(src_pe, addr, 1)[0]
 
     # -- atomics (oshmem/mca/atomic) -----------------------------------
+    def atomic_set(self, dest_pe: int, addr: int, value) -> None:
+        self.p(dest_pe, addr, value)
+
+    def atomic_fetch(self, src_pe: int, addr: int):
+        return self.g(src_pe, addr)
+
+    def atomic_swap(self, dest_pe: int, addr: int, value):
+        return self.heap.fetch_and_op(value, dest_pe, op_mod.REPLACE, addr)
+
     def atomic_add(self, dest_pe: int, addr: int, value) -> None:
         self.heap.accumulate(np.asarray([value]), dest_pe, op_mod.SUM, addr)
 
@@ -87,10 +96,7 @@ class ShmemCtx:
         self.comm.barrier()
 
     def broadcast(self, addr: int, nelems: int, root_pe: int) -> None:
-        data = self.get(root_pe, addr, nelems)
-        for pe in range(self.n_pes):
-            if pe != root_pe:
-                self.put(pe, addr, data)
+        self.team_world().broadcast(addr, nelems, root_pe)
 
     def collect(self, addr: int, nelems: int):
         """fcollect: concatenation of every PE's segment, symmetric
@@ -102,9 +108,77 @@ class ShmemCtx:
                op: op_mod.Op = op_mod.SUM) -> None:
         """to_all reduction over all PEs' segments; result written back
         symmetrically."""
+        self.team_world().reduce(addr, nelems, op)
+
+    def alltoall(self, addr: int, nelems: int) -> None:
+        """shmem_alltoall: PE i's j-th ``nelems`` block lands in PE j's
+        segment at block i (symmetric, in place in the heap)."""
+        blocks = [self.get(pe, addr, nelems * self.n_pes)
+                  for pe in range(self.n_pes)]
+        for j in range(self.n_pes):
+            out = np.concatenate([
+                blocks[i][j * nelems:(j + 1) * nelems]
+                for i in range(self.n_pes)])
+            self.put(j, addr, out)
+
+    # -- teams (spml teams, oshmem/mca/spml/spml.h:689-784) -------------
+    def team_world(self) -> "ShmemTeam":
+        return ShmemTeam(self, list(range(self.n_pes)))
+
+
+class ShmemTeam:
+    """A SHMEM team: an ordered PE subset with its own collectives —
+    backed by a sub-communicator (mesh subset), the way OpenSHMEM teams
+    sit over process groups (``spml.h:689-784`` team create/translate).
+    """
+
+    def __init__(self, ctx: ShmemCtx, pes: list):
+        self.ctx = ctx
+        self.pes = list(pes)
+
+    @property
+    def n_pes(self) -> int:
+        return len(self.pes)
+
+    def translate_pe(self, pe: int, dest: "ShmemTeam") -> int:
+        """shmem_team_translate_pe: this team's ``pe`` in ``dest``'s
+        numbering (-1 if absent)."""
+        world_pe = self.pes[pe]
+        try:
+            return dest.pes.index(world_pe)
+        except ValueError:
+            return -1
+
+    def split_strided(self, start: int, stride: int,
+                      size: int) -> "ShmemTeam":
+        """shmem_team_split_strided over this team's numbering."""
+        sel = [self.pes[start + i * stride] for i in range(size)]
+        return ShmemTeam(self.ctx, sel)
+
+    def split_2d(self, xrange: int):
+        """shmem_team_split_2d: (x, y) sub-teams of an xrange-wide grid."""
+        xs = [ShmemTeam(self.ctx, self.pes[i:i + xrange])
+              for i in range(0, self.n_pes, xrange)]
+        ys = [ShmemTeam(self.ctx, self.pes[i::xrange])
+              for i in range(min(xrange, self.n_pes))]
+        return xs, ys
+
+    def sync(self) -> None:
+        """shmem_team_sync: order heap updates across the team."""
+        self.ctx.heap.flush_all()
+
+    def broadcast(self, addr: int, nelems: int, root_pe: int) -> None:
+        """Team broadcast: ``root_pe`` in team numbering."""
+        data = self.ctx.get(self.pes[root_pe], addr, nelems)
+        for pe in self.pes:
+            if pe != self.pes[root_pe]:
+                self.ctx.put(pe, addr, data)
+
+    def reduce(self, addr: int, nelems: int,
+               op: op_mod.Op = op_mod.SUM) -> None:
         acc: Optional[Any] = None
-        for pe in range(self.n_pes):
-            seg = self.get(pe, addr, nelems)
+        for pe in self.pes:
+            seg = self.ctx.get(pe, addr, nelems)
             acc = seg if acc is None else np.asarray(op.fn(acc, seg))
-        for pe in range(self.n_pes):
-            self.put(pe, addr, acc)
+        for pe in self.pes:
+            self.ctx.put(pe, addr, acc)

@@ -14,6 +14,32 @@ from typing import Any, Callable, Dict, List
 _lock = threading.Lock()
 _hooks: List[Callable[[str, Any, Dict[str, Any]], None]] = []
 
+# Event-name registry backing the MPI_T events API (``MPI_T_event_*``,
+# ``ompi/mpi/tool/events.c`` semantics): the event types a tool can bind
+# handlers to. Components pre-declare theirs; names are also learned
+# dynamically the first time they fire. Registration order is the index
+# space — MPI_T requires an event-type index to stay valid once handed
+# out, so this is an append-only list (never sorted, never compacted).
+_known_events: List[str] = [
+    "coll_allreduce", "coll_reduce", "coll_bcast", "coll_allgather",
+    "coll_gather", "coll_scatter", "coll_alltoall",
+    "coll_reduce_scatter_block", "coll_scan", "coll_exscan",
+    "coll_barrier", "pml_send", "pml_recv",
+]
+_known_event_set = set(_known_events)
+
+
+def declare_event(name: str) -> None:
+    with _lock:
+        if name not in _known_event_set:
+            _known_event_set.add(name)
+            _known_events.append(name)
+
+
+def known_events() -> List[str]:
+    with _lock:
+        return list(_known_events)
+
 
 def register_profiler(fn: Callable[[str, Any, Dict[str, Any]], None]):
     """Install a profiling hook; returns a handle for unregister."""
@@ -31,6 +57,10 @@ def unregister_profiler(handle) -> None:
 
 
 def fire(event: str, comm, info: Dict[str, Any]) -> None:
+    # Hot path (every collective and pt2pt entry): stay lock-free when
+    # there is nothing to do — membership reads on builtins are safe.
+    if event not in _known_event_set:
+        declare_event(event)
     if not _hooks:
         return
     with _lock:

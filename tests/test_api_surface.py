@@ -1,0 +1,282 @@
+"""v-collectives, reduce_local, pack/external32, MPI_T events, PSCW +
+dynamic windows, SHMEM teams, improbe — the API-surface parity batch.
+
+Reference behaviors: MPI_Gatherv/Scatterv/Alltoallv/Alltoallw
+(ompi/mca/coll/base), MPI_Reduce_local (check_op.sh matrix),
+MPI_Pack/Unpack + external32 (ompi/datatype/ompi_datatype_pack_external.c),
+MPI_T events (ompi/mpi/tool), MPI_Win_post/start/complete/wait
+(osc_rdma_active_target.c), MPI_Win_create_dynamic, SHMEM teams
+(oshmem spml.h:689-784).
+"""
+import numpy as np
+import pytest
+
+from ompi_tpu.core import op as op_mod
+from ompi_tpu.core.datatype import FLOAT, INT
+from ompi_tpu.core import convertor
+
+
+# -- v-collectives ---------------------------------------------------------
+def test_gatherv(world):
+    per_rank = [np.arange(r + 1, dtype=np.float32) + r
+                for r in range(world.size)]
+    out = world.gatherv(per_rank, root=1)
+    expect = np.concatenate(per_rank)
+    np.testing.assert_array_equal(out, expect)
+
+
+def test_scatterv(world):
+    chunks = [np.full(r + 2, r, np.float32) for r in range(world.size)]
+    outs = world.scatterv(chunks, root=0)
+    assert len(outs) == world.size
+    for r, o in enumerate(outs):
+        np.testing.assert_array_equal(o, chunks[r])
+
+
+def test_alltoallv(world):
+    n = world.size
+    send = [[np.full(i + j + 1, 10 * i + j, np.float32) for j in range(n)]
+            for i in range(n)]
+    recv = world.alltoallv(send)
+    for j in range(n):
+        for i in range(n):
+            np.testing.assert_array_equal(recv[j][i], send[i][j])
+
+
+def test_alltoallw_with_datatypes(world):
+    n = world.size
+    vec = FLOAT.create_vector(2, 1, 2)       # elements 0 and 2 of 4
+    send = [[np.arange(4, dtype=np.float32) + 100 * i + j
+             for j in range(n)] for i in range(n)]
+    types = [[vec] * n for _ in range(n)]
+    recv = world.alltoallw(send, types)
+    for j in range(n):
+        for i in range(n):
+            np.testing.assert_array_equal(recv[j][i],
+                                          send[i][j][[0, 2]])
+
+
+def test_nonblocking_v_variants(world):
+    per_rank = [np.arange(r + 1, dtype=np.float32)
+                for r in range(world.size)]
+    req = world.igatherv(per_rank, root=0)
+    out = req.get()
+    np.testing.assert_array_equal(out, np.concatenate(per_rank))
+    req2 = world.ialltoallv([[np.full(1, i + j, np.float32)
+                              for j in range(world.size)]
+                             for i in range(world.size)])
+    recv = req2.get()
+    assert recv[0][1][0] == 1.0
+
+
+def test_neighbor_v_variants(world):
+    cart = world.create_cart([world.size], periods=[True])
+    per_rank = [np.arange(r + 1, dtype=np.float32)
+                for r in range(cart.size)]
+    out = cart.neighbor_allgatherv(per_rank)
+    n = cart.size
+    for r in range(n):
+        nb = [x for x in cart.topo.neighbors(r) if x >= 0]
+        np.testing.assert_array_equal(
+            out[r], np.concatenate([per_rank[x] for x in nb]))
+    send = [[np.full(2, 10 * r + j, np.float32)
+             for j in range(len(cart.topo.neighbors(r)))]
+            for r in range(n)]
+    recv = cart.neighbor_alltoallv(send)
+    assert len(recv) == n
+    # rank r's first in-neighbor is (r-1)%n; its chunk to r is its j-th
+    # out-chunk where j indexes r in its neighbor list.
+    for r in range(n):
+        assert recv[r].size > 0
+
+
+# -- reduce_local ----------------------------------------------------------
+def test_reduce_local_matrix():
+    rng = np.random.default_rng(7)
+    for op, ref in [(op_mod.SUM, np.add), (op_mod.PROD, np.multiply),
+                    (op_mod.MAX, np.maximum), (op_mod.MIN, np.minimum)]:
+        a = rng.standard_normal(13).astype(np.float32)
+        b = rng.standard_normal(13).astype(np.float32)
+        np.testing.assert_allclose(op_mod.reduce_local(a, b, op),
+                                   ref(a, b), rtol=1e-6)
+    ia = rng.integers(0, 8, 9).astype(np.int32)
+    ib = rng.integers(0, 8, 9).astype(np.int32)
+    np.testing.assert_array_equal(
+        np.asarray(op_mod.reduce_local(ia, ib, op_mod.BXOR)), ia ^ ib)
+
+
+def test_reduce_local_bad_op():
+    with pytest.raises(TypeError):
+        op_mod.reduce_local(np.zeros(2), np.zeros(2), "sum")
+
+
+# -- pack / unpack / external32 -------------------------------------------
+def test_mpi_pack_unpack_roundtrip():
+    vec = FLOAT.create_vector(3, 1, 2)       # 3 elements strided by 2
+    buf = np.arange(6, dtype=np.float32)
+    out = bytearray()
+    pos = convertor.mpi_pack(buf, vec, 1, out, 0)
+    assert pos == 3 * 4
+    pos = convertor.mpi_pack(buf, vec, 1, out, pos)   # resumable append
+    assert pos == 6 * 4
+    dst = np.zeros(6, dtype=np.float32)
+    dst2, newpos = convertor.mpi_unpack(out, 0, dst, vec, 1)
+    np.testing.assert_array_equal(dst2[[0, 2, 4]], buf[[0, 2, 4]])
+    assert newpos == 12
+
+
+def test_pack_external32_endianness():
+    data = np.array([1.5, -2.25, 3.0], dtype=np.float32)
+    raw = convertor.pack_external(FLOAT, data, 3)
+    # external32 is big-endian on the wire
+    np.testing.assert_array_equal(
+        np.frombuffer(raw, dtype=">f4"), data)
+    back = convertor.unpack_external(FLOAT, raw, 3)
+    np.testing.assert_array_equal(np.asarray(back), data)
+
+
+def test_pack_external_derived_roundtrip():
+    idx = INT.create_indexed([2, 1], [0, 3])   # elements 0,1,3 of 4
+    buf = np.array([10, 11, 12, 13], dtype=np.int32)
+    raw = convertor.pack_external(idx, buf, 1)
+    assert len(raw) == 3 * 4
+    dst = np.zeros(4, dtype=np.int32)
+    convertor.unpack_external(idx, raw, 1, dst)
+    np.testing.assert_array_equal(dst, [10, 11, 0, 13])
+
+
+def test_pack_size():
+    assert convertor.pack_size(FLOAT.create_contiguous(5), 2) == 40
+
+
+# -- MPI_T events ----------------------------------------------------------
+def test_mpi_t_events(world):
+    from ompi_tpu.api import tool
+    assert tool.event_get_num() > 0
+    assert "coll_allreduce" in tool.event_list()
+    seen = []
+    h = tool.event_handle_alloc(
+        "coll_allreduce", lambda ev, comm, info: seen.append(ev))
+    x = world.alloc((2,), np.float32, fill=1.0)
+    world.allreduce(x, op_mod.SUM)
+    tool.event_handle_free(h)
+    world.allreduce(x, op_mod.SUM)
+    assert seen.count("coll_allreduce") == 1
+    info = tool.event_get_info(tool.event_list().index("coll_allreduce"))
+    assert info["name"] == "coll_allreduce"
+
+
+# -- OSC: PSCW, request-accumulates, dynamic windows ----------------------
+def test_win_pscw(world, mpi):
+    w = mpi.Win(world, 4)
+    g = world.group
+    w.post(g)
+    w.start(g)
+    w.put(np.ones(4, np.float32), 1)
+    w.complete()
+    w.wait()
+    np.testing.assert_array_equal(w.get(1), np.ones(4, np.float32))
+    with pytest.raises(mpi.MPIError):
+        w.complete()           # no epoch open
+
+
+def test_win_test_no_epoch(world, mpi):
+    w = mpi.Win(world, 2)
+    assert w.test() is True
+    w.post(world.group)
+    assert w.test() is True    # drained immediately in dispatch order
+
+
+def test_raccumulate_rget_accumulate(world, mpi):
+    w = mpi.Win(world, 3)
+    r1 = w.raccumulate(np.full(3, 2.0, np.float32), 0, op_mod.SUM)
+    r1.wait()
+    r2 = w.rget_accumulate(np.full(3, 5.0, np.float32), 0, op_mod.SUM)
+    old = r2.get()
+    np.testing.assert_array_equal(old, np.full(3, 2.0, np.float32))
+    np.testing.assert_array_equal(w.get(0), np.full(3, 7.0, np.float32))
+
+
+def test_dynamic_window(world, mpi):
+    w = mpi.Win.create_dynamic(world)
+    assert w.size == 0
+    base = w.attach(4)
+    assert base == 0
+    base2 = w.attach(2)
+    assert base2 == 4 and w.size == 6
+    w.put(np.full(2, 9.0, np.float32), 2, base2)
+    np.testing.assert_array_equal(w.get(2, base2, 2),
+                                  np.full(2, 9.0, np.float32))
+    w.detach(base)
+    with pytest.raises(mpi.MPIError):
+        mpi.Win(world, 2).attach(1)     # non-dynamic
+
+
+# -- SHMEM teams -----------------------------------------------------------
+def test_shmem_teams(world):
+    from ompi_tpu.shmem.api import ShmemCtx
+    ctx = ShmemCtx(world, heap_size=32)
+    team = ctx.team_world()
+    assert team.n_pes == world.size
+    evens = team.split_strided(0, 2, world.size // 2)
+    assert evens.pes == list(range(0, world.size, 2))
+    assert team.translate_pe(2, evens) == 1
+    assert evens.translate_pe(1, team) == 2
+    assert team.translate_pe(1, evens) == -1
+    addr = ctx.malloc(4)
+    for pe in range(world.size):
+        ctx.put(pe, addr, np.full(4, pe, np.float32))
+    evens.broadcast(addr, 4, 0)        # root = team pe 0 = world pe 0
+    np.testing.assert_array_equal(ctx.get(2, addr, 4),
+                                  np.zeros(4, np.float32))
+    # odd PEs untouched
+    np.testing.assert_array_equal(ctx.get(1, addr, 4),
+                                  np.ones(4, np.float32))
+    xs, ys = team.split_2d(2)
+    assert xs[0].pes == [0, 1] and ys[0].pes[0] == 0
+
+
+def test_shmem_team_reduce_and_atomics(world):
+    from ompi_tpu.shmem.api import ShmemCtx
+    ctx = ShmemCtx(world, heap_size=16)
+    team = ctx.team_world().split_strided(0, 1, 2)    # PEs {0,1}
+    addr = ctx.malloc(2)
+    for pe in range(world.size):
+        ctx.put(pe, addr, np.full(2, float(pe + 1), np.float32))
+    team.reduce(addr, 2, op_mod.SUM)
+    np.testing.assert_array_equal(ctx.get(0, addr, 2),
+                                  np.full(2, 3.0, np.float32))
+    np.testing.assert_array_equal(ctx.get(3, addr, 2),
+                                  np.full(2, 4.0, np.float32))
+    ctx.atomic_set(2, addr, 5.0)
+    assert ctx.atomic_fetch(2, addr) == 5.0
+    old = ctx.atomic_swap(2, addr, 7.0)
+    assert old == 5.0 and ctx.atomic_fetch(2, addr) == 7.0
+
+
+def test_shmem_alltoall(world):
+    from ompi_tpu.shmem.api import ShmemCtx
+    n = world.size
+    ctx = ShmemCtx(world, heap_size=n)
+    addr = ctx.malloc(n)
+    for pe in range(n):
+        ctx.put(pe, addr, np.arange(n, dtype=np.float32) + 10 * pe)
+    ctx.alltoall(addr, 1)
+    for j in range(n):
+        np.testing.assert_array_equal(
+            ctx.get(j, addr, n),
+            np.array([10 * i + j for i in range(n)], np.float32))
+
+
+# -- improbe ---------------------------------------------------------------
+def test_improbe(world):
+    flag, msg, st = world.improbe(source=0, dst=1)
+    assert flag is False and msg is None
+    world.send(np.arange(3, dtype=np.float32), src=0, dest=1, tag=42)
+    flag, msg, st = world.improbe(source=0, dst=1)
+    assert flag and st.tag == 42
+    data, st2 = world.mrecv(msg)
+    np.testing.assert_array_equal(data, np.arange(3, dtype=np.float32))
+    # message was consumed by the matched probe
+    flag, _, _ = world.improbe(source=0, dst=1)
+    assert flag is False
