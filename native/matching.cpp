@@ -1,0 +1,157 @@
+// ompi_tpu native matching core — the pt2pt matching engine hot path.
+//
+// Re-design of ob1's receive-side matching
+// (ompi/mca/pml/ob1/pml_ob1_recvfrag.c:296-330 and the pluggable
+// custom-match engines under ob1/custommatch/): an arriving message is
+// matched against posted receives in post order (source + tag with
+// MPI_ANY_SOURCE / MPI_ANY_TAG wildcards); unmatched messages join a
+// per-(dest, src) unexpected FIFO (MPI's non-overtaking rule); a new
+// receive first searches the unexpected queues.
+//
+// The core deals only in integer descriptors — (src, dest, tag, channel,
+// handle) — the Python layer owns payloads keyed by handle, exactly as
+// ob1's match headers travel separately from fragment data. Non-integer
+// tags (partitioned-channel tuples) are interned to ints by the caller.
+//
+// Handle-based C ABI over ctypes; one engine per communicator.
+
+#include <cstdint>
+#include <deque>
+#include <list>
+#include <map>
+#include <vector>
+
+namespace {
+
+constexpr int64_t ANY_SOURCE = -1;
+constexpr int64_t ANY_TAG = -1;
+
+struct Unexpected {
+  int64_t src, tag, channel, handle;
+};
+
+struct Posted {
+  int64_t src, tag, channel, handle;
+};
+
+struct Engine {
+  int64_t size;
+  // unexpected[(dest, src)] — FIFO per peer pair.
+  std::map<std::pair<int64_t, int64_t>, std::deque<Unexpected>> unexpected;
+  // posted[dest] — receives in post order (match order).
+  std::map<int64_t, std::list<Posted>> posted;
+};
+
+std::map<int64_t, Engine *> g_engines;
+int64_t g_next = 1;
+
+Engine *get(int64_t h) {
+  auto it = g_engines.find(h);
+  return it == g_engines.end() ? nullptr : it->second;
+}
+
+bool tag_ok(int64_t want, int64_t got, int64_t channel) {
+  // ANY_TAG is only meaningful on the ordinary channel (channel 0);
+  // interned tuple tags must match exactly.
+  return (channel == 0 && want == ANY_TAG) || want == got;
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t ompi_tpu_match_create(int64_t size) {
+  int64_t h = g_next++;
+  Engine *e = new Engine;
+  e->size = size;
+  g_engines[h] = e;
+  return h;
+}
+
+void ompi_tpu_match_destroy(int64_t h) {
+  auto it = g_engines.find(h);
+  if (it != g_engines.end()) {
+    delete it->second;
+    g_engines.erase(it);
+  }
+}
+
+// An arriving send: match against dest's posted receives in post order.
+// Returns the matched receive's handle (>= 0), or -1 after queueing the
+// message as unexpected (only when enqueue != 0 — a synchronous send
+// that cannot match must NOT join the queue, it deadlocks instead), or
+// -2 for a bad engine handle.
+int64_t ompi_tpu_match_send(int64_t h, int64_t src, int64_t dest,
+                            int64_t tag, int64_t channel,
+                            int64_t msg_handle, int64_t enqueue) {
+  Engine *e = get(h);
+  if (!e) return -2;
+  auto pit = e->posted.find(dest);
+  if (pit != e->posted.end()) {
+    for (auto it = pit->second.begin(); it != pit->second.end(); ++it) {
+      if (it->channel == channel &&
+          (it->src == ANY_SOURCE || it->src == src) &&
+          tag_ok(it->tag, tag, channel)) {
+        int64_t rh = it->handle;
+        pit->second.erase(it);
+        return rh;
+      }
+    }
+  }
+  if (enqueue)
+    e->unexpected[{dest, src}].push_back({src, tag, channel, msg_handle});
+  return -1;
+}
+
+// Search dest's unexpected queues (source order for ANY_SOURCE, FIFO
+// within a source). remove != 0 consumes the message (recv/mprobe);
+// remove == 0 peeks (probe). Returns msg handle or -1.
+int64_t ompi_tpu_match_take(int64_t h, int64_t dest, int64_t source,
+                            int64_t tag, int64_t channel, int64_t remove) {
+  Engine *e = get(h);
+  if (!e) return -2;
+  int64_t s_lo = source == ANY_SOURCE ? 0 : source;
+  int64_t s_hi = source == ANY_SOURCE ? e->size - 1 : source;
+  for (int64_t s = s_lo; s <= s_hi; ++s) {
+    auto qit = e->unexpected.find({dest, s});
+    if (qit == e->unexpected.end()) continue;
+    auto &q = qit->second;
+    for (auto it = q.begin(); it != q.end(); ++it) {
+      if (it->channel == channel && tag_ok(tag, it->tag, channel)) {
+        int64_t mh = it->handle;
+        if (remove) q.erase(it);
+        return mh;
+      }
+    }
+  }
+  return -1;
+}
+
+// Post a receive (no unexpected match was found by the caller).
+int64_t ompi_tpu_match_post(int64_t h, int64_t dest, int64_t source,
+                            int64_t tag, int64_t channel,
+                            int64_t recv_handle) {
+  Engine *e = get(h);
+  if (!e) return -2;
+  e->posted[dest].push_back({source, tag, channel, recv_handle});
+  return 0;
+}
+
+// Cancel a posted receive by handle. Returns 0 if removed, -1 if not
+// found (already matched).
+int64_t ompi_tpu_match_cancel(int64_t h, int64_t dest,
+                              int64_t recv_handle) {
+  Engine *e = get(h);
+  if (!e) return -2;
+  auto pit = e->posted.find(dest);
+  if (pit == e->posted.end()) return -1;
+  for (auto it = pit->second.begin(); it != pit->second.end(); ++it) {
+    if (it->handle == recv_handle) {
+      pit->second.erase(it);
+      return 0;
+    }
+  }
+  return -1;
+}
+
+}  // extern "C"

@@ -1,0 +1,129 @@
+// ompi_tpu native symmetric-heap allocator — binary buddy.
+//
+// Re-design of the reference's OSHMEM memheap buddy allocator
+// (oshmem/mca/memheap/buddy, ~878 LoC): power-of-two buddy system over
+// a symmetric heap, so shmem_malloc/shmem_free return offsets that are
+// identical on every PE (symmetry by construction — the controller runs
+// one allocator for all PEs). Offsets and sizes are in *elements*; the
+// Python layer owns the actual HBM window.
+//
+// Classic buddy: free lists per order; split on alloc, coalesce with the
+// buddy block on free. Handle-based C ABI (no exceptions across ctypes).
+
+#include <cstddef>
+#include <cstdint>
+#include <map>
+#include <vector>
+
+using std::size_t;
+
+namespace {
+
+struct Buddy {
+  int64_t min_order;                      // log2 of smallest block
+  int64_t max_order;                      // log2 of heap size
+  std::vector<std::vector<int64_t>> free_lists;  // per order: offsets
+  std::map<int64_t, int64_t> allocated;   // offset -> order
+
+  explicit Buddy(int64_t max_o, int64_t min_o)
+      : min_order(min_o), max_order(max_o),
+        free_lists(static_cast<size_t>(max_o + 1)) {
+    free_lists[static_cast<size_t>(max_o)].push_back(0);
+  }
+};
+
+std::map<int64_t, Buddy *> g_heaps;
+int64_t g_next = 1;
+
+int64_t order_for(int64_t n, int64_t min_order) {
+  int64_t o = min_order;
+  while ((int64_t(1) << o) < n) ++o;
+  return o;
+}
+
+bool take_free(Buddy *b, int64_t order, int64_t off) {
+  auto &fl = b->free_lists[static_cast<size_t>(order)];
+  for (size_t i = 0; i < fl.size(); ++i) {
+    if (fl[i] == off) {
+      fl[i] = fl.back();
+      fl.pop_back();
+      return true;
+    }
+  }
+  return false;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create a heap of 2^max_order elements with 2^min_order granularity.
+int64_t ompi_tpu_buddy_create(int64_t max_order, int64_t min_order) {
+  if (max_order < min_order || min_order < 0 || max_order > 62) return -1;
+  int64_t h = g_next++;
+  g_heaps[h] = new Buddy(max_order, min_order);
+  return h;
+}
+
+void ompi_tpu_buddy_destroy(int64_t h) {
+  auto it = g_heaps.find(h);
+  if (it != g_heaps.end()) {
+    delete it->second;
+    g_heaps.erase(it);
+  }
+}
+
+// Allocate >= n elements; returns element offset, or -1 when exhausted.
+int64_t ompi_tpu_buddy_alloc(int64_t h, int64_t n) {
+  auto it = g_heaps.find(h);
+  if (it == g_heaps.end() || n <= 0) return -1;
+  Buddy *b = it->second;
+  int64_t order = order_for(n, b->min_order);
+  if (order > b->max_order) return -1;
+  // Find the smallest order with a free block, splitting downward.
+  int64_t o = order;
+  while (o <= b->max_order &&
+         b->free_lists[static_cast<size_t>(o)].empty()) ++o;
+  if (o > b->max_order) return -1;
+  auto &fl = b->free_lists[static_cast<size_t>(o)];
+  int64_t off = fl.back();
+  fl.pop_back();
+  while (o > order) {                   // split: push upper buddy
+    --o;
+    b->free_lists[static_cast<size_t>(o)].push_back(
+        off + (int64_t(1) << o));
+  }
+  b->allocated[off] = order;
+  return off;
+}
+
+// Free a previously returned offset; coalesces with free buddies.
+// Returns 0, or -1 for an unknown offset (double free / corruption).
+int64_t ompi_tpu_buddy_free(int64_t h, int64_t off) {
+  auto it = g_heaps.find(h);
+  if (it == g_heaps.end()) return -1;
+  Buddy *b = it->second;
+  auto a = b->allocated.find(off);
+  if (a == b->allocated.end()) return -1;
+  int64_t order = a->second;
+  b->allocated.erase(a);
+  while (order < b->max_order) {
+    int64_t buddy = off ^ (int64_t(1) << order);
+    if (!take_free(b, order, buddy)) break;
+    off = off < buddy ? off : buddy;
+    ++order;
+  }
+  b->free_lists[static_cast<size_t>(order)].push_back(off);
+  return 0;
+}
+
+// Bytes-in-use introspection (element count actually reserved).
+int64_t ompi_tpu_buddy_used(int64_t h) {
+  auto it = g_heaps.find(h);
+  if (it == g_heaps.end()) return -1;
+  int64_t used = 0;
+  for (auto &kv : it->second->allocated) used += int64_t(1) << kv.second;
+  return used;
+}
+
+}  // extern "C"

@@ -1,0 +1,88 @@
+// ompi_tpu native reduction kernels — the host-side op table.
+//
+// Re-design of the reference's reduction-op component stack
+// (ompi/mca/op/base/op_base_functions.c: ~2.4K lines of scalar loops for
+// every (op x type); op/avx, op/aarch64: SIMD variants with runtime
+// dispatch). On TPU the device path needs none of this — XLA emits
+// vector code for the reduction computation — but the *host* tier (small
+// host-resident buffers routed to coll/basic by the tuned decision
+// layer, MPI_Reduce_local) wants tight loops. One templated kernel per
+// op, instantiated per dtype, auto-vectorized by the compiler: the
+// modern equivalent of the reference's hand-written SIMD table.
+//
+// ABI: ompi_tpu_reduce_local(op, dtype, in, inout, n) computes
+// inout[i] = in[i] OP inout[i] (MPI_Reduce_local operand order).
+// Returns 0, or -1 for an unsupported (op, dtype) pair — the caller
+// falls back to NumPy, mirroring how op/avx falls back to base kernels
+// (op_avx_functions.c:31-44 compile-capability fallback).
+
+#include <cstdint>
+
+namespace {
+
+enum Op : int64_t {
+  OP_SUM = 0, OP_PROD = 1, OP_MAX = 2, OP_MIN = 3,
+  OP_BAND = 4, OP_BOR = 5, OP_BXOR = 6,
+  OP_LAND = 7, OP_LOR = 8, OP_LXOR = 9,
+};
+
+enum Dtype : int64_t {
+  DT_I8 = 0, DT_I16 = 1, DT_I32 = 2, DT_I64 = 3,
+  DT_U8 = 4, DT_U16 = 5, DT_U32 = 6, DT_U64 = 7,
+  DT_F32 = 8, DT_F64 = 9,
+};
+
+template <typename T, typename F>
+inline void loop(const void *in, void *inout, int64_t n, F f) {
+  const T *a = static_cast<const T *>(in);
+  T *b = static_cast<T *>(inout);
+  for (int64_t i = 0; i < n; ++i) b[i] = f(a[i], b[i]);
+}
+
+// Arithmetic + logical ops exist for every dtype; bitwise only for ints.
+template <typename T>
+int dispatch_common(int64_t op, const void *in, void *inout, int64_t n) {
+  switch (op) {
+    case OP_SUM:  loop<T>(in, inout, n, [](T x, T y) { return T(x + y); }); return 0;
+    case OP_PROD: loop<T>(in, inout, n, [](T x, T y) { return T(x * y); }); return 0;
+    case OP_MAX:  loop<T>(in, inout, n, [](T x, T y) { return x > y ? x : y; }); return 0;
+    case OP_MIN:  loop<T>(in, inout, n, [](T x, T y) { return x < y ? x : y; }); return 0;
+    case OP_LAND: loop<T>(in, inout, n, [](T x, T y) { return T((x != T(0)) && (y != T(0)) ? 1 : 0); }); return 0;
+    case OP_LOR:  loop<T>(in, inout, n, [](T x, T y) { return T((x != T(0)) || (y != T(0)) ? 1 : 0); }); return 0;
+    case OP_LXOR: loop<T>(in, inout, n, [](T x, T y) { return T(((x != T(0)) ? 1 : 0) ^ ((y != T(0)) ? 1 : 0)); }); return 0;
+    default: return -1;
+  }
+}
+
+template <typename T>
+int dispatch_int(int64_t op, const void *in, void *inout, int64_t n) {
+  switch (op) {
+    case OP_BAND: loop<T>(in, inout, n, [](T x, T y) { return T(x & y); }); return 0;
+    case OP_BOR:  loop<T>(in, inout, n, [](T x, T y) { return T(x | y); }); return 0;
+    case OP_BXOR: loop<T>(in, inout, n, [](T x, T y) { return T(x ^ y); }); return 0;
+    default: return dispatch_common<T>(op, in, inout, n);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+int ompi_tpu_reduce_local(int64_t op, int64_t dtype, const void *in,
+                          void *inout, int64_t n) {
+  switch (dtype) {
+    case DT_I8:  return dispatch_int<int8_t>(op, in, inout, n);
+    case DT_I16: return dispatch_int<int16_t>(op, in, inout, n);
+    case DT_I32: return dispatch_int<int32_t>(op, in, inout, n);
+    case DT_I64: return dispatch_int<int64_t>(op, in, inout, n);
+    case DT_U8:  return dispatch_int<uint8_t>(op, in, inout, n);
+    case DT_U16: return dispatch_int<uint16_t>(op, in, inout, n);
+    case DT_U32: return dispatch_int<uint32_t>(op, in, inout, n);
+    case DT_U64: return dispatch_int<uint64_t>(op, in, inout, n);
+    case DT_F32: return dispatch_common<float>(op, in, inout, n);
+    case DT_F64: return dispatch_common<double>(op, in, inout, n);
+    default: return -1;
+  }
+}
+
+}  // extern "C"

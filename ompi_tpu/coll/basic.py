@@ -27,6 +27,19 @@ def _np_fold(op, stacked, axis=0):
     if name == "min":
         return np.min(stacked, axis=axis)
     acc = np.array(np.take(stacked, 0, axis=axis))
+    if op.predefined and not op.is_loc and op.commute:
+        # C++ kernel table (the op/avx role) for the remaining
+        # predefined (commutative) ops: one working accumulator reduced
+        # into in place, zero per-step copies. Per-step fallback keeps
+        # exotic dtypes correct; operand order is irrelevant here by
+        # commutativity — non-commutative ops take the generic loop.
+        from ompi_tpu.native import native_reduce_into
+        acc = np.ascontiguousarray(acc)
+        for i in range(1, stacked.shape[axis]):
+            step = np.ascontiguousarray(np.take(stacked, i, axis=axis))
+            if not native_reduce_into(op.name, step, acc):
+                acc = np.asarray(op.fn(acc, step), dtype=acc.dtype)
+        return acc
     for i in range(1, stacked.shape[axis]):
         acc = np.asarray(op.fn(acc, np.take(stacked, i, axis=axis)))
     return acc

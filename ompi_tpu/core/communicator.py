@@ -379,19 +379,38 @@ class Communicator:
                 for j in range(self.size)]
 
     def alltoallw(self, send_chunks: Sequence[Sequence[Any]],
-                  send_types: Sequence[Sequence[Optional[Datatype]]]):
+                  send_types: Sequence[Sequence[Optional[Datatype]]],
+                  send_counts: Optional[Sequence[Sequence[int]]] = None):
         """MPI_Alltoallw: per-(src,dst) datatypes. Each chunk is packed
         with its own datatype before the exchange (host pack — the w
         variant's per-pair layouts preclude one device index map), then
-        rides the padded alltoall."""
+        rides the padded alltoall. ``send_counts[i][j]`` is the instance
+        count (MPI's explicit count argument); when omitted, the maximal
+        count that fits the chunk is used — MPI buffer-length rule: the
+        last instance needs only the type's true extent."""
         packed = []
-        for row, trow in zip(send_chunks, send_types):
+        for i, (row, trow) in enumerate(zip(send_chunks, send_types)):
             prow = []
-            for c, t in zip(row, trow):
+            for j, (c, t) in enumerate(zip(row, trow)):
                 a = np.asarray(c)
                 if t is not None and not t.is_contiguous:
-                    cnt = a.shape[-1] // max(t.extent, 1)
-                    a = np.asarray(convertor.pack(a, t, cnt))
+                    extent = max(t.extent, 1)
+                    lo, rng = t.get_true_extent()
+                    if send_counts is not None:
+                        cnt = send_counts[i][j]
+                    elif a.shape[-1] < lo + rng:
+                        cnt = 0
+                    else:
+                        cnt = 1 + (a.shape[-1] - lo - rng) // extent
+                    if a.shape[-1] < ((cnt - 1) * extent + lo + rng
+                                      if cnt else 0):
+                        self._err(ERR_COUNT,
+                                  f"alltoallw chunk length {a.shape[-1]} "
+                                  f"cannot hold {cnt} instances "
+                                  f"(extent {extent}, true extent "
+                                  f"{lo + rng})")
+                    a = (np.asarray(convertor.pack(a, t, cnt)) if cnt
+                         else np.empty((0,), a.dtype))
                 prow.append(a.ravel())
             packed.append(prow)
         return self.alltoallv(packed)
@@ -834,11 +853,13 @@ class Communicator:
                        else np.empty((0,), arrs[0].dtype))
         return out
 
-    def neighbor_alltoallv(self,
-                           send_chunks: Sequence[Sequence[Any]]) -> List[Any]:
+    def neighbor_alltoallv(self, send_chunks: Sequence[Sequence[Any]]
+                           ) -> List[List[Any]]:
         """MPI_Neighbor_alltoallv: ``send_chunks[r][j]`` is rank r's
         ragged chunk for its j-th out-neighbor; rank r receives one chunk
-        per in-neighbor (in order), concatenated."""
+        per in-neighbor, as a list aligned with its in-neighbor order
+        (empty array where the sender provided no chunk — alignment is
+        never silently shifted)."""
         if self.topo is None:
             from ompi_tpu.core.errhandler import ERR_TOPOLOGY
             self._err(ERR_TOPOLOGY, "no topology attached")
@@ -852,17 +873,17 @@ class Communicator:
                 if 0 <= d < self.size and j < len(send_chunks[s]):
                     recv.setdefault((d, s), deque()).append(
                         np.asarray(send_chunks[s][j]).ravel())
-        out = []
+        empty = np.empty((0,), np.float32)
+        out: List[List[Any]] = []
         for r in range(self.size):
             chunks = []
             for n in self.topo.neighbors(r):
                 if n < 0:
+                    chunks.append(empty)
                     continue
                 q = recv.get((r, n))
-                if q:
-                    chunks.append(q.popleft())
-            out.append(np.concatenate(chunks) if chunks
-                       else np.empty((0,), np.float32))
+                chunks.append(q.popleft() if q else empty)
+            out.append(chunks)
         return out
 
     # -- attributes (keyvals) ------------------------------------------

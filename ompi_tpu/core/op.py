@@ -154,4 +154,9 @@ def reduce_local(inbuf, inoutbuf, op: Op):
     collectives use). Functional: returns the combined array."""
     if not isinstance(op, Op) or op.fn is None:
         raise TypeError("invalid reduction op")
+    if op.predefined and not op.is_loc:
+        from ompi_tpu.native import native_reduce_local
+        out = native_reduce_local(op.name, inbuf, inoutbuf)
+        if out is not None:           # C++ kernel table (op/avx role)
+            return out
     return op.fn(inbuf, inoutbuf)      # inoutbuf = inbuf op inoutbuf

@@ -2,6 +2,57 @@
 
 The shared library is built from ``native/*.cpp`` with g++ on first use
 (cached next to the sources); everything here degrades gracefully to the
-pure-NumPy paths when no compiler is available.
+pure-NumPy paths when no compiler is available. Components:
+
+- ``convertor.cpp`` — run-coalesced pack/unpack (OPAL convertor role)
+- ``ops.cpp``       — host reduction kernels (op/avx role)
+- ``memheap.cpp``   — buddy allocator for the SHMEM symmetric heap
+  (oshmem/mca/memheap/buddy role)
+- ``matching.cpp``  — pt2pt matching core (ob1 recvfrag matching role)
 """
 from ompi_tpu.native.loader import get_lib, native_available  # noqa: F401
+
+import numpy as _np
+
+# (op name -> id) and (numpy dtype -> id) tables mirroring ops.cpp enums.
+_OP_IDS = {"sum": 0, "prod": 1, "max": 2, "min": 3, "band": 4, "bor": 5,
+           "bxor": 6, "land": 7, "lor": 8, "lxor": 9}
+_DT_IDS = {_np.dtype(k): v for k, v in {
+    _np.int8: 0, _np.int16: 1, _np.int32: 2, _np.int64: 3,
+    _np.uint8: 4, _np.uint16: 5, _np.uint32: 6, _np.uint64: 7,
+    _np.float32: 8, _np.float64: 9}.items()}
+
+
+def native_reduce_into(op_name: str, inbuf, inout) -> bool:
+    """In-place ``inout = inbuf OP inout`` via the C++ kernel table.
+    ``inout`` must be a C-contiguous writable ndarray (it is mutated).
+    Returns False when the (op, dtype, layout) combination isn't native
+    (caller falls back — the op/avx fallback pattern)."""
+    lib = get_lib()
+    if lib is None:
+        return False
+    op_id = _OP_IDS.get(op_name)
+    if op_id is None:
+        return False
+    if not (isinstance(inbuf, _np.ndarray) and isinstance(inout, _np.ndarray)
+            and inbuf.dtype == inout.dtype
+            and inbuf.shape == inout.shape
+            and inout.flags["C_CONTIGUOUS"] and inout.flags["WRITEABLE"]):
+        return False
+    dt_id = _DT_IDS.get(inbuf.dtype)
+    if dt_id is None:
+        return False
+    a = _np.ascontiguousarray(inbuf)
+    rc = lib.ompi_tpu_reduce_local(op_id, dt_id, a.ctypes.data,
+                                   inout.ctypes.data, a.size)
+    return rc == 0
+
+
+def native_reduce_local(op_name: str, inbuf, inout):
+    """Functional variant: returns the combined array (inout untouched),
+    or None when not native."""
+    if not (isinstance(inbuf, _np.ndarray) and isinstance(inout, _np.ndarray)
+            and inbuf.dtype == inout.dtype):
+        return None
+    out = _np.ascontiguousarray(inout).copy()
+    return out if native_reduce_into(op_name, inbuf, out) else None

@@ -15,16 +15,24 @@ _tried = False
 
 _PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _REPO_DIR = os.path.dirname(_PKG_DIR)
-_SRC = os.path.join(_REPO_DIR, "native", "convertor.cpp")
-_SO = os.path.join(_REPO_DIR, "native", "libompi_tpu_native.so")
+_NATIVE_DIR = os.path.join(_REPO_DIR, "native")
+_SRCS = [os.path.join(_NATIVE_DIR, f)
+         for f in ("convertor.cpp", "ops.cpp", "memheap.cpp",
+                   "matching.cpp")]
+_SO = os.path.join(_NATIVE_DIR, "libompi_tpu_native.so")
 
 
 def _build() -> Optional[str]:
-    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+    srcs = [s for s in _SRCS if os.path.exists(s)]
+    if not srcs:
+        return None
+    if (os.path.exists(_SO)
+            and os.path.getmtime(_SO) >= max(os.path.getmtime(s)
+                                             for s in srcs)):
         return _SO
     try:
         subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC,
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", *srcs,
              "-o", _SO],
             check=True, capture_output=True, timeout=120)
         return _SO
@@ -47,7 +55,7 @@ def get_lib() -> Optional[ctypes.CDLL]:
             return None
         try:
             lib = ctypes.CDLL(so)
-            if lib.ompi_tpu_native_abi() != 1:
+            if lib.ompi_tpu_native_abi() != 2:
                 return None
             i64 = ctypes.c_int64
             lib.ompi_tpu_pack_runs_rows.argtypes = [
@@ -55,8 +63,36 @@ def get_lib() -> Optional[ctypes.CDLL]:
                 ctypes.c_void_p, i64, i64, i64, i64, i64, i64, i64]
             lib.ompi_tpu_unpack_runs_rows.argtypes = \
                 lib.ompi_tpu_pack_runs_rows.argtypes
+            # reduction-op kernels (ops.cpp)
+            lib.ompi_tpu_reduce_local.argtypes = [
+                i64, i64, ctypes.c_void_p, ctypes.c_void_p, i64]
+            lib.ompi_tpu_reduce_local.restype = ctypes.c_int
+            # buddy allocator (memheap.cpp)
+            for fn, nargs in (("ompi_tpu_buddy_create", 2),
+                              ("ompi_tpu_buddy_alloc", 2),
+                              ("ompi_tpu_buddy_free", 2),
+                              ("ompi_tpu_buddy_used", 1)):
+                f = getattr(lib, fn)
+                f.argtypes = [i64] * nargs
+                f.restype = i64
+            lib.ompi_tpu_buddy_destroy.argtypes = [i64]
+            lib.ompi_tpu_buddy_destroy.restype = None
+            # matching core (matching.cpp)
+            lib.ompi_tpu_match_create.argtypes = [i64]
+            lib.ompi_tpu_match_create.restype = i64
+            lib.ompi_tpu_match_destroy.argtypes = [i64]
+            lib.ompi_tpu_match_destroy.restype = None
+            for fn, nargs in (("ompi_tpu_match_send", 7),
+                              ("ompi_tpu_match_take", 6),
+                              ("ompi_tpu_match_post", 6),
+                              ("ompi_tpu_match_cancel", 3)):
+                f = getattr(lib, fn)
+                f.argtypes = [i64] * nargs
+                f.restype = i64
             _lib = lib
-        except OSError:
+        except (OSError, AttributeError):
+            # AttributeError = missing symbol in a stale cached library;
+            # fall back to the pure-Python paths like any load failure.
             _lib = None
     return _lib
 

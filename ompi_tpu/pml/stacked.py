@@ -105,12 +105,54 @@ class PtpRequest(Request):
 
 class MatchingEngine:
     """Per-communicator pt2pt state: one unexpected FIFO per (dest, src)
-    (non-overtaking), one posted-receive list (match order)."""
+    (non-overtaking), one posted-receive list (match order).
+
+    Two equivalent backends: the C++ matching core (``matching.cpp``, the
+    ob1-recvfrag role — integer descriptors in native queues, payloads
+    held here by handle) when the native library is available, else pure
+    Python. ``OMPI_TPU_DISABLE_NATIVE_MATCH=1`` forces the Python path
+    (the tests run both and assert identical behavior)."""
 
     def __init__(self, comm):
         self.comm = comm
         self.unexpected: Dict[Tuple[int, int], Deque[_Msg]] = {}
         self.posted: List[_PostedRecv] = []
+        self._lib = None
+        self._h = -1
+        import os
+        if not os.environ.get("OMPI_TPU_DISABLE_NATIVE_MATCH"):
+            from ompi_tpu.native import get_lib
+            lib = get_lib()
+            if lib is not None:
+                self._lib = lib
+                self._h = lib.ompi_tpu_match_create(comm.size)
+                self._msgs: Dict[int, _Msg] = {}       # unexpected payloads
+                self._reqs: Dict[int, PtpRequest] = {}  # posted receives
+                self._next_handle = 1
+                self._tag_ids: Dict[Any, int] = {}      # tuple-tag intern
+
+    def __del__(self):
+        lib, h = getattr(self, "_lib", None), getattr(self, "_h", -1)
+        if lib is not None and h >= 0:
+            try:
+                lib.ompi_tpu_match_destroy(h)
+            except Exception:
+                pass
+
+    def _tag_id(self, tag) -> int:
+        """Native tags are int64; tuple tags (partitioned channel) are
+        interned — equality of ids == equality of tags."""
+        if isinstance(tag, int):
+            return tag
+        tid = self._tag_ids.get(tag)
+        if tid is None:
+            tid = self._tag_ids[tag] = (1 << 40) + len(self._tag_ids)
+        return tid
+
+    def _handle(self) -> int:
+        h = self._next_handle
+        self._next_handle += 1
+        return h
 
     def _q(self, dest: int, src: int) -> Deque[_Msg]:
         return self.unexpected.setdefault((dest, src), deque())
@@ -135,27 +177,50 @@ class MatchingEngine:
             # copy). Device arrays are immutable — reference suffices.
             data = data.copy()
         msg = _Msg(src, dest, tag, data, synchronous, channel)
-        for i, pr in enumerate(self.posted):
-            if pr.matches(msg):
-                self.posted.pop(i)
-                pr.req.deliver(msg)
+        if self._lib is not None:
+            mh = self._handle()
+            r = self._lib.ompi_tpu_match_send(
+                self._h, src, dest, self._tag_id(tag), channel, mh,
+                0 if synchronous else 1)
+            if r >= 0:                       # matched a posted receive
+                self._reqs.pop(r).deliver(msg)
                 req = Request.completed()
                 req.status.count = 1
                 return req
+            if not synchronous:
+                self._msgs[mh] = msg
+        else:
+            for i, pr in enumerate(self.posted):
+                if pr.matches(msg):
+                    self.posted.pop(i)
+                    pr.req.deliver(msg)
+                    req = Request.completed()
+                    req.status.count = 1
+                    return req
         if synchronous:
             # MPI_Ssend completes only once the receive has started; in a
             # single-controller world an unmatched synchronous send can
-            # never complete — surface the deadlock.
+            # never complete — surface the deadlock. (The native core was
+            # told not to enqueue it.)
             raise MPIError(
                 ERR_PENDING,
                 "ssend would deadlock: no matching receive posted "
                 "(post irecv first)")
-        self._q(dest, src).append(msg)
+        if self._lib is None:
+            self._q(dest, src).append(msg)
         return Request.completed()
 
     # -- receive side --------------------------------------------------
     def _match_unexpected(self, dest: int, source: int, tag,
-                          channel: int = CH_P2P) -> Optional[_Msg]:
+                          channel: int = CH_P2P,
+                          remove: bool = True) -> Optional[_Msg]:
+        if self._lib is not None:
+            mh = self._lib.ompi_tpu_match_take(
+                self._h, dest, source, self._tag_id(tag), channel,
+                1 if remove else 0)
+            if mh < 0:
+                return None
+            return self._msgs.pop(mh) if remove else self._msgs[mh]
         srcs = (range(self.comm.size) if source == ANY_SOURCE
                 else [source])
         for s in srcs:
@@ -165,7 +230,8 @@ class MatchingEngine:
             for i, msg in enumerate(q):
                 if msg.channel == channel and (
                         tag == ANY_TAG or tag == msg.tag):
-                    del q[i]
+                    if remove:
+                        del q[i]
                     return msg
         return None
 
@@ -179,6 +245,11 @@ class MatchingEngine:
         msg = self._match_unexpected(dest, source, tag, channel)
         if msg is not None:
             req.deliver(msg)
+        elif self._lib is not None:
+            rh = self._handle()
+            self._reqs[rh] = req
+            self._lib.ompi_tpu_match_post(
+                self._h, dest, source, self._tag_id(tag), channel, rh)
         else:
             self.posted.append(_PostedRecv(source, dest, tag, channel, req))
         return req
@@ -191,15 +262,12 @@ class MatchingEngine:
     # -- probe ---------------------------------------------------------
     def iprobe(self, dest: int, source: int, tag
                ) -> Tuple[bool, Optional[Status]]:
-        srcs = (range(self.comm.size) if source == ANY_SOURCE
-                else [source])
-        for s in srcs:
-            for msg in self.unexpected.get((dest, s), ()):
-                if msg.channel == CH_P2P and (
-                        tag == ANY_TAG or tag == msg.tag):
-                    return True, Status(source=msg.src, tag=msg.tag,
-                                        count=getattr(msg.data, "size", 1))
-        return False, None
+        msg = self._match_unexpected(dest, source, tag, CH_P2P,
+                                     remove=False)
+        if msg is None:
+            return False, None
+        return True, Status(source=msg.src, tag=msg.tag,
+                            count=getattr(msg.data, "size", 1))
 
     def probe(self, dest: int, source: int, tag) -> Status:
         ok, st = self.iprobe(dest, source, tag)

@@ -32,6 +32,26 @@ class ShmemCtx:
         self.heap = Win(comm, heap_size, dtype=dtype, name="symheap")
         self._brk = 0
         self.heap_size = heap_size
+        # Buddy allocator (C++ — the memheap/buddy component role) when
+        # the native library is available; bump-allocator fallback. The
+        # buddy system manages exactly 2^k elements, so it only serves
+        # power-of-two heaps — any other size would either truncate the
+        # window or hand out offsets beyond it.
+        from ompi_tpu.native import get_lib
+        self._lib = get_lib()
+        self._buddy = -1
+        if (self._lib is not None and heap_size > 0
+                and heap_size & (heap_size - 1) == 0):
+            max_order = heap_size.bit_length() - 1
+            self._buddy = self._lib.ompi_tpu_buddy_create(max_order, 0)
+
+    def __del__(self):
+        lib, h = getattr(self, "_lib", None), getattr(self, "_buddy", -1)
+        if lib is not None and h >= 0:
+            try:
+                lib.ompi_tpu_buddy_destroy(h)
+            except Exception:
+                pass
 
     # -- setup (shmem_init / shmem_my_pe / shmem_n_pes) ----------------
     @property
@@ -40,8 +60,14 @@ class ShmemCtx:
 
     def malloc(self, nelems: int) -> int:
         """shmem_malloc: symmetric allocation — returns the symmetric
-        offset, identical on every PE (memheap buddy allocator's job;
-        a bump allocator suffices for the controller)."""
+        offset, identical on every PE. Served by the native buddy
+        allocator (oshmem/mca/memheap/buddy role: power-of-two blocks,
+        split/coalesce), falling back to a bump allocator."""
+        if self._buddy >= 0:
+            addr = self._lib.ompi_tpu_buddy_alloc(self._buddy, nelems)
+            if addr < 0:
+                raise MPIError(ERR_ARG, "symmetric heap exhausted")
+            return int(addr)
         if self._brk + nelems > self.heap_size:
             raise MPIError(ERR_ARG, "symmetric heap exhausted")
         addr = self._brk
@@ -49,7 +75,10 @@ class ShmemCtx:
         return addr
 
     def free(self, addr: int) -> None:
-        pass                        # bump allocator: no-op (like reset-free)
+        """shmem_free: returns the block to the buddy allocator (no-op
+        on the bump fallback)."""
+        if self._buddy >= 0:
+            self._lib.ompi_tpu_buddy_free(self._buddy, addr)
 
     # -- RMA (spml put/get) --------------------------------------------
     def put(self, dest_pe: int, addr: int, data) -> None:

@@ -84,10 +84,21 @@ def test_neighbor_v_variants(world):
             for r in range(n)]
     recv = cart.neighbor_alltoallv(send)
     assert len(recv) == n
-    # rank r's first in-neighbor is (r-1)%n; its chunk to r is its j-th
-    # out-chunk where j indexes r in its neighbor list.
+    # recv[r] is aligned with r's in-neighbor order: chunk i comes from
+    # in-neighbor s at the position of r in s's out-neighbor list.
     for r in range(n):
-        assert recv[r].size > 0
+        nbs = cart.topo.neighbors(r)
+        assert len(recv[r]) == len(nbs)
+        for i, s in enumerate(nbs):
+            j = cart.topo.neighbors(s).index(r)
+            np.testing.assert_array_equal(recv[r][i],
+                                          np.full(2, 10 * s + j, np.float32))
+    # a sender providing a short row leaves an empty placeholder, never
+    # shifting later neighbors' chunks
+    short = [row[:1] for row in send]
+    recv2 = cart.neighbor_alltoallv(short)
+    for r in range(n):
+        assert len(recv2[r]) == len(cart.topo.neighbors(r))
 
 
 # -- reduce_local ----------------------------------------------------------

@@ -1,0 +1,193 @@
+"""Native runtime components: reduction-op kernel table (ops.cpp, the
+op/avx role), buddy allocator (memheap.cpp, the oshmem memheap/buddy
+role), and the pt2pt matching core (matching.cpp, the ob1 recvfrag
+role) — including Python-vs-native backend parity for matching."""
+import numpy as np
+import pytest
+
+from ompi_tpu.core import op as op_mod
+from ompi_tpu.native import get_lib, native_available, native_reduce_local
+
+
+pytestmark = pytest.mark.skipif(not native_available(),
+                                reason="native library unavailable")
+
+
+# -- ops.cpp ---------------------------------------------------------------
+@pytest.mark.parametrize("opname,ref", [
+    ("sum", np.add), ("prod", np.multiply),
+    ("max", np.maximum), ("min", np.minimum),
+])
+@pytest.mark.parametrize("dtype", [np.int8, np.int32, np.int64, np.uint16,
+                                   np.float32, np.float64])
+def test_reduce_kernels_arith(rng, opname, ref, dtype):
+    if np.issubdtype(dtype, np.integer):
+        a = rng.integers(1, 5, 33).astype(dtype)
+        b = rng.integers(1, 5, 33).astype(dtype)
+    else:
+        a = rng.standard_normal(33).astype(dtype)
+        b = rng.standard_normal(33).astype(dtype)
+    out = native_reduce_local(opname, a, b)
+    assert out is not None and out.dtype == a.dtype
+    np.testing.assert_allclose(out, ref(a, b), rtol=1e-6)
+
+
+@pytest.mark.parametrize("opname,ref", [
+    ("band", np.bitwise_and), ("bor", np.bitwise_or),
+    ("bxor", np.bitwise_xor),
+])
+def test_reduce_kernels_bitwise(rng, opname, ref):
+    a = rng.integers(0, 255, 64).astype(np.uint8)
+    b = rng.integers(0, 255, 64).astype(np.uint8)
+    np.testing.assert_array_equal(native_reduce_local(opname, a, b),
+                                  ref(a, b))
+    # bitwise on float is unsupported -> caller falls back
+    assert native_reduce_local(
+        opname, np.ones(3, np.float32), np.ones(3, np.float32)) is None
+
+
+def test_reduce_kernels_logical(rng):
+    a = rng.integers(0, 2, 40).astype(np.int32)
+    b = rng.integers(0, 2, 40).astype(np.int32)
+    np.testing.assert_array_equal(
+        native_reduce_local("land", a, b), (a.astype(bool) & b.astype(bool)))
+    np.testing.assert_array_equal(
+        native_reduce_local("lxor", a, b),
+        (a.astype(bool) ^ b.astype(bool)).astype(np.int32))
+
+
+def test_reduce_local_uses_native_and_matches_fallback(rng, monkeypatch):
+    a = rng.standard_normal(17).astype(np.float32)
+    b = rng.standard_normal(17).astype(np.float32)
+    native = np.asarray(op_mod.reduce_local(a, b, op_mod.SUM))
+    import ompi_tpu.native as N
+    monkeypatch.setattr(N, "get_lib", lambda: None)
+    fallback = np.asarray(op_mod.reduce_local(a, b, op_mod.SUM))
+    np.testing.assert_allclose(native, fallback, rtol=1e-6)
+
+
+# -- memheap.cpp (buddy) ---------------------------------------------------
+def test_buddy_alloc_free_coalesce():
+    lib = get_lib()
+    h = lib.ompi_tpu_buddy_create(6, 0)          # 64-element heap
+    assert h > 0
+    a = lib.ompi_tpu_buddy_alloc(h, 16)
+    b = lib.ompi_tpu_buddy_alloc(h, 16)
+    c = lib.ompi_tpu_buddy_alloc(h, 32)
+    assert {a, b} == {0, 16} and c == 32
+    assert lib.ompi_tpu_buddy_alloc(h, 1) == -1   # exhausted
+    assert lib.ompi_tpu_buddy_used(h) == 64
+    # free the two 16s -> they coalesce into a 32
+    assert lib.ompi_tpu_buddy_free(h, a) == 0
+    assert lib.ompi_tpu_buddy_free(h, b) == 0
+    d = lib.ompi_tpu_buddy_alloc(h, 32)
+    assert d == 0
+    # double free detected
+    assert lib.ompi_tpu_buddy_free(h, 16) == -1
+    lib.ompi_tpu_buddy_destroy(h)
+
+
+def test_buddy_rounds_to_power_of_two():
+    lib = get_lib()
+    h = lib.ompi_tpu_buddy_create(5, 0)          # 32 elements
+    a = lib.ompi_tpu_buddy_alloc(h, 5)           # -> 8-block
+    b = lib.ompi_tpu_buddy_alloc(h, 8)
+    assert a != b and a % 8 == 0 and b % 8 == 0
+    lib.ompi_tpu_buddy_destroy(h)
+
+
+def test_shmem_malloc_free_reuses_space(world):
+    from ompi_tpu.shmem.api import ShmemCtx
+    ctx = ShmemCtx(world, heap_size=32)
+    addrs = [ctx.malloc(8) for _ in range(4)]    # fills the heap
+    assert len(set(addrs)) == 4
+    with pytest.raises(Exception):
+        ctx.malloc(8)
+    ctx.free(addrs[0])
+    again = ctx.malloc(8)
+    assert again == addrs[0]                     # space actually reclaimed
+
+
+# -- matching.cpp: backend parity ------------------------------------------
+class _FakeComm:
+    size = 4
+
+
+def _engine(monkeypatch, native: bool):
+    from ompi_tpu.pml.stacked import MatchingEngine
+    if native:
+        monkeypatch.delenv("OMPI_TPU_DISABLE_NATIVE_MATCH", raising=False)
+    else:
+        monkeypatch.setenv("OMPI_TPU_DISABLE_NATIVE_MATCH", "1")
+    return MatchingEngine(_FakeComm())
+
+
+@pytest.mark.parametrize("native", [True, False])
+def test_matching_backend(monkeypatch, native):
+    from ompi_tpu.pml.stacked import ANY_SOURCE, ANY_TAG
+    eng = _engine(monkeypatch, native)
+    assert (eng._lib is not None) == native
+    # non-overtaking FIFO per (dest, src)
+    eng.send(np.array([1.0]), 0, 1, 7)
+    eng.send(np.array([2.0]), 0, 1, 7)
+    d1, _ = eng.recv(1, 0, 7)
+    d2, _ = eng.recv(1, 0, 7)
+    assert d1[0] == 1.0 and d2[0] == 2.0
+    # wildcards: ANY_SOURCE scans sources in rank order
+    eng.send(np.array([30.0]), 3, 2, 5)
+    eng.send(np.array([10.0]), 1, 2, 5)
+    d, st = eng.recv(2, ANY_SOURCE, ANY_TAG)
+    assert d[0] == 10.0 and st.source == 1
+    d, st = eng.recv(2, ANY_SOURCE, 5)
+    assert d[0] == 30.0 and st.source == 3
+    # posted receive matched by later send, post order respected
+    r1 = eng.irecv(3, ANY_SOURCE, 9)
+    r2 = eng.irecv(3, 0, ANY_TAG)
+    eng.send(np.array([5.0]), 0, 3, 9)       # matches r1 (posted first)
+    ok, st1 = r1.test()
+    assert ok and r1.get()[0] == 5.0
+    ok2, _ = r2.test()
+    assert not ok2
+    eng.send(np.array([6.0]), 0, 3, 11)      # matches r2
+    assert r2.test()[0] and r2.get()[0] == 6.0
+
+
+@pytest.mark.parametrize("native", [True, False])
+def test_matching_backend_probe_and_ssend(monkeypatch, native):
+    from ompi_tpu.core.errhandler import MPIError
+    eng = _engine(monkeypatch, native)
+    ok, st = eng.iprobe(1, 0, 3)
+    assert not ok
+    eng.send(np.arange(4), 0, 1, 3)
+    ok, st = eng.iprobe(1, 0, 3)
+    assert ok and st.count == 4
+    ok2, _ = eng.iprobe(1, 0, 3)             # probe does not consume
+    assert ok2
+    msg = eng.mprobe(1, 0, 3)                # mprobe consumes
+    data, _ = eng.mrecv(msg)
+    assert data.size == 4
+    assert eng.iprobe(1, 0, 3)[0] is False
+    # unmatched ssend deadlock surfaces and does NOT enqueue the message
+    with pytest.raises(MPIError):
+        eng.send(np.ones(1), 2, 0, 1, synchronous=True)
+    assert eng.iprobe(0, 2, 1)[0] is False
+    # matched ssend completes
+    r = eng.irecv(0, 2, 1)
+    eng.send(np.ones(1), 2, 0, 1, synchronous=True)
+    assert r.test()[0]
+
+
+@pytest.mark.parametrize("native", [True, False])
+def test_matching_backend_partitioned_channel(monkeypatch, native):
+    from ompi_tpu.pml.stacked import CH_PART
+    eng = _engine(monkeypatch, native)
+    # tuple tags on the partitioned channel never cross-match user tags
+    eng.send(np.array([1.0]), 0, 1, ("part", 4, 0), channel=CH_PART)
+    assert eng.iprobe(1, 0, -1)[0] is False   # invisible to p2p channel
+    r = eng.irecv(1, 0, ("part", 4, 0), channel=CH_PART)
+    ok, _ = r.test()
+    assert ok and r.get()[0] == 1.0
+    # distinct tuple tags stay distinct
+    eng.send(np.array([2.0]), 0, 1, ("part", 4, 1), channel=CH_PART)
+    r2 = eng.irecv(1, 0, ("part", 4, 2), channel=CH_PART)
+    assert r2.test()[0] is False
