@@ -45,8 +45,10 @@ int dispatch_common(int64_t op, const void *in, void *inout, int64_t n) {
   switch (op) {
     case OP_SUM:  loop<T>(in, inout, n, [](T x, T y) { return T(x + y); }); return 0;
     case OP_PROD: loop<T>(in, inout, n, [](T x, T y) { return T(x * y); }); return 0;
-    case OP_MAX:  loop<T>(in, inout, n, [](T x, T y) { return x > y ? x : y; }); return 0;
-    case OP_MIN:  loop<T>(in, inout, n, [](T x, T y) { return x < y ? x : y; }); return 0;
+    // NaN-propagating (x!=x only for float NaN; folds away for ints) —
+    // must match the jnp.maximum/minimum fallback semantics.
+    case OP_MAX:  loop<T>(in, inout, n, [](T x, T y) { return x != x ? x : (y != y ? y : (x > y ? x : y)); }); return 0;
+    case OP_MIN:  loop<T>(in, inout, n, [](T x, T y) { return x != x ? x : (y != y ? y : (x < y ? x : y)); }); return 0;
     case OP_LAND: loop<T>(in, inout, n, [](T x, T y) { return T((x != T(0)) && (y != T(0)) ? 1 : 0); }); return 0;
     case OP_LOR:  loop<T>(in, inout, n, [](T x, T y) { return T((x != T(0)) || (y != T(0)) ? 1 : 0); }); return 0;
     case OP_LXOR: loop<T>(in, inout, n, [](T x, T y) { return T(((x != T(0)) ? 1 : 0) ^ ((y != T(0)) ? 1 : 0)); }); return 0;

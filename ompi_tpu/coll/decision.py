@@ -1,0 +1,89 @@
+"""coll decision tables — fixed per-collective algorithm selection.
+
+Re-design of coll/tuned's decision functions
+(``coll_tuned_decision_fixed.c:40-45``; the allreduce rule block at
+``:55-120`` is 1,644 lines of comm-size x message-size switch points
+"averaged across contributors' clusters"). On TPU the honest default is
+different: XLA's ``direct`` lowering already emits an ICI-optimal
+schedule, so the fixed table only diverges from ``direct`` where an
+explicit schedule is semantically or structurally better (multi-host
+tiers, very large buffers where the two-phase redscat+allgather shape
+gives XLA a bandwidth-optimal decomposition hint). The *structure* —
+ordered (min_comm_size, min_message_bytes) -> algorithm rules, first
+match from the most specific — mirrors the reference so that operators
+can retune via the dynamic-rules JSON exactly as tuned's dynamic file
+does (``coll_tuned_component.c:187-191``).
+
+Rule shape: ``{func: [[min_comm_size, min_bytes, algorithm], ...]}`` —
+rules are scanned in order, the *last* rule whose thresholds are both
+satisfied wins (so files list rules from general to specific, the way
+the reference's nested size switches read).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+# Fixed decision tables. Every entry must name an algorithm the xla
+# component implements for that collective (see coll/xla.py registry).
+FIXED_RULES: Dict[str, List[Sequence]] = {
+    # Small/latency-bound -> one fused collective (XLA's own schedule);
+    # huge single-host buffers -> explicit redscat+allgather
+    # (Rabenseifner's shape, coll_base_allreduce.c:919-926).
+    "allreduce": [
+        [0, 0, "direct"],
+        [0, 64 << 20, "rabenseifner"],
+    ],
+    "bcast": [
+        [0, 0, "direct"],
+        [0, 64 << 20, "scatter_allgather"],
+    ],
+    "allgather": [[0, 0, "direct"]],
+    "alltoall": [[0, 0, "direct"]],
+    "reduce_scatter_block": [[0, 0, "direct"]],
+    "barrier": [[0, 0, "direct"]],
+}
+
+# Algorithms that reorder floating-point combines relative to rank
+# order; selection must fall back to 'direct' for non-commutative ops
+# (the reference documents the same constraint per algorithm,
+# coll_base_allreduce.c:291-294).
+REORDERING = frozenset({
+    "ring", "hier", "recursive_doubling", "rabenseifner",
+})
+
+# Algorithms only defined for power-of-two communicator sizes.
+POW2_ONLY = frozenset({"recursive_doubling"})
+
+
+def _match(rules: List[Sequence], comm_size: int, nbytes: int) -> str:
+    alg = "direct"
+    for rule in rules:
+        try:
+            min_size, min_bytes, name = rule[0], rule[1], rule[2]
+        except (IndexError, TypeError):
+            continue
+        if comm_size >= min_size and nbytes >= min_bytes:
+            alg = str(name)
+    return alg
+
+
+def decide(func: str, comm_size: int, nbytes: int, multihost: bool,
+           dynamic: Dict[str, Dict] | None = None) -> str:
+    """Pick an algorithm for ``func`` on a ``comm_size``-rank comm moving
+    ``nbytes`` per rank. ``dynamic`` is the tuned dynamic-rules dict; a
+    ``{func: {"algorithm_rules": [...]}}`` entry overrides the fixed
+    table wholesale (the reference's dynamic file has the same
+    override-don't-merge semantics)."""
+    rules = None
+    if dynamic:
+        rules = dynamic.get(func, {}).get("algorithm_rules")
+    if rules:
+        return _match(rules, comm_size, nbytes)
+    if multihost and func == "allreduce":
+        # Multi-host: the two-tier composition keeps bulk traffic on
+        # ICI and only the scattered chunk on DCN (coll/han's role).
+        return "hier"
+    rules = FIXED_RULES.get(func)
+    if not rules:
+        return "direct"
+    return _match(rules, comm_size, nbytes)

@@ -18,7 +18,8 @@ override it per collective — the re-design of tuned's dynamic rule file
 from __future__ import annotations
 
 import json
-from typing import Dict, Optional
+import os
+from typing import Dict, Optional, Tuple
 
 from ompi_tpu.accelerator import (LOCUS_DEVICE, check_addr, to_device,
                                   to_host)
@@ -29,15 +30,30 @@ from ompi_tpu.mca import var
 from ompi_tpu.mca.base import Component
 
 
+_rules_cache: Dict[str, Tuple[float, Dict]] = {}
+
+
 def _load_rules(path: str) -> Dict[str, Dict]:
+    """mtime-memoized: the decision layer consults this per collective
+    call, so re-parsing the JSON every time would put file IO on the
+    hot path."""
     if not path:
         return {}
     try:
+        mtime = os.path.getmtime(path)
+    except OSError:
+        return {}
+    cached = _rules_cache.get(path)
+    if cached is not None and cached[0] == mtime:
+        return cached[1]
+    try:
         with open(path) as f:
             data = json.load(f)
-        return data if isinstance(data, dict) else {}
+        rules = data if isinstance(data, dict) else {}
     except (OSError, ValueError):
-        return {}
+        rules = {}
+    _rules_cache[path] = (mtime, rules)
+    return rules
 
 
 class TunedCollModule:

@@ -37,6 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ompi_tpu.core.communicator import AXIS
+from ompi_tpu.coll import decision
 from ompi_tpu.coll.framework import coll_framework
 from ompi_tpu.mca import var
 from ompi_tpu.mca.base import Component
@@ -98,12 +99,30 @@ class XlaCollModule:
     # with axis_index_groups so intra-group traffic stays on the fast
     # tier (ICI) and only the scattered chunk crosses the slow tier
     # (DCN), for multi-host meshes.
-    def _algorithm(self) -> str:
-        alg = var.var_get("coll_xla_allreduce_algorithm", "auto")
-        if alg != "auto":
-            return alg
+    def _multihost(self) -> bool:
         procs = {getattr(d, "process_index", 0) for d in self.comm.devices}
-        return "hier" if len(procs) > 1 else "direct"
+        return len(procs) > 1
+
+    def _algorithm(self, func: str = "allreduce", nbytes: int = 0,
+                   commute: bool = True) -> str:
+        """Per-collective algorithm selection: the explicit MCA var wins;
+        ``auto`` consults the decision tables (coll/decision.py, the
+        coll_tuned_decision_fixed role) plus the tuned dynamic-rules
+        file. Structural constraints (commutativity, power-of-two size)
+        degrade to ``direct`` exactly as the reference's decision
+        functions fall back to basic_linear."""
+        alg = var.var_get(f"coll_xla_{func}_algorithm", "auto")
+        if alg == "auto":
+            from ompi_tpu.coll.tuned import _load_rules
+            dyn = _load_rules(var.var_get("coll_tuned_dynamic_rules", ""))
+            alg = decision.decide(func, self.comm.size, nbytes,
+                                  self._multihost(), dyn)
+        if alg in decision.REORDERING and not commute:
+            return "direct"
+        n = self.comm.size
+        if alg in decision.POW2_ONLY and (n & (n - 1)) != 0:
+            return "direct"
+        return alg
 
     def _groups(self):
         """(low, high) axis_index_groups: low = ranks sharing a process
@@ -207,15 +226,198 @@ class XlaCollModule:
             return out.reshape(-1)[:total].reshape(shape)[None]
         return inner
 
+    def _rd_allreduce_inner(self, op, n):
+        """Explicit recursive doubling (butterfly): log2(n) ppermute
+        exchanges with partner r XOR d
+        (ompi_coll_base_allreduce_intra_recursivedoubling). Combine
+        order is normalized to (lower-rank, higher-rank) so every rank
+        folds in the same order -> bitwise-identical float results on
+        all ranks. Power-of-two sizes only (selection enforces)."""
+        def inner(b):                    # block (1, *s)
+            x = b
+            r = jax.lax.axis_index(AXIS)
+            d = 1
+            while d < n:
+                perm = [(i, i ^ d) for i in range(n)]
+                recvd = jax.lax.ppermute(x, AXIS, perm=perm)
+                lower = (r & d) == 0     # my rank has the d-bit clear
+                x = jnp.where(lower, op.fn(x, recvd), op.fn(recvd, x))
+                d *= 2
+            return x
+        return inner
+
+    def _rabenseifner_inner(self, op, n, shape):
+        """Explicit redscat+allgather (Rabenseifner's algorithm,
+        ompi_coll_base_allreduce_intra_redscat_allgather): phase 1
+        reduce-scatters the buffer so each rank reduces 1/n of it,
+        phase 2 all-gathers the reduced chunks. On ICI this hands XLA
+        the bandwidth-optimal two-phase decomposition explicitly —
+        2(n-1)/n of the buffer moves per phase. Sum-family ops only
+        (psum_scatter); selection gates others to direct."""
+        total = int(np.prod(shape))
+        chunk = -(-total // n)
+
+        def inner(b):                    # block (1, *s)
+            x = b.reshape(-1)
+            x = jnp.pad(x, (0, n * chunk - total)).reshape(n, chunk)
+            part = jax.lax.psum_scatter(x, AXIS, scatter_dimension=0,
+                                        tiled=True)
+            out = jax.lax.all_gather(part, AXIS, tiled=True)
+            return out.reshape(-1)[:total].reshape(b.shape)
+        return inner
+
+    def _ring_allgather_inner(self, n):
+        """Ring allgather (ompi_coll_base_allgather_intra_ring): n-1
+        neighbor shifts, each rank forwarding the block it received the
+        previous step."""
+        perm = [(i, (i + 1) % n) for i in range(n)]
+
+        def inner(b):                    # (1, *s) -> (1, n, *s)
+            x = b[0]
+            r = jax.lax.axis_index(AXIS)
+            buf = jnp.zeros((n,) + x.shape, x.dtype)
+            buf = jax.lax.dynamic_update_index_in_dim(buf, x, r, 0)
+
+            def step(carry, t):
+                buf, cur = carry
+                cur = jax.lax.ppermute(cur, AXIS, perm=perm)
+                idx = jnp.mod(r - t - 1, n)
+                buf = jax.lax.dynamic_update_index_in_dim(buf, cur, idx, 0)
+                return (buf, cur), None
+
+            (buf, _), _ = jax.lax.scan(step, (buf, x), jnp.arange(n - 1))
+            return buf[None]
+        return inner
+
+    def _bruck_allgather_inner(self, n):
+        """Bruck allgather (ompi_coll_base_allgather_intra_bruck):
+        ceil(log2 n) rounds, doubling the forwarded block count each
+        round; works for any n (final partial round), finishing with a
+        local rotation from relative to absolute rank order."""
+        def inner(b):                    # (1, *s) -> (1, n, *s)
+            x = b[0]
+            buf = x[None]                # blocks ordered r, r+1, ...
+            while buf.shape[0] < n:
+                dist = buf.shape[0]
+                perm = [(i, (i - dist) % n) for i in range(n)]
+                recvd = jax.lax.ppermute(buf, AXIS, perm=perm)
+                take = min(dist, n - buf.shape[0])
+                buf = jnp.concatenate([buf, recvd[:take]], axis=0)
+            r = jax.lax.axis_index(AXIS)
+            idx = jnp.mod(jnp.arange(n) - r, n)
+            return jnp.take(buf, idx, axis=0)[None]
+        return inner
+
+    def _binomial_bcast_inner(self, n, root):
+        """Binomial-tree bcast (ompi_coll_base_bcast_intra_binomial):
+        ceil(log2 n) rounds; in round k, virtual ranks < 2^k forward to
+        virtual rank + 2^k (virtual rank = (r - root) mod n)."""
+        def inner(b):                    # (1, *s)
+            x = b
+            r = jax.lax.axis_index(AXIS)
+            vr = jnp.mod(r - root, n)
+            d = 1
+            while d < n:
+                perm = [(i, (i + d) % n) for i in range(n)]
+                recvd = jax.lax.ppermute(x, AXIS, perm=perm)
+                accept = (vr >= d) & (vr < 2 * d)
+                x = jnp.where(accept, recvd, x)
+                d *= 2
+            return x
+        return inner
+
+    def _scatter_allgather_bcast_inner(self, n, root, shape):
+        """Large-message bcast as scatter+allgather
+        (ompi_coll_base_bcast_intra_scatter_allgather): the root's
+        buffer is chunked, the scatter phase moves one chunk per rank
+        (bandwidth-optimal: psum_scatter over a root-masked stack), the
+        allgather phase reassembles. Arithmetic dtypes only."""
+        total = int(np.prod(shape))
+        chunk = -(-total // n)
+
+        def inner(b):                    # (1, *s)
+            r = jax.lax.axis_index(AXIS)
+            x = b.reshape(-1)
+            x = jnp.pad(x, (0, n * chunk - total)).reshape(n, chunk)
+            masked = jnp.where(r == root, x, jnp.zeros_like(x))
+            part = jax.lax.psum_scatter(masked, AXIS,
+                                        scatter_dimension=0, tiled=True)
+            out = jax.lax.all_gather(part, AXIS, tiled=True)
+            return out.reshape(-1)[:total].reshape(b.shape)
+        return inner
+
+    def _pairwise_alltoall_inner(self, n):
+        """Pairwise-exchange alltoall
+        (ompi_coll_base_alltoall_intra_pairwise): n-1 rounds; in round
+        t, rank r sends its block for (r+t) mod n and receives from
+        (r-t) mod n."""
+        def inner(b):                    # (1, n, *s)
+            x = b[0]
+            r = jax.lax.axis_index(AXIS)
+            own = jax.lax.dynamic_index_in_dim(x, r, 0, keepdims=False)
+            out = jnp.zeros_like(x)
+            out = jax.lax.dynamic_update_index_in_dim(out, own, r, 0)
+            for t in range(1, n):
+                perm = [(i, (i + t) % n) for i in range(n)]
+                send = jax.lax.dynamic_index_in_dim(
+                    x, jnp.mod(r + t, n), 0, keepdims=False)
+                recvd = jax.lax.ppermute(send, AXIS, perm=perm)
+                out = jax.lax.dynamic_update_index_in_dim(
+                    out, recvd, jnp.mod(r - t, n), 0)
+            return out[None]
+        return inner
+
+    def _ring_reduce_scatter_inner(self, op, n):
+        """Ring reduce_scatter (ompi_coll_base_reduce_scatter_intra_ring
+        shape): n-1 accumulating shifts; rank r ends owning fully
+        reduced chunk r. This is exactly the reduce-scatter phase of
+        the segmented-ring allreduce with the ownership offset chosen
+        so the final chunk index equals the rank."""
+        perm = [(i, (i + 1) % n) for i in range(n)]
+
+        def inner(b):                    # (1, n, *s) -> (1, *s)
+            buf = b[0]
+            r = jax.lax.axis_index(AXIS)
+
+            def step(buf, t):
+                send_idx = jnp.mod(r - t - 1, n)
+                send = jax.lax.dynamic_index_in_dim(buf, send_idx, 0,
+                                                    keepdims=False)
+                recvd = jax.lax.ppermute(send, AXIS, perm=perm)
+                tgt = jnp.mod(r - t - 2, n)
+                cur = jax.lax.dynamic_index_in_dim(buf, tgt, 0,
+                                                   keepdims=False)
+                buf = jax.lax.dynamic_update_index_in_dim(
+                    buf, op.fn(cur, recvd), tgt, 0)
+                return buf, None
+
+            buf, _ = jax.lax.scan(step, buf, jnp.arange(n - 1))
+            return jax.lax.dynamic_index_in_dim(buf, r, 0,
+                                                keepdims=False)[None]
+        return inner
+
+    def _dissemination_barrier_inner(self, n):
+        """Dissemination barrier (ompi_coll_base_barrier_intra_bruck /
+        scoll_basic's dissemination): ceil(log2 n) rounds; in round k
+        each rank signals rank (r + 2^k) mod n. Token sums make every
+        round's arrival observable by dataflow."""
+        def inner(b):                    # (1,) token
+            x = b
+            d = 1
+            while d < n:
+                perm = [(i, (i + d) % n) for i in range(n)]
+                x = x + jax.lax.ppermute(x, AXIS, perm=perm)
+                d *= 2
+            return x
+        return inner
+
     # -- collectives -----------------------------------------------------
     def allreduce(self, x, op):
         x = self._to_mesh(x)
         n = self.comm.size
-        alg = self._algorithm()
-        if alg in ("ring", "hier") and not op.commute:
-            # Ring and the two-level hierarchy both reorder combines;
-            # the reference documents the same commutativity constraint
-            # (coll_base_allreduce.c:291). 'direct' keeps rank order.
+        alg = self._algorithm("allreduce", x.nbytes // max(n, 1),
+                              op.commute)
+        if alg == "rabenseifner" and op.xla_prim != "sum":
             alg = "direct"
         low = high = None
         if alg == "hier":
@@ -228,6 +430,10 @@ class XlaCollModule:
                 inner = self._ring_allreduce_inner(op, n, x.shape[1:])
             elif alg == "hier":
                 inner = self._hier_allreduce_inner(op, low, high)
+            elif alg == "recursive_doubling":
+                inner = self._rd_allreduce_inner(op, n)
+            elif alg == "rabenseifner":
+                inner = self._rabenseifner_inner(op, n, x.shape[1:])
             elif op.xla_prim == "sum":
                 inner = lambda b: jax.lax.psum(b, AXIS)
             elif op.xla_prim == "max":
@@ -250,9 +456,19 @@ class XlaCollModule:
 
     def bcast(self, x, root: int):
         x = self._to_mesh(x)
+        n = self.comm.size
+        arith = np.dtype(x.dtype).kind in _ARITH_KINDS
+        alg = self._algorithm("bcast", x.nbytes // max(n, 1))
+        if alg == "scatter_allgather" and not arith:
+            alg = "direct"
 
         def build():
-            if np.dtype(x.dtype).kind in _ARITH_KINDS:
+            if alg == "binomial":
+                inner = self._binomial_bcast_inner(n, root)
+            elif alg == "scatter_allgather":
+                inner = self._scatter_allgather_bcast_inner(
+                    n, root, x.shape[1:])
+            elif arith:
                 def inner(b):
                     r = jax.lax.axis_index(AXIS)
                     masked = jnp.where(r == root, b, jnp.zeros_like(b))
@@ -262,17 +478,25 @@ class XlaCollModule:
                     g = jax.lax.all_gather(b, AXIS, axis=0, tiled=True)
                     return jax.lax.dynamic_slice_in_dim(g, root, 1, 0)
             return self._smap(inner, x.ndim, x.ndim)
-        return self._compiled(self._key("bcast", x, root), build)(x)
+        return self._compiled(self._key("bcast", x, root, alg), build)(x)
 
     def allgather(self, x):
         x = self._to_mesh(x)
+        n = self.comm.size
+        alg = self._algorithm("allgather", x.nbytes // max(n, 1))
 
         def build():
-            def inner(b):                       # (1, *s) -> (1, N, *s)
-                g = jax.lax.all_gather(b[0], AXIS, axis=0, tiled=False)
-                return g[None]
+            if alg == "ring":
+                inner = self._ring_allgather_inner(n)
+            elif alg == "bruck":
+                inner = self._bruck_allgather_inner(n)
+            else:
+                def inner(b):                   # (1, *s) -> (1, N, *s)
+                    g = jax.lax.all_gather(b[0], AXIS, axis=0,
+                                           tiled=False)
+                    return g[None]
             return self._smap(inner, x.ndim, x.ndim + 1)
-        return self._compiled(self._key("allgather", x), build)(x)
+        return self._compiled(self._key("allgather", x, alg), build)(x)
 
     def gather(self, x, root: int):
         # Symmetric-ICI design choice: gather lowers to all_gather (every
@@ -292,20 +516,30 @@ class XlaCollModule:
 
     def alltoall(self, x):
         x = self._to_mesh(x)
+        n = self.comm.size
+        alg = self._algorithm("alltoall", x.nbytes // max(n, 1))
 
         def build():
-            def inner(b):                       # (1, N, *s) -> (1, N, *s)
-                y = jax.lax.all_to_all(b[0], AXIS, split_axis=0,
-                                       concat_axis=0, tiled=True)
-                return y[None]
+            if alg == "pairwise":
+                inner = self._pairwise_alltoall_inner(n)
+            else:
+                def inner(b):               # (1, N, *s) -> (1, N, *s)
+                    y = jax.lax.all_to_all(b[0], AXIS, split_axis=0,
+                                           concat_axis=0, tiled=True)
+                    return y[None]
             return self._smap(inner, x.ndim, x.ndim)
-        return self._compiled(self._key("alltoall", x), build)(x)
+        return self._compiled(self._key("alltoall", x, alg), build)(x)
 
     def reduce_scatter_block(self, x, op):
         x = self._to_mesh(x)
+        n = self.comm.size
+        alg = self._algorithm("reduce_scatter_block",
+                              x.nbytes // max(n, 1), op.commute)
 
         def build():
-            if op.xla_prim == "sum":
+            if alg == "ring":
+                inner = self._ring_reduce_scatter_inner(op, n)
+            elif op.xla_prim == "sum":
                 def inner(b):                   # (1, N, *s) -> (1, *s)
                     return jax.lax.psum_scatter(b[0], AXIS,
                                                 scatter_dimension=0,
@@ -317,7 +551,7 @@ class XlaCollModule:
                     return op.reduce_tree(y, axis=0)[None]
             return self._smap(inner, x.ndim, x.ndim - 1)
         return self._compiled(
-            self._key("reduce_scatter_block", x, op.name), build)(x)
+            self._key("reduce_scatter_block", x, op.name, alg), build)(x)
 
     def _prefix(self, g, op):
         if op.name == "sum":
@@ -358,10 +592,15 @@ class XlaCollModule:
 
     def _barrier_arrays(self):
         x = self._to_mesh(jnp.ones((self.comm.size,), jnp.int32))
+        n = self.comm.size
+        alg = self._algorithm("barrier", 4)
 
         def build():
+            if alg == "dissemination":
+                return self._smap(self._dissemination_barrier_inner(n),
+                                  1, 1)
             return self._smap(lambda b: jax.lax.psum(b, AXIS), 1, 1)
-        y = self._compiled(("barrier", self.comm.size), build)(x)
+        y = self._compiled(("barrier", n, alg), build)(x)
         return [y]
 
     def barrier(self) -> None:
@@ -380,11 +619,42 @@ class XlaCollComponent(Component):
                               "collective component")
         var.var_register(
             "coll", "xla", "allreduce_algorithm", vtype="str",
-            default="auto", enumerator=["auto", "direct", "ring", "hier"],
+            default="auto",
+            enumerator=["auto", "direct", "ring", "hier",
+                        "recursive_doubling", "rabenseifner"],
             help="Allreduce lowering: direct fused XLA collective, "
-                 "explicit ppermute segmented ring, or han-style "
-                 "two-level hierarchy (auto: hier on multi-host, else "
-                 "direct)")
+                 "explicit ppermute segmented ring, han-style two-level "
+                 "hierarchy, recursive-doubling butterfly, or "
+                 "Rabenseifner redscat+allgather (auto: decision table)")
+        var.var_register(
+            "coll", "xla", "allgather_algorithm", vtype="str",
+            default="auto",
+            enumerator=["auto", "direct", "ring", "bruck"],
+            help="Allgather lowering: fused XLA all_gather, explicit "
+                 "neighbor-shift ring, or log-round Bruck doubling")
+        var.var_register(
+            "coll", "xla", "bcast_algorithm", vtype="str",
+            default="auto",
+            enumerator=["auto", "direct", "binomial",
+                        "scatter_allgather"],
+            help="Bcast lowering: root-masked psum, binomial tree over "
+                 "ppermute, or scatter+allgather (large messages)")
+        var.var_register(
+            "coll", "xla", "alltoall_algorithm", vtype="str",
+            default="auto", enumerator=["auto", "direct", "pairwise"],
+            help="Alltoall lowering: fused XLA all_to_all or explicit "
+                 "pairwise exchange rounds")
+        var.var_register(
+            "coll", "xla", "reduce_scatter_block_algorithm", vtype="str",
+            default="auto", enumerator=["auto", "direct", "ring"],
+            help="Reduce_scatter_block lowering: fused psum_scatter or "
+                 "explicit accumulating ring")
+        var.var_register(
+            "coll", "xla", "barrier_algorithm", vtype="str",
+            default="auto",
+            enumerator=["auto", "direct", "dissemination"],
+            help="Barrier lowering: scalar psum or dissemination "
+                 "(log-round signal) pattern")
 
     def comm_query(self, comm):
         if comm is None or not getattr(comm, "mesh", None):

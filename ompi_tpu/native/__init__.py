@@ -51,8 +51,13 @@ def native_reduce_into(op_name: str, inbuf, inout) -> bool:
 def native_reduce_local(op_name: str, inbuf, inout):
     """Functional variant: returns the combined array (inout untouched),
     or None when not native."""
-    if not (isinstance(inbuf, _np.ndarray) and isinstance(inout, _np.ndarray)
-            and inbuf.dtype == inout.dtype):
+    if (get_lib() is None
+            or _OP_IDS.get(op_name) is None
+            or not (isinstance(inbuf, _np.ndarray)
+                    and isinstance(inout, _np.ndarray)
+                    and inbuf.dtype == inout.dtype
+                    and inbuf.shape == inout.shape)
+            or _DT_IDS.get(inbuf.dtype) is None):
         return None
     out = _np.ascontiguousarray(inout).copy()
     return out if native_reduce_into(op_name, inbuf, out) else None

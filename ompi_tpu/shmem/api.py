@@ -78,7 +78,11 @@ class ShmemCtx:
         """shmem_free: returns the block to the buddy allocator (no-op
         on the bump fallback)."""
         if self._buddy >= 0:
-            self._lib.ompi_tpu_buddy_free(self._buddy, addr)
+            rc = self._lib.ompi_tpu_buddy_free(self._buddy, addr)
+            if rc != 0:
+                raise MPIError(ERR_ARG,
+                               f"shmem_free: invalid or double free at "
+                               f"offset {addr}")
 
     # -- RMA (spml put/get) --------------------------------------------
     def put(self, dest_pe: int, addr: int, data) -> None:

@@ -1,0 +1,135 @@
+"""Explicit algorithm registry tests (coll/xla + coll/decision).
+
+Each explicit schedule (ring, recursive doubling, Rabenseifner, bruck,
+binomial, pairwise, dissemination) must produce the same result as the
+``direct`` fused-XLA lowering — the analogue of the reference validating
+every coll_base algorithm against basic_linear.
+"""
+import numpy as np
+import pytest
+
+from ompi_tpu.coll import decision
+from ompi_tpu.mca import var
+
+
+@pytest.fixture
+def alg(request):
+    """Set one coll_xla_*_algorithm var for the test, restore after."""
+    def _set(func, name):
+        key = f"coll_xla_{func}_algorithm"
+        var.var_set(key, name)
+        request.addfinalizer(lambda: var.var_set(key, "auto"))
+    return _set
+
+
+def _rank_data(world, shape=(5,), dtype=np.float32, seed=0):
+    rng = np.random.default_rng(seed)
+    rows = [rng.standard_normal(shape).astype(dtype) + r
+            for r in range(world.size)]
+    return rows, world.stack(rows)
+
+
+@pytest.mark.parametrize("name", ["ring", "recursive_doubling",
+                                  "rabenseifner", "hier"])
+def test_allreduce_algorithms_match_direct(mpi, world, alg, name):
+    rows, x = _rank_data(world, (7,))
+    alg("allreduce", name)
+    y = np.asarray(world.allreduce(x, mpi.SUM))
+    want = np.sum(rows, axis=0)
+    assert np.allclose(y, np.broadcast_to(want, y.shape), atol=1e-4)
+
+
+def test_recursive_doubling_bitwise_identical_across_ranks(mpi, world,
+                                                           alg):
+    # The normalized (lower, higher) combine order must give every rank
+    # the exact same float bits.
+    _, x = _rank_data(world, (16,), seed=3)
+    alg("allreduce", "recursive_doubling")
+    y = np.asarray(world.allreduce(x, mpi.SUM))
+    for r in range(1, world.size):
+        assert np.array_equal(y[0], y[r])
+
+
+def test_allreduce_max_via_recursive_doubling(mpi, world, alg):
+    rows, x = _rank_data(world, (4,), seed=5)
+    alg("allreduce", "recursive_doubling")
+    y = np.asarray(world.allreduce(x, mpi.MAX))
+    assert np.allclose(y[0], np.max(rows, axis=0))
+
+
+@pytest.mark.parametrize("name", ["ring", "bruck"])
+def test_allgather_algorithms(mpi, world, alg, name):
+    rows, x = _rank_data(world, (3,), seed=1)
+    alg("allgather", name)
+    y = np.asarray(world.allgather(x))
+    want = np.stack(rows)                     # (n, 3)
+    for r in range(world.size):
+        assert np.allclose(y[r], want)
+
+
+@pytest.mark.parametrize("name", ["binomial", "scatter_allgather"])
+def test_bcast_algorithms(mpi, world, alg, name):
+    rows, x = _rank_data(world, (6,), seed=2)
+    root = 3
+    alg("bcast", name)
+    y = np.asarray(world.bcast(x, root=root))
+    for r in range(world.size):
+        assert np.allclose(y[r], rows[root], atol=1e-6)
+
+
+def test_alltoall_pairwise(mpi, world, alg):
+    n = world.size
+    rows = [np.arange(n * 2, dtype=np.float32).reshape(n, 2) + 100 * r
+            for r in range(n)]
+    x = world.stack(rows)
+    alg("alltoall", "pairwise")
+    y = np.asarray(world.alltoall(x))
+    for r in range(n):
+        for s in range(n):
+            assert np.allclose(y[r, s], rows[s][r])
+
+
+def test_reduce_scatter_ring(mpi, world, alg):
+    n = world.size
+    rows = [np.random.default_rng(r).standard_normal((n, 3))
+            .astype(np.float32) for r in range(n)]
+    x = world.stack(rows)
+    alg("reduce_scatter_block", "ring")
+    y = np.asarray(world.reduce_scatter_block(x, mpi.SUM))
+    want = np.sum(rows, axis=0)               # (n, 3)
+    for r in range(n):
+        assert np.allclose(y[r], want[r], atol=1e-4)
+
+
+def test_barrier_dissemination(mpi, world, alg):
+    alg("barrier", "dissemination")
+    world.barrier()                            # completes -> pass
+
+
+def test_decision_fixed_table_structure():
+    # last-match-wins over (min_comm_size, min_bytes) thresholds
+    assert decision.decide("allreduce", 8, 64, False) == "direct"
+    assert decision.decide("allreduce", 8, 128 << 20, False) == \
+        "rabenseifner"
+    assert decision.decide("allreduce", 8, 64, True) == "hier"
+    assert decision.decide("bcast", 8, 128 << 20, False) == \
+        "scatter_allgather"
+
+
+def test_decision_dynamic_rules_override():
+    dyn = {"allgather": {"algorithm_rules": [[0, 0, "ring"],
+                                             [4, 1024, "bruck"]]}}
+    assert decision.decide("allgather", 2, 64, False, dyn) == "ring"
+    assert decision.decide("allgather", 8, 4096, False, dyn) == "bruck"
+
+
+def test_non_commutative_falls_back_to_direct(mpi, world, alg):
+    # A non-commutative user op must not run a reordering schedule.
+    rows, x = _rank_data(world, (3,), seed=9)
+    # "take the right operand" is associative but NOT commutative: an
+    # ordered left fold yields the highest rank's data; a reordering
+    # schedule would yield some other rank's.
+    f = mpi.op_create(lambda a, b: b, commute=False)
+    alg("allreduce", "ring")
+    y = np.asarray(world.allreduce(x, f))
+    assert np.allclose(y[0], rows[world.size - 1], atol=1e-6)
