@@ -59,11 +59,10 @@ def _match(rules: List[Sequence], comm_size: int, nbytes: int) -> str:
     alg = "direct"
     for rule in rules:
         try:
-            min_size, min_bytes, name = rule[0], rule[1], rule[2]
+            if comm_size >= rule[0] and nbytes >= rule[1]:
+                alg = str(rule[2])
         except (IndexError, TypeError):
-            continue
-        if comm_size >= min_size and nbytes >= min_bytes:
-            alg = str(name)
+            continue                  # malformed user rule: skip it
     return alg
 
 

@@ -487,8 +487,13 @@ class Communicator:
     def _pml(self):
         eng = getattr(self, "_pml_engine", None)
         if eng is None:
+            from ompi_tpu.mca import var
+            from ompi_tpu.pml import vprotocol  # registers pml_v_protocol
             from ompi_tpu.pml.stacked import MatchingEngine
-            eng = self._pml_engine = MatchingEngine(self)
+            if var.var_get("pml_v_protocol", "none") == "pessimist":
+                eng = self._pml_engine = vprotocol.PessimistEngine(self)
+            else:
+                eng = self._pml_engine = MatchingEngine(self)
         return eng
 
     def _record_pml(self, event: str) -> None:

@@ -14,13 +14,30 @@ coll framework like scoll/mpi.
 """
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 
 from ompi_tpu.core import op as op_mod
-from ompi_tpu.core.errhandler import ERR_ARG, MPIError
+from ompi_tpu.core.errhandler import ERR_ARG, ERR_PENDING, MPIError
 from ompi_tpu.osc.framework import Win
+
+# shmem_wait_until / shmem_test comparison constants
+# (oshmem/include/shmem.h SHMEM_CMP_*).
+CMP_EQ, CMP_NE, CMP_GT, CMP_LE, CMP_LT, CMP_GE = range(6)
+
+_CMP_FNS = {
+    CMP_EQ: lambda a, b: a == b,
+    CMP_NE: lambda a, b: a != b,
+    CMP_GT: lambda a, b: a > b,
+    CMP_LE: lambda a, b: a <= b,
+    CMP_LT: lambda a, b: a < b,
+    CMP_GE: lambda a, b: a >= b,
+}
+
+# shmem_put_signal signal operations (SHMEM_SIGNAL_SET / _ADD).
+SIGNAL_SET = 0
+SIGNAL_ADD = 1
 
 
 class ShmemCtx:
@@ -98,6 +115,110 @@ class ShmemCtx:
     def g(self, src_pe: int, addr: int):
         return self.get(src_pe, addr, 1)[0]
 
+    # Nonblocking-implicit variants (shmem_put_nbi / shmem_get_nbi):
+    # completion is deferred to quiet(). Device puts complete at XLA
+    # dispatch here, so these alias the blocking calls — the contract
+    # (result not guaranteed until quiet) still holds.
+    def put_nbi(self, dest_pe: int, addr: int, data) -> None:
+        self.put(dest_pe, addr, data)
+
+    def get_nbi(self, src_pe: int, addr: int, nelems: int):
+        return self.get(src_pe, addr, nelems)
+
+    def iput(self, dest_pe: int, addr: int, data, tst: int = 1,
+             sst: int = 1) -> None:
+        """shmem_iput: strided put — element i of the (source-strided)
+        ``data`` lands at ``addr + i*tst`` on the target."""
+        src = np.asarray(data)[::sst]
+        for i, v in enumerate(src):
+            self.p(dest_pe, addr + i * tst, v)
+
+    def iget(self, src_pe: int, addr: int, nelems: int,
+             tst: int = 1, sst: int = 1):
+        """shmem_iget: strided get — reads ``nelems`` elements from
+        ``addr, addr+sst, ...`` and returns them laid out as the local
+        target buffer would be: element i at index ``i*tst`` (holes
+        zero-filled), exactly mirroring iput's target stride."""
+        vals = [self.g(src_pe, addr + i * sst) for i in range(nelems)]
+        out = np.zeros((nelems - 1) * tst + 1 if nelems else 0,
+                       dtype=np.asarray(vals).dtype if vals else float)
+        out[::tst] = vals
+        return out
+
+    def ptr(self, pe: int):
+        """shmem_ptr: direct load/store access to ``pe``'s heap segment.
+        The heap row is an immutable HBM shard, so this returns a host
+        snapshot (reads are direct; stores must go through put — the
+        same degradation shmem_ptr has on non-shared-memory PEs, where
+        it returns NULL and callers fall back to put/get)."""
+        return self.get(pe, 0, self.heap_size)
+
+    # -- pt2pt synchronization (shmem_wait_until / shmem_test) ---------
+    def test(self, pe: int, addr: int, cmp: int, value) -> bool:
+        """shmem_test: does PE ``pe``'s heap word at ``addr`` satisfy
+        the comparison now?"""
+        fn = _CMP_FNS.get(cmp)
+        if fn is None:
+            raise MPIError(ERR_ARG, f"bad SHMEM_CMP constant: {cmp}")
+        return bool(fn(self.g(pe, addr), value))
+
+    def wait_until(self, pe: int, addr: int, cmp: int, value) -> None:
+        """shmem_wait_until. Single-controller: no other thread can
+        change the heap while we block, so an unsatisfied wait is a
+        deadlock — surfaced, like the matching engine does."""
+        if not self.test(pe, addr, cmp, value):
+            raise MPIError(
+                ERR_PENDING,
+                "shmem_wait_until would deadlock: condition is not "
+                "satisfied and no concurrent producer exists "
+                "(single-controller: perform the put first)")
+
+    # -- signaling (shmem_put_signal, SHMEM 1.5) -----------------------
+    def put_signal(self, dest_pe: int, addr: int, data, sig_addr: int,
+                   signal, sig_op: int = SIGNAL_SET) -> None:
+        """shmem_put_signal: deliver ``data`` then update the signal
+        word at ``sig_addr`` (SET or ADD) — delivery ordering (payload
+        visible before signal) is by construction here."""
+        self.put(dest_pe, addr, data)
+        if sig_op == SIGNAL_ADD:
+            self.atomic_add(dest_pe, sig_addr, signal)
+        else:
+            self.atomic_set(dest_pe, sig_addr, signal)
+
+    def signal_fetch(self, pe: int, sig_addr: int):
+        """shmem_signal_fetch."""
+        return self.g(pe, sig_addr)
+
+    def signal_wait_until(self, pe: int, sig_addr: int, cmp: int,
+                          value) -> None:
+        self.wait_until(pe, sig_addr, cmp, value)
+
+    # -- distributed locks (shmem_set_lock / test / clear) -------------
+    def set_lock(self, addr: int, pe: int = 0) -> None:
+        """shmem_set_lock: acquire the lock at symmetric ``addr`` on
+        behalf of PE ``pe``. Held-lock acquisition is a deadlock in a
+        single-controller world and is surfaced."""
+        if not self.test_lock(addr, pe):
+            raise MPIError(
+                ERR_PENDING,
+                f"shmem_set_lock would deadlock: lock at offset {addr} "
+                f"is already held")
+
+    def test_lock(self, addr: int, pe: int = 0) -> bool:
+        """shmem_test_lock: try-acquire; True on success. Implemented
+        as compare-and-swap 0 -> pe+1 on the lock word at PE 0's heap
+        (the lock-owner PE in OpenSHMEM's algorithm)."""
+        prev = self.atomic_compare_swap(0, addr, 0, pe + 1)
+        return int(prev) == 0
+
+    def clear_lock(self, addr: int, pe: int = 0) -> None:
+        """shmem_clear_lock: release (must hold it)."""
+        prev = self.atomic_compare_swap(0, addr, pe + 1, 0)
+        if int(prev) != pe + 1:
+            raise MPIError(ERR_ARG,
+                           f"shmem_clear_lock: PE {pe} does not hold "
+                           f"the lock at offset {addr}")
+
     # -- atomics (oshmem/mca/atomic) -----------------------------------
     def atomic_set(self, dest_pe: int, addr: int, value) -> None:
         self.p(dest_pe, addr, value)
@@ -154,9 +275,105 @@ class ShmemCtx:
                 for i in range(self.n_pes)])
             self.put(j, addr, out)
 
+    def alltoalls(self, addr: int, nelems: int, dst: int = 1,
+                  sst: int = 1) -> None:
+        """shmem_alltoalls: strided alltoall — PE i's block j is read
+        with source stride ``sst`` and written into PE j's segment with
+        destination stride ``dst`` at block i."""
+        n = self.n_pes
+        span_src = nelems * sst * n
+        blocks = [self.get(pe, addr, span_src) for pe in range(n)]
+        span_dst = (n * nelems - 1) * dst + 1
+        for j in range(n):
+            # Assemble the whole destination row host-side (holes keep
+            # their current content) and write it with ONE put — the
+            # bulk pattern alltoall uses, not n*nelems single-element
+            # puts.
+            row = np.array(self.get(j, addr, span_dst))
+            for i in range(n):
+                seg = blocks[i][j * nelems * sst:
+                                (j + 1) * nelems * sst:sst]
+                base = i * nelems * dst
+                row[base:base + (nelems - 1) * dst + 1:dst] = seg
+            self.put(j, addr, row)
+
+    def fcollect(self, addr: int, nelems: int):
+        """shmem_fcollect: fixed-size concatenation (alias of collect
+        with uniform block size)."""
+        return self.collect(addr, nelems)
+
+    def collect_varying(self, addr: int, nelems_per_pe: List[int]):
+        """shmem_collect: concatenation with per-PE block sizes (the
+        varying-nelems form the f-variant fixes)."""
+        return np.concatenate([self.get(pe, addr, int(ne))
+                               for pe, ne in enumerate(nelems_per_pe)])
+
+    # Named to_all reductions (shmem_<type>_<op>_to_all surface).
+    def sum_to_all(self, addr, nelems):
+        self.reduce(addr, nelems, op_mod.SUM)
+
+    def prod_to_all(self, addr, nelems):
+        self.reduce(addr, nelems, op_mod.PROD)
+
+    def max_to_all(self, addr, nelems):
+        self.reduce(addr, nelems, op_mod.MAX)
+
+    def min_to_all(self, addr, nelems):
+        self.reduce(addr, nelems, op_mod.MIN)
+
+    def and_to_all(self, addr, nelems):
+        self.reduce(addr, nelems, op_mod.BAND)
+
+    def or_to_all(self, addr, nelems):
+        self.reduce(addr, nelems, op_mod.BOR)
+
+    def xor_to_all(self, addr, nelems):
+        self.reduce(addr, nelems, op_mod.BXOR)
+
+    # -- contexts (shmem_ctx_create, SHMEM 1.4) ------------------------
+    def ctx_create(self) -> "ShmemCommCtx":
+        """shmem_ctx_create: an independent ordering stream over the
+        same heap (its quiet orders only its own operations)."""
+        return ShmemCommCtx(self)
+
     # -- teams (spml teams, oshmem/mca/spml/spml.h:689-784) -------------
     def team_world(self) -> "ShmemTeam":
         return ShmemTeam(self, list(range(self.n_pes)))
+
+
+class ShmemCommCtx:
+    """A communication context (``shmem_ctx_t``): put/get/atomics
+    delegated to the parent heap, with an independent completion scope —
+    ``quiet`` orders only operations issued through this context (the
+    contexts framework's purpose; here each op completes at issue, so
+    the scope is trivially satisfied, but the op count makes the scope
+    observable/testable)."""
+
+    def __init__(self, parent: ShmemCtx):
+        self.parent = parent
+        self.pending_ops = 0
+
+    def put(self, dest_pe: int, addr: int, data) -> None:
+        self.parent.put(dest_pe, addr, data)
+        self.pending_ops += 1
+
+    def get(self, src_pe: int, addr: int, nelems: int):
+        self.pending_ops += 1
+        return self.parent.get(src_pe, addr, nelems)
+
+    def atomic_add(self, dest_pe: int, addr: int, value) -> None:
+        self.parent.atomic_add(dest_pe, addr, value)
+        self.pending_ops += 1
+
+    def quiet(self) -> None:
+        self.parent.quiet()
+        self.pending_ops = 0
+
+    def fence(self) -> None:
+        self.parent.fence()
+
+    def destroy(self) -> None:
+        self.quiet()
 
 
 class ShmemTeam:

@@ -116,6 +116,14 @@ def test_decision_fixed_table_structure():
         "scatter_allgather"
 
 
+def test_decision_malformed_rules_skipped():
+    dyn = {"allreduce": {"algorithm_rules": [["0", "0", "ring"],
+                                             [0, 0, "rabenseifner"]]}}
+    # string thresholds are skipped, well-formed rules still apply
+    assert decision.decide("allreduce", 8, 64, False, dyn) == \
+        "rabenseifner"
+
+
 def test_decision_dynamic_rules_override():
     dyn = {"allgather": {"algorithm_rules": [[0, 0, "ring"],
                                              [4, 1024, "bruck"]]}}

@@ -1,0 +1,132 @@
+"""Extended OSHMEM surface: wait/test, signals, locks, strided RMA,
+strided alltoall, varying collect, named reductions, contexts.
+
+Behavioral spec: ``oshmem/shmem/c`` entry points (SHMEM 1.4/1.5 —
+wait_until/test, put_signal, set/test/clear_lock, iput/iget,
+alltoalls, collect, ctx_create).
+"""
+import numpy as np
+import pytest
+
+from ompi_tpu.core.errhandler import MPIError
+from ompi_tpu.shmem.api import (CMP_EQ, CMP_GE, CMP_LT, CMP_NE,
+                                SIGNAL_ADD, SIGNAL_SET, ShmemCtx)
+
+
+@pytest.fixture
+def ctx(world):
+    return ShmemCtx(world, heap_size=1 << 10, dtype=np.float64)
+
+
+def test_wait_until_and_test(ctx):
+    a = ctx.malloc(4)
+    ctx.p(2, a, 7.0)
+    assert ctx.test(2, a, CMP_EQ, 7.0)
+    assert ctx.test(2, a, CMP_GE, 7.0)
+    assert not ctx.test(2, a, CMP_LT, 7.0)
+    ctx.wait_until(2, a, CMP_NE, 0.0)          # satisfied -> returns
+    with pytest.raises(MPIError):
+        ctx.wait_until(2, a, CMP_EQ, 99.0)     # deadlock surfaced
+
+
+def test_put_signal_set_and_add(ctx):
+    data = ctx.malloc(4)
+    sig = ctx.malloc(1)
+    ctx.put_signal(3, data, np.float64([1, 2, 3, 4]), sig, 1.0,
+                   SIGNAL_SET)
+    assert np.allclose(ctx.get(3, data, 4), [1, 2, 3, 4])
+    assert ctx.signal_fetch(3, sig) == 1.0
+    ctx.put_signal(3, data, np.float64([5, 6, 7, 8]), sig, 1.0,
+                   SIGNAL_ADD)
+    assert ctx.signal_fetch(3, sig) == 2.0
+    ctx.signal_wait_until(3, sig, CMP_EQ, 2.0)
+
+
+def test_locks(ctx):
+    lk = ctx.malloc(1)
+    assert ctx.test_lock(lk, pe=2)             # acquired
+    assert not ctx.test_lock(lk, pe=5)         # contended
+    with pytest.raises(MPIError):
+        ctx.set_lock(lk, pe=5)                 # deadlock surfaced
+    with pytest.raises(MPIError):
+        ctx.clear_lock(lk, pe=5)               # not the holder
+    ctx.clear_lock(lk, pe=2)
+    ctx.set_lock(lk, pe=5)                     # now free
+    ctx.clear_lock(lk, pe=5)
+
+
+def test_iput_iget_strided(ctx):
+    a = ctx.malloc(16)
+    ctx.put(1, a, np.zeros(16))
+    ctx.iput(1, a, np.float64([1, 2, 3, 4]), tst=2)
+    row = ctx.get(1, a, 8)
+    assert np.allclose(row, [1, 0, 2, 0, 3, 0, 4, 0])
+    got = ctx.iget(1, a, 4, sst=2)
+    assert np.allclose(got, [1, 2, 3, 4])
+    # target stride spaces elements locally (mirrors iput), never drops
+    spaced = ctx.iget(1, a, 4, tst=2, sst=2)
+    assert np.allclose(spaced, [1, 0, 2, 0, 3, 0, 4])
+
+
+def test_alltoalls_strided(ctx):
+    n = ctx.n_pes
+    a = ctx.malloc(2 * n)
+    for pe in range(n):                        # PE pe's block j = pe*10+j
+        ctx.put(pe, a, np.float64([pe * 10 + j for j in range(n)]))
+    ctx.alltoalls(a, 1, dst=1, sst=1)
+    for pe in range(n):
+        got = ctx.get(pe, a, n)
+        assert np.allclose(got, [i * 10 + pe for i in range(n)])
+
+
+def test_collect_varying_and_fcollect(ctx):
+    n = ctx.n_pes
+    a = ctx.malloc(4)
+    for pe in range(n):
+        ctx.put(pe, a, np.float64([pe, pe, pe, pe]))
+    assert np.allclose(ctx.fcollect(a, 2),
+                       np.repeat(np.arange(n), 2))
+    sizes = [1 + (pe % 2) for pe in range(n)]
+    got = ctx.collect_varying(a, sizes)
+    want = np.concatenate([[pe] * s for pe, s in enumerate(sizes)])
+    assert np.allclose(got, want)
+
+
+def test_named_reductions(ctx):
+    a = ctx.malloc(2)
+    for pe in range(ctx.n_pes):
+        ctx.put(pe, a, np.float64([pe + 1, 1.0]))
+    ctx.max_to_all(a, 2)
+    assert np.allclose(ctx.get(0, a, 2), [ctx.n_pes, 1.0])
+    for pe in range(ctx.n_pes):
+        ctx.put(pe, a, np.float64([pe + 1, 2.0]))
+    ctx.sum_to_all(a, 2)
+    n = ctx.n_pes
+    assert np.allclose(ctx.get(3, a, 2), [n * (n + 1) / 2, 2.0 * n])
+
+
+def test_named_bitwise_reductions(world):
+    ctx = ShmemCtx(world, heap_size=1 << 8, dtype=np.int64)
+    a = ctx.malloc(1)
+    for pe in range(ctx.n_pes):
+        ctx.p(pe, a, 1 << pe)
+    ctx.or_to_all(a, 1)
+    assert int(ctx.g(0, a)) == (1 << ctx.n_pes) - 1
+
+
+def test_ctx_create_scope(ctx):
+    c = ctx.ctx_create()
+    a = ctx.malloc(2)
+    c.put(1, a, np.float64([4, 5]))
+    assert c.pending_ops == 1
+    c.quiet()
+    assert c.pending_ops == 0
+    assert np.allclose(ctx.get(1, a, 2), [4, 5])
+    c.destroy()
+
+
+def test_ptr_snapshot(ctx):
+    a = ctx.malloc(2)
+    ctx.put(2, a, np.float64([8, 9]))
+    snap = ctx.ptr(2)
+    assert np.allclose(snap[a:a + 2], [8, 9])
