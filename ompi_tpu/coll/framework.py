@@ -32,18 +32,34 @@ def _ensure_components() -> None:
     _components_loaded = True
 
 
+def select_winners(comm):
+    """Run selection and pick the highest-priority provider per
+    collective function. Returns (winners: func -> (component, module),
+    selected: [(prio, component, module)] descending). Shared by
+    comm_select_coll and the comm_method selection-table tool so the
+    two can't drift."""
+    _ensure_components()
+    selected = coll_framework.comm_select(comm)   # descending priority
+    winners: Dict[str, Any] = {}
+    for func in COLL_FUNCS:
+        for _prio, comp, module in selected:
+            if getattr(module, func, None) is not None:
+                winners[func] = (comp, module)
+                break
+    return winners, selected
+
+
 def comm_select_coll(comm) -> Dict[str, Any]:
     """Build the c_coll vtable for ``comm``: highest-priority provider per
     collective function; when monitoring is enabled, wrap every slot in
     the counting shim (which delegates to the slot's real winner)."""
-    _ensure_components()
-    selected = coll_framework.comm_select(comm)   # descending priority
-    vtable: Dict[str, Any] = {}
-    for func in COLL_FUNCS:
-        for _prio, _comp, module in selected:
-            if getattr(module, func, None) is not None:
-                vtable[func] = module
-                break
+    winners, selected = select_winners(comm)
+    # Cache the selection outcome for introspection (comm_method).
+    comm._coll_winners = {f: comp.name
+                          for f, (comp, _m) in winners.items()}
+    comm._coll_priorities = [(comp.name, prio)
+                             for prio, comp, _m in selected]
+    vtable: Dict[str, Any] = {f: m for f, (_c, m) in winners.items()}
     from ompi_tpu.coll import monitoring
     if vtable and monitoring.enabled():
         vtable = monitoring.wrap_vtable(comm, vtable)

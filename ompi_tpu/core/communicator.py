@@ -102,6 +102,8 @@ class Communicator:
     def _select_coll(self) -> None:
         from ompi_tpu.coll.framework import comm_select_coll
         self.c_coll = comm_select_coll(self)
+        from ompi_tpu.tools import comm_method
+        comm_method.maybe_display(self)
 
     def _err(self, error_class: int, msg: str = ""):
         return self.errhandler.invoke(self, error_class, msg)

@@ -117,6 +117,10 @@ class MatchingEngine:
         self.comm = comm
         self.unexpected: Dict[Tuple[int, int], Deque[_Msg]] = {}
         self.posted: List[_PostedRecv] = []
+        # Per-peer traffic accounting (the pml/monitoring role): the
+        # (src, dest) -> [messages, bytes] table behind
+        # tools/profile.py's communication matrix.
+        self.traffic: Dict[Tuple[int, int], List[int]] = {}
         self._lib = None
         self._h = -1
         import os
@@ -176,6 +180,9 @@ class MatchingEngine:
             # returns; mutable host arrays are snapshotted (the eager
             # copy). Device arrays are immutable — reference suffices.
             data = data.copy()
+        t = self.traffic.setdefault((src, dest), [0, 0])
+        t[0] += 1
+        t[1] += int(getattr(data, "nbytes", 0) or 0)
         msg = _Msg(src, dest, tag, data, synchronous, channel)
         if self._lib is not None:
             mh = self._handle()

@@ -114,7 +114,12 @@ class PessimistEngine(MatchingEngine):
         if replay_log is not None:
             self._replay = {}
             for ev in replay_log:
-                if ev.kind == "match":
+                # Only wildcard resolutions are nondeterministic; a
+                # named receive replays itself (and consumes no
+                # determinant), so enqueuing its match event would
+                # shift every later wildcard onto the wrong one.
+                if ev.kind == "match" and (ev.posted_src == ANY_SOURCE
+                                           or ev.posted_tag == ANY_TAG):
                     self._replay.setdefault(ev.dest, deque()).append(ev)
 
     # -- record side ---------------------------------------------------

@@ -128,10 +128,17 @@ class ShmemCtx:
     def iput(self, dest_pe: int, addr: int, data, tst: int = 1,
              sst: int = 1) -> None:
         """shmem_iput: strided put — element i of the (source-strided)
-        ``data`` lands at ``addr + i*tst`` on the target."""
+        ``data`` lands at ``addr + i*tst`` on the target. Assembled
+        host-side (holes keep their current content) and written with
+        one put, not one put per element."""
         src = np.asarray(data)[::sst]
-        for i, v in enumerate(src):
-            self.p(dest_pe, addr + i * tst, v)
+        n = len(src)
+        if n == 0:
+            return
+        span = (n - 1) * tst + 1
+        row = np.array(self.get(dest_pe, addr, span))
+        row[::tst] = src
+        self.put(dest_pe, addr, row)
 
     def iget(self, src_pe: int, addr: int, nelems: int,
              tst: int = 1, sst: int = 1):

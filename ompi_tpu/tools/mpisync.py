@@ -1,0 +1,88 @@
+"""mpisync — cross-participant clock-offset measurement.
+
+Behavioral spec: ``ompi/tools/mpisync`` (``mpigclock.c``): measure the
+clock offset of every rank against rank 0 by ping-pong round trips,
+keeping the sample with the smallest RTT (the least contaminated by
+network jitter), so traces from different hosts can be aligned.
+
+TPU-native re-design: ranks on one controller share a clock (offset 0
+by construction); what needs syncing is *controllers* (multi-host) and
+the host <-> device timeline. The estimator is the same mpigclock
+algorithm generalized over any remote-clock probe: ``measure_offset``
+takes a callable returning the remote clock "now" and returns the
+(offset, rtt) of the best of N round trips; ``sync_report`` applies it
+to every participant of a communicator (remote controllers probed via
+the coordination-service KV when distributed, the shared clock
+otherwise).
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Tuple
+
+
+def measure_offset(remote_now: Callable[[], float],
+                   rounds: int = 10,
+                   local_now: Callable[[], float] = time.perf_counter,
+                   ) -> Tuple[float, float]:
+    """mpigclock's kernel: ``rounds`` ping-pongs; for each, the remote
+    clock is sampled between two local samples (t0, t1) and the offset
+    estimate is ``remote - (t0 + t1)/2``. The sample with the smallest
+    RTT wins. Returns (offset_seconds, best_rtt_seconds)."""
+    best_rtt = float("inf")
+    best_off = 0.0
+    for _ in range(max(rounds, 1)):
+        t0 = local_now()
+        r = remote_now()
+        t1 = local_now()
+        rtt = t1 - t0
+        if rtt < best_rtt:
+            best_rtt = rtt
+            best_off = r - (t0 + t1) / 2.0
+    return best_off, best_rtt
+
+
+def sync_report(comm, rounds: int = 10,
+                remote_clocks: Dict[int, Callable[[], float]] | None
+                = None) -> List[Dict]:
+    """Offset of every rank's clock against rank 0 (the mpisync output
+    table). Ranks sharing this controller share its clock: offset is 0
+    by construction and reported with rtt 0. Remote controllers (ranks
+    whose device belongs to another process) are probed through
+    ``remote_clocks[process_index]`` — a callable returning that
+    controller's "now", e.g. a coordination-service KV timestamp
+    exchange. Without a probe the rank is reported ``unprobed``
+    (offset None) rather than a fabricated zero."""
+    rows: List[Dict] = []
+    local_proc = 0
+    devices = list(getattr(comm, "devices", []) or [])
+    for rank in range(comm.size):
+        proc = (getattr(devices[rank], "process_index", 0)
+                if rank < len(devices) else 0)
+        if proc == local_proc:
+            rows.append({"rank": rank, "offset_s": 0.0, "rtt_s": 0.0,
+                         "clock": "controller"})
+            continue
+        probe = (remote_clocks or {}).get(proc)
+        if probe is None:
+            rows.append({"rank": rank, "offset_s": None, "rtt_s": None,
+                         "clock": f"process_{proc} (unprobed)"})
+        else:
+            off, rtt = measure_offset(probe, rounds)
+            rows.append({"rank": rank, "offset_s": off, "rtt_s": rtt,
+                         "clock": f"process_{proc}"})
+    return rows
+
+
+def main() -> None:
+    import json
+
+    import ompi_tpu as MPI
+    if not MPI.Initialized():
+        MPI.Init()
+    for row in sync_report(MPI.get_comm_world()):
+        print(json.dumps(row))
+
+
+if __name__ == "__main__":
+    main()

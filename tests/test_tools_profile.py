@@ -1,0 +1,106 @@
+"""Tools parity: comm_method selection table, mpisync offset estimator,
+profile (monitoring_prof/profile2mat) matrices."""
+import numpy as np
+import pytest
+
+from ompi_tpu.mca import var
+from ompi_tpu.tools import comm_method, mpisync, profile
+
+
+def test_comm_method_table(world):
+    t = comm_method.table(world)
+    assert t["size"] == world.size
+    assert t["coll"]["allreduce"] in ("tuned", "xla")
+    assert t["coll"]["barrier"]
+    names = [n for n, _p in t["priorities"]]
+    assert "xla" in names and "basic" in names
+    text = comm_method.format_table(world)
+    assert "coll selection" in text and "allreduce" in text
+
+
+def test_comm_method_display_var(world, capsys):
+    var.var_set("hook_comm_method_display", True)
+    try:
+        c = world.dup()
+        out = capsys.readouterr().out
+        assert "coll selection" in out
+        c.free()
+    finally:
+        var.var_set("hook_comm_method_display", False)
+
+
+def test_mpisync_offset_estimator():
+    # A remote clock 5s ahead with jittery probes: the min-RTT sample
+    # must recover the offset to well under the jitter bound.
+    import itertools
+    base = itertools.count()
+
+    def local_now():
+        return next(base) * 1e-4            # 100us per local sample
+
+    def remote_now():
+        return next(base) * 1e-4 + 5.0
+
+    off, rtt = mpisync.measure_offset(remote_now, rounds=8,
+                                      local_now=local_now)
+    assert abs(off - 5.0) < 1e-3
+    assert rtt == pytest.approx(2e-4)
+
+
+def test_mpisync_report_controller_clock(world):
+    rows = mpisync.sync_report(world, rounds=2)
+    assert len(rows) == world.size
+    for row in rows:
+        assert row["offset_s"] == 0.0       # one controller, one clock
+        assert row["clock"] == "controller"
+
+
+def test_mpisync_remote_probe_and_unprobed():
+    class Dev:
+        def __init__(self, pi):
+            self.process_index = pi
+
+    class FakeComm:
+        size = 3
+        devices = [Dev(0), Dev(1), Dev(2)]
+
+    import time
+    probes = {1: lambda: time.perf_counter() + 2.0}
+    rows = mpisync.sync_report(FakeComm(), rounds=4,
+                               remote_clocks=probes)
+    assert rows[0]["offset_s"] == 0.0
+    assert abs(rows[1]["offset_s"] - 2.0) < 0.1   # probed remote clock
+    assert rows[2]["offset_s"] is None            # honest: unprobed
+    assert "unprobed" in rows[2]["clock"]
+
+
+def test_profile_pt2pt_matrix(world):
+    comm = world.dup()
+    comm.send(np.float32([1, 2, 3]), src=1, dest=0, tag=1)
+    comm.recv(source=1, tag=1, dst=0)
+    comm.send(np.float32([4]), src=1, dest=2, tag=2)
+    comm.recv(source=1, tag=2, dst=2)
+    m = profile.pt2pt_matrix(comm, "messages")
+    assert m[1, 0] == 1 and m[1, 2] == 1 and m.sum() == 2
+    b = profile.pt2pt_matrix(comm, "bytes")
+    assert b[1, 0] == 12 and b[1, 2] == 4
+    csv = profile.to_csv(m)
+    assert len(csv.splitlines()) == world.size
+    rep = profile.report(comm)
+    assert "pt2pt bytes" in rep
+    comm.free()
+
+
+def test_profile_coll_table(world):
+    var.var_set("coll_monitoring_enable", True)
+    try:
+        comm = world.dup()
+        x = comm.stack([np.float32([r]) for r in range(comm.size)])
+        comm.allreduce(x, __import__("ompi_tpu").SUM)
+        table = profile.coll_table()
+        assert any(func == "allreduce" for (_cid, func) in table)
+        rep = profile.report(comm)
+        assert "collectives:" in rep
+        comm.free()
+    finally:
+        var.var_set("coll_monitoring_enable", False)

@@ -62,6 +62,25 @@ def test_wildcard_determinant_and_replay_forces_order(world):
     assert int(r2[0]) == 20 and st2.source == 2
 
 
+def test_replay_mixed_named_and_wildcard_receives(world):
+    # A named receive consumes no determinant; its match event must not
+    # shift the wildcard receives' determinant queue.
+    rec = PessimistEngine(world)
+    rec.send(np.int32([10]), 1, 0, 5)
+    rec.send(np.int32([20]), 2, 0, 6)
+    d1, _ = rec.recv(0, 1, 5)                  # named
+    d2, _ = rec.recv(0, ANY_SOURCE, ANY_TAG)   # wildcard -> src 2
+    assert int(d1[0]) == 10 and int(d2[0]) == 20
+
+    rep = PessimistEngine(world, replay_log=rec.log)
+    rep.send(np.int32([10]), 1, 0, 5)
+    rep.send(np.int32([20]), 2, 0, 6)
+    r1, st1 = rep.recv(0, 1, 5)
+    r2, st2 = rep.recv(0, ANY_SOURCE, ANY_TAG)
+    assert int(r1[0]) == 10 and st1.source == 1
+    assert int(r2[0]) == 20 and st2.source == 2
+
+
 def test_replay_determinant_exhaustion_raises(world):
     rep = PessimistEngine(world, replay_log=[])
     rep.send(np.int32([1]), 1, 0, 3)
@@ -89,8 +108,8 @@ def test_orphan_redelivery_from_payload_log(world):
     rec = PessimistEngine(world)
     rec.send(np.float64([1.5]), 1, 0, 2)
     rec.send(np.float64([2.5]), 2, 0, 2)
-    rec.recv(0, 1, 2)
-    rec.recv(0, 2, 2)
+    rec.recv(0, ANY_SOURCE, 2)
+    rec.recv(0, ANY_SOURCE, 2)
 
     fresh = PessimistEngine(world, replay_log=rec.log)
     fresh.log = list(rec.log)            # restored escrow
@@ -103,7 +122,7 @@ def test_orphan_redelivery_from_payload_log(world):
 def test_log_snapshot_roundtrip(world):
     eng = PessimistEngine(world)
     eng.send(np.int16([3, 4]), 0, 1, 1)
-    eng.recv(1, 0, 1)
+    eng.recv(1, ANY_SOURCE, ANY_TAG)
     dicts = eng.snapshot()
     log = PessimistEngine.restore_log(dicts)
     assert [ev.kind for ev in log] == ["send", "match"]
