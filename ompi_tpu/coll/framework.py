@@ -16,6 +16,9 @@ from ompi_tpu.mca.base import register_framework
 COLL_FUNCS = (
     "allreduce", "reduce", "bcast", "allgather", "gather", "scatter",
     "alltoall", "reduce_scatter_block", "scan", "exscan", "barrier",
+    # ULFM fault-tolerant agreement (reference vtable slots
+    # ompi/mca/coll/coll.h:215-220, provided by coll/ftagree)
+    "agree", "iagree",
 )
 
 coll_framework = register_framework("coll")
@@ -28,7 +31,8 @@ def _ensure_components() -> None:
     if _components_loaded:
         return
     # Importing registers each component with the framework.
-    from ompi_tpu.coll import basic, monitoring, self_, tuned, xla  # noqa: F401
+    from ompi_tpu.coll import (basic, ftagree, monitoring,  # noqa: F401
+                               self_, tuned, xla)
     _components_loaded = True
 
 

@@ -1,0 +1,96 @@
+"""coll/ftagree — fault-tolerant agreement.
+
+Behavioral spec: the reference's ULFM agreement component
+(``ompi/mca/coll/ftagree/coll_ftagree_earlyreturning.c``) — the
+Early-Returning Agreement (ERA): ranks combine contributions up a binary
+tree with bitwise AND, the root decides, and the decision is broadcast
+down; dead ranks are routed around, and ranks that discover new failures
+re-elect subtree roots. The result is a uniform decision every *live*
+rank observes, plus a flag telling the caller whether any participant
+failed (``MPIX_Comm_agree`` semantics: unacknowledged failures make the
+call return ``MPI_ERR_PROC_FAILED`` while still agreeing).
+
+TPU-native re-design: contributions are host-side ints (control plane —
+agreement never rides the ICI data plane in the reference either; it
+rides the PML). The controller owns global knowledge, so the ERA
+re-election dance collapses, but the tree pass is kept explicit: the
+same up-AND / down-broadcast structure, skipping failed ranks, so the
+decision provably only includes live contributions in tree order.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from ompi_tpu.mca.base import Component
+from ompi_tpu.coll.framework import coll_framework
+from ompi_tpu.runtime import ft
+
+
+def _tree_agree(contribs: Sequence[int], alive: Sequence[bool]) -> int:
+    """One ERA round: AND contributions up a binomial tree rooted at the
+    lowest live rank, skipping failed ranks (their subtree still
+    percolates through the live parent chain)."""
+    n = len(contribs)
+    acc: List[Optional[int]] = [int(contribs[r]) if alive[r] else None
+                                for r in range(n)]
+    dist = 1
+    while dist < n:
+        for r in range(0, n, 2 * dist):
+            peer = r + dist
+            if peer >= n:
+                continue
+            a, b = acc[r], acc[peer]
+            if a is None:
+                acc[r] = b
+            elif b is not None:
+                acc[r] = a & b
+        dist *= 2
+    root = acc[0]
+    if root is None:                    # every rank failed
+        return ~0
+    return root
+
+
+class FtAgreeModule:
+    """Provides the ``agree``/``iagree`` slots of the coll module vtable
+    (reference vtable slots: ``ompi/mca/coll/coll.h:215-220``)."""
+
+    def __init__(self, comm):
+        self.comm = comm
+
+    def _alive_mask(self) -> List[bool]:
+        wr = self.comm.group.world_ranks
+        return [not ft.is_failed(w) for w in wr]
+
+    def agree(self, flags: Sequence[int]) -> Tuple[int, List[int]]:
+        """Returns (agreed_value, failed_local_ranks). The caller (the
+        communicator layer) converts unacked failures into
+        MPIX_ERR_PROC_FAILED per the ULFM contract."""
+        flags = list(flags)[:self.comm.size]
+        if len(flags) < self.comm.size:
+            # Missing contributions are the AND identity (the rank "had
+            # nothing to veto").
+            flags += [~0] * (self.comm.size - len(flags))
+        alive = self._alive_mask()
+        value = _tree_agree(flags, alive)
+        failed = [r for r, ok in enumerate(alive) if not ok]
+        return value, failed
+
+    def iagree(self, flags: Sequence[int]):
+        from ompi_tpu.core.request import Request
+        value, failed = self.agree(flags)
+        req = Request.completed()
+        req._result = (value, failed)
+        return req
+
+
+class FtAgreeComponent(Component):
+    name = "ftagree"
+
+    def comm_query(self, comm):
+        # Always available; only provider of agree/iagree, so priority
+        # does not contend with the data-plane components.
+        return (5, FtAgreeModule(comm))
+
+
+coll_framework.register(FtAgreeComponent())

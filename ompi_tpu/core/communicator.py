@@ -82,6 +82,7 @@ class Communicator:
         self.topo = None               # set by topo layer (cart/graph)
         self._freed = False
         self._revoked = False          # ULFM
+        self._acked_failures: frozenset = frozenset()  # ULFM failure_ack
         # The communicator's data plane: a private 1-D mesh over its
         # devices. Stacked rank buffers shard along this axis.
         self.mesh = Mesh(np.array(self.devices, dtype=object), (AXIS,))
@@ -141,6 +142,7 @@ class Communicator:
     # -- validation + dispatch -----------------------------------------
     def _coll(self, func: str):
         self._check()
+        self._check_ft_coll()
         m = self.c_coll.get(func)
         if m is None:
             self._err(ERR_ARG, f"no coll component provides {func} "
@@ -508,11 +510,13 @@ class Communicator:
         """MPI_Send from rank ``src`` to ``dest`` (single-controller: the
         sender rank is explicit; ``data`` is that rank's local buffer)."""
         self._check()
+        self._check_peer_ft(dest)
         self._record_pml("pml_send")
         self._pml.send(data, src, dest, tag)
 
     def isend(self, data, src: int, dest: int, tag: int = 0) -> Request:
         self._check()
+        self._check_peer_ft(dest)
         self._record_pml("pml_send")
         return self._pml.send(data, src, dest, tag)
 
@@ -520,12 +524,14 @@ class Communicator:
         """MPI_Ssend: completes only if the receive has started; raises
         the deadlock otherwise (single-controller semantics)."""
         self._check()
+        self._check_peer_ft(dest)
         self._record_pml("pml_send")
         self._pml.send(data, src, dest, tag, synchronous=True)
 
     def bsend(self, data, src: int, dest: int, tag: int = 0) -> None:
         """MPI_Bsend: the payload is buffered (copied) at send time."""
         self._check()
+        self._check_peer_ft(dest)
         self._record_pml("pml_send")
         self._pml.send(data, src, dest, tag)
 
@@ -533,11 +539,19 @@ class Communicator:
         """MPI_Recv executed by rank ``dst``: returns (data, Status).
         Raises instead of deadlocking if no matching send was posted."""
         self._check()
+        if source == -1:  # ANY_SOURCE
+            self._check_anysource_ft()
+        else:
+            self._check_peer_ft(source)
         self._record_pml("pml_recv")
         return self._pml.recv(dst, source, tag)
 
     def irecv(self, source: int, tag: int = -1, *, dst: int = 0) -> Request:
         self._check()
+        if source == -1:  # ANY_SOURCE
+            self._check_anysource_ft()
+        else:
+            self._check_peer_ft(source)
         self._record_pml("pml_recv")
         return self._pml.irecv(dst, source, tag)
 
@@ -546,6 +560,11 @@ class Communicator:
         """MPI_Sendrecv executed by rank ``src``: post the send, then
         receive (deadlock-free by construction, as in the reference)."""
         self._check()
+        self._check_peer_ft(dest)
+        if recvsource == -1:  # ANY_SOURCE
+            self._check_anysource_ft()
+        else:
+            self._check_peer_ft(recvsource)
         self._record_pml("pml_send")
         self._record_pml("pml_recv")
         self._pml.send(senddata, src, dest, sendtag)
@@ -925,27 +944,147 @@ class Communicator:
         sys.stderr.write(f"MPI_Abort on {self.name} errorcode={errorcode}\n")
         raise SystemExit(errorcode)
 
-    # -- ULFM-lite (mpiext/ftmpi semantics) ----------------------------
+    # -- ULFM (mpiext/ftmpi semantics; docs/features/ulfm.rst) ---------
+    # The failure registry (runtime/ft.py) is the PMIx-event-stream
+    # equivalent; these methods implement the MPIX_Comm_* surface over
+    # it. Per ULFM, agree/shrink/failure_ack remain usable on revoked
+    # communicators — they bypass _check().
+    def _failed_local(self) -> List[int]:
+        from ompi_tpu.runtime import ft
+        return [r for r, w in enumerate(self.group.world_ranks)
+                if ft.is_failed(w)]
+
+    def _check_ft_coll(self) -> None:
+        """Collectives must not silently complete across a failure
+        (ompi/request/req_ft.c behavior: ops involving failed procs
+        raise MPIX_ERR_PROC_FAILED until the comm is shrunk)."""
+        failed = self._failed_local()
+        if failed:
+            from ompi_tpu.core.errhandler import ERR_PROC_FAILED
+            self._err(ERR_PROC_FAILED,
+                      f"rank(s) {failed} of {self.name} have failed "
+                      f"(shrink or agree to continue)")
+
+    def _check_peer_ft(self, peer: int) -> None:
+        if peer is None or not (0 <= peer < self.size):
+            return
+        from ompi_tpu.runtime import ft
+        if ft.is_failed(self.group.world_ranks[peer]):
+            from ompi_tpu.core.errhandler import ERR_PROC_FAILED
+            self._err(ERR_PROC_FAILED, f"peer rank {peer} has failed")
+
+    def _check_anysource_ft(self) -> None:
+        """A wildcard receive with un-acknowledged failures raises
+        MPIX_ERR_PROC_FAILED_PENDING semantics: the matching send might
+        have come from the dead peer. failure_ack() re-arms wildcards."""
+        unacked = [r for r in self._failed_local()
+                   if self.group.world_ranks[r] not in self._acked_failures]
+        if unacked:
+            from ompi_tpu.core.errhandler import ERR_PROC_FAILED
+            self._err(ERR_PROC_FAILED,
+                      f"ANY_SOURCE receive with unacknowledged failed "
+                      f"rank(s) {unacked}; call failure_ack() first")
+
     def revoke(self) -> None:
+        """MPIX_Comm_revoke. Single-controller: the comm object is the
+        shared state all ranks observe, so setting the flag *is* the
+        reliable revocation broadcast (coll_base_revoke_local.c's job);
+        pending pt2pt requests observe it at completion (pml.h:244
+        revoke_comm hook ≈ the matching engine consulting the flag)."""
         self._revoked = True
 
     def is_revoked(self) -> bool:
         return self._revoked
 
-    def shrink(self, failed_ranks: Sequence[int]) -> "Communicator":
-        alive = [r for r in range(self.size) if r not in set(failed_ranks)]
+    def shrink(self, failed_ranks: Optional[Sequence[int]] = None
+               ) -> "Communicator":
+        """MPIX_Comm_shrink: agree on the failed set, return a new
+        communicator over the survivors. Works on revoked comms."""
+        if self._freed:
+            raise MPIError(ERR_COMM, "communicator has been freed")
+        from ompi_tpu.runtime import ft
+        failed = set(failed_ranks or ())
+        failed.update(self._failed_local())
+        # Agreement on the failed set: encode each rank's view as a
+        # bitmask and AND-agree (the ftagree pass the reference's shrink
+        # performs to reach a uniform survivor list).
+        mask = ~sum(1 << r for r in failed)
+        agreed, _ = self._agree_module().agree([mask] * self.size)
+        alive = [r for r in range(self.size)
+                 if (agreed >> r) & 1 and r not in failed]
         g = Group([self.group.world_ranks[r] for r in alive])
         devs = [self.devices[r] for r in alive]
         return Communicator(g, devs, name=f"{self.name}.shrink",
                             errhandler=self.errhandler)
 
+    def ishrink(self):
+        from ompi_tpu.core.request import Request
+        req = Request.completed()
+        req._result = self.shrink()
+        return req
+
+    def _agree_module(self):
+        m = self.c_coll.get("agree")
+        if m is None:
+            from ompi_tpu.coll.ftagree import FtAgreeModule
+            return FtAgreeModule(self)
+        return m.__self__ if hasattr(m, "__self__") else m
+
     def agree(self, flags: Sequence[int]) -> int:
-        """MPIX_Comm_agree: bitwise AND agreement over contributed flags
-        (coll/ftagree semantics, minus failure detection)."""
-        v = ~0
-        for f in flags:
-            v &= int(f)
-        return v
+        """MPIX_Comm_agree: uniform bitwise-AND agreement via
+        coll/ftagree. Raises MPIX_ERR_PROC_FAILED (carrying the agreed
+        value in ``.agreed_value``) when a participant failed and was not
+        acknowledged — the ULFM contract: agreement is still reached."""
+        if self._freed:
+            raise MPIError(ERR_COMM, "communicator has been freed")
+        value, failed = self._agree_module().agree(flags)
+        unacked = [r for r in failed
+                   if self.group.world_ranks[r] not in self._acked_failures]
+        if unacked:
+            from ompi_tpu.core.errhandler import ERR_PROC_FAILED
+            err = MPIError(ERR_PROC_FAILED,
+                           f"agreement reached over failed rank(s) "
+                           f"{unacked}")
+            err.agreed_value = value
+            raise err
+        return value
+
+    def iagree(self, flags: Sequence[int]):
+        from ompi_tpu.core.request import Request
+        value = self.agree(flags)
+        req = Request.completed()
+        req._result = value
+        return req
+
+    def failure_ack(self) -> None:
+        """MPIX_Comm_failure_ack: acknowledge all currently-known
+        failures, re-arming ANY_SOURCE receives and quieting agree()."""
+        from ompi_tpu.runtime import ft
+        self._acked_failures = frozenset(self._acked_failures | {
+            w for w in self.group.world_ranks if ft.is_failed(w)})
+
+    def failure_get_acked(self) -> Group:
+        """MPIX_Comm_failure_get_acked: group of acknowledged failed
+        processes."""
+        return Group([w for w in self.group.world_ranks
+                      if w in self._acked_failures])
+
+    def get_failed(self) -> Group:
+        """MPIX_Comm_get_failed (MPI-5 FT): all known-failed members."""
+        from ompi_tpu.runtime import ft
+        return Group([w for w in self.group.world_ranks
+                      if ft.is_failed(w)])
+
+    def ack_failed(self, num_to_ack: Optional[int] = None) -> Group:
+        """MPIX_Comm_ack_failed (MPI-5 FT): acknowledge the first
+        ``num_to_ack`` failed members (all, when None); returns the
+        acked group."""
+        from ompi_tpu.runtime import ft
+        failed = [w for w in self.group.world_ranks if ft.is_failed(w)]
+        if num_to_ack is not None:
+            failed = failed[:num_to_ack]
+        self._acked_failures = frozenset(self._acked_failures | set(failed))
+        return Group(sorted(self._acked_failures))
 
     def __repr__(self):
         return (f"Communicator({self.name}, size={self.size}, "

@@ -1,0 +1,7 @@
+"""MPI extensions — mirrors ``ompi/mpiext`` (the MPIX_* namespace).
+
+The reference ships extensions as self-contained sub-trees with their own
+C bindings (ftmpi/ULFM, cuda/rocm support queries, affinity, shortfloat);
+here each is a module exporting MPIX-style functions over the core.
+"""
+from ompi_tpu.mpiext import ftmpi  # noqa: F401

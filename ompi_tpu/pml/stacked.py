@@ -87,11 +87,40 @@ class PtpRequest(Request):
         self.status.count = getattr(msg.data, "size", 1)
         self._complete = True
 
+    def _check_ft(self) -> None:
+        """Request-level fault tolerance (ompi/request/req_ft.c): a
+        pending receive whose communicator was revoked, or whose (named)
+        peer has failed, completes in error rather than deadlocking."""
+        comm = getattr(self._engine, "comm", None)
+        if comm is None or getattr(comm, "group", None) is None:
+            return
+        from ompi_tpu.core.errhandler import ERR_PROC_FAILED, ERR_REVOKED
+        if getattr(comm, "_revoked", False):
+            raise MPIError(ERR_REVOKED,
+                           "pending receive on a revoked communicator")
+        from ompi_tpu.runtime import ft
+        src = self.status.source
+        if src == ANY_SOURCE:
+            unacked = [w for w in comm.group.world_ranks
+                       if ft.is_failed(w)
+                       and w not in comm._acked_failures]
+            if unacked:
+                raise MPIError(ERR_PROC_FAILED,
+                               f"wildcard receive with unacknowledged "
+                               f"failed world rank(s) {unacked}")
+        elif 0 <= src < comm.size and ft.is_failed(
+                comm.group.world_ranks[src]):
+            raise MPIError(ERR_PROC_FAILED,
+                           f"receive peer rank {src} has failed")
+
     def test(self):
+        if not self._complete:
+            self._check_ft()
         return (True, self.status) if self._complete else (False, None)
 
     def wait(self):
         if not self._complete:
+            self._check_ft()
             # Single controller: no other thread can produce the matching
             # send while we block — this is the deadlock MPI semantics
             # prescribe; surface it instead of hanging.
@@ -180,9 +209,12 @@ class MatchingEngine:
             # returns; mutable host arrays are snapshotted (the eager
             # copy). Device arrays are immutable — reference suffices.
             data = data.copy()
-        t = self.traffic.setdefault((src, dest), [0, 0])
-        t[0] += 1
-        t[1] += int(getattr(data, "nbytes", 0) or 0)
+        if channel == CH_P2P:
+            # Internal fragments (partitioned channel, vprotocol replay)
+            # are not user messages; keep the profile matrix honest.
+            t = self.traffic.setdefault((src, dest), [0, 0])
+            t[0] += 1
+            t[1] += int(getattr(data, "nbytes", 0) or 0)
         msg = _Msg(src, dest, tag, data, synchronous, channel)
         if self._lib is not None:
             mh = self._handle()

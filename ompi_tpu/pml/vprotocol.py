@@ -208,11 +208,15 @@ class PessimistEngine(MatchingEngine):
         sender-based payload log (orphan redelivery: the senders are
         not being re-executed). Returns the number re-injected."""
         n = 0
+        # Redelivered payloads were counted when first sent; keep the
+        # per-peer profile matrix at first-execution truth.
+        saved = {k: list(v) for k, v in self.traffic.items()}
         for ev in self.log:
             if ev.kind == "send" and ev.dest == dest:
                 super().send(ev.payload, ev.src, ev.dest, ev.tag,
                              channel=ev.channel)
                 n += 1
+        self.traffic = saved
         return n
 
     # -- persistence (checkpoint escrow) -------------------------------

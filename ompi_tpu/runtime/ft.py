@@ -1,0 +1,95 @@
+"""Failure detection and propagation — the ULFM runtime plane.
+
+Behavioral spec: the reference's ULFM support (``docs/features/ulfm.rst``)
+detects process failure through PMIx/PRRTE events and propagates it to
+every layer: requests complete with ``MPI_ERR_PROC_FAILED``
+(``ompi/request/req_ft.c``), collectives bail out, revocation spreads via
+a reliable broadcast (``ompi/mca/coll/base/coll_base_revoke_local.c``),
+and the pml exposes a ``revoke_comm`` hook (``ompi/mca/pml/pml.h:244``).
+
+TPU-native re-design: the "process" is a rank bound to a device on the
+controller's mesh. Failure events come from two sources — a device health
+probe (a failed chip surfaces as an XLA execution error) and explicit
+injection (the fault-injection entry the reference lacks; here it is the
+test surface). The registry is the single source of truth the whole stack
+consults: communicator collectives, the pt2pt matching engine, and the
+ftagree component all read it. Epochs order failure knowledge the way
+PMIx event sequence numbers do.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, FrozenSet, List
+
+_lock = threading.Lock()
+_failed: Dict[int, str] = {}          # world rank -> reason
+_epoch = 0
+_listeners: List[Callable[[int, str], None]] = []
+
+
+def fail_rank(world_rank: int, reason: str = "injected") -> None:
+    """Report rank failure (detector ingress + fault injection API)."""
+    global _epoch
+    with _lock:
+        if world_rank in _failed:
+            return
+        _failed[world_rank] = reason
+        _epoch += 1
+        listeners = list(_listeners)
+    for cb in listeners:
+        cb(world_rank, reason)
+
+
+def is_failed(world_rank: int) -> bool:
+    return world_rank in _failed
+
+
+def failed_ranks() -> FrozenSet[int]:
+    with _lock:
+        return frozenset(_failed)
+
+
+def failure_reason(world_rank: int) -> str:
+    return _failed.get(world_rank, "")
+
+
+def epoch() -> int:
+    return _epoch
+
+
+def add_listener(cb: Callable[[int, str], None]) -> None:
+    """Register a failure-event callback (the PMIx event-handler role)."""
+    with _lock:
+        _listeners.append(cb)
+
+
+def probe_devices(devices, world_ranks=None) -> List[int]:
+    """Health-check each rank's device with a trivial computation; mark
+    ranks whose device errors as failed. Returns newly failed *world*
+    ranks. ``world_ranks[i]`` is the world rank owning ``devices[i]``
+    (identity when omitted — correct only for COMM_WORLD-shaped device
+    lists). (The active side of the detector; in the reference the PRRTE
+    daemon notices a dead process and PMIx fans the event out.)"""
+    import jax
+    import numpy as np
+    if world_ranks is None:
+        world_ranks = range(len(devices))
+    newly = []
+    for w, d in zip(world_ranks, devices):
+        if is_failed(w):
+            continue
+        try:
+            x = jax.device_put(np.ones((1,), np.float32), d)
+            float(np.asarray(x)[0])
+        except Exception as e:          # noqa: BLE001 — any device error
+            fail_rank(w, f"device probe: {type(e).__name__}")
+            newly.append(w)
+    return newly
+
+
+def _reset_for_tests() -> None:
+    global _epoch
+    with _lock:
+        _failed.clear()
+        _listeners.clear()
+        _epoch = 0

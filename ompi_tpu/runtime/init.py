@@ -153,3 +153,5 @@ def processor_name() -> str:
 
 def _reset_for_tests() -> None:
     _state.update(initialized=False, finalized=False, world=None, self=None)
+    from ompi_tpu.runtime import ft
+    ft._reset_for_tests()

@@ -54,7 +54,8 @@ def sync_report(comm, rounds: int = 10,
     exchange. Without a probe the rank is reported ``unprobed``
     (offset None) rather than a fabricated zero."""
     rows: List[Dict] = []
-    local_proc = 0
+    import jax
+    local_proc = jax.process_index()
     devices = list(getattr(comm, "devices", []) or [])
     for rank in range(comm.size):
         proc = (getattr(devices[rank], "process_index", 0)

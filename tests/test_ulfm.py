@@ -1,0 +1,187 @@
+"""ULFM fault tolerance: revoke / shrink / agree / failure_ack.
+
+Mirrors the reference's ULFM semantics (docs/features/ulfm.rst,
+ompi/mpiext/ftmpi, coll/ftagree, request-level FT in
+ompi/request/req_ft.c) exercised through injected failures — the
+fault-injection surface the reference delegates to external harnesses.
+"""
+import numpy as np
+import pytest
+
+from ompi_tpu.core.errhandler import (ERR_PROC_FAILED, ERR_REVOKED,
+                                      MPIError)
+from ompi_tpu.mpiext import ftmpi
+from ompi_tpu.runtime import ft
+
+
+@pytest.fixture()
+def comm(world):
+    """A dup of COMM_WORLD with a clean failure registry, so injected
+    failures never leak into other tests."""
+    ft._reset_for_tests()
+    c = world.dup()
+    c.set_errhandler(__import__("ompi_tpu").ERRORS_RETURN)
+    yield c
+    ft._reset_for_tests()
+
+
+def test_collective_raises_proc_failed(comm):
+    x = comm.alloc((4,), np.float32, fill=1.0)
+    assert float(np.asarray(comm.allreduce(x))[0, 0]) == comm.size
+    ftmpi.fail_rank(comm.group.world_ranks[1], "test kill")
+    with pytest.raises(MPIError) as ei:
+        comm.allreduce(x)
+    assert ei.value.error_class == ERR_PROC_FAILED
+
+
+def test_shrink_produces_working_comm(comm):
+    n = comm.size
+    ftmpi.fail_rank(comm.group.world_ranks[1])
+    ftmpi.fail_rank(comm.group.world_ranks[3])
+    small = ftmpi.Comm_shrink(comm)
+    assert small.size == n - 2
+    assert comm.group.world_ranks[1] not in small.group.world_ranks
+    x = small.alloc((4,), np.float32, fill=1.0)
+    y = small.allreduce(x)
+    assert float(np.asarray(y)[0, 0]) == small.size
+
+
+def test_agree_masks_and_flags_failures(comm):
+    # No failures: plain AND agreement.
+    flags = [0b111] * comm.size
+    flags[2] = 0b101
+    assert comm.agree(flags) == 0b101
+    # With an unacked failure: agreement still reached, error raised,
+    # dead rank's contribution excluded.
+    ftmpi.fail_rank(comm.group.world_ranks[2])
+    with pytest.raises(MPIError) as ei:
+        comm.agree(flags)
+    assert ei.value.error_class == ERR_PROC_FAILED
+    assert ei.value.agreed_value == 0b111      # rank 2's 0b101 excluded
+    # Acknowledge -> agree is quiet again.
+    ftmpi.Comm_failure_ack(comm)
+    assert comm.agree(flags) == 0b111
+
+
+def test_iagree_and_ishrink(comm):
+    req = ftmpi.Comm_iagree(comm, [1] * comm.size)
+    assert req.wait() is not None
+    assert req.get() == 1
+    ftmpi.fail_rank(comm.group.world_ranks[0])
+    sreq = ftmpi.Comm_ishrink(comm)
+    sreq.wait()
+    assert sreq.get().size == comm.size - 1
+
+
+def test_failure_ack_and_get_acked(comm):
+    assert ftmpi.Comm_failure_get_acked(comm).size == 0
+    wr = comm.group.world_ranks[1]
+    ftmpi.fail_rank(wr)
+    assert ftmpi.Comm_get_failed(comm).size == 1
+    assert ftmpi.Comm_failure_get_acked(comm).size == 0
+    ftmpi.Comm_failure_ack(comm)
+    acked = ftmpi.Comm_failure_get_acked(comm)
+    assert acked.size == 1 and acked.world_ranks[0] == wr
+
+
+def test_ack_failed_partial(comm):
+    for r in (1, 2):
+        ftmpi.fail_rank(comm.group.world_ranks[r])
+    g = ftmpi.Comm_ack_failed(comm, 1)
+    assert g.size == 1
+    g = ftmpi.Comm_ack_failed(comm)
+    assert g.size == 2
+
+
+def test_pt2pt_to_failed_peer_raises(comm):
+    ftmpi.fail_rank(comm.group.world_ranks[2])
+    with pytest.raises(MPIError) as ei:
+        comm.send(np.ones(2, np.float32), src=0, dest=2, tag=7)
+    assert ei.value.error_class == ERR_PROC_FAILED
+    with pytest.raises(MPIError) as ei:
+        comm.recv(source=2, tag=7, dst=0)
+    assert ei.value.error_class == ERR_PROC_FAILED
+
+
+def test_sendrecv_checks_both_peers(comm):
+    ftmpi.fail_rank(comm.group.world_ranks[2])
+    with pytest.raises(MPIError) as ei:
+        comm.sendrecv(np.ones(1, np.float32), src=0, dest=2,
+                      recvsource=1)
+    assert ei.value.error_class == ERR_PROC_FAILED
+    with pytest.raises(MPIError) as ei:
+        comm.sendrecv(np.ones(1, np.float32), src=0, dest=1,
+                      recvsource=2)
+    assert ei.value.error_class == ERR_PROC_FAILED
+
+
+def test_anysource_needs_ack(comm):
+    ftmpi.fail_rank(comm.group.world_ranks[1])
+    with pytest.raises(MPIError) as ei:
+        comm.recv(source=-1, tag=7, dst=0)
+    assert ei.value.error_class == ERR_PROC_FAILED
+    ftmpi.Comm_failure_ack(comm)
+    # Acked: wildcard receive is re-armed and sees a live sender's message.
+    comm.send(np.full(2, 5.0, np.float32), src=0, dest=3, tag=7)
+    data, st = comm.recv(source=-1, tag=7, dst=3)
+    assert st.source == 0 and float(data[0]) == 5.0
+
+
+def test_pending_irecv_fails_when_peer_dies(comm):
+    req = comm.irecv(source=2, tag=9, dst=0)
+    ftmpi.fail_rank(comm.group.world_ranks[2])
+    with pytest.raises(MPIError) as ei:
+        req.wait()
+    assert ei.value.error_class == ERR_PROC_FAILED
+
+
+def test_revoke_blocks_ops_but_not_shrink_agree(comm):
+    ftmpi.Comm_revoke(comm)
+    assert ftmpi.Comm_is_revoked(comm)
+    x = comm.alloc((2,), np.float32, fill=1.0)
+    with pytest.raises(MPIError) as ei:
+        comm.allreduce(x)
+    assert ei.value.error_class == ERR_REVOKED
+    with pytest.raises(MPIError):
+        comm.send(np.ones(1), src=0, dest=1)
+    # ULFM: agree and shrink still work on a revoked communicator.
+    assert comm.agree([3] * comm.size) == 3
+    fresh = ftmpi.Comm_shrink(comm)
+    assert fresh.size == comm.size and not fresh.is_revoked()
+
+
+def test_pending_irecv_observes_revoke(comm):
+    req = comm.irecv(source=1, tag=3, dst=0)
+    ftmpi.Comm_revoke(comm)
+    with pytest.raises(MPIError) as ei:
+        req.wait()
+    assert ei.value.error_class == ERR_REVOKED
+
+
+def test_failure_listener_epoch(comm):
+    events = []
+    ftmpi.add_failure_listener(lambda r, why: events.append((r, why)))
+    e0 = ftmpi.failure_epoch()
+    ftmpi.fail_rank(comm.group.world_ranks[0], "kill")
+    ftmpi.fail_rank(comm.group.world_ranks[0], "kill-again")  # dedup
+    assert ftmpi.failure_epoch() == e0 + 1
+    assert events == [(comm.group.world_ranks[0], "kill")]
+
+
+def test_ftagree_tree_structure():
+    """The agreement value must be the AND of live contributions only,
+    for every failure pattern (exhaustive over 4 ranks)."""
+    from ompi_tpu.coll.ftagree import _tree_agree
+    contribs = [0b1111, 0b1110, 0b1101, 0b1011]
+    for mask in range(16):
+        alive = [(mask >> r) & 1 == 1 for r in range(4)]
+        expect = ~0
+        for r in range(4):
+            if alive[r]:
+                expect &= contribs[r]
+        assert _tree_agree(contribs, alive) == expect
+
+
+def test_probe_devices_healthy(comm):
+    assert ftmpi.probe_devices(comm.devices) == []
+    assert ftmpi.failed_ranks() == frozenset()
