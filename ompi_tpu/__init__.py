@@ -65,6 +65,12 @@ from ompi_tpu.api.mpi import (  # noqa: F401
     Get_library_version,
     # local reduction + pack/external32
     reduce_local, Pack, Unpack, Pack_external, Unpack_external, Pack_size,
+    # dynamic process management (ompi/dpm)
+    Intercomm, Intercomm_create,
+    Open_port, Close_port, Publish_name, Lookup_name, Unpublish_name,
+    Comm_accept, Comm_connect, Comm_iaccept, Comm_iconnect,
+    Comm_spawn, Comm_spawn_multiple, Comm_get_parent, Comm_join,
+    Comm_disconnect,
 )
 
 __version__ = "0.1.0"

@@ -155,3 +155,65 @@ def Testany(requests):
 
 def Testsome(requests):
     return testsome(requests)
+
+
+# -- dynamic process management (ompi/dpm) --------------------------------
+from ompi_tpu.core import dpm as _dpm                      # noqa: E402
+from ompi_tpu.core.intercomm import (Intercomm,            # noqa: F401,E402
+                                     intercomm_create as Intercomm_create)
+
+
+def Open_port(info=None) -> str:
+    return _dpm.open_port(info)
+
+
+def Close_port(port: str) -> None:
+    _dpm.close_port(port)
+
+
+def Publish_name(service: str, port: str, info=None) -> None:
+    _dpm.publish_name(service, port, info)
+
+
+def Lookup_name(service: str, info=None) -> str:
+    return _dpm.lookup_name(service, info)
+
+
+def Unpublish_name(service: str, info=None) -> None:
+    _dpm.unpublish_name(service, info)
+
+
+def Comm_accept(port: str, comm) -> "Intercomm":
+    return _dpm.accept(port, comm)
+
+
+def Comm_connect(port: str, comm) -> "Intercomm":
+    return _dpm.connect(port, comm)
+
+
+def Comm_iaccept(port: str, comm):
+    return _dpm.iaccept(port, comm)
+
+
+def Comm_iconnect(port: str, comm):
+    return _dpm.iconnect(port, comm)
+
+
+def Comm_spawn(fn, maxprocs: int, comm, **kw) -> "Intercomm":
+    return _dpm.spawn(fn, maxprocs, comm, **kw)
+
+
+def Comm_spawn_multiple(apps, comm, **kw) -> "Intercomm":
+    return _dpm.spawn_multiple(apps, comm, **kw)
+
+
+def Comm_get_parent(comm):
+    return _dpm.get_parent(comm)
+
+
+def Comm_join(fd, comm):
+    return _dpm.join(fd, comm)
+
+
+def Comm_disconnect(comm) -> None:
+    _dpm.disconnect(comm)

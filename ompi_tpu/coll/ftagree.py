@@ -78,10 +78,7 @@ class FtAgreeModule:
 
     def iagree(self, flags: Sequence[int]):
         from ompi_tpu.core.request import Request
-        value, failed = self.agree(flags)
-        req = Request.completed()
-        req._result = (value, failed)
-        return req
+        return Request.completed(self.agree(flags))
 
 
 class FtAgreeComponent(Component):

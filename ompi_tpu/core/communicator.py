@@ -1019,16 +1019,14 @@ class Communicator:
 
     def ishrink(self):
         from ompi_tpu.core.request import Request
-        req = Request.completed()
-        req._result = self.shrink()
-        return req
+        return Request.completed(self.shrink())
 
     def _agree_module(self):
         m = self.c_coll.get("agree")
         if m is None:
             from ompi_tpu.coll.ftagree import FtAgreeModule
             return FtAgreeModule(self)
-        return m.__self__ if hasattr(m, "__self__") else m
+        return m
 
     def agree(self, flags: Sequence[int]) -> int:
         """MPIX_Comm_agree: uniform bitwise-AND agreement via
@@ -1051,10 +1049,7 @@ class Communicator:
 
     def iagree(self, flags: Sequence[int]):
         from ompi_tpu.core.request import Request
-        value = self.agree(flags)
-        req = Request.completed()
-        req._result = value
-        return req
+        return Request.completed(self.agree(flags))
 
     def failure_ack(self) -> None:
         """MPIX_Comm_failure_ack: acknowledge all currently-known

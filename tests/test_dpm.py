@@ -1,0 +1,163 @@
+"""Dynamic process management (ompi/dpm): spawn, ports,
+connect/accept, naming service, join, disconnect."""
+import numpy as np
+import pytest
+
+from ompi_tpu.core import dpm
+from ompi_tpu.core.errhandler import ERR_ARG, ERR_PENDING, MPIError
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    dpm._reset_for_tests()
+    yield
+    dpm._reset_for_tests()
+
+
+def test_spawn_basic(mpi, world):
+    ran = []
+
+    def child_main(child):
+        ran.append(child.size)
+        x = child.alloc((3,), np.float32, fill=2.0)
+        y = child.allreduce(x, mpi.SUM)
+        assert float(np.asarray(y)[0, 0]) == 2.0 * child.size
+
+    inter = mpi.Comm_spawn(child_main, 4, world)
+    assert ran == [4]
+    assert inter.size == world.size and inter.remote_size == 4
+    child = inter.remote_comm
+    # parent and child worlds are disjoint rank namespaces
+    assert not (set(child.group.world_ranks)
+                & set(world.group.world_ranks))
+    # child sees the parent through Comm_get_parent
+    parent_view = mpi.Comm_get_parent(child)
+    assert parent_view is not None
+    assert parent_view.remote_size == world.size
+    assert mpi.Comm_get_parent(world) is None
+
+
+def test_spawn_intercomm_traffic(mpi, world):
+    inter = mpi.Comm_spawn(None, 2, world)
+    child = inter.remote_comm
+    # parent group broadcasts to the child group across the intercomm
+    out = inter.bcast(np.arange(3, dtype=np.float32), root=0,
+                      root_side="local")
+    assert np.allclose(np.asarray(out)[1], [0, 1, 2])
+    assert np.asarray(out).shape[0] == child.size
+
+
+def test_spawn_multiple_appnums(mpi, world):
+    mains = []
+
+    def app_a(child, appnum):
+        mains.append(("a", appnum, child.size))
+
+    def app_b(child, appnum):
+        mains.append(("b", appnum, child.size))
+
+    inter = mpi.Comm_spawn_multiple([(app_a, 2), (app_b, 3)], world)
+    child = inter.remote_comm
+    assert child.size == 5
+    assert child._spawn_appnums == [0, 0, 1, 1, 1]
+    assert mains == [("a", 0, 5), ("b", 1, 5)]
+
+
+def test_spawn_on_explicit_devices(mpi, world):
+    devs = world.devices[:2]
+    inter = mpi.Comm_spawn(None, 2, world, devices=devs)
+    assert inter.remote_comm.devices == tuple(devs)
+
+
+def test_spawn_bad_args(mpi, world):
+    with pytest.raises(MPIError):
+        mpi.Comm_spawn(None, 0, world)
+    with pytest.raises(MPIError):
+        mpi.Comm_spawn(None, 2, world, devices=[])
+
+
+def test_spawn_oversubscribe(mpi, world):
+    from ompi_tpu.core.errhandler import ERR_SPAWN
+    # one rank = one device: asking for more than available is ERR_SPAWN
+    with pytest.raises(MPIError) as ei:
+        mpi.Comm_spawn(None, world.size + 1, world)
+    assert ei.value.error_class == ERR_SPAWN
+    # the MPI "soft" key: spawn as many as possible
+    inter = mpi.Comm_spawn(None, world.size + 5, world, soft=True)
+    assert inter.remote_size == world.size
+    # duplicate devices in an explicit list are de-duplicated
+    inter = mpi.Comm_spawn(None, 2, world,
+                           devices=[world.devices[0], world.devices[0],
+                                    world.devices[1]])
+    assert inter.remote_size == 2
+
+
+def test_rendezvous_fifo_multiple_clients(mpi, world):
+    subs = world.split([0, 0, 1, 1, 2, 2, 3, 3])
+    server, c1, c2 = subs[0], subs[2], subs[4]
+    port = mpi.Open_port()
+    a1 = mpi.Comm_iaccept(port, server)
+    a2 = mpi.Comm_iaccept(port, server)
+    i1 = mpi.Comm_connect(port, c1)      # pairs with the FIRST accept
+    assert a1.test()[0] and not a2.test()[0]
+    assert a1.get().remote_comm is c1 and i1.remote_comm is server
+    i2 = mpi.Comm_connect(port, c2)
+    assert a2.test()[0] and a2.get().remote_comm is c2
+    assert i2.remote_comm is server
+
+
+def test_connect_accept_rendezvous(mpi, world):
+    subs = world.split([0, 0, 0, 0, 1, 1, 1, 1])
+    a, b = subs[0], subs[4]
+    port = mpi.Open_port()
+    # blocking accept with no connect posted: surfaced deadlock
+    with pytest.raises(MPIError) as ei:
+        mpi.Comm_accept(port, a)
+    assert ei.value.error_class == ERR_PENDING
+    # post accept nonblocking, then connect completes both sides
+    areq = mpi.Comm_iaccept(port, a)
+    ok, _ = areq.test()
+    assert not ok
+    inter_b = mpi.Comm_connect(port, b)
+    ok, _ = areq.test()
+    assert ok
+    inter_a = areq.get()
+    assert inter_a.size == 4 and inter_a.remote_size == 4
+    assert inter_b.local_comm is b and inter_b.remote_comm is a
+    assert inter_a.local_comm is a and inter_a.remote_comm is b
+    mpi.Close_port(port)
+    with pytest.raises(MPIError):
+        mpi.Comm_connect(port, b)
+
+
+def test_naming_service(mpi, world):
+    port = mpi.Open_port()
+    mpi.Publish_name("ocean", port)
+    assert mpi.Lookup_name("ocean") == port
+    with pytest.raises(MPIError) as ei:
+        mpi.Publish_name("ocean", port)
+    assert ei.value.error_class == ERR_ARG
+    mpi.Unpublish_name("ocean")
+    with pytest.raises(MPIError):
+        mpi.Lookup_name("ocean")
+
+
+def test_join(mpi, world):
+    subs = world.split([0, 0, 0, 0, 1, 1, 1, 1])
+    a, b = subs[0], subs[4]
+    r1 = mpi.Comm_join("sock-7", a)     # first side posts
+    ok, _ = r1.test()
+    assert not ok
+    inter_b = mpi.Comm_join("sock-7", b)  # second side completes
+    assert inter_b.remote_comm is a
+    ok, _ = r1.test()
+    assert ok and r1.get().remote_comm is b
+
+
+def test_disconnect(mpi, world):
+    inter = mpi.Comm_spawn(None, 2, world)
+    child = inter.remote_comm
+    assert mpi.Comm_get_parent(child) is not None
+    mpi.Comm_disconnect(child)
+    assert mpi.Comm_get_parent(child) is None
+    mpi.Comm_disconnect(inter)
