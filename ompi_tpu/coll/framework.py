@@ -19,6 +19,10 @@ COLL_FUNCS = (
     # ULFM fault-tolerant agreement (reference vtable slots
     # ompi/mca/coll/coll.h:215-220, provided by coll/ftagree)
     "agree", "iagree",
+    # schedule-based nonblocking collectives (provided by coll/nbc, the
+    # libnbc role; blocking-slot winners serve the rest of the i-surface
+    # through async dispatch)
+    "iallreduce", "ibcast", "iallgather", "ibarrier",
 )
 
 coll_framework = register_framework("coll")
@@ -32,7 +36,7 @@ def _ensure_components() -> None:
         return
     # Importing registers each component with the framework.
     from ompi_tpu.coll import (basic, ftagree, monitoring,  # noqa: F401
-                               self_, tuned, xla)
+                               nbc, self_, tuned, xla)
     _components_loaded = True
 
 

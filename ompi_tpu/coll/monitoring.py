@@ -55,16 +55,20 @@ class MonitoringCollModule:
 
     def ibarrier(self):
         record(self.comm.cid, "barrier", 0)
+        m = self.vtable.get("ibarrier")
+        if m is not None:
+            return m.ibarrier()
+        from ompi_tpu.core.request import Request
         inner = self.vtable["barrier"]
-        inner_ib = getattr(inner, "ibarrier", None)
-        if inner_ib is not None:
-            return inner_ib()
+        fn = getattr(inner, "_ibarrier_arrays", None)
+        if fn is not None:
+            return Request(arrays=fn())
         inner.barrier()
-        return None
+        return Request.completed()
 
 
 for _f in COLL_FUNCS:
-    if _f != "barrier":
+    if _f not in ("barrier", "ibarrier"):
         def _mk(f):
             def method(self, buf, *args):
                 record(self.comm.cid, f, int(getattr(buf, "nbytes", 0)))

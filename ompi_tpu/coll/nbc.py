@@ -1,0 +1,241 @@
+"""coll/nbc — nonblocking collectives as round-based *schedules*.
+
+Behavioral spec: ``ompi/mca/coll/libnbc`` — a nonblocking collective is
+compiled into a schedule of rounds (``nbc_internal.h:156-168``: each
+round is a batch of send/recv/op/copy primitives with a barrier between
+rounds) and executed incrementally by a progress callback registered
+with ``opal_progress`` (``coll_libnbc_component.c:555-601``); the user's
+``MPI_Test/Wait`` drives progress.
+
+TPU-native re-design: a round's send/recv/op batch collapses into ONE
+device program per round — a shifted-index update on the stacked array
+(`jnp.roll` along the rank axis is the ppermute neighbor exchange; the
+`.at[rows, chunk].add` is the op primitive). Rounds are dispatched one
+at a time by the progress engine, only after the previous round's
+arrays are ready — exactly libnbc's round barrier — so host work
+interleaves between rounds (the overlap nonblocking collectives exist
+for). Algorithms mirror the base registry: ring allreduce
+(``coll_base_allreduce.c:345``), binomial bcast, ring allgather,
+dissemination barrier (host rounds).
+"""
+from __future__ import annotations
+
+import math
+from collections import deque
+from typing import Any, Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ompi_tpu.core import op as op_mod
+from ompi_tpu.core.request import Request, _is_ready
+from ompi_tpu.mca.base import Component
+from ompi_tpu.mca import var
+from ompi_tpu.coll.framework import coll_framework
+from ompi_tpu.runtime import progress as prog
+
+
+class ScheduleRequest(Request):
+    """A request completed by executing schedule rounds through the
+    progress engine (the libnbc NBC_Handle role)."""
+
+    def __init__(self, module: "NbcModule", state: Any,
+                 rounds: List[Callable[[Any], Any]],
+                 finalize: Optional[Callable[[Any], Any]] = None):
+        super().__init__(arrays=[])
+        self._complete = False
+        self._module = module
+        self._state = state
+        self._rounds = deque(rounds)
+        self._finalize = finalize
+        self._inflight: Optional[Any] = None
+        module._ensure_progress_cb()
+        module._active.append(self)
+
+    @property
+    def rounds_left(self) -> int:
+        return len(self._rounds)
+
+    def _progress(self) -> int:
+        """Advance at most one round; returns 1 if something happened.
+        A round is dispatched only when the previous round's output is
+        ready (libnbc's inter-round barrier)."""
+        if self._complete:
+            return 0
+        if self._inflight is not None:
+            leaves = [a for a in jax.tree_util.tree_leaves(self._inflight)
+                      if isinstance(a, jax.Array)]
+            if not all(_is_ready(a) for a in leaves):
+                return 0                       # previous round still flying
+            self._inflight = None
+        if self._rounds:
+            rnd = self._rounds.popleft()
+            self._state = rnd(self._state)
+            self._inflight = self._state
+            return 1
+        result = self._state
+        if self._finalize is not None:
+            result = self._finalize(result)
+        self._result = result
+        self._complete = True
+        self._module._active.remove(self)
+        return 1
+
+    def test(self):
+        if not self._complete:
+            prog.progress()
+        return (True, self.status) if self._complete else (False, None)
+
+    def wait(self):
+        while not self._complete:
+            if prog.progress() == 0 and self._inflight is not None:
+                # previous round still executing: block on it rather
+                # than busy-spin (request.h:451 completion sync)
+                jax.block_until_ready(self._inflight)
+        return self.status
+
+
+class NbcModule:
+    """Schedule builders. All operate on stacked arrays (N, ...)."""
+
+    def __init__(self, comm):
+        self.comm = comm
+        self._active: List[ScheduleRequest] = []
+        self._cb_registered = False
+
+    # -- component progress callback (coll_libnbc_component.c:555) -----
+    def _ensure_progress_cb(self) -> None:
+        if not self._cb_registered:
+            prog.register(self._progress_cb)
+            self._cb_registered = True
+
+    def _progress_cb(self) -> int:
+        n = 0
+        for req in list(self._active):
+            n += req._progress()
+        if not self._active:
+            # keep the engine's callback list tight across many comms
+            prog.unregister(self._progress_cb)
+            self._cb_registered = False
+        return n
+
+    # -- schedule builders --------------------------------------------
+    def _chunked(self, x):
+        """Pad the last axis to a multiple of comm size and view it as
+        (N, N, C) chunks (the ring algorithms' segmentation)."""
+        n = self.comm.size
+        flat = x.reshape(n, -1)
+        length = flat.shape[1]
+        c = max(1, math.ceil(length / n))
+        pad = c * n - length
+        if pad:
+            flat = jnp.pad(flat, ((0, 0), (0, pad)))
+        return flat.reshape(n, n, c), length, x.shape
+
+    def iallreduce(self, x, op: op_mod.Op = op_mod.SUM) -> ScheduleRequest:
+        """Ring allreduce: N-1 reduce-scatter rounds + N-1 allgather
+        rounds (coll_base_allreduce.c:345; the 2(N-1)-step loop)."""
+        n = self.comm.size
+        if n == 1:
+            return ScheduleRequest(self, x, [])
+        chunks, length, shape = self._chunked(jnp.asarray(x))
+        rows = jnp.arange(n)
+        fn = op.fn
+
+        def rs_round(s):
+            def run(acc):
+                shifted = jnp.roll(acc, 1, axis=0)    # [i] <- [i-1]
+                cidx = (rows - 1 - s) % n
+                return acc.at[rows, cidx].set(
+                    fn(acc[rows, cidx], shifted[rows, cidx]))
+            return run
+
+        def ag_round(s):
+            def run(acc):
+                shifted = jnp.roll(acc, 1, axis=0)
+                cidx = (rows - s) % n
+                return acc.at[rows, cidx].set(shifted[rows, cidx])
+            return run
+
+        rounds = [rs_round(s) for s in range(n - 1)]
+        rounds += [ag_round(s) for s in range(n - 1)]
+
+        def finalize(acc):
+            return acc.reshape(n, -1)[:, :length].reshape(shape)
+
+        return ScheduleRequest(self, chunks, rounds, finalize)
+
+    def ibcast(self, x, root: int = 0) -> ScheduleRequest:
+        """Binomial-tree bcast: ceil(log2 N) rounds; in round k ranks
+        with vrank < 2^k feed vrank + 2^k (coll_base_bcast binomial)."""
+        n = self.comm.size
+        if n == 1:
+            return ScheduleRequest(self, x, [])
+        x = jnp.asarray(x)
+        rows = np.arange(n)
+        vr = (rows - root) % n
+
+        def round_k(k):
+            active = (vr >= (1 << k)) & (vr < (1 << (k + 1)))
+            src = ((vr - (1 << k)) + root) % n
+            src = np.where(active, src, rows)
+            src_j = jnp.asarray(src)
+            mask = jnp.asarray(active).reshape((n,) + (1,) * (x.ndim - 1))
+
+            def run(buf):
+                return jnp.where(mask, buf[src_j], buf)
+            return run
+
+        rounds = [round_k(k) for k in range(max(1, math.ceil(
+            math.log2(n))))]
+        return ScheduleRequest(self, x, rounds)
+
+    def iallgather(self, x) -> ScheduleRequest:
+        """Ring allgather: N-1 rounds; round s moves the chunk each
+        rank completed s rounds ago to its +1 neighbor (the ring
+        algorithm of the base registry)."""
+        n = self.comm.size
+        x = jnp.asarray(x)
+        out0 = jnp.zeros((n,) + x.shape, x.dtype)
+        out0 = out0.at[jnp.arange(n), jnp.arange(n)].set(x)
+        if n == 1:
+            return ScheduleRequest(self, out0, [])
+        rows = jnp.arange(n)
+
+        def round_s(s):
+            def run(out):
+                shifted = jnp.roll(out, 1, axis=0)
+                cidx = (rows - 1 - s) % n
+                return out.at[rows, cidx].set(shifted[rows, cidx])
+            return run
+
+        return ScheduleRequest(self, out0,
+                               [round_s(s) for s in range(n - 1)])
+
+    def ibarrier(self) -> ScheduleRequest:
+        """Dissemination barrier: ceil(log2 N) host rounds (no data
+        plane — the reference's dissemination algorithm's round count,
+        scoll_basic_barrier.c / coll_base_barrier.c bruck)."""
+        n = self.comm.size
+        rounds = [(lambda st: st)
+                  for _ in range(max(1, math.ceil(math.log2(max(n, 2)))))]
+        return ScheduleRequest(self, None, rounds)
+
+
+class NbcComponent(Component):
+    name = "nbc"
+
+    def register_params(self) -> None:
+        var.var_register("coll", "nbc", "priority", vtype="int", default=30,
+                         help="Selection priority of the schedule-based "
+                              "nonblocking collective component")
+
+    def comm_query(self, comm):
+        prio = var.var_get("coll_nbc_priority", 30)
+        if prio < 0:
+            return None
+        return (prio, NbcModule(comm))
+
+
+coll_framework.register(NbcComponent())

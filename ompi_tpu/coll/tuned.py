@@ -116,8 +116,8 @@ class TunedCollModule:
     def barrier(self) -> None:
         self.device.barrier()
 
-    def ibarrier(self):
-        return self.device.ibarrier()
+    def _ibarrier_arrays(self):
+        return self.device._ibarrier_arrays()
 
 
 class TunedCollComponent(Component):

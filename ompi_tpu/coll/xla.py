@@ -606,7 +606,9 @@ class XlaCollModule:
     def barrier(self) -> None:
         jax.block_until_ready(self._barrier_arrays())
 
-    def ibarrier(self):
+    def _ibarrier_arrays(self):
+        # arrays backing an async barrier (the coll/nbc component owns
+        # the schedule-based MPI_Ibarrier slot)
         return self._barrier_arrays()
 
 

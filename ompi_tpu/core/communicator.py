@@ -429,16 +429,51 @@ class Communicator:
                   if isinstance(a, jax.Array)]
         return Request(result=out, arrays=arrays or None)
 
+    def _isched(self, func: str):
+        """The i-collective's vtable slot when a schedule component
+        (coll/nbc) won it; None routes through async dispatch (_nb).
+        Contiguous-buffer calls only — datatype/count kwargs take the
+        blocking path, whose convertor handles packing. Runs the same
+        entry checks/counters as _coll so state errors, FT, SPC and
+        hooks behave identically on both paths."""
+        m = self.c_coll.get(func)
+        if m is None:
+            return None
+        self._check()
+        self._check_ft_coll()
+        from ompi_tpu.runtime import spc
+        from ompi_tpu.utils import hooks
+        spc.record(f"coll_{func}", 1)
+        hooks.fire(f"coll_{func}", self, {})
+        return m
+
     def iallreduce(self, sendbuf, op=op_mod.SUM, **kw) -> Request:
+        if not kw:
+            m = self._isched("iallreduce")
+            if m is not None:
+                self._validate_stacked(sendbuf)
+                self._validate_op(op)
+                return m.iallreduce(sendbuf, op)
         return self._nb(self.allreduce, sendbuf, op, **kw)
 
     def ibcast(self, buf, root: int = 0, **kw) -> Request:
+        if not kw:
+            m = self._isched("ibcast")
+            if m is not None:
+                self._validate_stacked(buf)
+                self._validate_root(root)
+                return m.ibcast(buf, root)
         return self._nb(self.bcast, buf, root, **kw)
 
     def ireduce(self, sendbuf, op=op_mod.SUM, root: int = 0, **kw) -> Request:
         return self._nb(self.reduce, sendbuf, op, root, **kw)
 
     def iallgather(self, sendbuf, **kw) -> Request:
+        if not kw:
+            m = self._isched("iallgather")
+            if m is not None:
+                self._validate_stacked(sendbuf)
+                return m.iallgather(sendbuf)
         return self._nb(self.allgather, sendbuf, **kw)
 
     def igather(self, sendbuf, root: int = 0, **kw) -> Request:
@@ -472,9 +507,18 @@ class Communicator:
         return self._nb(self.alltoallv, send_chunks)
 
     def ibarrier(self) -> Request:
+        ms = self._isched("ibarrier")
+        if ms is not None:
+            return ms.ibarrier()
         m = self._coll("barrier")
-        arrays = m.ibarrier() if hasattr(m, "ibarrier") else None
-        return Request(arrays=arrays)
+        fn = getattr(m, "_ibarrier_arrays", None)
+        if fn is not None:
+            return Request(arrays=fn())
+        # winner has no async form (e.g. the monitoring shim with nbc
+        # disabled): a completed synchronous barrier is still a correct
+        # MPI_Ibarrier
+        m.barrier()
+        return Request.completed()
 
     # -- persistent collectives (MPI-4 MPI_Allreduce_init etc.) --------
     def allreduce_init(self, sendbuf, op=op_mod.SUM, **kw) -> Request:

@@ -23,7 +23,8 @@ import itertools
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from ompi_tpu.core.communicator import Communicator
-from ompi_tpu.core.errhandler import (ERR_ARG, ERR_PENDING, ERR_SPAWN,
+from ompi_tpu.core.errhandler import (ERR_ARG, ERR_NAME, ERR_PENDING,
+                                      ERR_PORT, ERR_SERVICE, ERR_SPAWN,
                                       MPIError)
 from ompi_tpu.core.group import Group
 from ompi_tpu.core.intercomm import Intercomm
@@ -73,14 +74,15 @@ def close_port(port: str) -> None:
 def publish_name(service: str, port: str, info=None) -> None:
     """MPI_Publish_name (the PMIx naming-service role)."""
     if service in _names:
-        raise MPIError(ERR_ARG, f"service {service!r} already published")
+        raise MPIError(ERR_SERVICE,
+                       f"service {service!r} already published")
     _names[service] = port
 
 
 def lookup_name(service: str, info=None) -> str:
     port = _names.get(service)
     if port is None:
-        raise MPIError(ERR_ARG, f"service {service!r} not published")
+        raise MPIError(ERR_NAME, f"service {service!r} not published")
     return port
 
 
@@ -91,7 +93,7 @@ def unpublish_name(service: str, info=None) -> None:
 def _slot(port: str) -> dict:
     slot = _ports.get(port)
     if slot is None:
-        raise MPIError(ERR_ARG, f"port {port!r} is not open")
+        raise MPIError(ERR_PORT, f"port {port!r} is not open")
     return slot
 
 
@@ -252,21 +254,26 @@ def disconnect(comm) -> None:
     comm.free()
 
 
-_world_base = itertools.count(1)
+_world_hwm = 0          # high-water mark of handed-out world-rank blocks
 
 
 def _next_world_base(comm: Communicator) -> int:
-    """A world-rank namespace slice disjoint from every live group.
-    Deterministic (the CID-agreement property): monotone blocks above
-    the parent's maximum world rank."""
+    """A world-rank namespace slice disjoint from every group allocated
+    so far — including nested spawns — via a single global high-water
+    mark (the PMIx nspace-uniqueness property). Deterministic
+    (the CID-agreement property): allocation order is program order."""
+    global _world_hwm
     step = 1 << 20
-    return max(comm.group.world_ranks, default=0) + step * next(_world_base)
+    floor = max(_world_hwm, max(comm.group.world_ranks, default=0) + 1)
+    base = ((floor + step - 1) // step) * step
+    _world_hwm = base + step
+    return base
 
 
 def _reset_for_tests() -> None:
-    global _port_counter, _world_base
+    global _port_counter, _world_hwm
     _ports.clear()
     _names.clear()
     _joins.clear()
     _port_counter = itertools.count(0)
-    _world_base = itertools.count(1)
+    _world_hwm = 0

@@ -70,6 +70,7 @@ class Request:
         self.status = status or Status()
         self._persistent_start = persistent_start
         self._active = persistent_start is None
+        self._inner_req: Optional["Request"] = None
 
     # -- completion --------------------------------------------------------
     def _finish(self):
@@ -82,6 +83,15 @@ class Request:
         """MPI_Test: non-blocking completion check."""
         if self._complete:
             return True, self.status
+        if self._inner_req is not None:
+            # started persistent request: delegate to this iteration's
+            # operation (which may itself be schedule-backed)
+            ok, _st = self._inner_req.test()
+            if ok:
+                self._result = self._inner_req._result
+                self._finish()
+                return True, self.status
+            return False, None
         if self._arrays is None or all(_is_ready(a) for a in self._arrays):
             self._finish()
             return True, self.status
@@ -90,7 +100,10 @@ class Request:
     def wait(self) -> Status:
         """MPI_Wait: block until complete; returns the Status."""
         if not self._complete:
-            if self._arrays is not None:
+            if self._inner_req is not None:
+                self._inner_req.wait()
+                self._result = self._inner_req._result
+            elif self._arrays is not None:
                 jax.block_until_ready(self._arrays)
             self._finish()
         return self.status
@@ -114,11 +127,8 @@ class Request:
     def start(self) -> "Request":
         if self._persistent_start is None:
             raise ValueError("not a persistent request")
-        inner = self._persistent_start()
-        self._arrays = inner._arrays
-        self._result = inner._result
-        self._on_complete = inner._on_complete
-        self._complete = inner._complete
+        self._inner_req = self._persistent_start()
+        self._complete = False
         self._active = True
         return self
 

@@ -131,15 +131,30 @@ def test_connect_accept_rendezvous(mpi, world):
 
 
 def test_naming_service(mpi, world):
+    from ompi_tpu.core.errhandler import ERR_NAME, ERR_PORT, ERR_SERVICE
     port = mpi.Open_port()
     mpi.Publish_name("ocean", port)
     assert mpi.Lookup_name("ocean") == port
     with pytest.raises(MPIError) as ei:
         mpi.Publish_name("ocean", port)
-    assert ei.value.error_class == ERR_ARG
+    assert ei.value.error_class == ERR_SERVICE
     mpi.Unpublish_name("ocean")
-    with pytest.raises(MPIError):
+    with pytest.raises(MPIError) as ei:
         mpi.Lookup_name("ocean")
+    assert ei.value.error_class == ERR_NAME
+    with pytest.raises(MPIError) as ei:
+        mpi.Comm_connect("tpu://port/999", world)
+    assert ei.value.error_class == ERR_PORT
+
+
+def test_nested_spawn_namespaces_disjoint(mpi, world):
+    a = mpi.Comm_spawn(None, 4, world).remote_comm
+    nested = mpi.Comm_spawn(None, 4, a).remote_comm
+    c = mpi.Comm_spawn(None, 8, world).remote_comm
+    ws = [set(x.group.world_ranks) for x in (world, a, nested, c)]
+    for i in range(len(ws)):
+        for j in range(i + 1, len(ws)):
+            assert not (ws[i] & ws[j]), (i, j, ws[i] & ws[j])
 
 
 def test_join(mpi, world):
