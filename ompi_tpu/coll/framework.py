@@ -35,8 +35,8 @@ def _ensure_components() -> None:
     if _components_loaded:
         return
     # Importing registers each component with the framework.
-    from ompi_tpu.coll import (basic, ftagree, monitoring,  # noqa: F401
-                               nbc, self_, tuned, xla)
+    from ompi_tpu.coll import (basic, ftagree, han, monitoring,  # noqa: F401
+                               nbc, self_, tuned, xhc, xla)
     _components_loaded = True
 
 
@@ -62,11 +62,13 @@ def comm_select_coll(comm) -> Dict[str, Any]:
     collective function; when monitoring is enabled, wrap every slot in
     the counting shim (which delegates to the slot's real winner)."""
     winners, selected = select_winners(comm)
-    # Cache the selection outcome for introspection (comm_method).
+    # Cache the selection outcome for introspection (comm_method) and
+    # for components that need their fallback module (han's flat path).
     comm._coll_winners = {f: comp.name
                           for f, (comp, _m) in winners.items()}
     comm._coll_priorities = [(comp.name, prio)
                              for prio, comp, _m in selected]
+    comm._coll_selected = selected
     vtable: Dict[str, Any] = {f: m for f, (_c, m) in winners.items()}
     from ompi_tpu.coll import monitoring
     if vtable and monitoring.enabled():

@@ -1,0 +1,267 @@
+"""coll/han — hierarchical collectives by sub-communicator composition.
+
+Behavioral spec: ``ompi/mca/coll/han`` — split the communicator into
+*low* (intra-node) and *up* (inter-node leaders) sub-communicators per
+topology level and compose each collective from per-level modules
+(``coll_han.h:29-33,180-195``); which level runs first is governed by a
+dynamic run-time rule table (``coll_han_dynamic.c``) keyed on collective
+and message size, overridable from an MCA-supplied rule file.
+
+TPU-native re-design: levels map to fabric tiers — ranks sharing a host
+process sit on one ICI domain (low), leaders ride the DCN tier (up).
+Sub-communicators are real mesh subsets whose own c_coll vtables were
+priority-selected by the framework, so each tier automatically uses its
+best component (the composition property han exists for). On a
+single-process mesh the hierarchy can be imposed synthetically
+(``coll_han_split`` = low-group size), which is also how tests model the
+ICI/DCN split. The module keeps out of sub-communicator selection
+(it disqualifies itself for its own inner comms) to avoid recursion,
+exactly as the reference han refuses comms without hierarchy.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ompi_tpu.core import op as op_mod
+from ompi_tpu.mca import var
+from ompi_tpu.mca.base import Component
+from ompi_tpu.coll.framework import coll_framework
+
+
+_constructing = False
+
+
+def locality_groups(comm, group_size: int = 0) -> Optional[List[List[int]]]:
+    """Partition comm ranks into low-level groups. ``group_size`` > 0
+    forces a synthetic split (rank // group_size); otherwise group by
+    device locality (process index — the host/ICI-domain boundary).
+    Returns None when the hierarchy is trivial (one group, or all
+    singleton groups)."""
+    n = comm.size
+    if group_size > 0:
+        groups: Dict[int, List[int]] = {}
+        for r in range(n):
+            groups.setdefault(r // group_size, []).append(r)
+    else:
+        groups = {}
+        for r, d in enumerate(comm.devices):
+            groups.setdefault(int(getattr(d, "process_index", 0) or 0),
+                              []).append(r)
+    out = [sorted(g) for _k, g in sorted(groups.items())]
+    if len(out) <= 1 or all(len(g) == 1 for g in out):
+        return None
+    return out
+
+
+class Hierarchy:
+    """Materialized 2-level hierarchy: low sub-comms + the up (leader)
+    sub-comm, built through the ordinary communicator algebra so every
+    tier re-enters framework selection (coll_han.h:180-195)."""
+
+    def __init__(self, comm, groups: List[List[int]]):
+        self.comm = comm
+        self.groups = groups
+        self.group_of = np.empty(comm.size, np.int64)
+        for gi, g in enumerate(groups):
+            self.group_of[np.asarray(g)] = gi
+        colors = [int(self.group_of[r]) for r in range(comm.size)]
+        global _constructing
+        _constructing = True       # han never claims its own tiers
+        try:
+            subs = comm.split(colors)
+            self.low = []
+            for g in groups:
+                sub = subs[g[0]]
+                sub._han_inner = True   # keep han out of future reselects
+                self.low.append(sub)
+            self.leaders = [g[0] for g in groups]
+            from ompi_tpu.core.group import Group
+            up = comm.create(Group([comm.group.world_ranks[r]
+                                    for r in self.leaders]))
+            up._han_inner = True
+            self.up = up
+        finally:
+            _constructing = False
+
+    def rows(self, gi: int):
+        return jnp.asarray(self.groups[gi])
+
+
+class HanModule:
+    """Two-level composed collectives over stacked arrays (N, *s)."""
+
+    def __init__(self, comm, groups: List[List[int]]):
+        self.comm = comm
+        self._groups = groups
+        self._h: Optional[Hierarchy] = None
+
+    @property
+    def h(self) -> Hierarchy:
+        if self._h is None:
+            self._h = Hierarchy(self.comm, self._groups)
+        return self._h
+
+    # -- dynamic rule table (coll_han_dynamic.c) -----------------------
+    def _strategy(self, func: str, nbytes: int) -> str:
+        """'hier' (compose levels) or 'flat' (delegate to the next
+        component) per the dynamic table."""
+        rules = _dynamic_rules()
+        for rule in rules.get(func, []):
+            if nbytes <= int(rule.get("max_bytes", 1 << 62)):
+                return rule.get("algorithm", "hier")
+        # default: hierarchy pays off except for tiny messages where
+        # the extra level latency dominates (barrier is latency-only
+        # and always benefits from the two-tier fan-in)
+        if func == "barrier":
+            return "hier"
+        return "flat" if nbytes <= 256 else "hier"
+
+    def _flat(self, func: str):
+        """The next-priority provider of ``func`` below han (the
+        reference's fallback module pointer)."""
+        for _prio, comp, module in self.comm._coll_selected:
+            if comp.name == "han":
+                continue
+            m = getattr(module, func, None)
+            if m is not None:
+                return m
+        raise RuntimeError(f"no fallback provider for {func}")
+
+    # -- collectives ---------------------------------------------------
+    def allreduce(self, x, op: op_mod.Op = op_mod.SUM):
+        if self._strategy("allreduce", int(getattr(x, "nbytes", 0))) \
+                == "flat":
+            return self._flat("allreduce")(x, op)
+        h = self.h
+        # level 1: intra-group allreduce on each low comm
+        partials = []
+        for gi, low in enumerate(h.low):
+            sub = jax.device_put(jnp.take(jnp.asarray(x), h.rows(gi),
+                                          axis=0), low.sharding)
+            partials.append(low.allreduce(sub, op))
+        # level 2: leaders allreduce across groups (the DCN tier).
+        # Leader rows live on different sub-meshes; the host staging
+        # here IS the tier boundary hop (the reference's up-comm send).
+        lead_buf = jax.device_put(
+            np.stack([np.asarray(p[0]) for p in partials]),
+            h.up.sharding)
+        reduced = np.asarray(h.up.allreduce(lead_buf, op))
+        # level 3: result redistribution down the low tier
+        out = reduced[np.asarray(h.group_of)]
+        return jax.device_put(out, self.comm.sharding)
+
+    def bcast(self, x, root: int = 0):
+        if self._strategy("bcast", int(getattr(x, "nbytes", 0))) == "flat":
+            return self._flat("bcast")(x, root)
+        h = self.h
+        xg = jnp.asarray(x)
+        root_gi = int(h.group_of[root])
+        # up tier: root's row reaches every leader
+        row = np.asarray(xg[root])
+        lead_buf = jax.device_put(np.stack([row] * len(h.leaders)),
+                                  h.up.sharding)
+        lead_out = np.asarray(h.up.bcast(lead_buf, root_gi))
+        # low tier: each leader broadcasts into its group
+        out = lead_out[np.asarray(h.group_of)]
+        return jax.device_put(out, self.comm.sharding)
+
+    def reduce(self, x, op: op_mod.Op = op_mod.SUM, root: int = 0):
+        if self._strategy("reduce", int(getattr(x, "nbytes", 0))) == "flat":
+            return self._flat("reduce")(x, op, root)
+        h = self.h
+        partials = []
+        for gi, low in enumerate(h.low):
+            sub = jax.device_put(jnp.take(jnp.asarray(x), h.rows(gi),
+                                          axis=0), low.sharding)
+            partials.append(low.allreduce(sub, op))
+        lead_buf = jax.device_put(
+            np.stack([np.asarray(p[0]) for p in partials]),
+            h.up.sharding)
+        root_gi = int(h.group_of[root])
+        red = np.asarray(h.up.reduce(lead_buf, op, root_gi))
+        out = np.zeros_like(np.asarray(x))
+        out[root] = red[root_gi]
+        return jax.device_put(out, self.comm.sharding)
+
+    def allgather(self, x):
+        if self._strategy("allgather",
+                          int(getattr(x, "nbytes", 0))) == "flat":
+            return self._flat("allgather")(x)
+        h = self.h
+        xg = jnp.asarray(x)
+        n = self.comm.size
+        # low tier gathers per group; leaders exchange their group
+        # blocks over the up tier (v-collective: group sizes may differ)
+        gathered = []
+        for gi, low in enumerate(h.low):
+            sub = jax.device_put(jnp.take(xg, h.rows(gi), axis=0),
+                                 low.sharding)
+            gathered.append(np.asarray(low.allgather(sub))[0])  # (g, *s)
+        blocks = h.up.allgatherv([g.ravel() for g in gathered])
+        full = np.asarray(blocks[0]).reshape((n,) + xg.shape[1:])
+        # rows arrive in group order; permute back to rank order
+        order = np.concatenate([np.asarray(g) for g in h.groups])
+        pos = np.empty(n, np.int64)
+        pos[order] = np.arange(n)
+        full = full[pos]
+        out = np.broadcast_to(full[None], (n,) + full.shape)
+        return jax.device_put(jnp.asarray(out), self.comm.sharding)
+
+    def barrier(self) -> None:
+        if self._strategy("barrier", 0) == "flat":
+            self._flat("barrier")()
+            return
+        h = self.h
+        for low in h.low:
+            low.barrier()
+        h.up.barrier()
+
+
+def _dynamic_rules() -> Dict[str, List[dict]]:
+    """The run-time rule table: MCA var ``coll_han_dynamic_rules`` names
+    a JSON file {collective: [{max_bytes, algorithm}...]} (the
+    coll_han_dynamic.c idea). Parsing rides tuned's shared
+    mtime-memoized loader so the two components' file handling cannot
+    drift."""
+    from ompi_tpu.coll.tuned import _load_rules
+    return _load_rules(var.var_get("coll_han_dynamic_rules", "") or "")
+
+
+def _reset_rules_for_tests() -> None:
+    from ompi_tpu.coll import tuned
+    tuned._rules_cache.clear()
+
+
+class HanComponent(Component):
+    name = "han"
+
+    def register_params(self) -> None:
+        var.var_register("coll", "han", "priority", vtype="int", default=35,
+                         help="Selection priority of the hierarchical "
+                              "composition component")
+        var.var_register("coll", "han", "split", vtype="int", default=0,
+                         help="Synthetic low-group size (0 = use device "
+                              "locality); models the ICI/DCN boundary on "
+                              "flat meshes")
+        var.var_register("coll", "han", "dynamic_rules", vtype="str",
+                         default="",
+                         help="JSON rule file keyed by collective: "
+                              "[{max_bytes, algorithm: hier|flat}]")
+
+    def comm_query(self, comm):
+        if getattr(comm, "_han_inner", False):
+            return None                   # never recurse into own tiers
+        prio = var.var_get("coll_han_priority", 35)
+        if prio < 0:
+            return None
+        groups = locality_groups(comm, var.var_get("coll_han_split", 0))
+        if groups is None:
+            return None                   # no hierarchy, no han
+        return (prio, HanModule(comm, groups))
+
+
+coll_framework.register(HanComponent())

@@ -436,16 +436,7 @@ class Communicator:
         blocking path, whose convertor handles packing. Runs the same
         entry checks/counters as _coll so state errors, FT, SPC and
         hooks behave identically on both paths."""
-        m = self.c_coll.get(func)
-        if m is None:
-            return None
-        self._check()
-        self._check_ft_coll()
-        from ompi_tpu.runtime import spc
-        from ompi_tpu.utils import hooks
-        spc.record(f"coll_{func}", 1)
-        hooks.fire(f"coll_{func}", self, {})
-        return m
+        return self._coll(func) if func in self.c_coll else None
 
     def iallreduce(self, sendbuf, op=op_mod.SUM, **kw) -> Request:
         if not kw:
@@ -511,12 +502,13 @@ class Communicator:
         if ms is not None:
             return ms.ibarrier()
         m = self._coll("barrier")
+        if hasattr(m, "ibarrier"):       # e.g. the monitoring shim
+            return m.ibarrier()
         fn = getattr(m, "_ibarrier_arrays", None)
         if fn is not None:
             return Request(arrays=fn())
-        # winner has no async form (e.g. the monitoring shim with nbc
-        # disabled): a completed synchronous barrier is still a correct
-        # MPI_Ibarrier
+        # winner has no async form at all: a completed synchronous
+        # barrier is still a correct MPI_Ibarrier
         m.barrier()
         return Request.completed()
 
