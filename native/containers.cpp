@@ -1,0 +1,451 @@
+// ompi_tpu native containers — the opal/class role.
+//
+// Re-design of the reference's object/container layer (opal/class/:
+// opal_fifo.h, opal_lifo.h, opal_ring_buffer.h, opal_hotel.h,
+// opal_bitmap.h, opal_pointer_array.h; lock-free structures stress-
+// tested by test/class/opal_fifo.c and opal_lifo.c, atomics by
+// test/asm/). The reference builds its lock-free lists from tagged
+// pointers + CAS (opal/sys atomics); here:
+//   - FIFO: Vyukov bounded MPMC queue (per-cell sequence numbers) —
+//     the role of opal_fifo's two-lock-free-pointer design.
+//   - LIFO: Treiber stack over a fixed node pool with a 32-bit ABA tag
+//     packed beside the 32-bit node index in one 64-bit CAS word —
+//     exactly the counted-pointer trick opal_lifo uses.
+//   - hotel: opal_hotel's timeout manager (checkin/checkout/eviction).
+//   - bitmap / pointer array: index-recycling registries.
+// Items are int64 descriptors; Python owns any associated objects
+// (the same descriptor/payload split as the matching core).
+//
+// Handle-based C ABI over ctypes.
+
+#include <atomic>
+#include <mutex>
+#include <shared_mutex>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------- FIFO
+struct FifoCell {
+  std::atomic<uint64_t> seq;
+  int64_t data;
+};
+
+struct Fifo {
+  std::vector<FifoCell> cells;
+  uint64_t mask;
+  int64_t bound;                   // caller's exact capacity
+  std::atomic<int64_t> count{0};
+  std::atomic<uint64_t> head{0};   // pop side
+  std::atomic<uint64_t> tail{0};   // push side
+
+  explicit Fifo(uint64_t capacity) {
+    uint64_t cap = 1;
+    while (cap < capacity) cap <<= 1;
+    cells = std::vector<FifoCell>(cap);
+    for (uint64_t i = 0; i < cap; ++i)
+      cells[i].seq.store(i, std::memory_order_relaxed);
+    mask = cap - 1;
+    bound = (int64_t)capacity;
+  }
+
+  bool push(int64_t v) {
+    // enforce the caller's exact bound (cells round up to a power of
+    // two; the counter keeps the backpressure contract precise)
+    if (count.fetch_add(1, std::memory_order_acq_rel) >= bound) {
+      count.fetch_sub(1, std::memory_order_acq_rel);
+      return false;
+    }
+    uint64_t pos = tail.load(std::memory_order_relaxed);
+    for (;;) {
+      FifoCell &c = cells[pos & mask];
+      uint64_t seq = c.seq.load(std::memory_order_acquire);
+      intptr_t dif = (intptr_t)seq - (intptr_t)pos;
+      if (dif == 0) {
+        if (tail.compare_exchange_weak(pos, pos + 1,
+                                       std::memory_order_relaxed))
+        {
+          c.data = v;
+          c.seq.store(pos + 1, std::memory_order_release);
+          return true;
+        }
+      } else if (dif < 0) {
+        return false;                       // full
+      } else {
+        pos = tail.load(std::memory_order_relaxed);
+      }
+    }
+  }
+
+  bool pop(int64_t *out) {
+    uint64_t pos = head.load(std::memory_order_relaxed);
+    for (;;) {
+      FifoCell &c = cells[pos & mask];
+      uint64_t seq = c.seq.load(std::memory_order_acquire);
+      intptr_t dif = (intptr_t)seq - (intptr_t)(pos + 1);
+      if (dif == 0) {
+        if (head.compare_exchange_weak(pos, pos + 1,
+                                       std::memory_order_relaxed))
+        {
+          *out = c.data;
+          c.seq.store(pos + mask + 1, std::memory_order_release);
+          count.fetch_sub(1, std::memory_order_acq_rel);
+          return true;
+        }
+      } else if (dif < 0) {
+        return false;                       // empty
+      } else {
+        pos = head.load(std::memory_order_relaxed);
+      }
+    }
+  }
+};
+
+// ---------------------------------------------------------------- LIFO
+// Treiber stack; top word = [tag:32 | index+1:32]; 0 == empty.
+struct LifoNode {
+  int64_t value;
+  uint32_t next;                            // index+1; 0 == null
+};
+
+struct Lifo {
+  std::vector<LifoNode> pool;
+  std::atomic<uint64_t> top{0};
+  std::atomic<uint64_t> free_top{0};
+
+  explicit Lifo(uint32_t capacity) : pool(capacity) {
+    // thread the free list through the pool
+    uint64_t prev = 0;
+    for (uint32_t i = capacity; i-- > 0;) {
+      pool[i].next = (uint32_t)prev;
+      prev = i + 1;
+    }
+    free_top.store(prev, std::memory_order_relaxed);
+  }
+
+  static uint32_t idx(uint64_t word) { return (uint32_t)word; }
+  static uint64_t make(uint32_t index_plus1, uint32_t tag) {
+    return ((uint64_t)tag << 32) | index_plus1;
+  }
+
+  bool take(std::atomic<uint64_t> &stack, uint32_t *out_idx) {
+    uint64_t cur = stack.load(std::memory_order_acquire);
+    for (;;) {
+      uint32_t ip1 = idx(cur);
+      if (ip1 == 0) return false;
+      LifoNode &n = pool[ip1 - 1];
+      uint64_t next = make(n.next, (uint32_t)(cur >> 32) + 1);
+      if (stack.compare_exchange_weak(cur, next,
+                                      std::memory_order_acq_rel))
+      {
+        *out_idx = ip1 - 1;
+        return true;
+      }
+    }
+  }
+
+  void put(std::atomic<uint64_t> &stack, uint32_t index) {
+    uint64_t cur = stack.load(std::memory_order_acquire);
+    for (;;) {
+      pool[index].next = idx(cur);
+      uint64_t next = make(index + 1, (uint32_t)(cur >> 32) + 1);
+      if (stack.compare_exchange_weak(cur, next,
+                                      std::memory_order_acq_rel))
+        return;
+    }
+  }
+
+  bool push(int64_t v) {
+    uint32_t i;
+    if (!take(free_top, &i)) return false;  // pool exhausted
+    pool[i].value = v;
+    put(top, i);
+    return true;
+  }
+
+  bool pop(int64_t *out) {
+    uint32_t i;
+    if (!take(top, &i)) return false;       // empty
+    *out = pool[i].value;
+    put(free_top, i);
+    return true;
+  }
+};
+
+// ---------------------------------------------------------- ring buffer
+struct Ring {
+  std::vector<int64_t> buf;
+  uint64_t head = 0, tail = 0;              // single-threaded (opal's is
+                                            // SPSC; Python side holds GIL)
+  explicit Ring(uint64_t cap) : buf(cap) {}
+  bool push(int64_t v) {
+    if (tail - head == buf.size()) return false;
+    buf[tail++ % buf.size()] = v;
+    return true;
+  }
+  bool pop(int64_t *out) {
+    if (tail == head) return false;
+    *out = buf[head++ % buf.size()];
+    return true;
+  }
+};
+
+// --------------------------------------------------------------- hotel
+struct Hotel {
+  struct Room {
+    int64_t occupant = 0;
+    int64_t deadline = 0;
+    bool occupied = false;
+  };
+  std::vector<Room> rooms;
+  std::vector<int32_t> free_rooms;
+  explicit Hotel(int32_t n) : rooms(n) {
+    for (int32_t i = n; i-- > 0;) free_rooms.push_back(i);
+  }
+  int32_t checkin(int64_t occupant, int64_t deadline) {
+    if (free_rooms.empty()) return -1;
+    int32_t r = free_rooms.back();
+    free_rooms.pop_back();
+    rooms[r] = {occupant, deadline, true};
+    return r;
+  }
+  bool checkout(int32_t room, int64_t *occupant) {
+    if (room < 0 || room >= (int32_t)rooms.size()
+        || !rooms[room].occupied)
+      return false;
+    *occupant = rooms[room].occupant;
+    rooms[room].occupied = false;
+    free_rooms.push_back(room);
+    return true;
+  }
+  // evict ONE expired occupant (deadline <= now); returns room or -1
+  int32_t evict_one(int64_t now, int64_t *occupant) {
+    for (int32_t r = 0; r < (int32_t)rooms.size(); ++r) {
+      if (rooms[r].occupied && rooms[r].deadline <= now) {
+        *occupant = rooms[r].occupant;
+        rooms[r].occupied = false;
+        free_rooms.push_back(r);
+        return r;
+      }
+    }
+    return -1;
+  }
+  int32_t occupancy() const {
+    return (int32_t)(rooms.size() - free_rooms.size());
+  }
+};
+
+// -------------------------------------------------------------- bitmap
+struct Bitmap {
+  std::vector<uint64_t> words;
+  explicit Bitmap(int64_t nbits) : words((nbits + 63) / 64, 0) {}
+  void ensure(int64_t bit) {
+    if ((size_t)(bit / 64) >= words.size()) words.resize(bit / 64 + 1, 0);
+  }
+  void set(int64_t b) {
+    if (b < 0) return;
+    ensure(b);
+    words[b / 64] |= 1ULL << (b % 64);
+  }
+  void clear(int64_t b) {
+    if (b < 0) return;
+    ensure(b);
+    words[b / 64] &= ~(1ULL << (b % 64));
+  }
+  bool test(int64_t b) const {
+    return b >= 0 && (size_t)(b / 64) < words.size()
+           && (words[b / 64] >> (b % 64)) & 1;
+  }
+  int64_t find_and_set_first_unset() {
+    for (size_t w = 0; w < words.size(); ++w) {
+      if (words[w] != ~0ULL) {
+        int bit = __builtin_ctzll(~words[w]);
+        words[w] |= 1ULL << bit;
+        return (int64_t)w * 64 + bit;
+      }
+    }
+    words.push_back(1);
+    return (int64_t)(words.size() - 1) * 64;
+  }
+};
+
+// ------------------------------------------------------- pointer array
+struct PtrArray {
+  std::vector<int64_t> vals;
+  std::vector<char> used;
+  std::vector<int64_t> free_idx;
+  int64_t add(int64_t v) {
+    int64_t i;
+    if (!free_idx.empty()) {
+      i = free_idx.back();
+      free_idx.pop_back();
+    } else {
+      i = (int64_t)vals.size();
+      vals.push_back(0);
+      used.push_back(0);
+    }
+    vals[i] = v;
+    used[i] = 1;
+    return i;
+  }
+  bool set(int64_t i, int64_t v) {
+    if (i < 0) return false;
+    if ((size_t)i >= vals.size()) {
+      vals.resize(i + 1, 0);
+      used.resize(i + 1, 0);
+    }
+    vals[i] = v;
+    used[i] = 1;
+    return true;
+  }
+  bool get(int64_t i, int64_t *out) const {
+    if (i < 0 || (size_t)i >= vals.size() || !used[i]) return false;
+    *out = vals[i];
+    return true;
+  }
+  bool remove(int64_t i) {
+    if (i < 0 || (size_t)i >= vals.size() || !used[i]) return false;
+    used[i] = 0;
+    free_idx.push_back(i);
+    return true;
+  }
+};
+
+// ------------------------------------------------------- handle tables
+// Handle lookup is shared-locked so payload ops stay concurrent while
+// create/destroy (rare) take the exclusive lock — the table itself must
+// be thread-safe for the lock-free structures' guarantee to mean
+// anything.
+template <typename T> struct Table {
+  std::map<int64_t, T *> items;
+  int64_t next = 1;
+  mutable std::shared_mutex mu;
+  int64_t put(T *t) {
+    std::unique_lock<std::shared_mutex> lk(mu);
+    items[next] = t;
+    return next++;
+  }
+  T *get(int64_t h) const {
+    std::shared_lock<std::shared_mutex> lk(mu);
+    auto it = items.find(h);
+    return it == items.end() ? nullptr : it->second;
+  }
+  void drop(int64_t h) {
+    std::unique_lock<std::shared_mutex> lk(mu);
+    auto it = items.find(h);
+    if (it != items.end()) {
+      delete it->second;
+      items.erase(it);
+    }
+  }
+};
+
+Table<Fifo> g_fifos;
+Table<Lifo> g_lifos;
+Table<Ring> g_rings;
+Table<Hotel> g_hotels;
+Table<Bitmap> g_bitmaps;
+Table<PtrArray> g_arrays;
+
+}  // namespace
+
+extern "C" {
+
+// FIFO / LIFO / ring: create(cap) -> handle; push/pop; destroy.
+int64_t ompi_tpu_fifo_create(int64_t cap) { return g_fifos.put(new Fifo((uint64_t)cap)); }
+int64_t ompi_tpu_fifo_push(int64_t h, int64_t v) {
+  Fifo *f = g_fifos.get(h);
+  return f && f->push(v) ? 1 : 0;
+}
+int64_t ompi_tpu_fifo_pop(int64_t h, int64_t *out) {
+  Fifo *f = g_fifos.get(h);
+  return f && f->pop(out) ? 1 : 0;
+}
+void ompi_tpu_fifo_destroy(int64_t h) { g_fifos.drop(h); }
+
+int64_t ompi_tpu_lifo_create(int64_t cap) { return g_lifos.put(new Lifo((uint32_t)cap)); }
+int64_t ompi_tpu_lifo_push(int64_t h, int64_t v) {
+  Lifo *l = g_lifos.get(h);
+  return l && l->push(v) ? 1 : 0;
+}
+int64_t ompi_tpu_lifo_pop(int64_t h, int64_t *out) {
+  Lifo *l = g_lifos.get(h);
+  return l && l->pop(out) ? 1 : 0;
+}
+void ompi_tpu_lifo_destroy(int64_t h) { g_lifos.drop(h); }
+
+int64_t ompi_tpu_ring_create(int64_t cap) { return g_rings.put(new Ring((uint64_t)cap)); }
+int64_t ompi_tpu_ring_push(int64_t h, int64_t v) {
+  Ring *r = g_rings.get(h);
+  return r && r->push(v) ? 1 : 0;
+}
+int64_t ompi_tpu_ring_pop(int64_t h, int64_t *out) {
+  Ring *r = g_rings.get(h);
+  return r && r->pop(out) ? 1 : 0;
+}
+void ompi_tpu_ring_destroy(int64_t h) { g_rings.drop(h); }
+
+// hotel
+int64_t ompi_tpu_hotel_create(int64_t rooms) { return g_hotels.put(new Hotel((int32_t)rooms)); }
+int64_t ompi_tpu_hotel_checkin(int64_t h, int64_t occupant, int64_t deadline) {
+  Hotel *ho = g_hotels.get(h);
+  return ho ? ho->checkin(occupant, deadline) : -1;
+}
+int64_t ompi_tpu_hotel_checkout(int64_t h, int64_t room, int64_t *occupant) {
+  Hotel *ho = g_hotels.get(h);
+  return ho && ho->checkout((int32_t)room, occupant) ? 1 : 0;
+}
+int64_t ompi_tpu_hotel_evict_one(int64_t h, int64_t now, int64_t *occupant) {
+  Hotel *ho = g_hotels.get(h);
+  return ho ? ho->evict_one(now, occupant) : -1;
+}
+int64_t ompi_tpu_hotel_occupancy(int64_t h) {
+  Hotel *ho = g_hotels.get(h);
+  return ho ? ho->occupancy() : -1;
+}
+void ompi_tpu_hotel_destroy(int64_t h) { g_hotels.drop(h); }
+
+// bitmap
+int64_t ompi_tpu_bitmap_create(int64_t nbits) { return g_bitmaps.put(new Bitmap(nbits)); }
+void ompi_tpu_bitmap_set(int64_t h, int64_t b) {
+  Bitmap *bm = g_bitmaps.get(h);
+  if (bm) bm->set(b);
+}
+void ompi_tpu_bitmap_clear(int64_t h, int64_t b) {
+  Bitmap *bm = g_bitmaps.get(h);
+  if (bm) bm->clear(b);
+}
+int64_t ompi_tpu_bitmap_test(int64_t h, int64_t b) {
+  Bitmap *bm = g_bitmaps.get(h);
+  return bm && bm->test(b) ? 1 : 0;
+}
+int64_t ompi_tpu_bitmap_find_and_set(int64_t h) {
+  Bitmap *bm = g_bitmaps.get(h);
+  return bm ? bm->find_and_set_first_unset() : -1;
+}
+void ompi_tpu_bitmap_destroy(int64_t h) { g_bitmaps.drop(h); }
+
+// pointer array
+int64_t ompi_tpu_parray_create(int64_t) { return g_arrays.put(new PtrArray()); }
+int64_t ompi_tpu_parray_add(int64_t h, int64_t v) {
+  PtrArray *a = g_arrays.get(h);
+  return a ? a->add(v) : -1;
+}
+int64_t ompi_tpu_parray_set(int64_t h, int64_t i, int64_t v) {
+  PtrArray *a = g_arrays.get(h);
+  return a && a->set(i, v) ? 1 : 0;
+}
+int64_t ompi_tpu_parray_get(int64_t h, int64_t i, int64_t *out) {
+  PtrArray *a = g_arrays.get(h);
+  return a && a->get(i, out) ? 1 : 0;
+}
+int64_t ompi_tpu_parray_remove(int64_t h, int64_t i) {
+  PtrArray *a = g_arrays.get(h);
+  return a && a->remove(i) ? 1 : 0;
+}
+void ompi_tpu_parray_destroy(int64_t h) { g_arrays.drop(h); }
+
+}  // extern "C"

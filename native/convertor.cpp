@@ -87,6 +87,6 @@ void ompi_tpu_unpack_runs_rows(char *dst, const char *src,
 // Bump whenever a symbol is added/changed: the loader refuses a library
 // whose ABI doesn't match, so a stale cached .so can never satisfy the
 // version probe yet miss newer symbols.
-int ompi_tpu_native_abi(void) { return 2; }
+int ompi_tpu_native_abi(void) { return 3; }
 
 }  // extern "C"

@@ -253,7 +253,7 @@ class HanComponent(Component):
                               "[{max_bytes, algorithm: hier|flat}]")
 
     def comm_query(self, comm):
-        if getattr(comm, "_han_inner", False):
+        if _constructing or getattr(comm, "_han_inner", False):
             return None                   # never recurse into own tiers
         prio = var.var_get("coll_han_priority", 35)
         if prio < 0:

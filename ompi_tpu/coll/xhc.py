@@ -120,7 +120,8 @@ class XhcComponent(Component):
                               "innermost first (empty = device locality)")
 
     def comm_query(self, comm):
-        if getattr(comm, "_han_inner", False):
+        from ompi_tpu.coll import han as _han
+        if _han._constructing or getattr(comm, "_han_inner", False):
             return None
         prio = var.var_get("coll_xhc_priority", 25)
         if prio < 0:

@@ -18,13 +18,16 @@ _REPO_DIR = os.path.dirname(_PKG_DIR)
 _NATIVE_DIR = os.path.join(_REPO_DIR, "native")
 _SRCS = [os.path.join(_NATIVE_DIR, f)
          for f in ("convertor.cpp", "ops.cpp", "memheap.cpp",
-                   "matching.cpp")]
+                   "matching.cpp", "containers.cpp")]
 _SO = os.path.join(_NATIVE_DIR, "libompi_tpu_native.so")
 
 
 def _build() -> Optional[str]:
     srcs = [s for s in _SRCS if os.path.exists(s)]
-    if not srcs:
+    if len(srcs) != len(_SRCS):
+        # A partial tree would pass the ABI probe (one file owns the
+        # version) yet miss symbols, which would disable everything at
+        # bind time — refuse up front instead.
         return None
     if (os.path.exists(_SO)
             and os.path.getmtime(_SO) >= max(os.path.getmtime(s)
@@ -55,7 +58,7 @@ def get_lib() -> Optional[ctypes.CDLL]:
             return None
         try:
             lib = ctypes.CDLL(so)
-            if lib.ompi_tpu_native_abi() != 2:
+            if lib.ompi_tpu_native_abi() != 3:
                 return None
             i64 = ctypes.c_int64
             lib.ompi_tpu_pack_runs_rows.argtypes = [
@@ -89,6 +92,53 @@ def get_lib() -> Optional[ctypes.CDLL]:
                 f = getattr(lib, fn)
                 f.argtypes = [i64] * nargs
                 f.restype = i64
+            # containers (containers.cpp, the opal/class role)
+            pi64 = ctypes.POINTER(ctypes.c_int64)
+            for kind in ("fifo", "lifo", "ring"):
+                getattr(lib, f"ompi_tpu_{kind}_create").argtypes = [i64]
+                getattr(lib, f"ompi_tpu_{kind}_create").restype = i64
+                getattr(lib, f"ompi_tpu_{kind}_push").argtypes = [i64, i64]
+                getattr(lib, f"ompi_tpu_{kind}_push").restype = i64
+                getattr(lib, f"ompi_tpu_{kind}_pop").argtypes = [i64, pi64]
+                getattr(lib, f"ompi_tpu_{kind}_pop").restype = i64
+                getattr(lib, f"ompi_tpu_{kind}_destroy").argtypes = [i64]
+                getattr(lib, f"ompi_tpu_{kind}_destroy").restype = None
+            lib.ompi_tpu_hotel_create.argtypes = [i64]
+            lib.ompi_tpu_hotel_create.restype = i64
+            lib.ompi_tpu_hotel_checkin.argtypes = [i64, i64, i64]
+            lib.ompi_tpu_hotel_checkin.restype = i64
+            lib.ompi_tpu_hotel_checkout.argtypes = [i64, i64, pi64]
+            lib.ompi_tpu_hotel_checkout.restype = i64
+            lib.ompi_tpu_hotel_evict_one.argtypes = [i64, i64, pi64]
+            lib.ompi_tpu_hotel_evict_one.restype = i64
+            lib.ompi_tpu_hotel_occupancy.argtypes = [i64]
+            lib.ompi_tpu_hotel_occupancy.restype = i64
+            lib.ompi_tpu_hotel_destroy.argtypes = [i64]
+            lib.ompi_tpu_hotel_destroy.restype = None
+            lib.ompi_tpu_bitmap_create.argtypes = [i64]
+            lib.ompi_tpu_bitmap_create.restype = i64
+            lib.ompi_tpu_bitmap_set.argtypes = [i64, i64]
+            lib.ompi_tpu_bitmap_set.restype = None
+            lib.ompi_tpu_bitmap_clear.argtypes = [i64, i64]
+            lib.ompi_tpu_bitmap_clear.restype = None
+            lib.ompi_tpu_bitmap_test.argtypes = [i64, i64]
+            lib.ompi_tpu_bitmap_test.restype = i64
+            lib.ompi_tpu_bitmap_find_and_set.argtypes = [i64]
+            lib.ompi_tpu_bitmap_find_and_set.restype = i64
+            lib.ompi_tpu_bitmap_destroy.argtypes = [i64]
+            lib.ompi_tpu_bitmap_destroy.restype = None
+            lib.ompi_tpu_parray_create.argtypes = [i64]
+            lib.ompi_tpu_parray_create.restype = i64
+            lib.ompi_tpu_parray_add.argtypes = [i64, i64]
+            lib.ompi_tpu_parray_add.restype = i64
+            lib.ompi_tpu_parray_set.argtypes = [i64, i64, i64]
+            lib.ompi_tpu_parray_set.restype = i64
+            lib.ompi_tpu_parray_get.argtypes = [i64, i64, pi64]
+            lib.ompi_tpu_parray_get.restype = i64
+            lib.ompi_tpu_parray_remove.argtypes = [i64, i64]
+            lib.ompi_tpu_parray_remove.restype = i64
+            lib.ompi_tpu_parray_destroy.argtypes = [i64]
+            lib.ompi_tpu_parray_destroy.restype = None
             _lib = lib
         except (OSError, AttributeError):
             # AttributeError = missing symbol in a stale cached library;

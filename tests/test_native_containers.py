@@ -1,0 +1,169 @@
+"""Native container layer (opal/class role): correctness + the
+thread-stress discipline of test/class/opal_{fifo,lifo}.c."""
+import threading
+
+import pytest
+
+from ompi_tpu.native import containers as C
+
+pytestmark = pytest.mark.skipif(not C.available(),
+                                reason="native library unavailable")
+
+
+def test_fifo_order_and_bounds():
+    with C.Fifo(8) as f:
+        for i in range(8):
+            assert f.push(i)
+        assert not f.push(99)            # full (capacity 8)
+        assert [f.pop() for _ in range(8)] == list(range(8))
+        assert f.pop() is None           # empty
+
+
+def test_fifo_exact_capacity_bound():
+    """Capacity is the caller's bound, not the rounded cell count."""
+    with C.Fifo(6) as f:
+        for i in range(6):
+            assert f.push(i)
+        assert not f.push(99)            # 6 means 6, not 8
+        assert f.pop() == 0
+        assert f.push(6)
+
+
+def test_bitmap_negative_index_safe():
+    with C.Bitmap(8) as b:
+        b.set(-1)                        # ignored, not UB
+        b.clear(-5)
+        assert not b.test(-1)
+        assert b.find_and_set() == 0     # state uncorrupted
+
+
+def test_lifo_order_and_pool_exhaustion():
+    with C.Lifo(4) as s:
+        for i in range(4):
+            assert s.push(i)
+        assert not s.push(99)            # node pool exhausted
+        assert [s.pop() for _ in range(4)] == [3, 2, 1, 0]
+        assert s.pop() is None
+
+
+def test_ring_buffer():
+    with C.RingBuffer(3) as r:
+        assert r.push(1) and r.push(2) and r.push(3)
+        assert not r.push(4)
+        assert r.pop() == 1
+        assert r.push(4)
+        assert [r.pop(), r.pop(), r.pop()] == [2, 3, 4]
+
+
+def _stress(make_queue, n_threads=4, per_thread=2000):
+    q = make_queue()
+    produced = [list(range(t * per_thread, (t + 1) * per_thread))
+                for t in range(n_threads)]
+    popped = [[] for _ in range(n_threads)]
+    start = threading.Barrier(2 * n_threads)
+
+    def producer(t):
+        start.wait()
+        for v in produced[t]:
+            while not q.push(v):
+                pass
+
+    def consumer(t):
+        start.wait()
+        count = 0
+        while count < per_thread:
+            v = q.pop()
+            if v is not None:
+                popped[t].append(v)
+                count += 1
+
+    threads = [threading.Thread(target=producer, args=(t,))
+               for t in range(n_threads)]
+    threads += [threading.Thread(target=consumer, args=(t,))
+                for t in range(n_threads)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    q.close()
+    drained = sorted(v for lst in popped for v in lst)
+    assert drained == sorted(v for lst in produced for v in lst)
+
+
+def test_fifo_mpmc_stress():
+    """4 producers x 4 consumers; every element exactly once
+    (test/class/opal_fifo.c's multi-thread discipline)."""
+    _stress(lambda: C.Fifo(256))
+
+
+def test_lifo_mpmc_stress():
+    _stress(lambda: C.Lifo(256))
+
+
+def test_fifo_per_producer_order():
+    """MPMC FIFO keeps each producer's elements in order."""
+    q = C.Fifo(1024)
+    for i in range(100):
+        q.push(i)
+    seen = [q.pop() for _ in range(100)]
+    assert seen == list(range(100))
+    q.close()
+
+
+def test_hotel_checkin_checkout_evict():
+    with C.Hotel(3) as h:
+        r1 = h.checkin(occupant=101, deadline=50)
+        r2 = h.checkin(occupant=102, deadline=10)
+        r3 = h.checkin(occupant=103, deadline=90)
+        assert sorted({r1, r2, r3}) == [0, 1, 2]
+        assert h.checkin(104, 1) == -1    # full
+        assert h.occupancy == 3
+        # eviction strictly by deadline <= now
+        assert h.evict_one(now=5) is None
+        room, occ = h.evict_one(now=20)
+        assert occ == 102 and room == r2
+        assert h.evict_one(now=20) is None
+        assert h.checkout(r1) == 101
+        assert h.checkout(r1) is None     # double checkout
+        assert h.occupancy == 1
+        # the freed room is reusable
+        assert h.checkin(105, 99) in (r1, r2)
+
+
+def test_bitmap():
+    with C.Bitmap(64) as b:
+        assert not b.test(3)
+        b.set(3)
+        assert b.test(3)
+        b.clear(3)
+        assert not b.test(3)
+        # find-and-set allocates the lowest clear bit
+        assert b.find_and_set() == 0
+        assert b.find_and_set() == 1
+        b.set(2)
+        assert b.find_and_set() == 3
+        # growth past the initial size
+        b.set(1000)
+        assert b.test(1000)
+
+
+def test_bitmap_find_all_then_grow():
+    with C.Bitmap(64) as b:
+        for i in range(64):
+            assert b.find_and_set() == i
+        assert b.find_and_set() == 64     # auto-grown word
+
+
+def test_pointer_array_recycling():
+    a = C.PointerArray()
+    i0 = a.add(100)
+    i1 = a.add(200)
+    assert (a.get(i0), a.get(i1)) == (100, 200)
+    assert a.remove(i0)
+    assert a.get(i0) is None
+    i2 = a.add(300)                       # lowest free index reused
+    assert i2 == i0
+    assert a.set(50, 999)                 # sparse set with growth
+    assert a.get(50) == 999
+    assert a.get(49) is None
+    a.close()
