@@ -19,11 +19,12 @@
 // Handle-based C ABI over ctypes.
 
 #include <atomic>
-#include <mutex>
-#include <shared_mutex>
 #include <cstdint>
 #include <cstring>
 #include <map>
+#include <memory>
+#include <mutex>
+#include <shared_mutex>
 #include <vector>
 
 namespace {
@@ -176,17 +177,21 @@ struct Lifo {
 };
 
 // ---------------------------------------------------------- ring buffer
+// ctypes releases the GIL around calls, so even the "simple" container
+// must lock (a comment about the GIL would be a lie here).
 struct Ring {
   std::vector<int64_t> buf;
-  uint64_t head = 0, tail = 0;              // single-threaded (opal's is
-                                            // SPSC; Python side holds GIL)
+  uint64_t head = 0, tail = 0;
+  std::mutex mu;
   explicit Ring(uint64_t cap) : buf(cap) {}
   bool push(int64_t v) {
+    std::lock_guard<std::mutex> lk(mu);
     if (tail - head == buf.size()) return false;
     buf[tail++ % buf.size()] = v;
     return true;
   }
   bool pop(int64_t *out) {
+    std::lock_guard<std::mutex> lk(mu);
     if (tail == head) return false;
     *out = buf[head++ % buf.size()];
     return true;
@@ -316,30 +321,28 @@ struct PtrArray {
 
 // ------------------------------------------------------- handle tables
 // Handle lookup is shared-locked so payload ops stay concurrent while
-// create/destroy (rare) take the exclusive lock — the table itself must
-// be thread-safe for the lock-free structures' guarantee to mean
-// anything.
+// create/destroy (rare) take the exclusive lock. get() hands out a
+// shared_ptr so a destroy racing an in-flight push/pop defers the
+// actual destruction until the operation drops its reference — without
+// this, drop()'s delete would be a use-after-free for the caller that
+// looked the pointer up a moment earlier.
 template <typename T> struct Table {
-  std::map<int64_t, T *> items;
+  std::map<int64_t, std::shared_ptr<T>> items;
   int64_t next = 1;
   mutable std::shared_mutex mu;
   int64_t put(T *t) {
     std::unique_lock<std::shared_mutex> lk(mu);
-    items[next] = t;
+    items[next].reset(t);
     return next++;
   }
-  T *get(int64_t h) const {
+  std::shared_ptr<T> get(int64_t h) const {
     std::shared_lock<std::shared_mutex> lk(mu);
     auto it = items.find(h);
     return it == items.end() ? nullptr : it->second;
   }
   void drop(int64_t h) {
     std::unique_lock<std::shared_mutex> lk(mu);
-    auto it = items.find(h);
-    if (it != items.end()) {
-      delete it->second;
-      items.erase(it);
-    }
+    items.erase(h);
   }
 };
 
@@ -357,33 +360,33 @@ extern "C" {
 // FIFO / LIFO / ring: create(cap) -> handle; push/pop; destroy.
 int64_t ompi_tpu_fifo_create(int64_t cap) { return g_fifos.put(new Fifo((uint64_t)cap)); }
 int64_t ompi_tpu_fifo_push(int64_t h, int64_t v) {
-  Fifo *f = g_fifos.get(h);
+  auto f = g_fifos.get(h);
   return f && f->push(v) ? 1 : 0;
 }
 int64_t ompi_tpu_fifo_pop(int64_t h, int64_t *out) {
-  Fifo *f = g_fifos.get(h);
+  auto f = g_fifos.get(h);
   return f && f->pop(out) ? 1 : 0;
 }
 void ompi_tpu_fifo_destroy(int64_t h) { g_fifos.drop(h); }
 
 int64_t ompi_tpu_lifo_create(int64_t cap) { return g_lifos.put(new Lifo((uint32_t)cap)); }
 int64_t ompi_tpu_lifo_push(int64_t h, int64_t v) {
-  Lifo *l = g_lifos.get(h);
+  auto l = g_lifos.get(h);
   return l && l->push(v) ? 1 : 0;
 }
 int64_t ompi_tpu_lifo_pop(int64_t h, int64_t *out) {
-  Lifo *l = g_lifos.get(h);
+  auto l = g_lifos.get(h);
   return l && l->pop(out) ? 1 : 0;
 }
 void ompi_tpu_lifo_destroy(int64_t h) { g_lifos.drop(h); }
 
 int64_t ompi_tpu_ring_create(int64_t cap) { return g_rings.put(new Ring((uint64_t)cap)); }
 int64_t ompi_tpu_ring_push(int64_t h, int64_t v) {
-  Ring *r = g_rings.get(h);
+  auto r = g_rings.get(h);
   return r && r->push(v) ? 1 : 0;
 }
 int64_t ompi_tpu_ring_pop(int64_t h, int64_t *out) {
-  Ring *r = g_rings.get(h);
+  auto r = g_rings.get(h);
   return r && r->pop(out) ? 1 : 0;
 }
 void ompi_tpu_ring_destroy(int64_t h) { g_rings.drop(h); }
@@ -391,19 +394,19 @@ void ompi_tpu_ring_destroy(int64_t h) { g_rings.drop(h); }
 // hotel
 int64_t ompi_tpu_hotel_create(int64_t rooms) { return g_hotels.put(new Hotel((int32_t)rooms)); }
 int64_t ompi_tpu_hotel_checkin(int64_t h, int64_t occupant, int64_t deadline) {
-  Hotel *ho = g_hotels.get(h);
+  auto ho = g_hotels.get(h);
   return ho ? ho->checkin(occupant, deadline) : -1;
 }
 int64_t ompi_tpu_hotel_checkout(int64_t h, int64_t room, int64_t *occupant) {
-  Hotel *ho = g_hotels.get(h);
+  auto ho = g_hotels.get(h);
   return ho && ho->checkout((int32_t)room, occupant) ? 1 : 0;
 }
 int64_t ompi_tpu_hotel_evict_one(int64_t h, int64_t now, int64_t *occupant) {
-  Hotel *ho = g_hotels.get(h);
+  auto ho = g_hotels.get(h);
   return ho ? ho->evict_one(now, occupant) : -1;
 }
 int64_t ompi_tpu_hotel_occupancy(int64_t h) {
-  Hotel *ho = g_hotels.get(h);
+  auto ho = g_hotels.get(h);
   return ho ? ho->occupancy() : -1;
 }
 void ompi_tpu_hotel_destroy(int64_t h) { g_hotels.drop(h); }
@@ -411,19 +414,19 @@ void ompi_tpu_hotel_destroy(int64_t h) { g_hotels.drop(h); }
 // bitmap
 int64_t ompi_tpu_bitmap_create(int64_t nbits) { return g_bitmaps.put(new Bitmap(nbits)); }
 void ompi_tpu_bitmap_set(int64_t h, int64_t b) {
-  Bitmap *bm = g_bitmaps.get(h);
+  auto bm = g_bitmaps.get(h);
   if (bm) bm->set(b);
 }
 void ompi_tpu_bitmap_clear(int64_t h, int64_t b) {
-  Bitmap *bm = g_bitmaps.get(h);
+  auto bm = g_bitmaps.get(h);
   if (bm) bm->clear(b);
 }
 int64_t ompi_tpu_bitmap_test(int64_t h, int64_t b) {
-  Bitmap *bm = g_bitmaps.get(h);
+  auto bm = g_bitmaps.get(h);
   return bm && bm->test(b) ? 1 : 0;
 }
 int64_t ompi_tpu_bitmap_find_and_set(int64_t h) {
-  Bitmap *bm = g_bitmaps.get(h);
+  auto bm = g_bitmaps.get(h);
   return bm ? bm->find_and_set_first_unset() : -1;
 }
 void ompi_tpu_bitmap_destroy(int64_t h) { g_bitmaps.drop(h); }
@@ -431,19 +434,19 @@ void ompi_tpu_bitmap_destroy(int64_t h) { g_bitmaps.drop(h); }
 // pointer array
 int64_t ompi_tpu_parray_create(int64_t) { return g_arrays.put(new PtrArray()); }
 int64_t ompi_tpu_parray_add(int64_t h, int64_t v) {
-  PtrArray *a = g_arrays.get(h);
+  auto a = g_arrays.get(h);
   return a ? a->add(v) : -1;
 }
 int64_t ompi_tpu_parray_set(int64_t h, int64_t i, int64_t v) {
-  PtrArray *a = g_arrays.get(h);
+  auto a = g_arrays.get(h);
   return a && a->set(i, v) ? 1 : 0;
 }
 int64_t ompi_tpu_parray_get(int64_t h, int64_t i, int64_t *out) {
-  PtrArray *a = g_arrays.get(h);
+  auto a = g_arrays.get(h);
   return a && a->get(i, out) ? 1 : 0;
 }
 int64_t ompi_tpu_parray_remove(int64_t h, int64_t i) {
-  PtrArray *a = g_arrays.get(h);
+  auto a = g_arrays.get(h);
   return a && a->remove(i) ? 1 : 0;
 }
 void ompi_tpu_parray_destroy(int64_t h) { g_arrays.drop(h); }

@@ -32,7 +32,13 @@ from ompi_tpu.mca.base import Component
 from ompi_tpu.coll.framework import coll_framework
 
 
-_constructing = False
+import threading as _threading
+
+_tls = _threading.local()
+
+
+def _in_construction() -> bool:
+    return getattr(_tls, "constructing", False)
 
 
 def locality_groups(comm, group_size: int = 0) -> Optional[List[List[int]]]:
@@ -69,8 +75,7 @@ class Hierarchy:
         for gi, g in enumerate(groups):
             self.group_of[np.asarray(g)] = gi
         colors = [int(self.group_of[r]) for r in range(comm.size)]
-        global _constructing
-        _constructing = True       # han never claims its own tiers
+        _tls.constructing = True   # han never claims its own tiers
         try:
             subs = comm.split(colors)
             self.low = []
@@ -85,7 +90,7 @@ class Hierarchy:
             up._han_inner = True
             self.up = up
         finally:
-            _constructing = False
+            _tls.constructing = False
 
     def rows(self, gi: int):
         return jnp.asarray(self.groups[gi])
@@ -253,7 +258,7 @@ class HanComponent(Component):
                               "[{max_bytes, algorithm: hier|flat}]")
 
     def comm_query(self, comm):
-        if _constructing or getattr(comm, "_han_inner", False):
+        if _in_construction() or getattr(comm, "_han_inner", False):
             return None                   # never recurse into own tiers
         prio = var.var_get("coll_han_priority", 35)
         if prio < 0:

@@ -121,7 +121,7 @@ class XhcComponent(Component):
 
     def comm_query(self, comm):
         from ompi_tpu.coll import han as _han
-        if _han._constructing or getattr(comm, "_han_inner", False):
+        if _han._in_construction() or getattr(comm, "_han_inner", False):
             return None
         prio = var.var_get("coll_xhc_priority", 25)
         if prio < 0:

@@ -1,19 +1,14 @@
 """MPI-IO — parallel file I/O (mirrors ``ompi/mca/io/ompio`` +
-``ompi/mca/common/ompio`` orchestration, with the sub-framework roles
-collapsed where the TPU runtime makes them trivial):
+``ompi/mca/common/ompio`` orchestration over real sub-frameworks):
 
-- fs (filesystem glue: ufs/lustre/gpfs)  -> plain POSIX here; the locus
-  that matters on TPU hosts is HBM<->host, handled by the accelerator
-  framework before bytes reach the filesystem.
-- fbtl (individual byte transfer: posix) -> ``pread``/``pwrite`` on the
-  shared file descriptor, offsets in elements x etype.
-- fcoll (collective algorithms: two-phase dynamic/vulcan) ->
-  ``write_at_all``/``read_at_all`` aggregate the stacked rank buffers in
-  the controller (which *is* the aggregator — the two-phase exchange
-  degenerates to one gather/scatter over the mesh) and issue one large
-  contiguous request, the same optimization two-phase IO exists for.
-- sharedfp (shared file pointer: sm/lockedfile) -> a controller-side
-  shared offset under a lock.
+- fs    (``io/fs.py``)       — filesystem glue selected per file from
+  the mount table (ufs fallback; lustre/gpfs claim their types).
+- fbtl  (``io/fbtl.py``)     — individual byte transfer: vectored
+  positioned IO batching noncontiguous runs.
+- fcoll (``io/fcoll.py``)    — collective algorithms: individual /
+  two-phase dynamic / vulcan aggregation, selected by MCA var.
+- sharedfp (``io/sharedfp.py``) — shared file pointer: sm / lockedfile /
+  individual components.
 
 File views (etype + filetype displacement maps) reuse the datatype
 engine's index maps, so a strided view is the same object as a derived
@@ -23,7 +18,7 @@ from __future__ import annotations
 
 import os
 import threading
-from typing import Any, Optional
+from typing import Optional
 
 import numpy as np
 
@@ -31,6 +26,10 @@ from ompi_tpu.accelerator import to_host
 from ompi_tpu.core.datatype import Datatype
 from ompi_tpu.core.errhandler import ERR_ARG, MPIError
 from ompi_tpu.core.request import Request
+from ompi_tpu.io.fbtl import PosixFbtl, elem_runs_to_bytes
+from ompi_tpu.io.fcoll import select_fcoll
+from ompi_tpu.io.fs import select_fs
+from ompi_tpu.io.sharedfp import IndividualSharedfp, select_sharedfp
 
 MODE_RDONLY = os.O_RDONLY
 MODE_WRONLY = os.O_WRONLY
@@ -49,9 +48,12 @@ class File:
         self.path = path
         self.amode = amode
         self.etype = np.dtype(etype or np.uint8)
-        self._fd = os.open(path, amode, 0o644)
+        self.fs = select_fs(path)
+        self.fbtl = PosixFbtl()
+        self.fcoll = select_fcoll(self.fbtl)
+        self.sharedfp = select_sharedfp(path)
+        self._fd = self.fs.open(path, amode)
         self._lock = threading.RLock()
-        self._shared_ptr = 0                 # sharedfp: element offset
         self._view_disp = 0                  # view displacement, elements
         self._view_type: Optional[Datatype] = None
         self.atomicity = False
@@ -69,11 +71,17 @@ class File:
         return os.fstat(self._fd).st_size // self._ebytes()
 
     def set_size(self, nelems: int) -> None:
-        os.ftruncate(self._fd, nelems * self._ebytes())
+        self.fs.resize(self._fd, nelems * self._ebytes())
 
     def preallocate(self, nelems: int) -> None:
         if self.get_size() < nelems:
             self.set_size(nelems)
+
+    def get_amode(self) -> int:
+        return self.amode
+
+    def get_group(self):
+        return self.comm.group
 
     # -- views (MPI_File_set_view) -------------------------------------
     def set_view(self, disp: int = 0, etype=None,
@@ -86,6 +94,9 @@ class File:
         self._view_disp = int(disp)
         self._view_type = filetype
 
+    def get_view(self):
+        return self._view_disp, self.etype, self._view_type
+
     def _map_offset(self, offset: int, count: int) -> np.ndarray:
         """Element file-offsets for ``count`` elements starting at view
         element ``offset`` (applying the filetype's index map)."""
@@ -97,7 +108,12 @@ class File:
         idx = ft.flat_indices(inst0 + n_inst)[inst0 * ft.count:]
         return idx[within:within + count] + self._view_disp
 
-    # -- individual I/O (fbtl/posix role) ------------------------------
+    # -- individual I/O (fbtl role) ------------------------------------
+    def _runs_bytes(self, offs: np.ndarray):
+        from ompi_tpu.core.datatype import coalesce_runs
+        starts, lens = coalesce_runs(offs)
+        return elem_runs_to_bytes(starts, lens, self._ebytes())
+
     def write_at(self, offset: int, data) -> int:
         """Write ``data`` (any array; device buffers are fetched D2H by
         the accelerator framework) at view offset (elements)."""
@@ -105,35 +121,15 @@ class File:
                                                          copy=False).ravel()
         offs = self._map_offset(offset, arr.size)
         with self._lock:
-            return self._pwrite_elems(offs, arr)
+            self.fbtl.pwritev_runs(self._fd, self._runs_bytes(offs),
+                                   arr.tobytes())
+        return arr.size
 
     def read_at(self, offset: int, count: int) -> np.ndarray:
         offs = self._map_offset(offset, count)
         with self._lock:
-            return self._pread_elems(offs)
-
-    def _runs(self, offs: np.ndarray):
-        from ompi_tpu.core.datatype import coalesce_runs
-        starts, lens = coalesce_runs(offs)
-        return list(zip(starts.tolist(), lens.tolist()))
-
-    def _pwrite_elems(self, offs: np.ndarray, arr: np.ndarray) -> int:
-        eb = self._ebytes()
-        pos = 0
-        for off, ln in self._runs(offs):
-            os.pwrite(self._fd, arr[pos:pos + ln].tobytes(), off * eb)
-            pos += ln
-        return arr.size
-
-    def _pread_elems(self, offs: np.ndarray) -> np.ndarray:
-        eb = self._ebytes()
-        out = np.empty(offs.size, self.etype)
-        pos = 0
-        for off, ln in self._runs(offs):
-            raw = os.pread(self._fd, ln * eb, off * eb)
-            out[pos:pos + ln] = np.frombuffer(raw, self.etype, count=ln)
-            pos += ln
-        return out
+            raw = self.fbtl.preadv_runs(self._fd, self._runs_bytes(offs))
+        return np.frombuffer(raw, self.etype, count=count).copy()
 
     # -- nonblocking ----------------------------------------------------
     def iwrite_at(self, offset: int, data) -> Request:
@@ -143,55 +139,101 @@ class File:
         return Request.completed(self.read_at(offset, count))
 
     # -- collective I/O (fcoll role) -----------------------------------
+    def _per_rank_io(self, offset: int, host: np.ndarray):
+        """Per-rank (element offsets, data) with each rank's block at
+        ``offset + r*block`` of the view — the interleaving the fcoll
+        aggregation policies operate on."""
+        n = self.comm.size
+        block = int(np.prod(host.shape[1:])) if host.ndim > 1 else 1
+        out = []
+        for r in range(n):
+            offs = self._map_offset(offset + r * block, block)
+            out.append((offs, np.ascontiguousarray(host[r]).astype(
+                self.etype, copy=False).ravel()))
+        return out
+
     def write_at_all(self, offset: int, stacked) -> int:
         """Collective write: rank r's block (stacked axis 0) lands at
-        view offset ``offset + r*block``. The controller is the two-phase
-        aggregator: one contiguous pwrite when the view allows."""
+        view offset ``offset + r*block``, aggregated by the selected
+        fcoll component."""
+        host = np.asarray(to_host(stacked))
+        if host.shape[0] != self.comm.size:
+            raise MPIError(ERR_ARG, "stacked buffer must have one block "
+                                    "per rank")
+        with self._lock:
+            return self.fcoll.write(self._fd,
+                                    self._per_rank_io(offset, host),
+                                    self._ebytes())
+
+    def read_at_all(self, offset: int, count_per_rank: int) -> np.ndarray:
+        """Collective read: returns stacked (nranks, count_per_rank)."""
+        n = self.comm.size
+        per_rank = [self._map_offset(offset + r * count_per_rank,
+                                     count_per_rank) for r in range(n)]
+        with self._lock:
+            chunks = self.fcoll.read(self._fd, per_rank, self.etype)
+        return np.stack([c.reshape(count_per_rank) for c in chunks])
+
+    def iwrite_at_all(self, offset: int, stacked) -> Request:
+        return Request.completed(self.write_at_all(offset, stacked))
+
+    def iread_at_all(self, offset: int, count_per_rank: int) -> Request:
+        return Request.completed(self.read_at_all(offset, count_per_rank))
+
+    # -- shared file pointer (sharedfp role) ---------------------------
+    def write_shared(self, data) -> int:
+        arr = np.ascontiguousarray(to_host(data)).astype(
+            self.etype, copy=False).ravel()
+        if isinstance(self.sharedfp, IndividualSharedfp):
+            self.sharedfp.log_write(arr)      # ordered at sync
+            return arr.size
+        off = self.sharedfp.fetch_add(arr.size)
+        return self.write_at(off, arr)
+
+    def read_shared(self, count: int) -> np.ndarray:
+        off = self.sharedfp.fetch_add(count)
+        return self.read_at(off, count)
+
+    def seek_shared(self, offset: int) -> None:
+        self.sharedfp.seek(offset)
+
+    def get_position_shared(self) -> int:
+        return self.sharedfp.get()
+
+    def write_ordered(self, stacked) -> int:
+        """MPI_File_write_ordered: collective; rank r's block lands at
+        the shared pointer after ranks < r, pointer advances by the
+        total."""
         host = np.asarray(to_host(stacked))
         if host.shape[0] != self.comm.size:
             raise MPIError(ERR_ARG, "stacked buffer must have one block "
                                     "per rank")
         flat = np.ascontiguousarray(host).astype(self.etype,
-                                                 copy=False).ravel()
-        offs = self._map_offset(offset, flat.size)
-        with self._lock:
-            return self._pwrite_elems(offs, flat)
+                                                 copy=False)
+        total = int(flat.size)
+        if isinstance(self.sharedfp, IndividualSharedfp):
+            self.sharedfp.log_write(flat.ravel())
+            return total
+        off = self.sharedfp.fetch_add(total)
+        return self.write_at(off, flat.ravel())
 
-    def read_at_all(self, offset: int, count_per_rank: int) -> np.ndarray:
-        """Collective read: returns stacked (nranks, count_per_rank)."""
+    def read_ordered(self, count_per_rank: int) -> np.ndarray:
         n = self.comm.size
-        offs = self._map_offset(offset, count_per_rank * n)
-        with self._lock:
-            flat = self._pread_elems(offs)
+        off = self.sharedfp.fetch_add(count_per_rank * n)
+        flat = self.read_at(off, count_per_rank * n)
         return flat.reshape(n, count_per_rank)
-
-    # -- shared file pointer (sharedfp role) ---------------------------
-    def write_shared(self, data) -> int:
-        arr = np.ascontiguousarray(to_host(data)).ravel()
-        with self._lock:
-            off = self._shared_ptr
-            self._shared_ptr += arr.size
-        return self.write_at(off, arr)
-
-    def read_shared(self, count: int) -> np.ndarray:
-        with self._lock:
-            off = self._shared_ptr
-            self._shared_ptr += count
-        return self.read_at(off, count)
-
-    def seek_shared(self, offset: int) -> None:
-        with self._lock:
-            self._shared_ptr = offset
-
-    def get_position_shared(self) -> int:
-        return self._shared_ptr
 
     # -- sync/close ----------------------------------------------------
     def sync(self) -> None:
-        os.fsync(self._fd)
+        if isinstance(self.sharedfp, IndividualSharedfp):
+            for off, arr in self.sharedfp.drain():
+                self.write_at(off, arr)
+        self.fs.sync(self._fd)
 
     def close(self) -> None:
         if self._fd >= 0:
+            self.sync()
+            self.sharedfp.close()
             os.close(self._fd)
             self._fd = -1
 

@@ -92,53 +92,50 @@ def get_lib() -> Optional[ctypes.CDLL]:
                 f = getattr(lib, fn)
                 f.argtypes = [i64] * nargs
                 f.restype = i64
-            # containers (containers.cpp, the opal/class role)
+            # containers (containers.cpp, the opal/class role):
+            # i64-in/i64-out symbols ride the same table as the
+            # buddy/matching bindings; pointer-out and void-returning
+            # symbols are listed separately.
             pi64 = ctypes.POINTER(ctypes.c_int64)
-            for kind in ("fifo", "lifo", "ring"):
-                getattr(lib, f"ompi_tpu_{kind}_create").argtypes = [i64]
-                getattr(lib, f"ompi_tpu_{kind}_create").restype = i64
-                getattr(lib, f"ompi_tpu_{kind}_push").argtypes = [i64, i64]
-                getattr(lib, f"ompi_tpu_{kind}_push").restype = i64
-                getattr(lib, f"ompi_tpu_{kind}_pop").argtypes = [i64, pi64]
-                getattr(lib, f"ompi_tpu_{kind}_pop").restype = i64
-                getattr(lib, f"ompi_tpu_{kind}_destroy").argtypes = [i64]
-                getattr(lib, f"ompi_tpu_{kind}_destroy").restype = None
-            lib.ompi_tpu_hotel_create.argtypes = [i64]
-            lib.ompi_tpu_hotel_create.restype = i64
-            lib.ompi_tpu_hotel_checkin.argtypes = [i64, i64, i64]
-            lib.ompi_tpu_hotel_checkin.restype = i64
-            lib.ompi_tpu_hotel_checkout.argtypes = [i64, i64, pi64]
-            lib.ompi_tpu_hotel_checkout.restype = i64
-            lib.ompi_tpu_hotel_evict_one.argtypes = [i64, i64, pi64]
-            lib.ompi_tpu_hotel_evict_one.restype = i64
-            lib.ompi_tpu_hotel_occupancy.argtypes = [i64]
-            lib.ompi_tpu_hotel_occupancy.restype = i64
-            lib.ompi_tpu_hotel_destroy.argtypes = [i64]
-            lib.ompi_tpu_hotel_destroy.restype = None
-            lib.ompi_tpu_bitmap_create.argtypes = [i64]
-            lib.ompi_tpu_bitmap_create.restype = i64
-            lib.ompi_tpu_bitmap_set.argtypes = [i64, i64]
-            lib.ompi_tpu_bitmap_set.restype = None
-            lib.ompi_tpu_bitmap_clear.argtypes = [i64, i64]
-            lib.ompi_tpu_bitmap_clear.restype = None
-            lib.ompi_tpu_bitmap_test.argtypes = [i64, i64]
-            lib.ompi_tpu_bitmap_test.restype = i64
-            lib.ompi_tpu_bitmap_find_and_set.argtypes = [i64]
-            lib.ompi_tpu_bitmap_find_and_set.restype = i64
-            lib.ompi_tpu_bitmap_destroy.argtypes = [i64]
-            lib.ompi_tpu_bitmap_destroy.restype = None
-            lib.ompi_tpu_parray_create.argtypes = [i64]
-            lib.ompi_tpu_parray_create.restype = i64
-            lib.ompi_tpu_parray_add.argtypes = [i64, i64]
-            lib.ompi_tpu_parray_add.restype = i64
-            lib.ompi_tpu_parray_set.argtypes = [i64, i64, i64]
-            lib.ompi_tpu_parray_set.restype = i64
-            lib.ompi_tpu_parray_get.argtypes = [i64, i64, pi64]
-            lib.ompi_tpu_parray_get.restype = i64
-            lib.ompi_tpu_parray_remove.argtypes = [i64, i64]
-            lib.ompi_tpu_parray_remove.restype = i64
-            lib.ompi_tpu_parray_destroy.argtypes = [i64]
-            lib.ompi_tpu_parray_destroy.restype = None
+            for fn, nargs in (("ompi_tpu_fifo_create", 1),
+                              ("ompi_tpu_fifo_push", 2),
+                              ("ompi_tpu_lifo_create", 1),
+                              ("ompi_tpu_lifo_push", 2),
+                              ("ompi_tpu_ring_create", 1),
+                              ("ompi_tpu_ring_push", 2),
+                              ("ompi_tpu_hotel_create", 1),
+                              ("ompi_tpu_hotel_checkin", 3),
+                              ("ompi_tpu_hotel_occupancy", 1),
+                              ("ompi_tpu_bitmap_create", 1),
+                              ("ompi_tpu_bitmap_test", 2),
+                              ("ompi_tpu_bitmap_find_and_set", 1),
+                              ("ompi_tpu_parray_create", 1),
+                              ("ompi_tpu_parray_add", 2),
+                              ("ompi_tpu_parray_set", 3),
+                              ("ompi_tpu_parray_remove", 2)):
+                f = getattr(lib, fn)
+                f.argtypes = [i64] * nargs
+                f.restype = i64
+            for fn in ("ompi_tpu_fifo_destroy", "ompi_tpu_lifo_destroy",
+                       "ompi_tpu_ring_destroy", "ompi_tpu_hotel_destroy",
+                       "ompi_tpu_bitmap_destroy",
+                       "ompi_tpu_parray_destroy"):
+                f = getattr(lib, fn)
+                f.argtypes = [i64]
+                f.restype = None
+            for fn in ("ompi_tpu_bitmap_set", "ompi_tpu_bitmap_clear"):
+                f = getattr(lib, fn)
+                f.argtypes = [i64, i64]
+                f.restype = None
+            for fn, nargs in (("ompi_tpu_fifo_pop", 1),
+                              ("ompi_tpu_lifo_pop", 1),
+                              ("ompi_tpu_ring_pop", 1),
+                              ("ompi_tpu_hotel_checkout", 2),
+                              ("ompi_tpu_hotel_evict_one", 2),
+                              ("ompi_tpu_parray_get", 2)):
+                f = getattr(lib, fn)
+                f.argtypes = [i64] * nargs + [pi64]
+                f.restype = i64
             _lib = lib
         except (OSError, AttributeError):
             # AttributeError = missing symbol in a stale cached library;

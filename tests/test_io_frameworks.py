@@ -1,0 +1,188 @@
+"""MPI-IO sub-frameworks: fs selection, fbtl batching, fcoll
+aggregation components, sharedfp components, ordered collectives."""
+import os
+
+import numpy as np
+import pytest
+
+from ompi_tpu.core.datatype import Datatype
+from ompi_tpu.io.fbtl import PosixFbtl
+from ompi_tpu.io.fcoll import IndividualFcoll, TwoPhaseFcoll, VulcanFcoll
+from ompi_tpu.io.file import File
+from ompi_tpu.io.fs import select_fs, _mount_fstype
+from ompi_tpu.io import sharedfp as sfp
+from ompi_tpu.mca import var
+
+
+@pytest.fixture()
+def _vars():
+    saved = {}
+
+    def set_(name, value):
+        saved.setdefault(name, var.var_get(name))
+        var.var_set(name, value)
+
+    yield set_
+    for name, value in saved.items():
+        var.var_set(name, value)
+
+
+# -- fs ----------------------------------------------------------------
+def test_fs_selects_ufs_for_plain_paths(tmp_path):
+    m = select_fs(str(tmp_path / "f.bin"))
+    assert m.name == "ufs"
+    assert isinstance(_mount_fstype(str(tmp_path)), str)
+
+
+def test_fs_parallel_component_claims_its_type(tmp_path):
+    from ompi_tpu.io.fs import LustreComponent
+    c = LustreComponent()
+    assert c.file_query("/mnt/lfs/x", "lustre")[0] == 50
+    assert c.file_query("/home/x", "ext4") is None
+
+
+# -- fbtl --------------------------------------------------------------
+def test_fbtl_batches_adjacent_runs(tmp_path):
+    fd = os.open(str(tmp_path / "b.bin"), os.O_RDWR | os.O_CREAT)
+    fbtl = PosixFbtl()
+    # three file-adjacent runs -> one contiguous write
+    runs = [(0, 4), (4, 4), (8, 4)]
+    data = np.arange(3, dtype=np.int32).tobytes()
+    assert fbtl.pwritev_runs(fd, runs, data) == 12
+    back = fbtl.preadv_runs(fd, [(0, 12)])
+    assert np.frombuffer(back, np.int32).tolist() == [0, 1, 2]
+    # disjoint runs with a hole
+    fbtl.pwritev_runs(fd, [(20, 4), (28, 4)],
+                      np.array([7, 8], np.int32).tobytes())
+    out = np.frombuffer(fbtl.preadv_runs(fd, [(20, 4), (28, 4)]),
+                        np.int32)
+    assert out.tolist() == [7, 8]
+    # short read past EOF zero-fills
+    tail = fbtl.preadv_runs(fd, [(100, 8)])
+    assert tail == b"\0" * 8
+    os.close(fd)
+
+
+# -- fcoll -------------------------------------------------------------
+def _per_rank_interleaved(n, block):
+    """Rank r owns elements r, r+n, r+2n, ... (round-robin interleave —
+    the access pattern two-phase IO exists for)."""
+    per = []
+    for r in range(n):
+        offs = np.arange(block) * n + r
+        data = np.full(block, 100 + r, np.int32)
+        per.append((offs, data))
+    return per
+
+
+@pytest.mark.parametrize("cls", [IndividualFcoll, TwoPhaseFcoll])
+def test_fcoll_components_agree(tmp_path, cls):
+    n, block = 4, 8
+    fd = os.open(str(tmp_path / f"{cls.__name__}.bin"),
+                 os.O_RDWR | os.O_CREAT)
+    fc = cls(PosixFbtl())
+    per = _per_rank_interleaved(n, block)
+    assert fc.write(fd, per, 4) == n * block
+    raw = os.pread(fd, n * block * 4, 0)
+    arr = np.frombuffer(raw, np.int32)
+    expect = np.tile(100 + np.arange(n), block)
+    assert arr.tolist() == expect.tolist()
+    # read side: every rank gets its own interleaved elements back
+    got = fc.read(fd, [o for o, _d in per], np.dtype(np.int32))
+    for r in range(n):
+        assert got[r].tolist() == [100 + r] * block
+    os.close(fd)
+
+
+def test_two_phase_coalesces_across_ranks(tmp_path):
+    """The interleaved pattern coalesces to ONE contiguous run across
+    ranks — the aggregation individual IO can't do."""
+    per = _per_rank_interleaved(4, 8)
+    tp = TwoPhaseFcoll(PosixFbtl())
+    offs, data = tp._merge(per)
+    from ompi_tpu.core.datatype import coalesce_runs
+    starts, lens = coalesce_runs(offs)
+    assert len(starts) == 1 and int(lens[0]) == 32
+    # merged data is in file order: rank of element e = e % 4
+    assert data.tolist() == np.tile(100 + np.arange(4), 8).tolist()
+
+
+def test_vulcan_domains_split_evenly(tmp_path):
+    fbtl = PosixFbtl()
+    tp = TwoPhaseFcoll(fbtl, n_aggregators=4)
+    starts = np.arange(0, 80, 10)
+    lens = np.full(8, 5)
+    doms = tp._domains(starts, lens)
+    assert len(doms) == 4
+    assert sum(d.stop - d.start for d in doms) == 8
+
+
+def test_fcoll_selection_var(_vars, tmp_path):
+    _vars("io_base_fcoll", "vulcan")
+    from ompi_tpu.io.fcoll import select_fcoll
+    assert isinstance(select_fcoll(PosixFbtl()), VulcanFcoll)
+    _vars("io_base_fcoll", "individual")
+    assert isinstance(select_fcoll(PosixFbtl()), IndividualFcoll)
+
+
+def test_file_collective_write_with_interleaved_view(world, tmp_path):
+    """End to end: a strided filetype interleaves ranks; the two-phase
+    fcoll writes it as coalesced runs; read_at_all round-trips."""
+    n = world.size
+    path = str(tmp_path / "view.bin")
+    with File(world, path, etype=np.int32) as f:
+        f.set_view(0, np.int32)
+        data = world.stack([np.full(6, r, np.int32) for r in range(n)])
+        assert f.write_at_all(0, data) == 6 * n
+        back = f.read_at_all(0, 6)
+        for r in range(n):
+            assert back[r].tolist() == [r] * 6
+
+
+# -- sharedfp ----------------------------------------------------------
+def test_sharedfp_sm(tmp_path):
+    p = sfp.SmSharedfp("x")
+    assert p.fetch_add(10) == 0
+    assert p.fetch_add(5) == 10
+    p.seek(100)
+    assert p.get() == 100
+    p.close()
+
+
+def test_sharedfp_lockedfile_shared_across_handles(tmp_path):
+    path = str(tmp_path / "lf.bin")
+    a = sfp.LockedFileSharedfp(path)
+    b = sfp.LockedFileSharedfp(path)
+    assert a.fetch_add(8) == 0
+    assert b.fetch_add(4) == 8          # observes a's advance via the fs
+    assert a.get() == 12
+    a.close()
+    b.close()
+
+
+def test_sharedfp_individual_orders_at_sync(world, tmp_path, _vars):
+    _vars("io_base_sharedfp", "individual")
+    path = str(tmp_path / "ind.bin")
+    with File(world, path, etype=np.int32) as f:
+        f.write_shared(np.array([1, 1], np.int32))
+        f.write_shared(np.array([2, 2, 2], np.int32))
+        # nothing on disk until sync; pointer undefined mid-stream
+        with pytest.raises(RuntimeError):
+            f.sharedfp.fetch_add(1)
+        f.sync()
+        assert f.read_at(0, 5).tolist() == [1, 1, 2, 2, 2]
+        assert f.get_position_shared() == 5
+
+
+def test_write_read_ordered(world, tmp_path):
+    n = world.size
+    path = str(tmp_path / "ord.bin")
+    with File(world, path, etype=np.int32) as f:
+        data = world.stack([np.full(3, r, np.int32) for r in range(n)])
+        assert f.write_ordered(data) == 3 * n
+        assert f.get_position_shared() == 3 * n
+        f.seek_shared(0)
+        back = f.read_ordered(3)
+        for r in range(n):
+            assert back[r].tolist() == [r] * 3
+        assert f.get_position_shared() == 3 * n
