@@ -207,10 +207,12 @@ struct Hotel {
   };
   std::vector<Room> rooms;
   std::vector<int32_t> free_rooms;
+  std::mutex mu;
   explicit Hotel(int32_t n) : rooms(n) {
     for (int32_t i = n; i-- > 0;) free_rooms.push_back(i);
   }
   int32_t checkin(int64_t occupant, int64_t deadline) {
+    std::lock_guard<std::mutex> lk(mu);
     if (free_rooms.empty()) return -1;
     int32_t r = free_rooms.back();
     free_rooms.pop_back();
@@ -218,6 +220,7 @@ struct Hotel {
     return r;
   }
   bool checkout(int32_t room, int64_t *occupant) {
+    std::lock_guard<std::mutex> lk(mu);
     if (room < 0 || room >= (int32_t)rooms.size()
         || !rooms[room].occupied)
       return false;
@@ -228,6 +231,7 @@ struct Hotel {
   }
   // evict ONE expired occupant (deadline <= now); returns room or -1
   int32_t evict_one(int64_t now, int64_t *occupant) {
+    std::lock_guard<std::mutex> lk(mu);
     for (int32_t r = 0; r < (int32_t)rooms.size(); ++r) {
       if (rooms[r].occupied && rooms[r].deadline <= now) {
         *occupant = rooms[r].occupant;
@@ -238,7 +242,8 @@ struct Hotel {
     }
     return -1;
   }
-  int32_t occupancy() const {
+  int32_t occupancy() {
+    std::lock_guard<std::mutex> lk(mu);
     return (int32_t)(rooms.size() - free_rooms.size());
   }
 };
@@ -246,25 +251,30 @@ struct Hotel {
 // -------------------------------------------------------------- bitmap
 struct Bitmap {
   std::vector<uint64_t> words;
+  std::mutex mu;   // ensure() may reallocate; ctypes calls drop the GIL
   explicit Bitmap(int64_t nbits) : words((nbits + 63) / 64, 0) {}
   void ensure(int64_t bit) {
     if ((size_t)(bit / 64) >= words.size()) words.resize(bit / 64 + 1, 0);
   }
   void set(int64_t b) {
+    std::lock_guard<std::mutex> lk(mu);
     if (b < 0) return;
     ensure(b);
     words[b / 64] |= 1ULL << (b % 64);
   }
   void clear(int64_t b) {
+    std::lock_guard<std::mutex> lk(mu);
     if (b < 0) return;
     ensure(b);
     words[b / 64] &= ~(1ULL << (b % 64));
   }
-  bool test(int64_t b) const {
+  bool test(int64_t b) {
+    std::lock_guard<std::mutex> lk(mu);
     return b >= 0 && (size_t)(b / 64) < words.size()
            && (words[b / 64] >> (b % 64)) & 1;
   }
   int64_t find_and_set_first_unset() {
+    std::lock_guard<std::mutex> lk(mu);
     for (size_t w = 0; w < words.size(); ++w) {
       if (words[w] != ~0ULL) {
         int bit = __builtin_ctzll(~words[w]);
@@ -279,10 +289,12 @@ struct Bitmap {
 
 // ------------------------------------------------------- pointer array
 struct PtrArray {
+  std::mutex mu;
   std::vector<int64_t> vals;
   std::vector<char> used;
   std::vector<int64_t> free_idx;
   int64_t add(int64_t v) {
+    std::lock_guard<std::mutex> lk(mu);
     int64_t i;
     if (!free_idx.empty()) {
       i = free_idx.back();
@@ -297,6 +309,7 @@ struct PtrArray {
     return i;
   }
   bool set(int64_t i, int64_t v) {
+    std::lock_guard<std::mutex> lk(mu);
     if (i < 0) return false;
     if ((size_t)i >= vals.size()) {
       vals.resize(i + 1, 0);
@@ -306,12 +319,14 @@ struct PtrArray {
     used[i] = 1;
     return true;
   }
-  bool get(int64_t i, int64_t *out) const {
+  bool get(int64_t i, int64_t *out) {
+    std::lock_guard<std::mutex> lk(mu);
     if (i < 0 || (size_t)i >= vals.size() || !used[i]) return false;
     *out = vals[i];
     return true;
   }
   bool remove(int64_t i) {
+    std::lock_guard<std::mutex> lk(mu);
     if (i < 0 || (size_t)i >= vals.size() || !used[i]) return false;
     used[i] = 0;
     free_idx.push_back(i);

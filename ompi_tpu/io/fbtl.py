@@ -1,9 +1,10 @@
 """fbtl framework — individual file byte transfer (``ompi/mca/fbtl``).
 
-The reference's fbtl/posix issues pread/pwrite per iovec entry; here the
-run lists produced by the datatype index maps go through ``preadv`` /
-``pwritev`` so one syscall covers many noncontiguous runs (the iovec
-batching fbtl exists for).
+The reference's fbtl/posix issues one positioned request per iovec
+entry. Runs arrive here already coalesced (``coalesce_runs`` merged
+adjacent element offsets upstream in the datatype/fcoll layers), so the
+transfer loop is one ``pread``/``pwrite`` per *disjoint* file run — the
+minimal syscall count for the access pattern.
 """
 from __future__ import annotations
 
@@ -12,56 +13,30 @@ from typing import List, Tuple
 
 import numpy as np
 
-_IOV_MAX = 1024
-
 
 class PosixFbtl:
-    """Vectored positioned IO over an fd. Runs are (byte_off, nbytes)."""
+    """Positioned IO over an fd. Runs are (byte_off, nbytes), disjoint
+    and sorted (the upstream coalescer's contract)."""
 
     name = "posix"
 
     def pwritev_runs(self, fd: int, runs: List[Tuple[int, int]],
                      payload: bytes) -> int:
-        """Write ``payload`` split across ``runs``. Adjacent file runs
-        are batched per contiguous file region (pwritev needs one file
-        offset per call, so batching applies to the buffer side: one
-        memoryview slice per run, one syscall per file-contiguous
-        stretch)."""
         written = 0
         pos = 0
         mv = memoryview(payload)
-        i = 0
-        while i < len(runs):
-            off, ln = runs[i]
-            # widen across file-adjacent runs
-            j = i + 1
-            total = ln
-            while j < len(runs) and runs[j][0] == off + total \
-                    and j - i < _IOV_MAX:
-                total += runs[j][1]
-                j += 1
-            written += os.pwrite(fd, mv[pos:pos + total], off)
-            pos += total
-            i = j
+        for off, ln in runs:
+            written += os.pwrite(fd, mv[pos:pos + ln], off)
+            pos += ln
         return written
 
-    def preadv_runs(self, fd: int, runs: List[Tuple[int, int]]
-                    ) -> bytes:
+    def preadv_runs(self, fd: int, runs: List[Tuple[int, int]]) -> bytes:
         out = bytearray()
-        i = 0
-        while i < len(runs):
-            off, ln = runs[i]
-            j = i + 1
-            total = ln
-            while j < len(runs) and runs[j][0] == off + total \
-                    and j - i < _IOV_MAX:
-                total += runs[j][1]
-                j += 1
-            chunk = os.pread(fd, total, off)
-            if len(chunk) < total:               # short read past EOF
-                chunk = chunk + b"\0" * (total - len(chunk))
+        for off, ln in runs:
+            chunk = os.pread(fd, ln, off)
+            if len(chunk) < ln:                  # short read past EOF
+                chunk = chunk + b"\0" * (ln - len(chunk))
             out += chunk
-            i = j
         return bytes(out)
 
 

@@ -122,9 +122,11 @@ def select_fs(path: str) -> FsModule:
     fs_framework.open()
     fstype = _mount_fstype(path)
     best: Optional[Tuple[int, FsModule]] = None
-    for comp in fs_framework.components.values():
+    # honor fs_base_include like every comm-scoped framework does
+    for comp in fs_framework._allowed():
         res = comp.file_query(path, fstype)
         if res is not None and (best is None or res[0] > best[0]):
             best = res
-    assert best is not None                  # ufs always answers
+    if best is None:                 # include list excluded even ufs
+        return FsModule()
     return best[1]

@@ -58,8 +58,13 @@ class LockedFileSharedfp:
     def __init__(self, path: str):
         self._path = path + ".sharedfp"
         self._fd = os.open(self._path, os.O_RDWR | os.O_CREAT, 0o644)
-        if os.fstat(self._fd).st_size < 8:
-            os.pwrite(self._fd, struct.pack("<q", 0), 0)
+        # initialize under the same lock fetch_add takes — an unlocked
+        # check-and-write could reset a pointer another process already
+        # advanced (init racing its fetch_add)
+        def init():
+            if os.fstat(self._fd).st_size < 8:
+                os.pwrite(self._fd, struct.pack("<q", 0), 0)
+        self._locked(init)
 
     def _locked(self, fn):
         import fcntl

@@ -4,4 +4,4 @@ The reference ships extensions as self-contained sub-trees with their own
 C bindings (ftmpi/ULFM, cuda/rocm support queries, affinity, shortfloat);
 here each is a module exporting MPIX-style functions over the core.
 """
-from ompi_tpu.mpiext import ftmpi  # noqa: F401
+from ompi_tpu.mpiext import accel, affinity, ftmpi, shortfloat  # noqa: F401

@@ -1,0 +1,52 @@
+"""mpiext/accel — accelerator support queries.
+
+Behavioral spec: ``ompi/mpiext/cuda`` / ``ompi/mpiext/rocm`` —
+``MPIX_Query_cuda_support()`` / ``MPIX_Query_rocm_support()`` return
+whether the library was built with, and is currently running against,
+device-buffer support (``ompi/mpiext/cuda/c/mpiext_cuda.c``).
+
+TPU-native re-design: the question is whether HBM-resident jax arrays
+ride the native XLA collective path (they always do when a TPU/device
+platform is up; on CPU-only hosts the "device" is the host platform and
+staging is the identity). The extension also exposes the device
+inventory the reference leaves to ``MPIX_Query_*`` callers to discover
+themselves.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+
+def Query_tpu_support() -> bool:
+    """True when device (HBM) buffers dispatch to XLA collectives
+    without host staging — the MPIX_Query_cuda_support analogue."""
+    import jax
+    try:
+        return len(jax.devices()) > 0
+    except RuntimeError:
+        return False
+
+
+def Query_cuda_support() -> bool:
+    """Always False: this framework's device plane is XLA/TPU, not CUDA
+    (provided so reference-portable apps can probe both)."""
+    return False
+
+
+def Query_rocm_support() -> bool:
+    return False
+
+
+def Device_inventory() -> List[Dict]:
+    """One record per visible device (platform, id, process, coords)."""
+    import jax
+    out = []
+    for d in jax.devices():
+        out.append({
+            "id": int(d.id),
+            "platform": str(d.platform),
+            "process_index": int(getattr(d, "process_index", 0) or 0),
+            "coords": tuple(getattr(d, "coords", ()) or ()),
+            "kind": str(getattr(d, "device_kind", "")),
+        })
+    return out

@@ -1,0 +1,34 @@
+"""mpiext/affinity — locality strings.
+
+Behavioral spec: ``ompi/mpiext/affinity`` — ``OMPI_Affinity_str()``
+returns three strings per calling rank describing requested binding,
+actual binding, and the map of the whole job (hwloc-derived).
+
+TPU-native re-design: "binding" is the rank -> device pinning on the
+mesh; the locality string names the device platform/id/process and its
+physical coordinates (the ICI-topology analogue of a socket/core map).
+"""
+from __future__ import annotations
+
+from typing import List, Tuple
+
+
+def _one(rank: int, device) -> str:
+    coords = tuple(getattr(device, "coords", ()) or ())
+    proc = int(getattr(device, "process_index", 0) or 0)
+    where = f" coords={coords}" if coords else ""
+    return (f"rank {rank} bound to {device.platform}:{device.id} "
+            f"(process {proc}{where})")
+
+
+def Affinity_str(comm, rank: int = 0) -> Tuple[str, str, str]:
+    """(requested, actual, full-map) binding strings for ``rank`` —
+    OMPI_Affinity_str shape. Requested == actual in this runtime: the
+    communicator constructor is the binding."""
+    actual = _one(rank, comm.devices[rank])
+    full = "; ".join(_one(r, d) for r, d in enumerate(comm.devices))
+    return actual, actual, full
+
+
+def Affinity_map(comm) -> List[str]:
+    return [_one(r, d) for r, d in enumerate(comm.devices)]

@@ -1,0 +1,15 @@
+"""mpiext/shortfloat — half-precision datatypes.
+
+Behavioral spec: ``ompi/mpiext/shortfloat`` — exposes
+``MPIX_SHORT_FLOAT`` / ``MPIX_C_SHORT_FLOAT`` (and, where the compiler
+supports it, bfloat16) as predefined datatypes usable in reductions.
+
+TPU-native: half precision is not an extension here — bfloat16 is the
+MXU's native format — so these are aliases into the core datatype
+registry, provided for source parity with reference-portable apps.
+"""
+from ompi_tpu.core.datatype import BFLOAT16, FLOAT16
+
+SHORT_FLOAT = FLOAT16          # MPIX_SHORT_FLOAT
+C_SHORT_FLOAT = FLOAT16        # MPIX_C_SHORT_FLOAT
+C_BF16 = BFLOAT16              # MPIX_C_BF16 (the MXU-native format)

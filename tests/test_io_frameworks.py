@@ -42,10 +42,9 @@ def test_fs_parallel_component_claims_its_type(tmp_path):
 
 
 # -- fbtl --------------------------------------------------------------
-def test_fbtl_batches_adjacent_runs(tmp_path):
+def test_fbtl_runs_roundtrip(tmp_path):
     fd = os.open(str(tmp_path / "b.bin"), os.O_RDWR | os.O_CREAT)
     fbtl = PosixFbtl()
-    # three file-adjacent runs -> one contiguous write
     runs = [(0, 4), (4, 4), (8, 4)]
     data = np.arange(3, dtype=np.int32).tobytes()
     assert fbtl.pwritev_runs(fd, runs, data) == 12

@@ -1,0 +1,113 @@
+"""mpiext extensions (accel/shortfloat/affinity), MPIR debugger
+interface, PERUSE instrumentation."""
+import numpy as np
+
+import ompi_tpu as MPI
+from ompi_tpu.mpiext import accel, affinity, shortfloat
+from ompi_tpu.tools import debuggers, peruse
+
+
+# -- mpiext ------------------------------------------------------------
+def test_accel_queries(world):
+    assert accel.Query_tpu_support() is True
+    assert accel.Query_cuda_support() is False
+    assert accel.Query_rocm_support() is False
+    inv = accel.Device_inventory()
+    assert len(inv) >= world.size
+    assert {"id", "platform", "process_index"} <= set(inv[0])
+
+
+def test_shortfloat_alias_reduces(world, rng):
+    assert shortfloat.SHORT_FLOAT is MPI.FLOAT16
+    assert shortfloat.C_BF16 is MPI.BFLOAT16
+    x = rng.standard_normal((world.size, 8)).astype(np.float32)
+    buf = world.stack([r.astype(np.float16) for r in x])
+    out = np.asarray(world.allreduce(buf, MPI.SUM)).astype(np.float32)
+    np.testing.assert_allclose(out[0], x.sum(0), rtol=2e-2)
+
+
+def test_affinity_strings(world):
+    req, actual, full = affinity.Affinity_str(world, rank=2)
+    assert req == actual and "rank 2" in actual
+    amap = affinity.Affinity_map(world)
+    assert len(amap) == world.size
+    assert all(f"rank {r}" in amap[r] for r in range(world.size))
+
+
+# -- debuggers (MPIR) --------------------------------------------------
+def test_mpir_proctable(world):
+    pt = debuggers.proctable(world)
+    assert len(pt) == world.size
+    assert pt[3]["rank"] == 3
+    assert pt[0]["pid"] > 0 and pt[0]["host_name"]
+    assert ":" in pt[0]["device"]
+
+
+def test_mpir_breakpoint_and_flag(world):
+    fired = []
+    debuggers.on_breakpoint(lambda: fired.append(1))
+    debuggers.set_being_debugged(True)
+    assert debuggers.MPIR_being_debugged
+    debuggers.MPIR_Breakpoint()
+    assert fired == [1]
+    debuggers.set_being_debugged(False)
+
+
+def test_message_queue_dump(world):
+    c = world.dup()
+    c.isend(np.ones(3, np.float32), src=0, dest=1, tag=42)  # unexpected
+    req = c.irecv(source=2, tag=7, dst=3)                   # posted
+    q = debuggers.message_queues(c)
+    assert any(u["tag"] == 42 for u in q["unexpected"])
+    assert any(p["tag"] == 7 for p in q["posted"])
+    # drain
+    c.recv(source=0, tag=42, dst=1)
+    c.send(np.ones(1, np.float32), src=2, dest=3, tag=7)
+    req.wait()
+
+
+# -- PERUSE ------------------------------------------------------------
+def test_peruse_lifecycle(world):
+    assert peruse.Init() == peruse.PERUSE_SUCCESS
+    assert "PERUSE_COMM_REQ_ACTIVATE" in peruse.Query_supported_events()
+    assert peruse.Query_event("PERUSE_COMM_COLL_BEGIN")
+    assert not peruse.Query_event("PERUSE_NOT_A_THING")
+    assert peruse.Event_comm_register("PERUSE_NOT_A_THING", world,
+                                      lambda *a: None) is None
+
+
+def test_peruse_events_fire_per_comm(world, rng):
+    c = world.dup()
+    other = world.dup()
+    seen = []
+    h = peruse.Event_comm_register(
+        "PERUSE_COMM_COLL_BEGIN", c,
+        lambda ev, comm, info: seen.append(ev))
+    h.start()
+    x = c.stack([np.ones(4, np.float32)] * c.size)
+    c.allreduce(x, MPI.SUM)
+    other.allreduce(other.stack([np.ones(2, np.float32)] * other.size),
+                    MPI.SUM)          # different comm: not counted
+    assert h.fired == 1 and seen == ["PERUSE_COMM_COLL_BEGIN"]
+    h.stop()
+    c.allreduce(x, MPI.SUM)
+    assert h.fired == 1               # stopped: no events
+    h.start()
+    c.barrier()
+    assert h.fired == 2
+    h.free()
+    c.barrier()
+    assert h.fired == 2
+
+
+def test_peruse_pt2pt_events(world):
+    c = world.dup()
+    seen = []
+    h = peruse.Event_comm_register(
+        "PERUSE_COMM_REQ_ACTIVATE", c,
+        lambda ev, comm, info: seen.append(ev))
+    h.start()
+    c.send(np.ones(2, np.float32), src=0, dest=1, tag=1)
+    c.recv(source=0, tag=1, dst=1)
+    assert h.fired == 2               # send + recv activations
+    h.free()
