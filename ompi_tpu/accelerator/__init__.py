@@ -1,3 +1,4 @@
 from ompi_tpu.accelerator.framework import (  # noqa: F401
-    LOCUS_DEVICE, LOCUS_HOST, check_addr, to_device, to_host, accel_framework,
+    LOCUS_DEVICE, LOCUS_HOST, Event, Stream, accel_framework, check_addr,
+    current_module, to_device, to_host,
 )

@@ -27,10 +27,87 @@ LOCUS_HOST = "host"
 accel_framework = register_framework("accelerator")
 
 
+def device_locality(device) -> Tuple[int, Tuple[int, ...]]:
+    """(process_index, physical coords) of a device — the one place the
+    JAX device-attribute extraction lives (affinity strings, treematch
+    distances, device inventories all read through here)."""
+    proc = int(getattr(device, "process_index", 0) or 0)
+    coords = tuple(getattr(device, "coords", ()) or ())
+    return proc, coords
+
+
+def device_attrs(device) -> dict:
+    """Fabric-position record for a device (the get_device_pci_attr
+    analogue: mesh coordinates instead of a PCI BDF)."""
+    proc, coords = device_locality(device)
+    return {
+        "id": int(device.id),
+        "platform": str(device.platform),
+        "process_index": proc,
+        "coords": coords,
+        "kind": str(getattr(device, "device_kind", "")),
+    }
+
+
+class Stream:
+    """An ordered work queue (``accelerator.h:189-226`` streams).
+
+    JAX orders operations per device automatically; what a stream adds
+    is a *join point*: arrays enqueued on the stream are synchronized
+    together, and ``sync`` drains in enqueue order — the semantics the
+    reference's ``wait_event``/``synchronize`` pair provides."""
+
+    def __init__(self):
+        self._work: list = []
+
+    def enqueue(self, arrays) -> None:
+        self._work.append(arrays)
+
+    def sync(self) -> None:
+        if self._work:
+            jax.block_until_ready(self._work)
+            self._work.clear()
+
+    @property
+    def depth(self) -> int:
+        return len(self._work)
+
+
+class Event:
+    """Completion marker (``accelerator.h:227-258``): ``record`` captures
+    the arrays in flight; ``query`` polls; ``synchronize`` blocks."""
+
+    def __init__(self):
+        self._arrays: Any = None
+
+    def record(self, arrays_or_stream) -> None:
+        if isinstance(arrays_or_stream, Stream):
+            self._arrays = list(arrays_or_stream._work)
+        else:
+            self._arrays = arrays_or_stream
+
+    def query(self) -> bool:
+        if self._arrays is None:
+            return True
+        from ompi_tpu.core.request import _is_ready
+        leaves = [a for a in jax.tree_util.tree_leaves(self._arrays)]
+        return all(_is_ready(a) for a in leaves)
+
+    def synchronize(self) -> None:
+        if self._arrays is not None:
+            jax.block_until_ready(self._arrays)
+            self._arrays = None
+
+
 class TpuAccelComponent(Component):
     """Live PJRT-backed device memory (peer of accelerator/cuda|rocm|ze)."""
 
     name = "tpu"
+
+    def __init__(self):
+        self._ipc: dict = {}          # handle -> buffer (IPC registry)
+        self._ipc_next = 1
+        self._pinned: dict = {}       # id(buf) -> buf (host_register)
 
     def comm_query(self, comm):
         return (50, self)
@@ -48,17 +125,82 @@ class TpuAccelComponent(Component):
     def mem_copy_d2h(self, dev_buf):
         return np.asarray(dev_buf)
 
+    # -- alloc (accelerator.h:364) -------------------------------------
+    def mem_alloc(self, shape, dtype=np.float32, device=None):
+        z = jax.numpy.zeros(shape, dtype)
+        return jax.device_put(z, device) if device is not None else z
+
+    # -- streams & events (accelerator.h:189-258) ----------------------
+    def create_stream(self) -> Stream:
+        return Stream()
+
+    def create_event(self) -> Event:
+        return Event()
+
     def event_synchronize(self, bufs):
         jax.block_until_ready(bufs)
 
+    # -- IPC handles (accelerator.h:460-561) ---------------------------
+    # The reference exports a device allocation to another process; the
+    # single-controller analogue is an opaque handle another subsystem
+    # (or spawned child world) can open without holding the array.
+    def get_ipc_handle(self, buf) -> int:
+        h = self._ipc_next
+        self._ipc_next += 1
+        self._ipc[h] = buf
+        return h
+
+    def open_ipc_handle(self, handle: int):
+        buf = self._ipc.get(handle)
+        if buf is None:
+            raise KeyError(f"unknown IPC handle {handle}")
+        return buf
+
+    def close_ipc_handle(self, handle: int) -> None:
+        self._ipc.pop(handle, None)
+
+    # -- host registration (accelerator.h:574) -------------------------
+    def host_register(self, buf: np.ndarray) -> None:
+        """Pin a host buffer: kept referenced (no GC mid-transfer) and
+        marked read-only to catch mutation during async use — the
+        honest analogue of page pinning. The pre-registration
+        writeability is restored at unregister."""
+        was_writeable = bool(buf.flags.writeable)
+        if was_writeable:
+            buf.flags.writeable = False
+        self._pinned[id(buf)] = (buf, was_writeable)
+
+    def host_unregister(self, buf: np.ndarray) -> None:
+        entry = self._pinned.pop(id(buf), None)
+        if entry is not None and entry[1]:
+            buf.flags.writeable = True
+
+    def is_host_registered(self, buf: np.ndarray) -> bool:
+        return id(buf) in self._pinned
+
+    # -- device info (accelerator.h:598-657) ---------------------------
     def get_device_info(self) -> Tuple[str, int]:
         devs = jax.devices()
         return (devs[0].platform, len(devs))
 
+    def get_device_attributes(self, device) -> dict:
+        attrs = device_attrs(device)
+        attrs["memory_stats"] = (device.memory_stats()
+                                 if hasattr(device, "memory_stats")
+                                 else None)
+        return attrs
 
-class NullAccelComponent(Component):
+    def device_can_access_peer(self, dev_a, dev_b) -> bool:
+        """Same fabric = peer-accessible (ICI); cross-process pairs go
+        through DCN (the reference returns false for non-peer PCIe)."""
+        return device_locality(dev_a)[0] == device_locality(dev_b)[0]
+
+
+class NullAccelComponent(TpuAccelComponent):
     """Host-only component (mirrors accelerator/null): every buffer is
-    host memory; device copies degrade to numpy."""
+    host memory, device copies degrade to numpy, and the rest of the
+    surface (streams/events/IPC/register/attrs) is the trivial host
+    implementation — accelerator/null implements the full API too."""
 
     name = "null"
 
@@ -75,6 +217,9 @@ class NullAccelComponent(Component):
 
     def mem_copy_d2h(self, dev_buf):
         return np.asarray(dev_buf)
+
+    def mem_alloc(self, shape, dtype=np.float32, device=None):
+        return np.zeros(shape, dtype)
 
     def event_synchronize(self, bufs):
         pass
@@ -93,6 +238,12 @@ def _mod() -> Component:
         sel = accel_framework.comm_select(None)
         _module = sel[0][2]
     return _module
+
+
+def current_module() -> Component:
+    """The selected accelerator module (framework-level accessor for
+    streams/events/IPC/host-register/device-attr operations)."""
+    return _mod()
 
 
 def check_addr(buf: Any) -> Optional[str]:

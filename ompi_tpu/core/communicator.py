@@ -824,12 +824,22 @@ class Communicator:
 
     def create_graph(self, index: Sequence[int], edges: Sequence[int],
                      reorder: bool = False) -> "Communicator":
+        """MPI_Graph_create. ``reorder=True`` runs the treematch
+        placement: rank r is bound to the device whose ICI position
+        minimizes the graph's weighted hop count (topo/treematch)."""
         from ompi_tpu.topo import GraphTopology
         topo = GraphTopology(index, edges)
         if topo.size > self.size:
             self._err(ERR_ARG, "graph larger than communicator")
+        devices = list(self.devices[:topo.size])
+        if reorder and topo.size > 1:
+            from ompi_tpu.topo import treematch as tm
+            cm = tm.comm_matrix_from_graph(index, edges)
+            hw = tm.hardware_distance(devices)
+            perm = tm.treematch_permutation(cm, hw)
+            devices = [devices[perm[r]] for r in range(topo.size)]
         g = Group(self.group.world_ranks[:topo.size])
-        c = Communicator(g, self.devices[:topo.size],
+        c = Communicator(g, devices,
                          name=f"{self.name}.graph", parent=self,
                          errhandler=self.errhandler)
         c.topo = topo

@@ -40,13 +40,5 @@ def Query_rocm_support() -> bool:
 def Device_inventory() -> List[Dict]:
     """One record per visible device (platform, id, process, coords)."""
     import jax
-    out = []
-    for d in jax.devices():
-        out.append({
-            "id": int(d.id),
-            "platform": str(d.platform),
-            "process_index": int(getattr(d, "process_index", 0) or 0),
-            "coords": tuple(getattr(d, "coords", ()) or ()),
-            "kind": str(getattr(d, "device_kind", "")),
-        })
-    return out
+    from ompi_tpu.accelerator.framework import device_attrs
+    return [device_attrs(d) for d in jax.devices()]

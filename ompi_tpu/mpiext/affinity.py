@@ -14,8 +14,8 @@ from typing import List, Tuple
 
 
 def _one(rank: int, device) -> str:
-    coords = tuple(getattr(device, "coords", ()) or ())
-    proc = int(getattr(device, "process_index", 0) or 0)
+    from ompi_tpu.accelerator.framework import device_locality
+    proc, coords = device_locality(device)
     where = f" coords={coords}" if coords else ""
     return (f"rank {rank} bound to {device.platform}:{device.id} "
             f"(process {proc}{where})")

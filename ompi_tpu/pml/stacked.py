@@ -278,6 +278,7 @@ class MatchingEngine:
               channel: int = CH_P2P) -> PtpRequest:
         """Post rank ``dest``'s receive."""
         req = PtpRequest(self, source, tag)
+        req.dest = dest               # receiving rank (debugger dumps)
         if source == PROC_NULL:
             req.deliver(_Msg(PROC_NULL, dest, tag, None))
             return req

@@ -66,6 +66,7 @@ def message_queues(comm, *, dst: Optional[int] = None
         # native queues: surface the Python-side payload registries
         for rh, req in getattr(eng, "_reqs", {}).items():
             posted.append({"handle": rh,
+                           "dest": getattr(req, "dest", -1),
                            "source": req.status.source,
                            "tag": req.status.tag})
         for mh, msg in getattr(eng, "_msgs", {}).items():
@@ -79,6 +80,6 @@ def message_queues(comm, *, dst: Optional[int] = None
             for msg in q:
                 unexpected.append({"src": s, "dest": d, "tag": msg.tag})
     if dst is not None:
-        posted = [p for p in posted if p.get("dest", dst) == dst]
+        posted = [p for p in posted if p.get("dest") == dst]
         unexpected = [u for u in unexpected if u["dest"] == dst]
     return {"posted": posted, "unexpected": unexpected}

@@ -1,0 +1,168 @@
+"""topo/treematch reordering + accelerator framework widening
+(streams, events, IPC, host register, device attrs)."""
+import numpy as np
+import pytest
+
+import ompi_tpu as MPI
+from ompi_tpu.accelerator import Event, Stream, current_module
+from ompi_tpu.topo import treematch as tm
+
+
+# -- treematch ---------------------------------------------------------
+class _Dev:
+    def __init__(self, i, coords, proc=0):
+        self.id = i
+        self.coords = coords
+        self.process_index = proc
+        self.platform = "fake"
+
+
+def test_hardware_distance_manhattan_and_dcn():
+    devs = [_Dev(0, (0, 0)), _Dev(1, (0, 1)), _Dev(2, (1, 0)),
+            _Dev(3, (1, 1), proc=1)]
+    d = tm.hardware_distance(devs)
+    assert d[0, 1] == 1 and d[0, 2] == 1
+    assert d[1, 2] == 2                       # (0,1)->(1,0)
+    assert d[0, 3] == 2 + 8                   # cross-process penalty
+
+
+def test_comm_matrix_from_graph():
+    # ring of 4: index/edges in MPI_Graph_create format
+    index = [2, 4, 6, 8]
+    edges = [1, 3, 0, 2, 1, 3, 0, 2]
+    m = tm.comm_matrix_from_graph(index, edges)
+    assert m[0, 1] == 2 and m[0, 3] == 2 and m[0, 2] == 0
+
+
+def test_treematch_improves_placement():
+    """A chain graph 0-1-2-3 placed on a line where logical neighbors
+    start physically far: treematch must beat identity cost."""
+    devs = [_Dev(0, (0,)), _Dev(1, (3,)), _Dev(2, (1,)), _Dev(3, (2,))]
+    hw = tm.hardware_distance(devs)
+    cm = np.zeros((4, 4))
+    for a, b in ((0, 1), (1, 2), (2, 3)):
+        cm[a, b] = cm[b, a] = 10.0
+    ident = tm.placement_cost(cm, hw)
+    perm = tm.treematch_permutation(cm, hw)
+    best = tm.placement_cost(cm, hw, perm)
+    assert sorted(perm) == [0, 1, 2, 3]
+    assert best < ident
+    assert best == 10.0 * 3                   # chain on a line: optimal
+
+
+def test_treematch_deterministic():
+    devs = [_Dev(i, (i,)) for i in range(6)]
+    hw = tm.hardware_distance(devs)
+    cm = np.random.default_rng(0).random((6, 6))
+    cm = cm + cm.T
+    assert (tm.treematch_permutation(cm, hw)
+            == tm.treematch_permutation(cm, hw))
+
+
+def test_graph_create_reorder(world):
+    """reorder=True rebinds ranks to devices; the topology itself is
+    unchanged and collectives still work."""
+    n = world.size
+    index, edges = [], []
+    for r in range(n):                        # ring
+        edges += [(r - 1) % n, (r + 1) % n]
+        index.append(len(edges))
+    c = world.create_graph(index, edges, reorder=True)
+    assert c.size == n
+    assert c.graph_neighbors(0) == [n - 1, 1]
+    assert sorted(d.id for d in c.devices) == \
+        sorted(d.id for d in world.devices[:n])
+    x = c.stack([np.full(3, r, np.float32) for r in range(n)])
+    out = np.asarray(c.allreduce(x, MPI.SUM))
+    assert out[0][0] == sum(range(n))
+
+
+# -- accelerator widening ----------------------------------------------
+def test_stream_ordering_and_sync(world):
+    m = current_module()
+    s = m.create_stream()
+    assert isinstance(s, Stream) and s.depth == 0
+    a = world.alloc((8,), np.float32, fill=1.0)
+    b = world.allreduce(a, MPI.SUM)
+    s.enqueue(a)
+    s.enqueue(b)
+    assert s.depth == 2
+    s.sync()
+    assert s.depth == 0
+
+
+def test_event_record_query_synchronize(world):
+    m = current_module()
+    ev = m.create_event()
+    assert isinstance(ev, Event)
+    assert ev.query()                          # nothing recorded
+    y = world.allreduce(world.alloc((4,), np.float32, fill=2.0), MPI.SUM)
+    ev.record([y])
+    ev.synchronize()
+    assert ev.query()
+
+
+def test_event_records_stream(world):
+    m = current_module()
+    s = m.create_stream()
+    y = world.allreduce(world.alloc((4,), np.float32, fill=1.0), MPI.SUM)
+    s.enqueue(y)
+    ev = m.create_event()
+    ev.record(s)
+    ev.synchronize()
+    assert ev.query()
+
+
+def test_ipc_handles(world):
+    m = current_module()
+    buf = world.alloc((16,), np.float32, fill=3.0)
+    h = m.get_ipc_handle(buf)
+    assert m.open_ipc_handle(h) is buf
+    m.close_ipc_handle(h)
+    with pytest.raises(KeyError):
+        m.open_ipc_handle(h)
+
+
+def test_host_register_pins_and_protects():
+    m = current_module()
+    buf = np.arange(10, dtype=np.float32)
+    m.host_register(buf)
+    assert m.is_host_registered(buf)
+    with pytest.raises(ValueError):
+        buf[0] = 99.0                          # pinned = immutable
+    m.host_unregister(buf)
+    assert not m.is_host_registered(buf)
+    buf[0] = 99.0                              # writable again
+
+
+def test_host_register_restores_prior_state():
+    m = current_module()
+    ro = np.frombuffer(b"12345678", dtype=np.uint8)   # born read-only
+    m.host_register(ro)
+    m.host_unregister(ro)                              # must not raise
+    assert not m.is_host_registered(ro)
+    assert not ro.flags.writeable                      # still read-only
+
+
+def test_message_queue_dst_filter(world):
+    from ompi_tpu.tools import debuggers
+    c = world.dup()
+    c.irecv(source=1, tag=5, dst=0)
+    c.irecv(source=2, tag=6, dst=3)
+    q = debuggers.message_queues(c, dst=3)
+    assert len(q["posted"]) == 1 and q["posted"][0]["tag"] == 6
+    c.send(np.ones(1, np.float32), src=1, dest=0, tag=5)
+    c.send(np.ones(1, np.float32), src=2, dest=3, tag=6)
+
+
+def test_device_attributes_and_peers(world):
+    m = current_module()
+    attrs = m.get_device_attributes(world.devices[0])
+    assert attrs["platform"] and "coords" in attrs
+    assert m.device_can_access_peer(world.devices[0], world.devices[1])
+
+
+def test_mem_alloc(world):
+    m = current_module()
+    z = m.mem_alloc((4, 4), np.float32)
+    assert z.shape == (4, 4) and float(np.asarray(z).sum()) == 0.0
