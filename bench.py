@@ -109,6 +109,39 @@ def main() -> None:
                              args.lat_iters, fetch_s)
     lat_staged_s = _staged_time(small, max(args.baseline_iters, 9))
 
+    # ---- secondary: OSU matrix (small-message latency per collective)
+    # One warm call compiles; the timed loop amortizes in small batches
+    # (large unsynced batches can overflow XLA's in-process rendezvous
+    # on the forced-host backend).
+    def _lat(fn, iters=None):
+        iters = iters or max(10, args.lat_iters // 2)
+        _fetch(fn())
+        return _osu_time(fn, iters, fetch_s)
+
+    osu = {}
+    try:
+        osu["osu_bcast_8B_us"] = round(_lat(
+            lambda: world.bcast(small, 0)) * 1e6, 2)
+        osu["osu_allgather_8B_us"] = round(_lat(
+            lambda: world.allgather(small)) * 1e6, 2)
+        osu["osu_reduce_8B_us"] = round(_lat(
+            lambda: world.reduce(small, MPI.SUM, 0)) * 1e6, 2)
+        if n > 1:
+            a2a = world.alloc((n, 2), np.float32, fill=1.0)
+            osu["osu_alltoall_8B_us"] = round(_lat(
+                lambda: world.alltoall(a2a)) * 1e6, 2)
+            osu["osu_reduce_scatter_8B_us"] = round(_lat(
+                lambda: world.reduce_scatter_block(a2a, MPI.SUM))
+                * 1e6, 2)
+        world.barrier()                 # warm (first call compiles)
+        t0 = time.perf_counter()
+        for _ in range(20):
+            world.barrier()
+        osu["osu_barrier_us"] = round(
+            (time.perf_counter() - t0) / 20 * 1e6, 2)
+    except Exception as e:              # noqa: BLE001 — report partial
+        osu["osu_matrix_error"] = f"{type(e).__name__}: {e}"
+
     # ---- secondary: large-message bandwidth -------------------------
     elems = int(args.size_mb * (1 << 20) // 4)
     bytes_per_rank = elems * 4
@@ -140,6 +173,7 @@ def main() -> None:
         "large_staged_ms": round(big_staged_s * 1e3, 3),
         "warmup_compile_s": round(warmup_s, 3),
         "correct": correct,
+        **osu,
         "caveat": ("size-1 world: large-message path is identity-aliased "
                    "by XLA; algbw is an upper bound" if n == 1 else ""),
     }))

@@ -165,14 +165,24 @@ class TpuAccelComponent(Component):
         marked read-only to catch mutation during async use — the
         honest analogue of page pinning. The pre-registration
         writeability is restored at unregister."""
+        entry = self._pinned.get(id(buf))
+        if entry is not None:          # re-register: refcount only
+            self._pinned[id(buf)] = (buf, entry[1], entry[2] + 1)
+            return
         was_writeable = bool(buf.flags.writeable)
         if was_writeable:
             buf.flags.writeable = False
-        self._pinned[id(buf)] = (buf, was_writeable)
+        self._pinned[id(buf)] = (buf, was_writeable, 1)
 
     def host_unregister(self, buf: np.ndarray) -> None:
-        entry = self._pinned.pop(id(buf), None)
-        if entry is not None and entry[1]:
+        entry = self._pinned.get(id(buf))
+        if entry is None:
+            return
+        if entry[2] > 1:               # matched register/unregister pairs
+            self._pinned[id(buf)] = (buf, entry[1], entry[2] - 1)
+            return
+        del self._pinned[id(buf)]
+        if entry[1]:
             buf.flags.writeable = True
 
     def is_host_registered(self, buf: np.ndarray) -> bool:

@@ -1004,6 +1004,9 @@ class Communicator:
         """Collectives must not silently complete across a failure
         (ompi/request/req_ft.c behavior: ops involving failed procs
         raise MPIX_ERR_PROC_FAILED until the comm is shrunk)."""
+        from ompi_tpu.runtime import ft
+        if not ft.any_failed():        # hot path: nothing has failed
+            return
         failed = self._failed_local()
         if failed:
             from ompi_tpu.core.errhandler import ERR_PROC_FAILED

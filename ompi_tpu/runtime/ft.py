@@ -40,6 +40,12 @@ def fail_rank(world_rank: int, reason: str = "injected") -> None:
         cb(world_rank, reason)
 
 
+def any_failed() -> bool:
+    """Fast-path check for the per-call FT guards (hot path: every
+    collective entry)."""
+    return bool(_failed)
+
+
 def is_failed(world_rank: int) -> bool:
     return world_rank in _failed
 

@@ -135,6 +135,19 @@ def test_host_register_pins_and_protects():
     buf[0] = 99.0                              # writable again
 
 
+def test_host_register_refcounts():
+    m = current_module()
+    buf = np.arange(4, dtype=np.float32)
+    m.host_register(buf)
+    m.host_register(buf)               # double register
+    m.host_unregister(buf)             # one unregister: still pinned
+    assert m.is_host_registered(buf)
+    assert not buf.flags.writeable
+    m.host_unregister(buf)             # matched: restored
+    assert not m.is_host_registered(buf)
+    assert buf.flags.writeable
+
+
 def test_host_register_restores_prior_state():
     m = current_module()
     ro = np.frombuffer(b"12345678", dtype=np.uint8)   # born read-only
