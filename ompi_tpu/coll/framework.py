@@ -35,8 +35,9 @@ def _ensure_components() -> None:
     if _components_loaded:
         return
     # Importing registers each component with the framework.
-    from ompi_tpu.coll import (basic, ftagree, han, monitoring,  # noqa: F401
-                               nbc, self_, tuned, xhc, xla)
+    from ompi_tpu.coll import (adapt, basic, ftagree, han,  # noqa: F401
+                               monitoring, nbc, self_, sync, tuned, xhc,
+                               xla)
     _components_loaded = True
 
 

@@ -1,0 +1,64 @@
+"""opal_output verbosity streams + show_help catalogs."""
+import io
+
+from ompi_tpu.mca import var
+from ompi_tpu.utils import output, show_help
+
+
+def test_output_stream_basic():
+    output._reset_for_tests()
+    buf = io.StringIO()
+    sid = output.open_stream(prefix="coll", file=buf)
+    output.output(sid, "hello")
+    assert buf.getvalue() == "[coll] hello\n"
+    output.close_stream(sid)
+    output.output(sid, "after close")      # dropped, no crash
+    assert buf.getvalue() == "[coll] hello\n"
+
+
+def test_output_verbose_gated_by_mca_var():
+    output._reset_for_tests()
+    buf = io.StringIO()
+    sid = output.open_stream(framework="coll", file=buf)
+    old = var.var_get("coll_base_verbose", 0)
+    try:
+        var.var_set("coll_base_verbose", 0)
+        output.output_verbose(5, sid, "quiet")
+        assert buf.getvalue() == ""
+        var.var_set("coll_base_verbose", 10)   # live re-read
+        output.output_verbose(5, sid, "loud")
+        assert "loud" in buf.getvalue()
+    finally:
+        var.var_set("coll_base_verbose", old)
+
+
+def test_show_help_renders_catalog():
+    show_help._reset_for_tests()
+    msg = show_help.render("help-mpi-errors.txt", "comm:revoked", "comm#5")
+    assert "comm#5" in msg and "revoked" in msg
+    # substitution with two args
+    msg = show_help.render("help-mpi-errors.txt", "comm:proc-failed",
+                           "[1, 3]", "MPI_COMM_WORLD")
+    assert "[1, 3]" in msg and "MPI_COMM_WORLD" in msg
+
+
+def test_show_help_missing_topic_fallback():
+    show_help._reset_for_tests()
+    msg = show_help.render("help-mpi-errors.txt", "no:such:topic")
+    assert "unavailable" in msg
+    msg = show_help.render("help-nope.txt", "x")
+    assert "unavailable" in msg
+
+
+def test_show_help_dedup_and_flush():
+    show_help._reset_for_tests()
+    buf = io.StringIO()
+    for _ in range(4):
+        show_help.show_help("help-mpi-errors.txt", "comm:revoked",
+                            "c", file=buf)
+    printed = buf.getvalue()
+    assert printed.count("revoked") == 1      # only the first emission
+    summary = show_help.flush(file=buf)
+    assert summary and "3 more occurrence(s)" in summary[0]
+    # counts reset after flush
+    assert show_help.flush(file=buf) == []
