@@ -1,0 +1,4 @@
+"""Hot-op kernels (Pallas TPU + jnp fallbacks)."""
+from ompi_tpu.ops.flash_attention import (  # noqa: F401
+    flash_block_update, pallas_available,
+)
