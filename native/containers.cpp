@@ -74,6 +74,9 @@ struct Fifo {
           return true;
         }
       } else if (dif < 0) {
+        // release the bound reservation taken above, or the counter
+        // would leak capacity if this path ever became reachable
+        count.fetch_sub(1, std::memory_order_acq_rel);
         return false;                       // full
       } else {
         pos = tail.load(std::memory_order_relaxed);
@@ -109,7 +112,11 @@ struct Fifo {
 // Treiber stack; top word = [tag:32 | index+1:32]; 0 == empty.
 struct LifoNode {
   int64_t value;
-  uint32_t next;                            // index+1; 0 == null
+  // atomic: put() stores while a take() holding a stale top may read
+  // concurrently; the tagged CAS discards the stale value, but the
+  // access itself must not be a C++ data race (relaxed is enough —
+  // correctness comes from the CAS on `top`)
+  std::atomic<uint32_t> next{0};            // index+1; 0 == null
 };
 
 struct Lifo {
@@ -121,7 +128,7 @@ struct Lifo {
     // thread the free list through the pool
     uint64_t prev = 0;
     for (uint32_t i = capacity; i-- > 0;) {
-      pool[i].next = (uint32_t)prev;
+      pool[i].next.store((uint32_t)prev, std::memory_order_relaxed);
       prev = i + 1;
     }
     free_top.store(prev, std::memory_order_relaxed);
@@ -138,7 +145,8 @@ struct Lifo {
       uint32_t ip1 = idx(cur);
       if (ip1 == 0) return false;
       LifoNode &n = pool[ip1 - 1];
-      uint64_t next = make(n.next, (uint32_t)(cur >> 32) + 1);
+      uint64_t next = make(n.next.load(std::memory_order_relaxed),
+                           (uint32_t)(cur >> 32) + 1);
       if (stack.compare_exchange_weak(cur, next,
                                       std::memory_order_acq_rel))
       {
@@ -151,7 +159,7 @@ struct Lifo {
   void put(std::atomic<uint64_t> &stack, uint32_t index) {
     uint64_t cur = stack.load(std::memory_order_acquire);
     for (;;) {
-      pool[index].next = idx(cur);
+      pool[index].next.store(idx(cur), std::memory_order_relaxed);
       uint64_t next = make(index + 1, (uint32_t)(cur >> 32) + 1);
       if (stack.compare_exchange_weak(cur, next,
                                       std::memory_order_acq_rel))

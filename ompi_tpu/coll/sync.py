@@ -76,6 +76,13 @@ class SyncCollComponent(Component):
             _tls.busy = False
         shim = _Shim(module)
         for func in COLL_FUNCS:
+            # Interpose only on blocking collectives, as the reference
+            # does: wrapping the nonblocking schedule slots would run
+            # the injected barrier synchronously inside i-collective
+            # *initiation*, and agree/iagree are fault-tolerance paths
+            # that must not pick up extra synchronization.
+            if func.startswith("i") or func in ("agree",):
+                continue
             for _p, _c, m in selected:
                 if getattr(m, func, None) is not None:
                     module._inner[func] = m

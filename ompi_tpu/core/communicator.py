@@ -42,6 +42,8 @@ from ompi_tpu.core.errhandler import (ERR_ARG, ERR_COMM, ERR_COUNT, ERR_OP,
 from ompi_tpu.core.group import Group, UNDEFINED
 from ompi_tpu.core.info import Info
 from ompi_tpu.core.request import Request, Status
+from ompi_tpu.runtime import ft, spc
+from ompi_tpu.utils import hooks
 
 AXIS = "mpi_r"          # the private mesh axis name every communicator uses
 
@@ -147,8 +149,6 @@ class Communicator:
         if m is None:
             self._err(ERR_ARG, f"no coll component provides {func} "
                                f"for {self.name}")
-        from ompi_tpu.runtime import spc
-        from ompi_tpu.utils import hooks
         spc.record(f"coll_{func}", 1)
         hooks.fire(f"coll_{func}", self, {})
         return m
@@ -583,10 +583,12 @@ class Communicator:
         return self._pml.recv(dst, source, tag)
 
     def irecv(self, source: int, tag: int = -1, *, dst: int = 0) -> Request:
+        # ULFM (req_ft.c): a *nonblocking* wildcard receive posts
+        # normally even with unacknowledged failures — a live sender may
+        # still match it; the pending error surfaces at test/wait
+        # (PtpRequest._check_ft). Only blocking recv raises at entry.
         self._check()
-        if source == -1:  # ANY_SOURCE
-            self._check_anysource_ft()
-        else:
+        if source != -1:  # named peer: fail fast, as the reference does
             self._check_peer_ft(source)
         self._record_pml("pml_recv")
         return self._pml.irecv(dst, source, tag)
@@ -996,7 +998,6 @@ class Communicator:
     # it. Per ULFM, agree/shrink/failure_ack remain usable on revoked
     # communicators — they bypass _check().
     def _failed_local(self) -> List[int]:
-        from ompi_tpu.runtime import ft
         return [r for r, w in enumerate(self.group.world_ranks)
                 if ft.is_failed(w)]
 
@@ -1004,7 +1005,6 @@ class Communicator:
         """Collectives must not silently complete across a failure
         (ompi/request/req_ft.c behavior: ops involving failed procs
         raise MPIX_ERR_PROC_FAILED until the comm is shrunk)."""
-        from ompi_tpu.runtime import ft
         if not ft.any_failed():        # hot path: nothing has failed
             return
         failed = self._failed_local()
@@ -1017,7 +1017,6 @@ class Communicator:
     def _check_peer_ft(self, peer: int) -> None:
         if peer is None or not (0 <= peer < self.size):
             return
-        from ompi_tpu.runtime import ft
         if ft.is_failed(self.group.world_ranks[peer]):
             from ompi_tpu.core.errhandler import ERR_PROC_FAILED
             self._err(ERR_PROC_FAILED, f"peer rank {peer} has failed")

@@ -17,10 +17,14 @@ User-defined ops (MPI_Op_create) supply a JAX-traceable combiner; the
 """
 from __future__ import annotations
 
+import itertools
 from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
+
+
+_op_counter = itertools.count()
 
 
 class Op:
@@ -37,6 +41,9 @@ class Op:
         self.fn = fn
         self.commute = commute
         self.name = name
+        # Cache identity: distinct user ops share the default name, so
+        # executable caches keyed on the name alone would collide.
+        self.uid = name if predefined else f"{name}#{next(_op_counter)}"
         self.xla_prim = xla_prim
         self.is_loc = is_loc         # MINLOC/MAXLOC pair semantics
         self.predefined = predefined

@@ -87,11 +87,13 @@ def var_register(framework: str, component: str, name: str, *,
     """Register a typed variable; resolve its value through the precedence
     chain and return the resolved value (as ``mca_base_var_register`` does
     via its out-param)."""
+    global _epoch
     full = "_".join(p for p in (framework, component, name) if p)
     coerce = _COERCE[vtype]
     with _lock:
         if full in _registry:
             return _registry[full].value
+        _epoch += 1
         v = _Var(name=full, vtype=vtype, default=default, help=help,
                  read_only=read_only, enumerator=enumerator)
         v.value, v.source = _resolve(full, coerce, default)
@@ -124,8 +126,20 @@ def var_get(full: str, default: Any = None) -> Any:
         return v.value if v is not None else default
 
 
+_epoch = 0
+
+
+def epoch() -> int:
+    """Monotone counter bumped on every mutation of the var store.
+    Decision layers may memoize var-derived choices keyed on this, so
+    per-call var reads leave the hot path while ``var_set`` still takes
+    effect immediately (source-tracking precedence is unchanged)."""
+    return _epoch
+
+
 def var_set(full: str, value: Any, source: str = SOURCE_SET) -> None:
     """Programmatic override (highest precedence)."""
+    global _epoch
     with _lock:
         v = _registry.get(full)
         if v is None:
@@ -135,6 +149,7 @@ def var_set(full: str, value: Any, source: str = SOURCE_SET) -> None:
         if _PRECEDENCE[source] >= _PRECEDENCE[v.source]:
             v.value = _COERCE[v.vtype](value)
             v.source = source
+            _epoch += 1
 
 
 def var_source(full: str) -> Optional[str]:
@@ -154,6 +169,8 @@ def var_dump() -> List[Dict[str, Any]]:
 
 
 def _reset_for_tests() -> None:
+    global _epoch
     with _lock:
         _registry.clear()
+        _epoch += 1
     _reset_param_file_cache()
