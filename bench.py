@@ -268,11 +268,14 @@ def main() -> None:
     lat_staged_s = _staged_time(small, 5)
 
     # framework-controlled cost: dispatch with no completion wait
+    # (bounded by the same unsynced-depth limit as _osu on the host
+    # backend)
+    disp_iters = 200 if not chunk else chunk
     world.allreduce(small, MPI.SUM)
     t0 = time.perf_counter()
-    for _ in range(200):
+    for _ in range(disp_iters):
         world.allreduce(small, MPI.SUM)
-    dispatch_us = (time.perf_counter() - t0) / 200 * 1e6
+    dispatch_us = (time.perf_counter() - t0) / disp_iters * 1e6
     _fetch(world.allreduce(small, MPI.SUM))          # drain the queue
 
     # ---- OSU small-message matrix -----------------------------------

@@ -62,9 +62,6 @@ class XlaCollModule:
         self._cache: Dict[Tuple, Callable] = {}
         self._fast: Dict[Tuple, Callable] = {}
         self._barrier_tokens: Dict[str, Tuple] = {}
-        # Host topology is fixed for the communicator's lifetime.
-        self._is_multihost = len(
-            {getattr(d, "process_index", 0) for d in comm.devices}) > 1
 
     # -- executable cache ------------------------------------------------
     def _compiled(self, key: Tuple, build: Callable[[], Callable],
@@ -100,7 +97,24 @@ class XlaCollModule:
                     return x
             except Exception:
                 pass
-        return jax.device_put(x, sh)
+            if not self.comm.is_multiprocess:
+                return jax.device_put(x, sh)
+            if not getattr(x, "is_fully_addressable", True):
+                # Multi-controller: a global array on a *different*
+                # sharding can be neither fetched nor device_put here.
+                # Surface a clear error instead of jax's opaque
+                # non-addressable RuntimeError.
+                from ompi_tpu.core.errhandler import ERR_ARG, MPIError
+                raise MPIError(
+                    ERR_ARG,
+                    "buffer is sharded over a different mesh than this "
+                    "communicator's; in a multi-controller world pass "
+                    "buffers created on this communicator (comm.put/"
+                    "alloc/stack) or host arrays")
+            # Fully-addressable local device array: fetch + replace.
+        # Host arrays go through the communicator's placement helper
+        # (multi-controller-safe).
+        return self.comm.put(np.asarray(x))
 
     def _key(self, func: str, x, *extra) -> Tuple:
         # dtype objects hash/compare directly; str() was ~15 us/call
@@ -119,7 +133,7 @@ class XlaCollModule:
     # tier (ICI) and only the scattered chunk crosses the slow tier
     # (DCN), for multi-host meshes.
     def _multihost(self) -> bool:
-        return self._is_multihost
+        return self.comm.spans_processes
 
     def _algorithm(self, func: str = "allreduce", nbytes: int = 0,
                    commute: bool = True) -> str:
@@ -439,8 +453,9 @@ class XlaCollModule:
         # decided at and are replaced in place on mismatch, so var_set
         # invalidates immediately without stranding old entries.
         fk = ("allreduce", x.shape, x.dtype, op.uid)
+        ep = var.epoch()            # snapshot BEFORE the decision reads
         hit = self._fast.get(fk)
-        if hit is not None and hit[0] == var.epoch():
+        if hit is not None and hit[0] == ep:
             return hit[1](x)
         n = self.comm.size
         alg = self._algorithm("allreduce", x.nbytes // max(n, 1),
@@ -475,7 +490,7 @@ class XlaCollModule:
             return self._smap(inner, x.ndim, x.ndim)
         fn = self._compiled(
             self._key("allreduce", x, op.uid, n, alg), build, x)
-        self._fast[fk] = (var.epoch(), fn)
+        self._fast[fk] = (ep, fn)
         return fn(x)
 
     def reduce(self, x, op, root: int):
@@ -487,8 +502,9 @@ class XlaCollModule:
     def bcast(self, x, root: int):
         x = self._to_mesh(x)
         fk = ("bcast", x.shape, x.dtype, root)
+        ep = var.epoch()            # snapshot BEFORE the decision reads
         hit = self._fast.get(fk)
-        if hit is not None and hit[0] == var.epoch():
+        if hit is not None and hit[0] == ep:
             return hit[1](x)
         n = self.comm.size
         arith = np.dtype(x.dtype).kind in _ARITH_KINDS
@@ -513,14 +529,15 @@ class XlaCollModule:
                     return jax.lax.dynamic_slice_in_dim(g, root, 1, 0)
             return self._smap(inner, x.ndim, x.ndim)
         fn = self._compiled(self._key("bcast", x, root, alg), build, x)
-        self._fast[fk] = (var.epoch(), fn)
+        self._fast[fk] = (ep, fn)
         return fn(x)
 
     def allgather(self, x):
         x = self._to_mesh(x)
         fk = ("allgather", x.shape, x.dtype)
+        ep = var.epoch()            # snapshot BEFORE the decision reads
         hit = self._fast.get(fk)
-        if hit is not None and hit[0] == var.epoch():
+        if hit is not None and hit[0] == ep:
             return hit[1](x)
         n = self.comm.size
         alg = self._algorithm("allgather", x.nbytes // max(n, 1))
@@ -537,7 +554,7 @@ class XlaCollModule:
                     return g[None]
             return self._smap(inner, x.ndim, x.ndim + 1)
         fn = self._compiled(self._key("allgather", x, alg), build, x)
-        self._fast[fk] = (var.epoch(), fn)
+        self._fast[fk] = (ep, fn)
         return fn(x)
 
     def gather(self, x, root: int):
@@ -560,8 +577,9 @@ class XlaCollModule:
     def alltoall(self, x):
         x = self._to_mesh(x)
         fk = ("alltoall", x.shape, x.dtype)
+        ep = var.epoch()            # snapshot BEFORE the decision reads
         hit = self._fast.get(fk)
-        if hit is not None and hit[0] == var.epoch():
+        if hit is not None and hit[0] == ep:
             return hit[1](x)
         n = self.comm.size
         alg = self._algorithm("alltoall", x.nbytes // max(n, 1))
@@ -576,14 +594,15 @@ class XlaCollModule:
                     return y[None]
             return self._smap(inner, x.ndim, x.ndim)
         fn = self._compiled(self._key("alltoall", x, alg), build, x)
-        self._fast[fk] = (var.epoch(), fn)
+        self._fast[fk] = (ep, fn)
         return fn(x)
 
     def reduce_scatter_block(self, x, op):
         x = self._to_mesh(x)
         fk = ("reduce_scatter_block", x.shape, x.dtype, op.uid)
+        ep = var.epoch()            # snapshot BEFORE the decision reads
         hit = self._fast.get(fk)
-        if hit is not None and hit[0] == var.epoch():
+        if hit is not None and hit[0] == ep:
             return hit[1](x)
         n = self.comm.size
         alg = self._algorithm("reduce_scatter_block",
@@ -605,7 +624,7 @@ class XlaCollModule:
             return self._smap(inner, x.ndim, x.ndim - 1)
         fn = self._compiled(
             self._key("reduce_scatter_block", x, op.uid, alg), build, x)
-        self._fast[fk] = (var.epoch(), fn)
+        self._fast[fk] = (ep, fn)
         return fn(x)
 
     def _prefix(self, g, op):

@@ -83,6 +83,7 @@ class Communicator:
         self.attributes: Dict[int, Any] = {}
         self.topo = None               # set by topo layer (cart/graph)
         self._freed = False
+        self._multiproc: Optional[bool] = None
         self._revoked = False          # ULFM
         self._acked_failures: frozenset = frozenset()  # ULFM failure_ack
         # The communicator's data plane: a private 1-D mesh over its
@@ -119,11 +120,54 @@ class Communicator:
             raise MPIError(ERR_REVOKED, "communicator has been revoked")
 
     # -- buffer helpers -------------------------------------------------
+    @property
+    def is_multiprocess(self) -> bool:
+        """True when any of this communicator's devices is not
+        addressable from THIS controller (multi-controller SPMD: every
+        controller runs the same program; each addresses only its local
+        shards). Governs buffer placement/readback strategy."""
+        if self._multiproc is None:
+            pi = jax.process_index()
+            self._multiproc = any(
+                getattr(d, "process_index", 0) != pi for d in self.devices)
+        return self._multiproc
+
+    @property
+    def spans_processes(self) -> bool:
+        """True when the devices live on more than one controller
+        process — the topology fact (distinct from addressability)
+        that gates the hier/DCN two-tier algorithm path."""
+        return len({getattr(d, "process_index", 0)
+                    for d in self.devices}) > 1
+
+    def put(self, host_array) -> Any:
+        """Place a host array onto this communicator's mesh (stacked
+        wire layout). Multi-controller: ``device_put`` cannot target
+        non-addressable devices, so build the global array from each
+        controller's local shards (the jax.make_array_from_callback
+        path — every controller computes the same host value, the
+        modex-like property PMIx establishes in the reference,
+        ``instance.c:547-569``)."""
+        arr = np.asarray(host_array)
+        if not self.is_multiprocess:
+            return jax.device_put(arr, self.sharding)
+        return jax.make_array_from_callback(
+            arr.shape, self.sharding, lambda idx: arr[idx])
+
     def alloc(self, local_shape: Tuple[int, ...], dtype=np.float32,
               fill: Optional[float] = None):
         """Allocate a stacked device buffer (size, *local_shape) sharded
         one-shard-per-rank over this communicator's mesh."""
         shape = (self.size,) + tuple(local_shape)
+        if self.is_multiprocess:
+            fill_v = 0.0 if fill is None else fill
+
+            def _shard(idx):
+                sshape = tuple(len(range(*sl.indices(dim)))
+                               for sl, dim in zip(idx, shape))
+                return np.full(sshape, fill_v, dtype=dtype)
+            return jax.make_array_from_callback(shape, self.sharding,
+                                                _shard)
         if fill is None:
             arr = jax.numpy.zeros(shape, dtype=dtype)
         else:
@@ -135,10 +179,21 @@ class Communicator:
         if len(per_rank) != self.size:
             self._err(ERR_COUNT, "need one array per rank")
         arr = np.stack([np.asarray(a) for a in per_rank])
-        return jax.device_put(arr, self.sharding)
+        return self.put(arr)
 
     def shard(self, stacked, rank: int):
-        """Rank ``rank``'s view of a stacked buffer (host copy)."""
+        """Rank ``rank``'s view of a stacked buffer (host copy). In a
+        multi-controller world only locally-addressable ranks can be
+        read; reading a remote rank raises (fetch it with a collective
+        instead — gather/allgather — exactly as real MPI requires)."""
+        if isinstance(stacked, jax.Array) and self.is_multiprocess:
+            for s in stacked.addressable_shards:
+                idx0 = s.index[0]
+                if idx0.start is not None and idx0.start == rank:
+                    return np.asarray(s.data)[0]
+            self._err(ERR_RANK,
+                      f"rank {rank}'s shard is not addressable from "
+                      f"process {jax.process_index()}")
         return np.asarray(stacked[rank])
 
     # -- validation + dispatch -----------------------------------------

@@ -75,6 +75,15 @@ def init(requested: int = THREAD_SINGLE,
         nproc = var.var_get("mpi_base_num_processes", 0)
         if nproc > 0:
             kw["num_processes"] = nproc
+        try:
+            # CPU backend needs a cross-process collectives transport
+            # (the DCN tier the reference reaches via btl/tcp); gloo is
+            # jax's host implementation. Harmless on TPU, where ICI/DCN
+            # collectives are native.
+            jax.config.update("jax_cpu_collectives_implementation",
+                              "gloo")
+        except Exception:                      # option absent: fine
+            pass
         jax.distributed.initialize(**kw)       # PMIx-equivalent wire-up
 
     if devices is None:
