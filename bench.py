@@ -189,6 +189,17 @@ def _ab_matrix_child() -> None:
     var.var_set("coll_xla_allreduce_algorithm", "auto")
     out["allreduce_ab"] = ab
 
+    # Root-targeted vs symmetric alias (VERDICT #3 "measure the delta"):
+    # reduce-to-root should beat allreduce on wire bytes at size.
+    rx = world.alloc(((8 << 20) // 4,), np.float32, fill=1.0)
+    rr = {}
+    for alg in ("alias", "rabenseifner_root"):
+        var.var_set("coll_xla_reduce_algorithm", alg)
+        rr[alg + "_ms"] = round(_osu(
+            lambda: world.reduce(rx, MPI.SUM, 0), 5, rtt, chunk) * 1e3, 3)
+    var.var_set("coll_xla_reduce_algorithm", "auto")
+    out["reduce_8MB_ab"] = rr
+
     small = world.alloc((2,), np.float32, fill=1.0)
     a2a = world.alloc((n, 2), np.float32, fill=1.0)
     out["osu_alltoall_8B_us"] = round(_osu(

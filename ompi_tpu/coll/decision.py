@@ -41,6 +41,24 @@ FIXED_RULES: Dict[str, List[Sequence]] = {
     "alltoall": [[0, 0, "direct"]],
     "reduce_scatter_block": [[0, 0, "direct"]],
     "barrier": [[0, 0, "direct"]],
+    # Root-targeted collectives (round 2): below the threshold one
+    # fused symmetric op wins on latency; above it the root-directed
+    # schedule wins on wire bytes (reduce: 1/2, gather: 1/n, scatter:
+    # 1/n of the symmetric alias). Crossovers are A/B-measured by the
+    # bench child (allreduce_ab analogues) and retunable via the
+    # dynamic-rules file.
+    "reduce": [
+        [0, 0, "alias"],
+        [0, 64 << 10, "rabenseifner_root"],
+    ],
+    "gather": [
+        [0, 0, "allgather"],
+        [0, 64 << 10, "binomial"],
+    ],
+    "scatter": [
+        [0, 0, "direct"],
+        [0, 64 << 10, "binomial"],
+    ],
 }
 
 # Algorithms that reorder floating-point combines relative to rank
@@ -49,6 +67,7 @@ FIXED_RULES: Dict[str, List[Sequence]] = {
 # coll_base_allreduce.c:291-294).
 REORDERING = frozenset({
     "ring", "hier", "recursive_doubling", "rabenseifner",
+    "rabenseifner_root",
 })
 
 # Algorithms only defined for power-of-two communicator sizes.
@@ -66,8 +85,13 @@ def _match(rules: List[Sequence], comm_size: int, nbytes: int) -> str:
     return alg
 
 
+_SYMMETRIC_FALLBACK = {"reduce": "alias", "gather": "allgather",
+                       "scatter": "direct"}
+
+
 def decide(func: str, comm_size: int, nbytes: int, multihost: bool,
-           dynamic: Dict[str, Dict] | None = None) -> str:
+           dynamic: Dict[str, Dict] | None = None,
+           platform: str = "") -> str:
     """Pick an algorithm for ``func`` on a ``comm_size``-rank comm moving
     ``nbytes`` per rank. ``dynamic`` is the tuned dynamic-rules dict; a
     ``{func: {"algorithm_rules": [...]}}`` entry overrides the fixed
@@ -82,6 +106,18 @@ def decide(func: str, comm_size: int, nbytes: int, multihost: bool,
         # Multi-host: the two-tier composition keeps bulk traffic on
         # ICI and only the scattered chunk on DCN (coll/han's role).
         return "hier"
+    if func in _SYMMETRIC_FALLBACK:
+        if multihost:
+            # Cross-process ppermute chains serialize on the DCN tier;
+            # the fused symmetric ops let XLA schedule the slow tier.
+            return _SYMMETRIC_FALLBACK[func]
+        if platform == "cpu":
+            # Measured (bench child, reduce_8MB_ab): on the shared-
+            # memory host backend "wire bytes saved" cost nothing and
+            # the log-round root-targeted schedules lose to one fused
+            # op at every size. The root-targeted defaults below are
+            # for ICI, where the traffic asymmetry is real.
+            return _SYMMETRIC_FALLBACK[func]
     rules = FIXED_RULES.get(func)
     if not rules:
         return "direct"

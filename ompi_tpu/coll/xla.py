@@ -147,8 +147,9 @@ class XlaCollModule:
         if alg == "auto":
             from ompi_tpu.coll.tuned import _load_rules
             dyn = _load_rules(var.var_get("coll_tuned_dynamic_rules", ""))
-            alg = decision.decide(func, self.comm.size, nbytes,
-                                  self._multihost(), dyn)
+            alg = decision.decide(
+                func, self.comm.size, nbytes, self._multihost(), dyn,
+                platform=getattr(self.comm.devices[0], "platform", ""))
         if alg in decision.REORDERING and not commute:
             return "direct"
         n = self.comm.size
@@ -443,6 +444,124 @@ class XlaCollModule:
             return x
         return inner
 
+    # -- root-targeted schedules (VERDICT round-2 #3) --------------------
+    # XLA's ppermute moves bytes only along the listed (src, dst) pairs,
+    # so binomial trees rooted at `root` are expressible in-graph: wire
+    # traffic is root-directed even though SPMD shapes stay uniform.
+    # Specs: reduce redscat_gather (Rabenseifner-to-root) and binomial
+    # gather/scatter in coll_base_functions.h:185-320.
+    @staticmethod
+    def _npad2(n: int) -> int:
+        p = 1
+        while p < n:
+            p *= 2
+        return p
+
+    def _rabenseifner_root_reduce_inner(self, n, root, shape):
+        """reduce = psum_scatter (each rank reduces 1/n) + binomial
+        collect of the reduced chunks into root: (n-1)/n of the buffer
+        crosses the wire toward root — half an allreduce's traffic
+        (spec: ompi_coll_base_reduce_intra_redscat_gather). SUM ONLY —
+        psum_scatter is the reduction; the caller must gate on
+        op.xla_prim == "sum". Output stacked (n, *s); only root's row
+        is significant."""
+        total = int(np.prod(shape))
+        chunk = -(-total // n)
+        npad = self._npad2(n)
+
+        def inner(b):                    # (1, *s) -> (1, *s)
+            x = b.reshape(-1)
+            x = jnp.pad(x, (0, n * chunk - total)).reshape(n, chunk)
+            # rank r's scattered chunk must be virtual-rank chunk
+            # v = (r - root) mod n so the collect tree roots at vr 0
+            x = jnp.roll(x, root, axis=0)
+            part = jax.lax.psum_scatter(x, AXIS, scatter_dimension=0,
+                                        tiled=True)        # (1, chunk)
+            r = jax.lax.axis_index(AXIS)
+            v = jnp.mod(r - root, n)
+            buf = jnp.zeros((npad, chunk), part.dtype)
+            buf = jax.lax.dynamic_update_slice(buf, part, (v, 0))
+            d = 1
+            while d < npad:
+                perm = [((vs + root) % n, (vs - d + root) % n)
+                        for vs in range(d, n, 2 * d)]
+                send = jax.lax.dynamic_slice(
+                    buf, (jnp.minimum(v, npad - d), 0), (d, chunk))
+                recvd = jax.lax.ppermute(send, AXIS, perm=perm)
+                upd = jax.lax.dynamic_update_slice(buf, recvd, (v + d, 0))
+                buf = jnp.where(jnp.mod(v, 2 * d) == 0, upd, buf)
+                d *= 2
+            res = buf[:n].reshape(-1)[:total]
+            out = jnp.where(r == root, res, jnp.zeros_like(res))
+            return out.reshape(b.shape)
+        return inner
+
+    def _binomial_gather_inner(self, n, root):
+        """Root-targeted binomial gather
+        (ompi_coll_base_gather_intra_binomial): log2(n) rounds of
+        block-doubling ppermute toward root. Aggregate wire bytes are
+        (n-1) blocks — 1/n of the allgather alias round 1 used. Output
+        stacked (n, n, *s); rows valid at root only."""
+        npad = self._npad2(n)
+
+        def inner(b):                    # (1, *s) -> (1, n, *s)
+            x = b[0]
+            r = jax.lax.axis_index(AXIS)
+            v = jnp.mod(r - root, n)
+            buf = jnp.zeros((npad,) + x.shape, x.dtype)
+            start0 = (v,) + (0,) * x.ndim
+            buf = jax.lax.dynamic_update_slice(buf, x[None], start0)
+            d = 1
+            while d < npad:
+                perm = [((vs + root) % n, (vs - d + root) % n)
+                        for vs in range(d, n, 2 * d)]
+                send = jax.lax.dynamic_slice(
+                    buf, (jnp.minimum(v, npad - d),) + (0,) * x.ndim,
+                    (d,) + x.shape)
+                recvd = jax.lax.ppermute(send, AXIS, perm=perm)
+                upd = jax.lax.dynamic_update_slice(
+                    buf, recvd, (v + d,) + (0,) * x.ndim)
+                buf = jnp.where(jnp.mod(v, 2 * d) == 0, upd, buf)
+                d *= 2
+            idx = jnp.mod(jnp.arange(n) - root, n)    # vrank -> rank rows
+            out = jnp.take(buf, idx, axis=0)
+            out = jnp.where(r == root, out, jnp.zeros_like(out))
+            return out[None]
+        return inner
+
+    def _binomial_scatter_inner(self, n, root):
+        """Root-targeted binomial scatter
+        (ompi_coll_base_scatter_intra_binomial): root's n blocks fan out
+        in log2(n) block-halving rounds; (n-1) blocks total leave root's
+        subtree vs the all_to_all lowering where every rank ships its
+        (meaningless) full row."""
+        npad = self._npad2(n)
+
+        def inner(b):                    # (1, n, *s) -> (1, *s)
+            x = b[0]                     # root's row of chunks
+            s = x.shape[1:]
+            r = jax.lax.axis_index(AXIS)
+            v = jnp.mod(r - root, n)
+            idx = jnp.mod(jnp.arange(npad) + root, n)  # rank -> vrank rows
+            buf = jnp.take(x, idx, axis=0)
+            buf = jnp.where(r == root, buf, jnp.zeros_like(buf))
+            d = npad // 2
+            while d >= 1:
+                perm = [((vs + root) % n, (vs + d + root) % n)
+                        for vs in range(0, n, 2 * d) if vs + d < n]
+                send = jax.lax.dynamic_slice(
+                    buf, (jnp.minimum(v + d, npad - d),) + (0,) * len(s),
+                    (d,) + s)
+                recvd = jax.lax.ppermute(send, AXIS, perm=perm)
+                upd = jax.lax.dynamic_update_slice(
+                    buf, recvd, (v,) + (0,) * len(s))
+                buf = jnp.where(jnp.mod(v, 2 * d) == d, upd, buf)
+                d //= 2
+            own = jax.lax.dynamic_slice(
+                buf, (v,) + (0,) * len(s), (1,) + s)
+            return own                   # (1, *s)
+        return inner
+
     # -- collectives -----------------------------------------------------
     def allreduce(self, x, op):
         x = self._to_mesh(x)
@@ -494,10 +613,34 @@ class XlaCollModule:
         return fn(x)
 
     def reduce(self, x, op, root: int):
-        # All-ranks result satisfies "recvbuf significant only at root";
-        # an XLA reduce-to-root would not be cheaper on a symmetric ICI
-        # ring, so this shares the allreduce executable (and its cache).
-        return self.allreduce(x, op)
+        """Root-targeted reduce. ``rabenseifner_root`` halves the wire
+        traffic of the round-1 allreduce alias; ``alias`` remains for
+        non-sum ops (psum_scatter is sum-only), size-1 worlds, and the
+        latency regime where one fused psum wins (decision table).
+        Output stacked (n, *s); only root's row is significant."""
+        x = self._to_mesh(x)
+        n = self.comm.size
+        fk = ("reduce", x.shape, x.dtype, op.uid, root)
+        ep = var.epoch()            # snapshot BEFORE the decision reads
+        hit = self._fast.get(fk)
+        if hit is not None and hit[0] == ep:
+            return hit[1](x)
+        alg = self._algorithm("reduce", x.nbytes // max(n, 1), op.commute)
+        # The root-targeted schedule is sum-only and meaningful only for
+        # n > 1; EVERY other selection outcome (alias, a commutativity
+        # demotion to 'direct', an unknown dynamic-rules name) delegates
+        # to allreduce, which honors the op.
+        if alg != "rabenseifner_root" or op.xla_prim != "sum" or n == 1:
+            fn = lambda xx, _op=op: self.allreduce(xx, _op)  # noqa: E731
+        else:
+            def build():
+                inner = self._rabenseifner_root_reduce_inner(
+                    n, root, x.shape[1:])
+                return self._smap(inner, x.ndim, x.ndim)
+            fn = self._compiled(
+                self._key("reduce", x, op.uid, n, root, alg), build, x)
+        self._fast[fk] = (ep, fn)
+        return fn(x)
 
     def bcast(self, x, root: int):
         x = self._to_mesh(x)
@@ -558,21 +701,57 @@ class XlaCollModule:
         return fn(x)
 
     def gather(self, x, root: int):
-        # Symmetric-ICI design choice: gather lowers to all_gather (every
-        # rank receives; root semantics are a superset). See module doc.
-        return self.allgather(x)
+        """Root-targeted gather: binomial tree toward root (aggregate
+        wire bytes 1/n of the allgather alias). ``allgather`` remains
+        the latency-regime choice (one fused op; root semantics are a
+        superset). Output (n, n, *s); rows valid at root only."""
+        x = self._to_mesh(x)
+        n = self.comm.size
+        fk = ("gather", x.shape, x.dtype, root)
+        ep = var.epoch()            # snapshot BEFORE the decision reads
+        hit = self._fast.get(fk)
+        if hit is not None and hit[0] == ep:
+            return hit[1](x)
+        alg = self._algorithm("gather", x.nbytes // max(n, 1))
+        if alg != "binomial" or n == 1:
+            fn = self.allgather          # alias (and any unknown name)
+        else:
+            def build():
+                return self._smap(self._binomial_gather_inner(n, root),
+                                  x.ndim, x.ndim + 1)
+            fn = self._compiled(self._key("gather", x, n, root, alg),
+                                build, x)
+        self._fast[fk] = (ep, fn)
+        return fn(x)
 
     def scatter(self, x, root: int):
+        """Root-targeted scatter: binomial fan-out from root; the
+        ``direct`` all_to_all lowering (every rank ships its row, only
+        root's is meaningful) remains the latency-regime choice."""
         x = self._to_mesh(x)
+        n = self.comm.size
+        fk = ("scatter", x.shape, x.dtype, root)
+        ep = var.epoch()            # snapshot BEFORE the decision reads
+        hit = self._fast.get(fk)
+        if hit is not None and hit[0] == ep:
+            return hit[1](x)
+        alg = self._algorithm("scatter", x.nbytes // max(n, 1))
+        if alg == "binomial" and n == 1:
+            alg = "direct"
 
         def build():
-            def inner(b):                       # (1, N, *s) -> (1, *s)
-                y = jax.lax.all_to_all(b[0], AXIS, split_axis=0,
-                                       concat_axis=0, tiled=True)
-                return jax.lax.dynamic_slice_in_dim(y, root, 1, 0)
+            if alg == "binomial":
+                inner = self._binomial_scatter_inner(n, root)
+            else:
+                def inner(b):                   # (1, N, *s) -> (1, *s)
+                    y = jax.lax.all_to_all(b[0], AXIS, split_axis=0,
+                                           concat_axis=0, tiled=True)
+                    return jax.lax.dynamic_slice_in_dim(y, root, 1, 0)
             return self._smap(inner, x.ndim, x.ndim - 1)
-        return self._compiled(self._key("scatter", x, root),
-                              build, x)(x)
+        fn = self._compiled(self._key("scatter", x, n, root, alg),
+                            build, x)
+        self._fast[fk] = (ep, fn)
+        return fn(x)
 
     def alltoall(self, x):
         x = self._to_mesh(x)
@@ -738,6 +917,23 @@ class XlaCollComponent(Component):
             default="auto", enumerator=["auto", "direct", "pairwise"],
             help="Alltoall lowering: fused XLA all_to_all or explicit "
                  "pairwise exchange rounds")
+        var.var_register(
+            "coll", "xla", "reduce_algorithm", vtype="str",
+            default="auto",
+            enumerator=["auto", "alias", "rabenseifner_root"],
+            help="Reduce lowering: allreduce alias (one fused psum) or "
+                 "root-targeted redscat+binomial-collect (half the "
+                 "alias's wire traffic; sum ops)")
+        var.var_register(
+            "coll", "xla", "gather_algorithm", vtype="str",
+            default="auto", enumerator=["auto", "allgather", "binomial"],
+            help="Gather lowering: allgather alias (one fused op) or "
+                 "root-targeted binomial tree (1/n the wire bytes)")
+        var.var_register(
+            "coll", "xla", "scatter_algorithm", vtype="str",
+            default="auto", enumerator=["auto", "direct", "binomial"],
+            help="Scatter lowering: fused all_to_all or root-targeted "
+                 "binomial fan-out")
         var.var_register(
             "coll", "xla", "reduce_scatter_block_algorithm", vtype="str",
             default="auto", enumerator=["auto", "direct", "ring"],
