@@ -168,11 +168,13 @@ def _ab_matrix_child() -> None:
     world = MPI.get_comm_world()
     n = world.size
     rtt = _measure_rtt()
-    chunk = 50                  # bound unsynced depth on the host backend
+    chunk = 10                  # bound unsynced depth on the host backend
+    # (50 was still enough for 8-participant all_to_all rendezvous
+    # threads to starve the shared CPU thread pool intermittently)
     out = {"ranks": n}
 
     sizes = {"1MB": 1 << 20, "8MB": 8 << 20, "32MB": 32 << 20}
-    algs = ("direct", "ring", "rabenseifner")
+    algs = ("direct", "ring", "ring_segmented", "rabenseifner")
     ab = {}
     for label, nbytes in sizes.items():
         x = world.alloc((nbytes // 4,), np.float32, fill=1.0)
@@ -253,7 +255,7 @@ def main() -> None:
         args.size_mb = 64.0                    # keep CI-host runs sane
     if platform == "cpu":
         args.lat_iters = min(args.lat_iters, 300)
-    chunk = 50 if platform == "cpu" else 0   # bound unsynced host depth
+    chunk = 10 if platform == "cpu" else 0   # bound unsynced host depth
 
     rtt = _measure_rtt()
 

@@ -66,8 +66,8 @@ FIXED_RULES: Dict[str, List[Sequence]] = {
 # (the reference documents the same constraint per algorithm,
 # coll_base_allreduce.c:291-294).
 REORDERING = frozenset({
-    "ring", "hier", "recursive_doubling", "rabenseifner",
-    "rabenseifner_root",
+    "ring", "ring_segmented", "hier", "recursive_doubling",
+    "rabenseifner", "rabenseifner_root",
 })
 
 # Algorithms only defined for power-of-two communicator sizes.
@@ -118,6 +118,12 @@ def decide(func: str, comm_size: int, nbytes: int, multihost: bool,
             # op at every size. The root-targeted defaults below are
             # for ICI, where the traffic asymmetry is real.
             return _SYMMETRIC_FALLBACK[func]
+    if platform == "cpu" and func == "allreduce":
+        # Measured on the 8-rank host mesh (bench child allreduce_ab):
+        # rabenseifner <= direct at 1 MB and above; ring loses at every
+        # size. Keep the table consistent with those numbers.
+        return _match([[0, 0, "direct"], [0, 1 << 20, "rabenseifner"]],
+                      comm_size, nbytes)
     rules = FIXED_RULES.get(func)
     if not rules:
         return "direct"

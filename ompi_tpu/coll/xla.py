@@ -259,6 +259,72 @@ class XlaCollModule:
             return out.reshape(-1)[:total].reshape(shape)[None]
         return inner
 
+    def _ring_segmented_allreduce_inner(self, op, n, shape, nseg):
+        """Segmented double-buffered ring
+        (``coll_base_allreduce.c:345-357,622``): each ring chunk is
+        split into ``nseg`` segments and the per-segment
+        permute/combine pairs are unrolled inside every ring step, so
+        segment s+1's ppermute has no data dependency on segment s's
+        combine — XLA's async collective-permute
+        (collective-permute-start/done) can overlap transfer with
+        combine, the in-graph expression of the reference's two-deep
+        double-buffered inbufs. The reduce-scatter phase carries the
+        dependency chain (what you send at step t is what you combined
+        at t-1 — the reason segmentation, not step pipelining, is the
+        overlap tool); the allgather phase forwards whole chunks."""
+        total = int(np.prod(shape))
+        chunk = -(-total // n)
+        seg = -(-chunk // nseg)
+        chunkp = seg * nseg
+        perm = [(i, (i + 1) % n) for i in range(n)]
+
+        def inner(b):                    # block (1, *s)
+            x = b.reshape(-1)
+            x = jnp.pad(x, (0, n * chunkp - total))
+            buf = x.reshape(n, nseg, seg)
+            r = jax.lax.axis_index(AXIS)
+
+            def rs_step(buf, t):
+                send_idx = jnp.mod(r - t, n)
+                tgt = jnp.mod(r - t - 1, n)
+                send = jax.lax.dynamic_index_in_dim(buf, send_idx, 0,
+                                                    keepdims=False)
+                cur = jax.lax.dynamic_index_in_dim(buf, tgt, 0,
+                                                   keepdims=False)
+                parts = []
+                for s in range(nseg):    # unrolled: permute(s+1) is
+                    recvd = jax.lax.ppermute(   # independent of
+                        send[s], AXIS, perm=perm)  # combine(s)
+                    parts.append(op.fn(cur[s], recvd))
+                buf = jax.lax.dynamic_update_index_in_dim(
+                    buf, jnp.stack(parts), tgt, 0)
+                return buf, None
+
+            buf, _ = jax.lax.scan(rs_step, buf, jnp.arange(n - 1))
+            own = jnp.mod(r + 1, n)
+            cur = jax.lax.dynamic_index_in_dim(buf, own, 0,
+                                               keepdims=False)
+
+            def ag_step(carry, t):
+                buf, cur = carry
+                cur = jax.lax.ppermute(cur, AXIS, perm=perm)
+                idx = jnp.mod(r - t, n)
+                buf = jax.lax.dynamic_update_index_in_dim(buf, cur,
+                                                          idx, 0)
+                return (buf, cur), None
+
+            buf = jax.lax.dynamic_update_index_in_dim(buf, cur, own, 0)
+            (buf, _), _ = jax.lax.scan(ag_step, (buf, cur),
+                                       jnp.arange(n - 1))
+            return buf.reshape(-1)[:total].reshape(b.shape)
+        return inner
+
+    def _nseg(self, chunk_bytes: int) -> int:
+        """Segment count from the segsize MCA var (the tuned segsize
+        knob); unroll-bounded at 8."""
+        segsize = max(1, int(var.var_get("coll_xla_segsize", 1 << 20)))
+        return max(1, min(8, -(-chunk_bytes // segsize)))
+
     def _rd_allreduce_inner(self, op, n):
         """Explicit recursive doubling (butterfly): log2(n) ppermute
         exchanges with partner r XOR d
@@ -587,9 +653,17 @@ class XlaCollModule:
             if low is None:
                 alg = "direct"
 
+        # nseg is part of the executable's identity: a segsize var
+        # change must compile a new schedule, not hit the stale one.
+        nseg = (self._nseg(x.nbytes // max(n * n, 1))
+                if alg == "ring_segmented" else 0)
+
         def build():
             if alg == "ring":
                 inner = self._ring_allreduce_inner(op, n, x.shape[1:])
+            elif alg == "ring_segmented":
+                inner = self._ring_segmented_allreduce_inner(
+                    op, n, x.shape[1:], nseg)
             elif alg == "hier":
                 inner = self._hier_allreduce_inner(op, low, high)
             elif alg == "recursive_doubling":
@@ -608,7 +682,7 @@ class XlaCollModule:
                     return op.reduce_tree(g, axis=0)[None]
             return self._smap(inner, x.ndim, x.ndim)
         fn = self._compiled(
-            self._key("allreduce", x, op.uid, n, alg), build, x)
+            self._key("allreduce", x, op.uid, n, alg, nseg), build, x)
         self._fast[fk] = (ep, fn)
         return fn(x)
 
@@ -893,12 +967,19 @@ class XlaCollComponent(Component):
         var.var_register(
             "coll", "xla", "allreduce_algorithm", vtype="str",
             default="auto",
-            enumerator=["auto", "direct", "ring", "hier",
-                        "recursive_doubling", "rabenseifner"],
+            enumerator=["auto", "direct", "ring", "ring_segmented",
+                        "hier", "recursive_doubling", "rabenseifner"],
             help="Allreduce lowering: direct fused XLA collective, "
-                 "explicit ppermute segmented ring, han-style two-level "
-                 "hierarchy, recursive-doubling butterfly, or "
-                 "Rabenseifner redscat+allgather (auto: decision table)")
+                 "explicit ppermute ring (whole-chunk or segmented "
+                 "double-buffered), han-style two-level hierarchy, "
+                 "recursive-doubling butterfly, or Rabenseifner "
+                 "redscat+allgather (auto: decision table)")
+        var.var_register(
+            "coll", "xla", "segsize", vtype="int", default=1 << 20,
+            help="Segment size in bytes for segmented schedules (the "
+                 "tuned segsize knob): ring chunks are split into "
+                 "ceil(chunk/segsize) segments (max 8) so segment "
+                 "transfer overlaps the previous segment's combine")
         var.var_register(
             "coll", "xla", "allgather_algorithm", vtype="str",
             default="auto",

@@ -338,7 +338,14 @@ class Communicator:
         ``chunks`` is root's (N, *local) send buffer (host array or
         root-resident device array); returns the standard stacked
         (N, *local) buffer, one shard per rank. The fan-out is a
-        runtime placement (device_put / comm.put) over ICI."""
+        runtime placement (device_put / comm.put) over ICI.
+
+        Multi-controller: SPMD single-program semantics require every
+        controller to pass the same host value (the controller-
+        replicated convention every stacked builder uses — comm.put's
+        modex property); device arrays are rejected there because a
+        root-resident array is unreadable from the other controllers.
+        """
         self._validate_root(root)
         if check_addr(chunks) is None:
             self._err(ERR_ARG, "chunks must be a jax or numpy array")
@@ -347,6 +354,13 @@ class Communicator:
                       f"chunks must have leading axis {self.size}")
         self._coll("scatter")            # state checks + SPC/hooks
         if self.is_multiprocess:
+            if isinstance(chunks, jax.Array):
+                self._err(ERR_ARG,
+                          "multi-controller scatter_root needs a host "
+                          "array replicated on every controller (a "
+                          "root-resident device array cannot be read "
+                          "from the other controllers); use scatter() "
+                          "with the stacked sendbuf instead")
             return self.put(np.asarray(chunks))
         return jax.device_put(chunks, self.sharding)
 

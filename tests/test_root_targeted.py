@@ -150,12 +150,60 @@ def test_scatter_root_roundtrip(world, rng):
     np.testing.assert_allclose(np.asarray(back), chunks, rtol=1e-6)
 
 
-def test_auto_threshold_switches(world, force, rng):
-    """The decision table switches to the root-targeted schedule above
-    64 KiB per rank and the result stays correct either side."""
-    n = world.size
-    for elems in (16, 32 * 1024):         # 64 B vs 128 KiB per rank
-        x = rng.standard_normal((n, elems)).astype(np.float32)
-        y = world.reduce(world.stack(list(x)), MPI.SUM, root=1)
-        np.testing.assert_allclose(world.shard(y, 1), x.sum(0),
-                                   rtol=1e-3, atol=1e-4)
+def test_auto_threshold_switches(world, tmp_path, rng):
+    """Auto selection picks the root-targeted schedule above the rule
+    threshold and the alias below it. On the CPU test platform the
+    fixed table's symmetric fallback would mask the threshold logic, so
+    the tuned dynamic-rules file (which decide() consults FIRST,
+    bypassing platform fallbacks) carries the 64 KiB rule — also
+    covering the dynamic-rules path itself."""
+    import json
+    rules = tmp_path / "rules.json"
+    rules.write_text(json.dumps({"reduce": {"algorithm_rules": [
+        [0, 0, "alias"], [0, 64 << 10, "rabenseifner_root"]]}}))
+    var.var_set("coll_tuned_dynamic_rules", str(rules))
+    try:
+        n = world.size
+        xmod = world.c_coll["reduce"].device
+        for elems, want in ((16, "alias"),
+                            (32 * 1024, "rabenseifner_root")):
+            x = rng.standard_normal((n, elems)).astype(np.float32)
+            nbytes = elems * 4
+            assert xmod._algorithm("reduce", nbytes, True) == want
+            y = world.reduce(world.stack(list(x)), MPI.SUM, root=1)
+            np.testing.assert_allclose(world.shard(y, 1), x.sum(0),
+                                       rtol=1e-3, atol=1e-4)
+        keys = [k for k in xmod._cache
+                if k[0] == "reduce" and "rabenseifner_root" in k]
+        assert keys, "threshold never selected the root-targeted path"
+    finally:
+        var.var_set("coll_tuned_dynamic_rules", "")
+
+
+def test_ring_segmented_allreduce(world, force, rng):
+    """Segmented double-buffered ring (coll_base_allreduce.c:345-357):
+    correct at a size that produces multiple segments per chunk, with a
+    small forced segsize."""
+    force("coll_xla_allreduce_algorithm", "ring_segmented")
+    var.var_set("coll_xla_segsize", 256)        # tiny -> several segs
+    try:
+        n = world.size
+        x = rng.standard_normal((n, 515)).astype(np.float32)  # odd size
+        y = world.allreduce(world.stack(list(x)), MPI.SUM)
+        np.testing.assert_allclose(np.asarray(y)[0], x.sum(0),
+                                   rtol=1e-4, atol=1e-5)
+    finally:
+        var.var_set("coll_xla_segsize", 1 << 20)
+
+
+def test_ring_segmented_non_pow2(comm6, force, rng):
+    force("coll_xla_allreduce_algorithm", "ring_segmented")
+    var.var_set("coll_xla_segsize", 128)
+    try:
+        n = comm6.size
+        x = rng.standard_normal((n, 100)).astype(np.float32)
+        y = comm6.allreduce(comm6.stack(list(x)), MPI.SUM)
+        np.testing.assert_allclose(np.asarray(y)[0], x.sum(0),
+                                   rtol=1e-4, atol=1e-5)
+    finally:
+        var.var_set("coll_xla_segsize", 1 << 20)
