@@ -1,0 +1,9 @@
+"""btl — byte-transfer layer for per-rank (multi-controller) worlds.
+
+The reference reaches remote peers through BTL components
+(``opal/mca/btl/btl.h:1175``): tcp sockets for the inter-node tier,
+self for loopback. The TPU-native framework needs a byte transport only
+for the *per-rank* execution mode's point-to-point data plane (the DCN
+tier); collectives ride XLA over ICI. ``btl.tcp`` is that transport.
+"""
+from ompi_tpu.btl.tcp import TcpEndpoint  # noqa: F401

@@ -1,0 +1,177 @@
+"""btl/tcp — framed TCP byte transport between ranks of a per-rank world.
+
+Behavioral spec: ``opal/mca/btl/tcp`` — libevent-driven sockets carrying
+eager/rendezvous fragments between peers whose addresses were exchanged
+through the PMIx modex (``btl_tcp_component.c:109,498-520``); plus
+``btl/self`` loopback for same-process sends.
+
+TPU-native re-design: in the per-rank execution model each OS process is
+one MPI rank (``rank() == jax.process_index()``); point-to-point payloads
+move over this host-side DCN-tier transport while collectives ride XLA
+over ICI. The modex is the JAX coordination-service KV store (the PMIx
+stand-in): every rank binds an ephemeral listening port and publishes
+``ompi_tpu/btl/<rank> -> host:port``; peers resolve lazily on first send
+(the reference's lazy endpoint connect). One frame = 4-byte magic +
+8-byte header length + pickled header + raw payload bytes; numpy/jax
+arrays travel as raw buffers described by (dtype, shape) in the header —
+no pickling of bulk data. A per-connection reader thread delivers frames
+to the registered sink (the per-rank matching engine), playing the role
+of the BTL active-message callback into ob1's ``recv_frag_match``.
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+MAGIC = 0x7f4d5049          # "\x7fMPI"
+_LEN = struct.Struct("!IQQ")  # magic, header_len, payload_len
+
+
+def encode_payload(data: Any) -> Tuple[dict, bytes]:
+    """(descriptor, raw bytes). Arrays go as raw buffers; anything else
+    is pickled (the mpi4py generic-object convention)."""
+    try:
+        import jax
+        if isinstance(data, jax.Array):
+            data = np.asarray(data)
+    except Exception:
+        pass
+    if isinstance(data, np.ndarray):
+        arr = np.ascontiguousarray(data)
+        return ({"kind": "nd", "dtype": arr.dtype.str,
+                 "shape": arr.shape}, arr.tobytes())
+    return {"kind": "obj"}, pickle.dumps(data)
+
+
+def decode_payload(desc: dict, raw: bytes) -> Any:
+    if desc.get("kind") == "nd":
+        return np.frombuffer(raw, dtype=np.dtype(desc["dtype"])) \
+                 .reshape(desc["shape"]).copy()
+    return pickle.loads(raw)
+
+
+class TcpEndpoint:
+    """One per process: the rank's listen socket + lazy peer connections.
+
+    ``sink(header, payload_bytes)`` is called from reader threads for
+    every arriving frame; it must be thread-safe.
+    """
+
+    def __init__(self, rank: int, nprocs: int,
+                 kv_set: Callable[[str, str], None],
+                 kv_get: Callable[[str], str],
+                 sink: Callable[[dict, bytes], None]):
+        self.rank = rank
+        self.nprocs = nprocs
+        self._kv_get = kv_get
+        self.sink = sink
+        self._peers: Dict[int, socket.socket] = {}
+        self._peer_locks: Dict[int, threading.Lock] = {}
+        self._lock = threading.Lock()
+        self._closed = False
+
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(("127.0.0.1", 0))
+        self._listener.listen(max(nprocs, 8))
+        host, port = self._listener.getsockname()
+        kv_set(f"ompi_tpu/btl/{rank}", f"{host}:{port}")
+
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"btl-tcp-accept-{rank}")
+        self._accept_thread.start()
+
+    # -- receive side --------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return                       # listener closed
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            t = threading.Thread(target=self._read_loop, args=(conn,),
+                                 daemon=True,
+                                 name=f"btl-tcp-read-{self.rank}")
+            t.start()
+
+    def _read_loop(self, conn: socket.socket) -> None:
+        try:
+            while not self._closed:
+                head = self._read_exact(conn, _LEN.size)
+                if head is None:
+                    return
+                magic, hlen, plen = _LEN.unpack(head)
+                if magic != MAGIC:
+                    return                   # corrupt stream: drop conn
+                hraw = self._read_exact(conn, hlen)
+                praw = self._read_exact(conn, plen) if plen else b""
+                if hraw is None or praw is None:
+                    return
+                self.sink(pickle.loads(hraw), praw)
+        except OSError:
+            return
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    @staticmethod
+    def _read_exact(conn: socket.socket, n: int) -> Optional[bytes]:
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf.extend(chunk)
+        return bytes(buf)
+
+    # -- send side -----------------------------------------------------
+    def _connect(self, peer: int) -> socket.socket:
+        with self._lock:
+            s = self._peers.get(peer)
+            if s is not None:
+                return s
+        addr = self._kv_get(f"ompi_tpu/btl/{peer}")
+        host, port = addr.rsplit(":", 1)
+        s = socket.create_connection((host, int(port)), timeout=60)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        with self._lock:
+            # lost race: keep the first connection
+            cur = self._peers.setdefault(peer, s)
+            if cur is not s:
+                s.close()
+            self._peer_locks.setdefault(peer, threading.Lock())
+            return cur
+
+    def send_frame(self, peer: int, header: dict,
+                   payload: bytes = b"") -> None:
+        """Self-sends loop back without touching a socket (btl/self)."""
+        if peer == self.rank:
+            self.sink(header, payload)
+            return
+        s = self._connect(peer)
+        hraw = pickle.dumps(header)
+        msg = _LEN.pack(MAGIC, len(hraw), len(payload)) + hraw + payload
+        with self._peer_locks[peer]:
+            s.sendall(msg)
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._lock:
+            for s in self._peers.values():
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            self._peers.clear()

@@ -53,6 +53,12 @@ def _load_rules(path: str) -> Dict[str, Dict]:
     except (OSError, ValueError):
         rules = {}
     _rules_cache[path] = (mtime, rules)
+    # A reload changes decision inputs that the hot-path epoch memo
+    # (coll/xla allreduce _fast) otherwise can't see: bump the var
+    # epoch so warm (shape, dtype, op) entries re-decide. Without this,
+    # editing the rules file on disk would never take effect on warm
+    # entries — a regression vs the per-call lookup.
+    var.bump_epoch()
     return rules
 
 

@@ -188,9 +188,13 @@ class Communicator:
         instead — gather/allgather — exactly as real MPI requires)."""
         if isinstance(stacked, jax.Array) and self.is_multiprocess:
             for s in stacked.addressable_shards:
-                idx0 = s.index[0]
+                idx0 = s.index[0] if s.index else slice(None)
                 if idx0.start is not None and idx0.start == rank:
                     return np.asarray(s.data)[0]
+                if idx0.start is None:
+                    # fully-replicated shard (index slice(None)): every
+                    # rank's row is locally readable
+                    return np.asarray(s.data)[rank]
             self._err(ERR_RANK,
                       f"rank {rank}'s shard is not addressable from "
                       f"process {jax.process_index()}")
@@ -397,6 +401,7 @@ class Communicator:
         nothing round-trips through the host."""
         self._validate_stacked(sendbuf)
         self._validate_op(op)
+        self._require_local_views("reduce_scatter")
         if len(recvcounts) != self.size:
             self._err(ERR_COUNT, "recvcounts must have comm-size entries")
         total = int(sum(recvcounts))
@@ -452,6 +457,7 @@ class Communicator:
     # output) — the round-1 implementation round-tripped everything
     # through NumPy, the opposite of the framework's thesis.
     def _ragged(self, per_rank: Sequence[Any], what: str):
+        self._require_local_views(what)
         if len(per_rank) != self.size:
             self._err(ERR_COUNT, f"{what} needs one entry per rank")
         if all(check_addr(a) == LOCUS_DEVICE for a in per_rank):
@@ -460,9 +466,25 @@ class Communicator:
             arrs = [np.asarray(a).ravel() for a in per_rank]
         return arrs, [a.size for a in arrs]
 
+    def _require_local_views(self, what: str) -> None:
+        """The v-/neighbor-collectives return per-rank slices of the
+        stacked result; on a multi-controller communicator the result is
+        a non-fully-addressable global array those slices cannot read.
+        Raise the same clean guard the coll path uses (_to_mesh) instead
+        of jax's opaque non-addressable error."""
+        if self.is_multiprocess:
+            from ompi_tpu.core.errhandler import ERR_INTERN
+            self._err(ERR_INTERN,
+                      f"{what} returns per-rank views of the stacked "
+                      f"result, which a multi-controller world cannot "
+                      f"address; use fixed-count collectives, or the "
+                      f"per-rank execution model (mpirun --per-rank)")
+
     def _pad_stack(self, arrs, counts, m):
         """(N, m) padded stack; device-side when the inputs are device
-        arrays, multi-controller-safe either way."""
+        arrays. Single-controller only — every v-collective entry point
+        guards with _require_local_views first (the output side slices
+        per-rank views a multi-controller world cannot read)."""
         if arrs and isinstance(arrs[0], jax.Array):
             segs = [jax.numpy.pad(a, (0, m - a.size)) for a in arrs]
             stacked = jax.numpy.stack(segs)
@@ -525,6 +547,7 @@ class Communicator:
         """MPI_Alltoallv: ``send_chunks[i][j]`` is rank i's (ragged)
         chunk for rank j; returns ``recv`` with ``recv[j][i]`` = the
         chunk i sent to j (per-rank lists of DEVICE arrays)."""
+        self._require_local_views("alltoallv")
         if len(send_chunks) != self.size:
             self._err(ERR_COUNT, "alltoallv needs one row per rank")
         device_in = all(check_addr(c) == LOCUS_DEVICE
@@ -707,6 +730,22 @@ class Communicator:
     def _pml(self):
         eng = getattr(self, "_pml_engine", None)
         if eng is None:
+            if self.is_multiprocess:
+                # The stacked matching engine is controller-local dict
+                # handoff; in a multi-controller world a peer's shard
+                # lives on another process and the handoff would be
+                # silently wrong. Same clean guard the collectives path
+                # raises (coll/xla._to_mesh). Genuine cross-process
+                # pt2pt lives in the per-rank model (pml/perrank over
+                # btl/tcp) — launch via mpirun --per-rank.
+                from ompi_tpu.core.errhandler import ERR_INTERN
+                raise MPIError(
+                    ERR_INTERN,
+                    "stacked pt2pt is single-controller only: this "
+                    "communicator spans processes whose shards are not "
+                    "addressable here. Use the per-rank execution "
+                    "model (mpirun --per-rank) for cross-process "
+                    "send/recv, or collectives on this communicator.")
             from ompi_tpu.mca import var
             from ompi_tpu.pml import vprotocol  # registers pml_v_protocol
             from ompi_tpu.pml.stacked import MatchingEngine

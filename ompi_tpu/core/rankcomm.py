@@ -1,0 +1,698 @@
+"""RankCommunicator — the per-rank (multi-controller) execution model.
+
+Behavioral spec: the textbook MPI model every reference binding serves —
+``MPI_Comm_rank`` returns THIS process's rank
+(``ompi/mpi/c/comm_rank.c.in``), point-to-point moves bytes between
+processes (``ompi/mca/pml/ob1/pml_ob1_recvfrag.c:296-330`` matching),
+collectives are called by every member and return each caller its local
+result, and ``mpirun -n N`` launches N such processes
+(``ompi/tools/mpirun/main.c:157-180``).
+
+TPU-native re-design: one OS process == one MPI rank, bound 1:1 to the
+JAX coordination service (``rank() == jax.process_index()``). Two data
+planes, mirroring the reference's split between byte transports and
+(here) the ICI fabric:
+
+- **Host tier (btl/tcp)**: pt2pt and generic-object collectives run
+  textbook algorithms (binomial bcast/reduce, dissemination barrier,
+  pairwise alltoall — the coll/base registry,
+  ``coll_base_functions.h:185-320``) over the framed TCP transport, with
+  addresses modex'd through the coordination-service KV (the PMIx role).
+- **Device tier (XLA/ICI)**: collectives on ``jax.Array`` buffers
+  assemble a global array over the communicator's device mesh
+  (one shard per rank via ``make_array_from_single_device_arrays``) and
+  dispatch ONE compiled SPMD program using XLA collectives
+  (psum/all_gather/all_to_all/psum_scatter under ``shard_map``) — every
+  member calls the collective, which is exactly the multi-controller
+  contract jit requires. No bytes touch the host tier.
+
+Internal collective traffic rides a separate CID channel (``("c", cid)``)
+so it can never cross-match user point-to-point tags — MPI's hidden
+collective context id, re-created literally.
+
+CID agreement: communicator creation is collective, so a deterministic
+derivation (parent cid + per-parent creation sequence + color) gives
+every member the same child CID with zero extra traffic — the property
+the reference's iterative CID allreduce establishes
+(``comm_cid.c:61-109``).
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ompi_tpu.core import op as op_mod
+from ompi_tpu.core.errhandler import (ERR_ARG, ERR_COMM, ERR_COUNT, ERR_OP,
+                                      ERR_RANK, ERR_ROOT, ERRORS_ARE_FATAL,
+                                      Errhandler, MPIError)
+from ompi_tpu.core.group import Group, UNDEFINED
+from ompi_tpu.core.info import Info
+from ompi_tpu.core.request import Request, Status
+from ompi_tpu.pml.perrank import (ANY_SOURCE, ANY_TAG, PROC_NULL,
+                                  PerRankEngine, RankRequest, Router)
+from ompi_tpu.runtime import spc
+
+AXIS = "mpi_r"
+
+
+class _CollChannel:
+    """The hidden collective-context view of a communicator: same ranks,
+    separate CID, so internal messages never match user receives."""
+
+    def __init__(self, comm: "RankCommunicator"):
+        self._comm = comm
+        self.cid = ("c", comm.cid)
+
+    @property
+    def size(self) -> int:
+        return self._comm.size
+
+    def rank(self) -> int:
+        return self._comm.rank()
+
+    def world_rank_of(self, local: int) -> int:
+        return self._comm.world_rank_of(local)
+
+
+class RankCommunicator:
+    """A communicator whose caller is exactly one rank."""
+
+    is_per_rank = True
+
+    def __init__(self, group: Group, my_world_rank: int, router: Router, *,
+                 cid: Any = "w", name: str = "",
+                 parent: Optional["RankCommunicator"] = None,
+                 errhandler: Optional[Errhandler] = None,
+                 info: Optional[Info] = None):
+        self.group = group
+        self.router = router
+        self.cid = cid
+        self.name = name or f"comm#{cid}"
+        self.info = info.dup() if info else Info()
+        self.errhandler = errhandler or (
+            parent.errhandler if parent else ERRORS_ARE_FATAL)
+        self.attributes: Dict[int, Any] = {}
+        self.topo = None
+        self._freed = False
+        self._rank = group.rank_of(my_world_rank)
+        if self._rank == UNDEFINED:
+            raise MPIError(ERR_RANK,
+                           f"process world rank {my_world_rank} is not a "
+                           f"member of {self.name}")
+        self._my_world = my_world_rank
+        self._pml = PerRankEngine(self, router)
+        self._coll_pml = PerRankEngine(_CollChannel(self), router)
+        self._seq = itertools.count(1)          # collective sequence
+        self._create_seq = itertools.count(1)   # comm-creation sequence
+        self._dev_fns: Dict[Any, Callable] = {}
+        self._mesh_cache = None
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+    @property
+    def size(self) -> int:
+        return self.group.size
+
+    def rank(self) -> int:
+        """MPI_Comm_rank: this process's rank (comm_rank.c.in) — the
+        round-2 gap closed: per-rank worlds no longer report 0
+        everywhere."""
+        return self._rank
+
+    @property
+    def is_multiprocess(self) -> bool:
+        return True
+
+    def world_rank_of(self, local: int) -> int:
+        return self.group.world_ranks[local]
+
+    def _err(self, error_class: int, msg: str = ""):
+        return self.errhandler.invoke(self, error_class, msg)
+
+    def _check(self) -> None:
+        if self._freed:
+            raise MPIError(ERR_COMM, "communicator has been freed")
+
+    def _validate_root(self, root: int) -> int:
+        if not (0 <= root < self.size):
+            self._err(ERR_ROOT, f"root {root} out of range")
+        return root
+
+    def _validate_op(self, op) -> op_mod.Op:
+        if not isinstance(op, op_mod.Op) or op.fn is None:
+            self._err(ERR_OP, "invalid reduction op")
+        return op
+
+    # ==================================================================
+    # Point-to-point (textbook signatures: caller IS the rank)
+    # ==================================================================
+    def send(self, data: Any, dest: int, tag: int = 0) -> None:
+        self._check()
+        spc.record("pml_send", 1)
+        self._pml.send(data, dest, tag)
+
+    def isend(self, data: Any, dest: int, tag: int = 0) -> Request:
+        self._check()
+        spc.record("pml_send", 1)
+        return self._pml.send(data, dest, tag)
+
+    def ssend(self, data: Any, dest: int, tag: int = 0) -> None:
+        self._check()
+        spc.record("pml_send", 1)
+        self._pml.send(data, dest, tag, synchronous=True)
+
+    def bsend(self, data: Any, dest: int, tag: int = 0) -> None:
+        self.send(data, dest, tag)        # sends are always buffered
+
+    def recv(self, source: int = ANY_SOURCE, tag: int = ANY_TAG
+             ) -> Tuple[Any, Status]:
+        self._check()
+        spc.record("pml_recv", 1)
+        return self._pml.recv(source, tag)
+
+    def irecv(self, source: int = ANY_SOURCE,
+              tag: int = ANY_TAG) -> RankRequest:
+        self._check()
+        spc.record("pml_recv", 1)
+        return self._pml.irecv(source, tag)
+
+    def sendrecv(self, senddata: Any, dest: int, source: int = ANY_SOURCE,
+                 sendtag: int = 0, recvtag: int = ANY_TAG
+                 ) -> Tuple[Any, Status]:
+        """Deadlock-free by construction: the receive is posted before
+        the (eager, buffered) send."""
+        self._check()
+        req = self._pml.irecv(source, recvtag)
+        self._pml.send(senddata, dest, sendtag)
+        st = req.wait()
+        return req.get(), st
+
+    def probe(self, source: int = ANY_SOURCE, tag: int = ANY_TAG) -> Status:
+        self._check()
+        return self._pml.probe(source, tag)
+
+    def iprobe(self, source: int = ANY_SOURCE, tag: int = ANY_TAG):
+        self._check()
+        return self._pml.iprobe(source, tag)
+
+    def mprobe(self, source: int = ANY_SOURCE, tag: int = ANY_TAG):
+        self._check()
+        return self._pml.mprobe(source, tag)
+
+    def improbe(self, source: int = ANY_SOURCE, tag: int = ANY_TAG):
+        self._check()
+        flag, status = self._pml.iprobe(source, tag)
+        if not flag:
+            return False, None, None
+        return True, self._pml.mprobe(source, tag), status
+
+    def mrecv(self, message) -> Tuple[Any, Status]:
+        return self._pml.mrecv(message)
+
+    def send_init(self, data: Any, dest: int, tag: int = 0) -> Request:
+        self._check()
+        return Request(persistent_start=lambda: self._pml.send(
+            data, dest, tag))
+
+    def recv_init(self, source: int = ANY_SOURCE,
+                  tag: int = ANY_TAG) -> Request:
+        self._check()
+        return Request(persistent_start=lambda: self._pml.irecv(
+            source, tag))
+
+    # ==================================================================
+    # Collectives — host tier (textbook algorithms over btl/tcp)
+    # ==================================================================
+    def _tag(self) -> int:
+        """Per-collective sequence tag: calls are collective, so every
+        member draws the same value; successive collectives can never
+        cross-match even under wildcard-free FIFO reordering."""
+        return next(self._seq)
+
+    def _csend(self, dest: int, tag: int, data: Any) -> None:
+        self._coll_pml.send(data, dest, tag)
+
+    def _crecv(self, src: int, tag: int) -> Any:
+        data, _ = self._coll_pml.recv(src, tag)
+        return data
+
+    def barrier(self) -> None:
+        """Dissemination barrier: ceil(log2 n) rounds
+        (coll_base_barrier.c bruck/dissemination)."""
+        self._check()
+        spc.record("coll_barrier", 1)
+        n, r, t = self.size, self._rank, self._tag()
+        k = 1
+        while k < n:
+            self._csend((r + k) % n, t, None)
+            self._crecv((r - k) % n, t)
+            k <<= 1
+
+    def bcast(self, data: Any = None, root: int = 0) -> Any:
+        """Binomial-tree bcast (coll_base_bcast.c binomial): non-root
+        callers pass nothing and receive the root's value."""
+        self._check()
+        self._validate_root(root)
+        spc.record("coll_bcast", 1)
+        if isinstance(data, _dev_array_type()) and self._mesh() is not None:
+            return self._device_bcast(data, root)
+        n, t = self.size, self._tag()
+        vr = (self._rank - root) % n
+        mask = 1
+        while mask < n:                  # climb to my parent
+            if vr & mask:
+                data = self._crecv(((vr - mask) + root) % n, t)
+                break
+            mask <<= 1
+        mask >>= 1
+        while mask:                      # feed my subtree
+            if vr + mask < n:
+                self._csend(((vr + mask) + root) % n, t, data)
+            mask >>= 1
+        return data
+
+    def reduce(self, data: Any, op: op_mod.Op = op_mod.SUM,
+               root: int = 0) -> Any:
+        """Binomial reduce for commutative ops; linear ordered fold at
+        root otherwise (the ordering constraint of
+        coll_base_allreduce.c:291-294)."""
+        self._check()
+        self._validate_op(op)
+        self._validate_root(root)
+        spc.record("coll_reduce", 1)
+        n, t = self.size, self._tag()
+        if n == 1:
+            return data
+        if not op.commute:
+            rows = self.gather(data, root)
+            if self._rank != root:
+                return None
+            acc = rows[0]
+            for x in rows[1:]:
+                acc = _apply(op, acc, x)
+            return acc
+        vr = (self._rank - root) % n
+        acc = data
+        k = 1
+        while k < n:
+            if vr & k:
+                self._csend(((vr - k) + root) % n, t, acc)
+                return None
+            if vr + k < n:
+                acc = _apply(op, acc, self._crecv(((vr + k) + root) % n, t))
+            k <<= 1
+        return acc if self._rank == root else None
+
+    def allreduce(self, data: Any, op: op_mod.Op = op_mod.SUM) -> Any:
+        self._check()
+        self._validate_op(op)
+        spc.record("coll_allreduce", 1)
+        if isinstance(data, _dev_array_type()) and self._mesh() is not None:
+            return self._device_allreduce(data, op)
+        r = self.reduce(data, op, 0)
+        return self.bcast(r, 0)
+
+    def gather(self, data: Any, root: int = 0) -> Optional[List[Any]]:
+        """Linear gather (coll/basic): returns the rank-ordered list at
+        root, None elsewhere."""
+        self._check()
+        self._validate_root(root)
+        spc.record("coll_gather", 1)
+        n, t = self.size, self._tag()
+        if self._rank != root:
+            self._csend(root, t, data)
+            return None
+        out: List[Any] = [None] * n
+        out[root] = data
+        for s in range(n):
+            if s != root:
+                out[s] = self._crecv(s, t)
+        return out
+
+    def scatter(self, chunks: Optional[Sequence[Any]] = None,
+                root: int = 0) -> Any:
+        """Linear scatter: root passes one chunk per rank; every caller
+        gets its chunk."""
+        self._check()
+        self._validate_root(root)
+        spc.record("coll_scatter", 1)
+        n, t = self.size, self._tag()
+        if self._rank == root:
+            if chunks is None or len(chunks) != n:
+                self._err(ERR_COUNT, "root must pass one chunk per rank")
+            for d in range(n):
+                if d != root:
+                    self._csend(d, t, chunks[d])
+            return chunks[root]
+        return self._crecv(root, t)
+
+    def allgather(self, data: Any) -> List[Any]:
+        """Ring allgather (coll_base_allgather ring): n-1 rounds, each
+        forwarding the chunk received last round."""
+        self._check()
+        spc.record("coll_allgather", 1)
+        if isinstance(data, _dev_array_type()) and self._mesh() is not None:
+            return self._device_allgather(data)
+        n, r, t = self.size, self._rank, self._tag()
+        out: List[Any] = [None] * n
+        out[r] = data
+        cur = data
+        right, left = (r + 1) % n, (r - 1) % n
+        for s in range(n - 1):
+            req = self._coll_pml.irecv(left, t)
+            self._csend(right, t, cur)
+            req.wait()
+            cur = req.get()
+            out[(r - 1 - s) % n] = cur
+        return out
+
+    def alltoall(self, chunks: Sequence[Any]) -> List[Any]:
+        """Pairwise-exchange alltoall (coll_base_alltoall pairwise)."""
+        self._check()
+        spc.record("coll_alltoall", 1)
+        n, r, t = self.size, self._rank, self._tag()
+        if len(chunks) != n:
+            self._err(ERR_COUNT, "alltoall needs one chunk per peer")
+        if all(isinstance(c, _dev_array_type()) for c in chunks) \
+                and self._mesh() is not None and n > 1:
+            return self._device_alltoall(chunks)
+        out: List[Any] = [None] * n
+        out[r] = chunks[r]
+        for s in range(1, n):
+            dest, src = (r + s) % n, (r - s) % n
+            req = self._coll_pml.irecv(src, t)
+            self._csend(dest, t, chunks[dest])
+            req.wait()
+            out[src] = req.get()
+        return out
+
+    def scan(self, data: Any, op: op_mod.Op = op_mod.SUM) -> Any:
+        """Linear scan: inclusive prefix over ranks 0..r."""
+        self._check()
+        self._validate_op(op)
+        spc.record("coll_scan", 1)
+        n, r, t = self.size, self._rank, self._tag()
+        acc = data
+        if r > 0:
+            acc = _apply(op, self._crecv(r - 1, t), data)
+        if r + 1 < n:
+            self._csend(r + 1, t, acc)
+        return acc
+
+    def exscan(self, data: Any, op: op_mod.Op = op_mod.SUM) -> Any:
+        """Exclusive prefix: rank 0 gets None."""
+        self._check()
+        self._validate_op(op)
+        spc.record("coll_exscan", 1)
+        n, r, t = self.size, self._rank, self._tag()
+        prev = None if r == 0 else self._crecv(r - 1, t)
+        if r + 1 < n:
+            nxt = data if prev is None else _apply(op, prev, data)
+            self._csend(r + 1, t, nxt)
+        return prev
+
+    def reduce_scatter_block(self, chunks: Sequence[Any],
+                             op: op_mod.Op = op_mod.SUM) -> Any:
+        """chunks[j] is this rank's contribution for rank j; returns the
+        reduction of everyone's chunk for me."""
+        self._check()
+        self._validate_op(op)
+        spc.record("coll_reduce_scatter_block", 1)
+        if len(chunks) != self.size:
+            self._err(ERR_COUNT, "need one chunk per rank")
+        mine = self.alltoall(list(chunks))
+        acc = mine[0]
+        for x in mine[1:]:
+            acc = _apply(op, acc, x)
+        return acc
+
+    # -- nonblocking collectives (async over a worker thread) ----------
+    def _nb(self, fn: Callable, *args) -> Request:
+        req = RankRequest(ANY_SOURCE, ANY_TAG)
+        req._error: Optional[BaseException] = None
+        orig_wait = req.wait
+
+        def wait(timeout=None):
+            st = orig_wait(timeout)
+            if req._error is not None:           # surfaced at wait()
+                raise req._error
+            return st
+        req.wait = wait
+
+        def run():
+            from ompi_tpu.pml.perrank import _Msg
+            try:
+                req._deliver(_Msg(self._rank, 0, fn(*args)))
+            except BaseException as e:
+                req._error = e
+                req._complete = True
+                req._event.set()
+        threading.Thread(target=run, daemon=True).start()
+        return req
+
+    def ibarrier(self) -> Request:
+        return self._nb(self.barrier)
+
+    def ibcast(self, data: Any = None, root: int = 0) -> Request:
+        return self._nb(self.bcast, data, root)
+
+    def iallreduce(self, data: Any, op: op_mod.Op = op_mod.SUM) -> Request:
+        return self._nb(self.allreduce, data, op)
+
+    def iallgather(self, data: Any) -> Request:
+        return self._nb(self.allgather, data)
+
+    def ireduce(self, data: Any, op: op_mod.Op = op_mod.SUM,
+                root: int = 0) -> Request:
+        return self._nb(self.reduce, data, op, root)
+
+    # ==================================================================
+    # Collectives — device tier (XLA over the global mesh)
+    # ==================================================================
+    def _mesh(self):
+        """Mesh over one device per member rank (rank -> the first
+        device of that rank's process). None when some member has no
+        visible device (host tier handles it)."""
+        if self._mesh_cache is not None:
+            return self._mesh_cache or None
+        import jax
+        from jax.sharding import Mesh
+        by_proc: Dict[int, Any] = {}
+        for d in jax.devices():
+            by_proc.setdefault(getattr(d, "process_index", 0), d)
+        devs = []
+        for w in self.group.world_ranks:
+            d = by_proc.get(w)
+            if d is None:
+                self._mesh_cache = False
+                return None
+            devs.append(d)
+        self._mesh_cache = Mesh(np.array(devs, dtype=object), (AXIS,))
+        return self._mesh_cache
+
+    def _global(self, x):
+        """Assemble the (n, *local) global array from my local shard."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        mesh = self._mesh()
+        sh = NamedSharding(mesh, P(AXIS))
+        local = jax.device_put(x, mesh.devices[self._rank])
+        return jax.make_array_from_single_device_arrays(
+            (self.size,) + tuple(x.shape), sh,
+            [local.reshape((1,) + tuple(x.shape))])
+
+    def _local(self, garr):
+        """My shard of a mesh-sharded result, squeezed."""
+        shard = garr.addressable_shards[0].data
+        return shard[0]
+
+    def _dev_fn(self, key, builder):
+        fn = self._dev_fns.get(key)
+        if fn is None:
+            fn = self._dev_fns[key] = builder()
+        return fn
+
+    def _device_allreduce(self, x, op: op_mod.Op):
+        import jax
+        from jax.sharding import PartitionSpec as P
+        mesh = self._mesh()
+
+        def build():
+            def inner(s):
+                if op.xla_prim == "sum":
+                    return jax.lax.psum(s, AXIS)
+                if op.xla_prim == "max":
+                    return jax.lax.pmax(s, AXIS)
+                if op.xla_prim == "min":
+                    return jax.lax.pmin(s, AXIS)
+                g = jax.lax.all_gather(s, AXIS, axis=0, tiled=True)
+                return op.reduce_tree(g, axis=0)[None]
+            return jax.jit(jax.shard_map(
+                inner, mesh=mesh, in_specs=P(AXIS), out_specs=P(AXIS)))
+        fn = self._dev_fn(("ar", op.uid), build)
+        return self._local(fn(self._global(x)))
+
+    def _device_bcast(self, x, root: int):
+        import jax
+        from jax.sharding import PartitionSpec as P
+        mesh = self._mesh()
+
+        def build():
+            def inner(s):
+                g = jax.lax.all_gather(s, AXIS, axis=0, tiled=True)
+                return jax.lax.dynamic_slice_in_dim(g, root, 1, 0)
+            return jax.jit(jax.shard_map(
+                inner, mesh=mesh, in_specs=P(AXIS), out_specs=P(AXIS)))
+        fn = self._dev_fn(("bc", root), build)
+        return self._local(fn(self._global(x)))
+
+    def _device_allgather(self, x) -> List[Any]:
+        import jax
+        from jax.sharding import PartitionSpec as P
+        mesh = self._mesh()
+
+        def build():
+            def inner(s):
+                return jax.lax.all_gather(s, AXIS, axis=0, tiled=True)[None]
+            return jax.jit(jax.shard_map(
+                inner, mesh=mesh, in_specs=P(AXIS), out_specs=P(AXIS)))
+        fn = self._dev_fn(("ag",), build)
+        g = self._local(fn(self._global(x)))           # (n, *local)
+        return [g[i] for i in range(self.size)]
+
+    def _device_alltoall(self, chunks: Sequence[Any]) -> List[Any]:
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+        mesh = self._mesh()
+
+        def build():
+            def inner(s):                  # s: (1, n, *c)
+                # split the peer axis, land chunk-from-rank-i at row i,
+                # then restore the (1, n, *c) local block layout
+                return jnp.moveaxis(
+                    jax.lax.all_to_all(s, AXIS, split_axis=1,
+                                       concat_axis=0), 0, 1)
+            return jax.jit(jax.shard_map(
+                inner, mesh=mesh, in_specs=P(AXIS), out_specs=P(AXIS)))
+        fn = self._dev_fn(("a2a",), build)
+        x = jnp.stack(list(chunks))                    # (n, *c)
+        g = self._local(fn(self._global(x)))           # (n, *c) received
+        return [g[i] for i in range(self.size)]
+
+    # ==================================================================
+    # Communicator algebra (collective; deterministic CIDs)
+    # ==================================================================
+    def split(self, color: int, key: int = 0
+              ) -> Optional["RankCommunicator"]:
+        """MPI_Comm_split (comm.c:749), textbook signature: each caller
+        passes ITS color/key and receives its child (or None)."""
+        self._check()
+        seq = next(self._create_seq)
+        rows = self.allgather((color, key))
+        if color == UNDEFINED:
+            return None
+        members = sorted((r for r in range(self.size)
+                          if rows[r][0] == color),
+                         key=lambda r: (rows[r][1], r))
+        g = Group([self.group.world_ranks[r] for r in members])
+        return RankCommunicator(
+            g, self._my_world, self.router,
+            cid=("s", self.cid, seq, color),
+            name=f"{self.name}.split({color})", parent=self,
+            errhandler=self.errhandler)
+
+    def split_type(self, split_type: int, key: int = 0):
+        if split_type == UNDEFINED:
+            return None
+        if split_type == 2:                 # COMM_TYPE_HWTHREAD
+            color = self._rank
+        else:                               # SHARED / NUMA: same host
+            import socket
+            names = self.allgather(socket.gethostname())
+            color = names.index(names[self._rank])
+        return self.split(color, key)
+
+    def dup(self, info: Optional[Info] = None) -> "RankCommunicator":
+        self._check()
+        seq = next(self._create_seq)
+        self.barrier()                      # dup is collective
+        return RankCommunicator(
+            Group(self.group.world_ranks), self._my_world, self.router,
+            cid=("d", self.cid, seq), name=f"{self.name}.dup",
+            parent=self, errhandler=self.errhandler,
+            info=info or self.info)
+
+    def create(self, group: Group) -> Optional["RankCommunicator"]:
+        self._check()
+        seq = next(self._create_seq)
+        self.barrier()
+        if group.rank_of(self._my_world) == UNDEFINED:
+            return None
+        return RankCommunicator(
+            group, self._my_world, self.router,
+            cid=("g", self.cid, seq, tuple(group.world_ranks)),
+            name=f"{self.name}.create", parent=self,
+            errhandler=self.errhandler)
+
+    def free(self) -> None:
+        self._pml.close()
+        self._coll_pml.close()
+        self._freed = True
+
+    # -- attributes / naming -------------------------------------------
+    def set_attr(self, keyval: int, value: Any) -> None:
+        self.attributes[keyval] = value
+
+    def get_attr(self, keyval: int) -> Tuple[bool, Any]:
+        if keyval in self.attributes:
+            return True, self.attributes[keyval]
+        return False, None
+
+    def set_errhandler(self, errh: Errhandler) -> None:
+        self.errhandler = errh
+
+    def get_errhandler(self) -> Errhandler:
+        return self.errhandler
+
+    def set_name(self, name: str) -> None:
+        self.name = name
+
+    def get_name(self) -> str:
+        return self.name
+
+    def abort(self, errorcode: int = 1):
+        import os
+        import sys
+        sys.stderr.write(f"MPI_Abort on {self.name} "
+                         f"errorcode={errorcode}\n")
+        sys.stderr.flush()
+        os._exit(errorcode)
+
+    def __repr__(self):
+        return (f"RankCommunicator({self.name}, rank={self._rank}/"
+                f"{self.size}, cid={self.cid!r})")
+
+
+def _apply(op: op_mod.Op, a: Any, b: Any) -> Any:
+    """Apply a reduction combiner on the host tier: numpy in, numpy out
+    (op combiners are jax-traceable and accept numpy operands)."""
+    if isinstance(a, np.ndarray) or isinstance(b, np.ndarray):
+        return np.asarray(op.fn(a, b))
+    try:
+        import jax
+        if isinstance(a, jax.Array):
+            return op.fn(a, b)
+    except Exception:
+        pass
+    r = op.fn(np.asarray(a), np.asarray(b))
+    r = np.asarray(r)
+    return r.item() if r.ndim == 0 else r
+
+
+def _dev_array_type():
+    import jax
+    return jax.Array

@@ -137,6 +137,15 @@ def epoch() -> int:
     return _epoch
 
 
+def bump_epoch() -> None:
+    """Invalidate epoch-keyed memos for a decision-input change the var
+    store itself cannot observe (e.g. the tuned dynamic-rules file
+    reloading on mtime change)."""
+    global _epoch
+    with _lock:
+        _epoch += 1
+
+
 def var_set(full: str, value: Any, source: str = SOURCE_SET) -> None:
     """Programmatic override (highest precedence)."""
     global _epoch

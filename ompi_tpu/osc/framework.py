@@ -43,6 +43,19 @@ class Win:
     def __init__(self, comm, size: int, dtype=np.float32,
                  buffer: Optional[Any] = None, name: str = ""):
         self.comm = comm
+        if getattr(comm, "is_multiprocess", False):
+            # Window state is controller-local; in a multi-controller
+            # world remote shards are not addressable and put/get would
+            # be silently wrong — the same clean guard the collectives
+            # path raises (coll/xla._to_mesh). Spec for the real thing:
+            # osc_rdma_comm.c remote-region tables.
+            from ompi_tpu.core.errhandler import ERR_INTERN
+            raise MPIError(
+                ERR_INTERN,
+                "RMA windows are single-controller only: this "
+                "communicator spans processes. Multi-controller RMA is "
+                "not implemented; use collectives or the per-rank "
+                "execution model's pt2pt instead.")
         if buffer is not None:
             if buffer.ndim < 2 or buffer.shape[0] != comm.size:
                 raise MPIError(ERR_ARG,

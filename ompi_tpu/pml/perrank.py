@@ -1,0 +1,292 @@
+"""pml/perrank — the per-rank (multi-controller) matching engine.
+
+Behavioral spec: ob1's receive-side matching
+(``ompi/mca/pml/ob1/pml_ob1_recvfrag.c:296-330``): arriving fragments are
+matched against posted receives (source/tag with wildcards); unmatched
+fragments queue in arrival order; ordering is FIFO per (source, comm) —
+MPI's non-overtaking rule. Unlike the single-controller stacked engine,
+this one serves exactly ONE rank per process, frames arrive from btl/tcp
+reader threads, and a blocking receive genuinely blocks — the matching
+send is produced by another OS process, so recv-before-send is the
+natural order (the reference's semantics the stacked engine cannot
+express).
+
+Synchronous send (MPI_Ssend): the sender attaches an ack id; the
+receiver's match emits a control frame back; the sender's request
+completes on the ack — the rendezvous-ACK handshake of
+``pml_ob1_sendreq.h:389-460`` reduced to its observable semantics.
+
+Frame routing: one process-wide :class:`Router` owns the TcpEndpoint and
+demultiplexes frames by communicator CID; frames for a CID whose engine
+is not yet constructed (a peer raced ahead through comm creation) wait in
+a pending queue — the reference's "non-matching fragments held until the
+communicator exists" behavior (comm_cid.c activation).
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional, Tuple
+
+from ompi_tpu.btl.tcp import TcpEndpoint, decode_payload, encode_payload
+from ompi_tpu.core.errhandler import ERR_PENDING, ERR_RANK, ERR_TAG, MPIError
+from ompi_tpu.core.request import Request, Status
+
+ANY_SOURCE = -1
+ANY_TAG = -1
+PROC_NULL = -2
+
+
+class Router:
+    """Process-wide frame router: CID -> engine, plus the ack table."""
+
+    def __init__(self, rank: int, nprocs: int, kv_set, kv_get):
+        self.rank = rank
+        self.nprocs = nprocs
+        self._engines: Dict[Any, "PerRankEngine"] = {}
+        self._pending: Dict[Any, List[Tuple[dict, bytes]]] = {}
+        self._acks: Dict[int, threading.Event] = {}
+        self._ack_ids = itertools.count(1)
+        self._lock = threading.Lock()
+        self.endpoint = TcpEndpoint(rank, nprocs, kv_set, kv_get,
+                                    self._deliver)
+
+    def register(self, cid, engine: "PerRankEngine") -> None:
+        with self._lock:
+            self._engines[cid] = engine
+            backlog = self._pending.pop(cid, [])
+        for header, raw in backlog:
+            engine._incoming(header, raw)
+
+    def unregister(self, cid) -> None:
+        with self._lock:
+            self._engines.pop(cid, None)
+
+    def new_ack(self) -> Tuple[int, threading.Event]:
+        aid = next(self._ack_ids)
+        ev = threading.Event()
+        with self._lock:
+            self._acks[aid] = ev
+        return aid, ev
+
+    def _deliver(self, header: dict, raw: bytes) -> None:
+        """Called from btl reader threads (and loopback sends)."""
+        if header.get("ctl") == "ack":
+            with self._lock:
+                ev = self._acks.pop(header["ack_id"], None)
+            if ev is not None:
+                ev.set()
+            return
+        cid = header["cid"]
+        with self._lock:
+            eng = self._engines.get(cid)
+            if eng is None:
+                self._pending.setdefault(cid, []).append((header, raw))
+                return
+        eng._incoming(header, raw)
+
+    def send_ack(self, world_rank: int, ack_id: int) -> None:
+        self.endpoint.send_frame(world_rank, {"ctl": "ack",
+                                              "ack_id": ack_id})
+
+    def close(self) -> None:
+        self.endpoint.close()
+
+
+class _Msg:
+    __slots__ = ("src", "tag", "data", "ack")
+
+    def __init__(self, src: int, tag: int, data: Any,
+                 ack: Optional[Tuple[int, int]] = None):
+        self.src = src                  # comm-local source rank
+        self.tag = tag
+        self.data = data
+        self.ack = ack                  # (sender world rank, ack id)
+
+
+class RankRequest(Request):
+    """A receive (or synchronous-send) request completed by the engine
+    from a btl reader thread; wait blocks on a real Event."""
+
+    def __init__(self, src: int, tag: int):
+        super().__init__(arrays=[])
+        self._complete = False
+        self._event = threading.Event()
+        self.status = Status(source=src, tag=tag)
+
+    def _deliver(self, msg: _Msg) -> None:
+        self._result = msg.data
+        self.status.source = msg.src
+        self.status.tag = msg.tag
+        self.status.count = int(getattr(msg.data, "size", 1) or 1)
+        self._complete = True
+        self._event.set()
+
+    def test(self):
+        return (True, self.status) if self._complete else (False, None)
+
+    def wait(self, timeout: Optional[float] = None):
+        if not self._event.wait(timeout if timeout is not None else 600):
+            raise MPIError(ERR_PENDING,
+                           "recv timed out waiting for a matching send")
+        return self.status
+
+
+class PerRankEngine:
+    """Matching state for ONE rank of one communicator.
+
+    ``comm`` provides ``cid``, ``size``, ``rank()``, and
+    ``world_rank_of(local_rank)`` for endpoint addressing.
+    """
+
+    def __init__(self, comm, router: Router):
+        self.comm = comm
+        self.router = router
+        self._lock = threading.Lock()
+        self.unexpected: Dict[int, Deque[_Msg]] = {}   # src -> FIFO
+        self._arrival: Deque[int] = deque()            # src arrival order
+        self.posted: List[Tuple[int, int, RankRequest]] = []
+        router.register(comm.cid, self)
+
+    # -- wire side -----------------------------------------------------
+    def _incoming(self, header: dict, raw: bytes) -> None:
+        msg = _Msg(header["src"], header["tag"],
+                   decode_payload(header["desc"], raw),
+                   ack=(header["wsrc"], header["ack_id"])
+                   if header.get("ack_id") else None)
+        with self._lock:
+            for i, (src, tag, req) in enumerate(self.posted):
+                if ((src == ANY_SOURCE or src == msg.src)
+                        and (tag == ANY_TAG or tag == msg.tag)):
+                    self.posted.pop(i)
+                    matched = req
+                    break
+            else:
+                self.unexpected.setdefault(msg.src, deque()).append(msg)
+                self._arrival.append(msg.src)
+                matched = None
+        if matched is not None:
+            self._ack(msg)
+            matched._deliver(msg)
+
+    def _ack(self, msg: _Msg) -> None:
+        if msg.ack is not None:
+            wsrc, aid = msg.ack
+            self.router.send_ack(wsrc, aid)
+
+    def _take_unexpected(self, source: int, tag: int,
+                         remove: bool = True) -> Optional[_Msg]:
+        """Caller holds self._lock. Wildcard source scans in arrival
+        order (the unexpected queue's FIFO across sources)."""
+        srcs = (list(dict.fromkeys(self._arrival))
+                if source == ANY_SOURCE else [source])
+        for s in srcs:
+            q = self.unexpected.get(s)
+            if not q:
+                continue
+            for i, msg in enumerate(q):
+                if tag == ANY_TAG or tag == msg.tag:
+                    if remove:
+                        del q[i]
+                        try:
+                            self._arrival.remove(s)
+                        except ValueError:
+                            pass
+                    return msg
+        return None
+
+    # -- send side -----------------------------------------------------
+    def send(self, data: Any, dest: int, tag: int = 0,
+             synchronous: bool = False) -> Request:
+        if dest == PROC_NULL:
+            return Request.completed()
+        if not (0 <= dest < self.comm.size):
+            raise MPIError(ERR_RANK, f"bad destination rank {dest}")
+        if not isinstance(tag, int) or tag < 0:
+            raise MPIError(ERR_TAG, f"send tag must be an int >= 0, "
+                                    f"got {tag!r}")
+        desc, raw = encode_payload(data)
+        header = {"cid": self.comm.cid, "src": self.comm.rank(),
+                  "tag": tag, "desc": desc}
+        ev = None
+        if synchronous:
+            aid, ev = self.router.new_ack()
+            header["ack_id"] = aid
+            header["wsrc"] = self.comm.world_rank_of(self.comm.rank())
+        self.router.endpoint.send_frame(self.comm.world_rank_of(dest),
+                                        header, raw)
+        if ev is not None and not ev.wait(600):
+            raise MPIError(ERR_PENDING,
+                           "ssend timed out waiting for the receive")
+        return Request.completed()
+
+    # -- receive side --------------------------------------------------
+    def irecv(self, source: int = ANY_SOURCE,
+              tag: int = ANY_TAG) -> RankRequest:
+        req = RankRequest(source, tag)
+        if source == PROC_NULL:
+            req._deliver(_Msg(PROC_NULL, tag, None))
+            return req
+        with self._lock:
+            msg = self._take_unexpected(source, tag)
+            if msg is None:
+                self.posted.append((source, tag, req))
+        if msg is not None:
+            self._ack(msg)
+            req._deliver(msg)
+        return req
+
+    def recv(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
+             timeout: Optional[float] = None) -> Tuple[Any, Status]:
+        req = self.irecv(source, tag)
+        st = req.wait(timeout)
+        return req.get(), st
+
+    # -- probe ---------------------------------------------------------
+    def iprobe(self, source: int = ANY_SOURCE, tag: int = ANY_TAG
+               ) -> Tuple[bool, Optional[Status]]:
+        with self._lock:
+            msg = self._take_unexpected(source, tag, remove=False)
+        if msg is None:
+            return False, None
+        return True, Status(source=msg.src, tag=msg.tag,
+                            count=int(getattr(msg.data, "size", 1) or 1))
+
+    def probe(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
+              timeout: float = 600, poll: float = 0.0005) -> Status:
+        """Blocking probe: spin-wait (with backoff) until a matching
+        message is pending — the opal_progress poll loop."""
+        import time
+        deadline = time.monotonic() + timeout
+        while True:
+            ok, st = self.iprobe(source, tag)
+            if ok:
+                return st
+            if time.monotonic() > deadline:
+                raise MPIError(ERR_PENDING, "probe timed out")
+            time.sleep(poll)
+            poll = min(poll * 2, 0.01)
+
+    def mprobe(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
+               timeout: float = 600) -> _Msg:
+        import time
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._lock:
+                msg = self._take_unexpected(source, tag)
+            if msg is not None:
+                self._ack(msg)
+                return msg
+            if time.monotonic() > deadline:
+                raise MPIError(ERR_PENDING, "mprobe timed out")
+            time.sleep(0.0005)
+
+    @staticmethod
+    def mrecv(msg: _Msg) -> Tuple[Any, Status]:
+        return msg.data, Status(source=msg.src, tag=msg.tag,
+                                count=int(getattr(msg.data, "size", 1)
+                                          or 1))
+
+    def close(self) -> None:
+        self.router.unregister(self.comm.cid)

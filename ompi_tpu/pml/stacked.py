@@ -18,8 +18,13 @@ protocol switch (eager vs rendezvous vs RDMA, ``pml_ob1_sendreq.h:389``)
 collapses: every transfer is an HBM-resident reference handoff until a
 rank actually reads it. Partitioned pt2pt rides a separate matching
 *channel* so its internal fragments can never cross-match user tags.
-Cross-process pt2pt (multi-controller) rides the same interface over
-``jax.lax.ppermute`` schedules — see ``InGraphComm.ppermute``.
+
+This engine is SINGLE-CONTROLLER ONLY: in a stacked multi-controller
+world a rank's shard may live on another process, so the dict handoff
+would be silently wrong — ``Communicator.send/recv`` guards against it.
+Genuine cross-process pt2pt lives in the per-rank execution model
+(``ompi_tpu.pml.perrank`` over ``btl/tcp``), where one process == one
+rank and bytes really move.
 """
 from __future__ import annotations
 

@@ -55,6 +55,11 @@ def _register_base_vars() -> None:
                      help="process_id for jax.distributed (-1 = from env)")
     var.var_register("mpi", "base", "num_processes", vtype="int", default=0,
                      help="num_processes for jax.distributed (0 = from env)")
+    var.var_register("mpi", "base", "per_rank", vtype="bool", default=False,
+                     help="Per-rank execution model: one OS process == "
+                          "one MPI rank (rank() == jax.process_index()); "
+                          "pt2pt over btl/tcp, collectives over XLA or "
+                          "textbook p2p algorithms")
 
 
 def init(requested: int = THREAD_SINGLE,
@@ -86,6 +91,9 @@ def init(requested: int = THREAD_SINGLE,
             pass
         jax.distributed.initialize(**kw)       # PMIx-equivalent wire-up
 
+    if var.var_get("mpi_base_per_rank", False):
+        return _init_per_rank(requested)
+
     if devices is None:
         devices = list(jax.devices())
         nr = var.var_get("mpi_base_num_ranks", 0)
@@ -108,6 +116,52 @@ def init(requested: int = THREAD_SINGLE,
     return _state["thread_level"]
 
 
+def _kv_client():
+    """The coordination-service KV store (PMIx modex equivalent)."""
+    from jax._src import distributed
+    client = distributed.global_state.client
+    if client is None:
+        raise MPIError(ERR_OTHER,
+                       "per-rank mode requires jax.distributed "
+                       "(set mpi_base_distributed or launch via "
+                       "mpirun --per-rank)")
+    return client
+
+
+def _init_per_rank(requested: int) -> int:
+    """Per-rank world bring-up: rank() == jax.process_index(), one
+    COMM_WORLD member per process, pt2pt endpoints modex'd through the
+    coordination-service KV (the reference's add_procs + modex steps,
+    instance.c:508-569)."""
+    from ompi_tpu.core.group import Group
+    from ompi_tpu.core.rankcomm import RankCommunicator
+    from ompi_tpu.pml.perrank import Router
+
+    client = _kv_client()
+    rank = jax.process_index()
+    nprocs = jax.process_count()
+    router = Router(rank, nprocs, client.key_value_set,
+                    lambda k: client.blocking_key_value_get(k, 120_000))
+    world = RankCommunicator(Group(range(nprocs)), rank, router,
+                             cid="w", name="MPI_COMM_WORLD")
+    self_comm = RankCommunicator(Group([rank]), rank, router,
+                                 cid=("self", rank),
+                                 name="MPI_COMM_SELF")
+    # init fence (ompi_mpi_init.c:434-447): nobody proceeds until every
+    # rank's endpoint is published.
+    client.wait_at_barrier("ompi_tpu_init", 120_000)
+
+    INFO_ENV.set("command", os.environ.get("_", ""))
+    INFO_ENV.set("maxprocs", str(nprocs))
+    INFO_ENV.set("host", socket.gethostname())
+    INFO_ENV.set("arch", jax.devices()[0].platform)
+
+    _state.update(initialized=True, finalized=False, world=world,
+                  self=self_comm, router=router, t0=time.perf_counter(),
+                  thread_level=min(requested, THREAD_MULTIPLE))
+    return _state["thread_level"]
+
+
 def finalize() -> None:
     if not _state["initialized"] or _state["finalized"]:
         raise MPIError(ERR_OTHER, "MPI not initialized or already finalized")
@@ -118,6 +172,13 @@ def finalize() -> None:
             w.barrier()
     except Exception:
         pass
+    router = _state.pop("router", None)
+    if router is not None:
+        try:
+            _kv_client().wait_at_barrier("ompi_tpu_fini", 120_000)
+        except Exception:
+            pass
+        router.close()
     _state["finalized"] = True
     _state["world"] = None
     _state["self"] = None

@@ -12,6 +12,12 @@ TPU-native re-design: placement is device binding.
   populate the jax.distributed coordination-service vars (the PMIx
   stand-in); one controller per host, each contributing its local
   devices.
+- Per-rank: ``mpirun --per-rank -n N prog.py`` takes the PRRTE DVM role
+  itself — fork/exec N rank processes on this host (each one MPI rank,
+  ``rank() == jax.process_index()``), wire them to a local coordination
+  service, wait for all, and propagate the first failure
+  (``main.c:157-180``'s process-boundary role, without the external
+  daemon).
 ``--mca k v`` translates to ``OMPI_TPU_MCA_<k>`` exactly like the
 reference's ``--mca`` -> ``OMPI_MCA_*`` env translation.
 """
@@ -19,6 +25,9 @@ from __future__ import annotations
 
 import argparse
 import os
+import signal
+import socket
+import subprocess
 import sys
 
 
@@ -57,9 +66,62 @@ def parse(argv):
                          "(multi-host)")
     ap.add_argument("--num-hosts", type=int, default=0)
     ap.add_argument("--host-id", type=int, default=None)
+    ap.add_argument("--per-rank", action="store_true",
+                    help="one OS process per MPI rank "
+                         "(rank() == process_index)")
+    ap.add_argument("--timeout", type=float, default=0,
+                    help="per-rank mode: kill the job after this many "
+                         "seconds (0 = no limit)")
     ap.add_argument("program", nargs=argparse.REMAINDER,
                     help="program and its args")
     return ap.parse_args(argv)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def run_per_rank(args, prog) -> int:
+    """Spawn N rank processes (the PRRTE daemon's fork/exec role) and
+    reap them; first nonzero exit aborts the job, as mpirun does."""
+    n = args.n or 2
+    coord = args.coordinator or f"127.0.0.1:{_free_port()}"
+    procs = []
+    for r in range(n):
+        env = dict(os.environ)
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        parts = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+                 if p]
+        if pkg_root not in parts:
+            env["PYTHONPATH"] = os.pathsep.join([pkg_root] + parts)
+        env["OMPI_TPU_MCA_mpi_base_distributed"] = "1"
+        env["OMPI_TPU_MCA_mpi_base_per_rank"] = "1"
+        env["OMPI_TPU_MCA_mpi_base_coordinator"] = coord
+        env["OMPI_TPU_MCA_mpi_base_num_processes"] = str(n)
+        env["OMPI_TPU_MCA_mpi_base_process_id"] = str(r)
+        for k, v in args.mca or []:
+            env[f"OMPI_TPU_MCA_{k}"] = v
+        procs.append(subprocess.Popen(prog, env=env))
+    rc = 0
+    try:
+        for p in procs:
+            prc = p.wait(timeout=args.timeout or None)
+            rc = rc or prc
+    except subprocess.TimeoutExpired:
+        rc = 124
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+    return rc
 
 
 def main(argv=None) -> None:
@@ -67,10 +129,12 @@ def main(argv=None) -> None:
     if not args.program:
         sys.stderr.write("mpirun: no program given\n")
         raise SystemExit(2)
-    env = build_env(args, os.environ)
     prog = args.program
     if prog[0].endswith(".py"):
         prog = [sys.executable] + prog
+    if args.per_rank:
+        raise SystemExit(run_per_rank(args, prog))
+    env = build_env(args, os.environ)
     os.execvpe(prog[0], prog, env)      # exec shim, like mpirun->prterun
 
 

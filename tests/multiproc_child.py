@@ -55,6 +55,34 @@ def main() -> None:
     got = world.shard(y2, 2 * pi)
     assert np.allclose(got, expect), (got, expect)
 
+    # No silent wrong answers (round-2 VERDICT missing #2): stacked
+    # pt2pt / RMA / SHMEM must raise the clean multi-controller guard,
+    # not hand back another controller's stale dict state.
+    from ompi_tpu.core.errhandler import MPIError
+    for fn in (lambda: world.send(np.zeros(2), 0, 1),
+               lambda: world.recv(0, dst=1),
+               lambda: world.probe(0)):
+        try:
+            fn()
+        except MPIError as e:
+            assert "single-controller" in str(e), e
+        else:
+            raise AssertionError("stacked pt2pt did not guard")
+    try:
+        from ompi_tpu.osc.framework import Win
+        Win(world, 8)
+    except MPIError as e:
+        assert "single-controller" in str(e), e
+    else:
+        raise AssertionError("OSC window did not guard")
+    try:
+        from ompi_tpu.shmem.api import ShmemCtx
+        ShmemCtx(world, heap_size=16)
+    except MPIError as e:
+        assert "single-controller" in str(e), e
+    else:
+        raise AssertionError("SHMEM ctx did not guard")
+
     # barrier across controllers + a sub-communicator that spans both
     world.barrier()
     subs = world.split([r % 2 for r in range(4)])     # {0,2} and {1,3}
