@@ -76,7 +76,7 @@ class Communicator:
             raise MPIError(ERR_ARG, "devices must match group size")
         self.group = group
         self.devices = tuple(devices)
-        self.cid = _next_cid()
+        self.cid = self._alloc_cid()
         self.name = name or f"comm#{self.cid}"
         self.info = info.dup() if info else Info()
         self.errhandler = errhandler or parent_errh(parent)
@@ -86,12 +86,24 @@ class Communicator:
         self._multiproc: Optional[bool] = None
         self._revoked = False          # ULFM
         self._acked_failures: frozenset = frozenset()  # ULFM failure_ack
+        # Failure-knowledge domain: the process-wide default registry,
+        # or (MPI-4 Sessions) the owning session's private registry —
+        # inherited through parent so sub-communicators stay in their
+        # instance's domain (instance.c per-instance state).
+        self._ft = parent._ft if parent is not None else (
+            ft.default_registry())
         # The communicator's data plane: a private 1-D mesh over its
         # devices. Stacked rank buffers shard along this axis.
         self.mesh = Mesh(np.array(self.devices, dtype=object), (AXIS,))
         self.sharding = NamedSharding(self.mesh, P(AXIS))
         self.c_coll: Dict[str, Any] = {}
         self._select_coll()
+
+    def _alloc_cid(self) -> int:
+        """CID allocation hook: the process-wide space by default;
+        MPI-4 Sessions override to draw from the instance's own space
+        (comm_cid.c allocates within the instance namespace)."""
+        return _next_cid()
 
     # ------------------------------------------------------------------
     @property
@@ -884,8 +896,8 @@ class Communicator:
     # ==================================================================
     def dup(self, info: Optional[Info] = None) -> "Communicator":
         self._check()
-        c = Communicator(Group(self.group.world_ranks), self.devices,
-                         name=f"{self.name}.dup", parent=self,
+        c = self.__class__(Group(self.group.world_ranks), self.devices,
+                           name=f"{self.name}.dup", parent=self,
                          info=info or self.info,
                          errhandler=self.errhandler)
         # MPI attribute-copy semantics: an attribute propagates to the dup
@@ -923,8 +935,9 @@ class Communicator:
             members = sorted(by_color[c], key=lambda r: (keys[r], r))
             g = Group([self.group.world_ranks[r] for r in members])
             devs = [self.devices[r] for r in members]
-            newc = Communicator(g, devs, name=f"{self.name}.split({c})",
-                                parent=self, errhandler=self.errhandler)
+            newc = self.__class__(
+                g, devs, name=f"{self.name}.split({c})",
+                parent=self, errhandler=self.errhandler)
             for r in members:
                 out[r] = newc
         return out
@@ -964,8 +977,8 @@ class Communicator:
                 self._err(ERR_RANK, "group not a subset of communicator")
             ranks.append(lr)
         devs = [self.devices[r] for r in ranks]
-        return Communicator(group, devs, name=f"{self.name}.create",
-                            parent=self, errhandler=self.errhandler)
+        return self.__class__(group, devs, name=f"{self.name}.create",
+                              parent=self, errhandler=self.errhandler)
 
     def compare(self, other: "Communicator") -> int:
         from ompi_tpu.core.group import CONGRUENT, IDENT, SIMILAR, UNEQUAL
@@ -1009,8 +1022,8 @@ class Communicator:
             ranks = sorted(range(n), key=devkey)
             devices = [self.devices[r] for r in ranks]
         g = Group([self.group.world_ranks[r] for r in ranks])
-        c = Communicator(g, devices, name=f"{self.name}.cart",
-                         parent=self, errhandler=self.errhandler)
+        c = self.__class__(g, devices, name=f"{self.name}.cart",
+                           parent=self, errhandler=self.errhandler)
         c.topo = CartTopology(dims, periods)
         return c
 
@@ -1060,9 +1073,9 @@ class Communicator:
             perm = tm.treematch_permutation(cm, hw)
             devices = [devices[perm[r]] for r in range(topo.size)]
         g = Group(self.group.world_ranks[:topo.size])
-        c = Communicator(g, devices,
-                         name=f"{self.name}.graph", parent=self,
-                         errhandler=self.errhandler)
+        c = self.__class__(g, devices,
+                           name=f"{self.name}.graph", parent=self,
+                           errhandler=self.errhandler)
         c.topo = topo
         return c
 
@@ -1082,12 +1095,18 @@ class Communicator:
 
     def neighbor_allgather(self, sendbuf) -> List[Any]:
         """MPI_Neighbor_allgather: each rank receives its neighbors'
-        buffers (in neighbor order). Returns a per-rank list of host
-        arrays (neighbor counts may differ across ranks)."""
+        buffers (in neighbor order). Device inputs stay on device: the
+        exchange lowers to edge-colored ppermute waves over the mesh
+        (topo/neighbor.py — a cart halo exchange is 2 collective-
+        permutes per dimension); host inputs take the NumPy path."""
         self._validate_stacked(sendbuf)
         if self.topo is None:
             from ompi_tpu.core.errhandler import ERR_TOPOLOGY
             self._err(ERR_TOPOLOGY, "no topology attached")
+        self._require_local_views("neighbor_allgather")
+        if isinstance(sendbuf, jax.Array):
+            from ompi_tpu.topo import neighbor as nbr
+            return nbr.device_neighbor_allgather(self, sendbuf)
         host = np.asarray(sendbuf)
         out = []
         for r in range(self.size):
@@ -1100,11 +1119,17 @@ class Communicator:
     def neighbor_alltoall(self, sendbuf) -> List[Any]:
         """MPI_Neighbor_alltoall: sendbuf (N, max_out_deg, *s); rank r's
         j-th chunk goes to its j-th out-neighbor; each rank receives one
-        chunk per in-neighbor (in neighbor order)."""
+        chunk per in-neighbor (in neighbor order). Device inputs ride
+        the ppermute-wave lowering (topo/neighbor.py), host inputs the
+        NumPy path."""
         self._validate_stacked(sendbuf, lead=2)
         if self.topo is None:
             from ompi_tpu.core.errhandler import ERR_TOPOLOGY
             self._err(ERR_TOPOLOGY, "no topology attached")
+        self._require_local_views("neighbor_alltoall")
+        if isinstance(sendbuf, jax.Array):
+            from ompi_tpu.topo import neighbor as nbr
+            return nbr.device_neighbor_alltoall(self, sendbuf)
         from collections import deque
         host = np.asarray(sendbuf)
         out_nb = getattr(self.topo, "out_neighbors", self.topo.neighbors)
@@ -1138,12 +1163,29 @@ class Communicator:
         if self.topo is None:
             from ompi_tpu.core.errhandler import ERR_TOPOLOGY
             self._err(ERR_TOPOLOGY, "no topology attached")
-        arrs, _ = self._ragged(per_rank, "neighbor_allgatherv")
+        arrs, counts = self._ragged(per_rank, "neighbor_allgatherv")
+        if arrs and isinstance(arrs[0], jax.Array):
+            # pad-to-max wire + ppermute waves, slice valid prefixes
+            # back off (the v-collectives' device convention)
+            from ompi_tpu.topo import neighbor as nbr
+            m = max(counts) if counts else 0
+            if m:
+                padded = self._pad_stack(arrs, counts, m)
+                res = nbr.device_neighbor_allgather(self, padded)
+                out = []
+                for r in range(self.size):
+                    nb = [n for n in self.topo.neighbors(r)
+                          if 0 <= n < self.size]
+                    out.append(jax.numpy.concatenate(
+                        [res[r][k][:counts[n]]
+                         for k, n in enumerate(nb)]) if nb
+                        else jax.numpy.empty((0,), arrs[0].dtype))
+                return out
         out = []
         for r in range(self.size):
             nb = [n for n in self.topo.neighbors(r) if n >= 0]
-            out.append(np.concatenate([arrs[n] for n in nb]) if nb
-                       else np.empty((0,), arrs[0].dtype))
+            out.append(np.concatenate([np.asarray(arrs[n]) for n in nb])
+                       if nb else np.empty((0,), arrs[0].dtype))
         return out
 
     def neighbor_alltoallv(self, send_chunks: Sequence[Sequence[Any]]
@@ -1158,6 +1200,11 @@ class Communicator:
             self._err(ERR_TOPOLOGY, "no topology attached")
         if len(send_chunks) != self.size:
             self._err(ERR_COUNT, "need one chunk row per rank")
+        self._require_local_views("neighbor_alltoallv")
+        if all(isinstance(c, jax.Array)
+               for row in send_chunks for c in row) and \
+                any(len(row) for row in send_chunks):
+            return self._neighbor_alltoallv_device(send_chunks)
         from collections import deque
         out_nb = getattr(self.topo, "out_neighbors", self.topo.neighbors)
         recv: Dict[Tuple[int, int], Any] = {}
@@ -1177,6 +1224,59 @@ class Communicator:
                 q = recv.get((r, n))
                 chunks.append(q.popleft() if q else empty)
             out.append(chunks)
+        return out
+
+    def _neighbor_alltoallv_device(self, send_chunks) -> List[List[Any]]:
+        """Device lowering of neighbor_alltoallv: pad ragged chunks to
+        the max count, ride the ppermute-wave alltoall, slice each
+        received chunk back to its sender's length (counts resolved
+        through the plan's FIFO edge pairing)."""
+        from ompi_tpu.topo import neighbor as nbr
+        plan = nbr._plan(self)
+        rows = [[jax.numpy.ravel(c) for c in row] for row in send_chunks]
+        counts = [[int(c.size) for c in row] for row in rows]
+        m = max((c for row in counts for c in row), default=0)
+        d_out = max(plan.max_out, 1)
+        if m == 0:
+            empty = jax.numpy.empty((0,), jax.numpy.float32)
+            return [[empty for _ in plan.in_lists[r]]
+                    for r in range(self.size)]
+        # dtype from the first actual chunk anywhere (an empty first row
+        # must not promote integer payloads to float32)
+        dt = next((c.dtype for row in rows for c in row),
+                  jax.numpy.float32)
+        padded = jax.numpy.stack([
+            jax.numpy.stack(
+                [jax.numpy.pad(row[j], (0, m - row[j].size))
+                 if j < len(row)
+                 else jax.numpy.zeros((m,), dt)
+                 for j in range(d_out)])
+            for row in rows])                       # (N, D_out, m)
+        padded = jax.device_put(padded, NamedSharding(
+            self.mesh, P(AXIS)))
+        res = nbr.device_neighbor_alltoall(self, padded)
+        # per-edge received length: the sender's chunk size for the
+        # paired out slot (zero-length when the sender sent nothing)
+        length = {}
+        for (s, d, j, i) in plan.edges:
+            if j is not None and j < len(counts[s]):
+                length[(d, i)] = counts[s][j]
+            else:
+                length[(d, i)] = 0
+        # row alignment matches the host path: one entry per in-slot,
+        # empty where the slot is invalid (never silently shifted)
+        out: List[List[Any]] = []
+        empty = jax.numpy.empty((0,), dt)
+        for r in range(self.size):
+            vs = plan.valid_slots[r]
+            row = []
+            for i in range(len(plan.in_lists[r])):
+                if i not in vs:
+                    row.append(empty)
+                else:
+                    row.append(res[r][vs.index(i)]
+                               [:length.get((r, i), 0)])
+            out.append(row)
         return out
 
     # -- attributes (keyvals) ------------------------------------------
@@ -1218,13 +1318,13 @@ class Communicator:
     # communicators — they bypass _check().
     def _failed_local(self) -> List[int]:
         return [r for r, w in enumerate(self.group.world_ranks)
-                if ft.is_failed(w)]
+                if self._ft.is_failed(w)]
 
     def _check_ft_coll(self) -> None:
         """Collectives must not silently complete across a failure
         (ompi/request/req_ft.c behavior: ops involving failed procs
         raise MPIX_ERR_PROC_FAILED until the comm is shrunk)."""
-        if not ft.any_failed():        # hot path: nothing has failed
+        if not self._ft.any_failed():        # hot path: nothing has failed
             return
         failed = self._failed_local()
         if failed:
@@ -1236,7 +1336,7 @@ class Communicator:
     def _check_peer_ft(self, peer: int) -> None:
         if peer is None or not (0 <= peer < self.size):
             return
-        if ft.is_failed(self.group.world_ranks[peer]):
+        if self._ft.is_failed(self.group.world_ranks[peer]):
             from ompi_tpu.core.errhandler import ERR_PROC_FAILED
             self._err(ERR_PROC_FAILED, f"peer rank {peer} has failed")
 
@@ -1269,7 +1369,6 @@ class Communicator:
         communicator over the survivors. Works on revoked comms."""
         if self._freed:
             raise MPIError(ERR_COMM, "communicator has been freed")
-        from ompi_tpu.runtime import ft
         failed = set(failed_ranks or ())
         failed.update(self._failed_local())
         # Agreement on the failed set: encode each rank's view as a
@@ -1281,8 +1380,8 @@ class Communicator:
                  if (agreed >> r) & 1 and r not in failed]
         g = Group([self.group.world_ranks[r] for r in alive])
         devs = [self.devices[r] for r in alive]
-        return Communicator(g, devs, name=f"{self.name}.shrink",
-                            errhandler=self.errhandler)
+        return self.__class__(g, devs, name=f"{self.name}.shrink",
+                              parent=self, errhandler=self.errhandler)
 
     def ishrink(self):
         from ompi_tpu.core.request import Request
@@ -1321,9 +1420,9 @@ class Communicator:
     def failure_ack(self) -> None:
         """MPIX_Comm_failure_ack: acknowledge all currently-known
         failures, re-arming ANY_SOURCE receives and quieting agree()."""
-        from ompi_tpu.runtime import ft
         self._acked_failures = frozenset(self._acked_failures | {
-            w for w in self.group.world_ranks if ft.is_failed(w)})
+            w for w in self.group.world_ranks
+            if self._ft.is_failed(w)})
 
     def failure_get_acked(self) -> Group:
         """MPIX_Comm_failure_get_acked: group of acknowledged failed
@@ -1333,16 +1432,14 @@ class Communicator:
 
     def get_failed(self) -> Group:
         """MPIX_Comm_get_failed (MPI-5 FT): all known-failed members."""
-        from ompi_tpu.runtime import ft
         return Group([w for w in self.group.world_ranks
-                      if ft.is_failed(w)])
+                      if self._ft.is_failed(w)])
 
     def ack_failed(self, num_to_ack: Optional[int] = None) -> Group:
         """MPIX_Comm_ack_failed (MPI-5 FT): acknowledge the first
         ``num_to_ack`` failed members (all, when None); returns the
         acked group."""
-        from ompi_tpu.runtime import ft
-        failed = [w for w in self.group.world_ranks if ft.is_failed(w)]
+        failed = [w for w in self.group.world_ranks if self._ft.is_failed(w)]
         if num_to_ack is not None:
             failed = failed[:num_to_ack]
         self._acked_failures = frozenset(self._acked_failures | set(failed))

@@ -121,20 +121,74 @@ def _resolve(full: str, coerce, default):
 
 
 def var_get(full: str, default: Any = None) -> Any:
+    scopes = _scope_stack.get()
+    if scopes:                       # innermost active scope wins
+        for sc in reversed(scopes):
+            if full in sc.values:
+                return sc.values[full]
     with _lock:
         v = _registry.get(full)
         return v.value if v is not None else default
 
 
+class VarScope:
+    """A private override layer for the var store — the per-instance
+    parameter state of MPI-4 Sessions (``ompi/instance/instance.c``:
+    each instance bootstraps its own MCA scope). Values set here are
+    visible only while the scope is active (``with scope(s): ...``) and
+    never bleed into the global store or other scopes."""
+
+    def __init__(self):
+        self.values: Dict[str, Any] = {}
+        self._epoch = 0              # folded into var.epoch()
+
+    def set(self, full: str, value: Any) -> None:
+        with _lock:
+            v = _registry.get(full)
+        if v is not None:
+            value = _COERCE[v.vtype](value)
+        self.values[full] = value
+        self._epoch += 1             # invalidate this scope's memo keys
+
+    def unset(self, full: str) -> None:
+        if self.values.pop(full, None) is not None:
+            self._epoch += 1
+
+
+import contextlib as _contextlib       # noqa: E402
+import contextvars as _contextvars     # noqa: E402
+
+_scope_stack: "_contextvars.ContextVar[tuple]" = _contextvars.ContextVar(
+    "ompi_tpu_var_scopes", default=())
+
+
+@_contextlib.contextmanager
+def scope(s: "VarScope"):
+    """Activate a VarScope for the dynamic extent (decision layers and
+    component selection read through it). Scope identity is folded into
+    ``epoch()`` rather than bumping the global counter: world-communicator
+    memo entries stay hot while session and world collectives interleave,
+    and each scope's entries key on its own (identity, epoch)."""
+    tok = _scope_stack.set(_scope_stack.get() + (s,))
+    try:
+        yield s
+    finally:
+        _scope_stack.reset(tok)
+
+
 _epoch = 0
 
 
-def epoch() -> int:
-    """Monotone counter bumped on every mutation of the var store.
-    Decision layers may memoize var-derived choices keyed on this, so
-    per-call var reads leave the hot path while ``var_set`` still takes
-    effect immediately (source-tracking precedence is unchanged)."""
-    return _epoch
+def epoch():
+    """Validity token for var-derived memos: the global mutation counter
+    alone when no scope is active (the common hot path — a plain int),
+    else a tuple folding in each active scope's (identity, epoch) so a
+    session's overrides key its own memo entries without invalidating
+    the world's. Compare with ``==``; never assume int."""
+    scopes = _scope_stack.get()
+    if not scopes:
+        return _epoch
+    return (_epoch,) + tuple((id(s), s._epoch) for s in scopes)
 
 
 def bump_epoch() -> None:

@@ -11,91 +11,135 @@ TPU-native re-design: the "process" is a rank bound to a device on the
 controller's mesh. Failure events come from two sources — a device health
 probe (a failed chip surfaces as an XLA execution error) and explicit
 injection (the fault-injection entry the reference lacks; here it is the
-test surface). The registry is the single source of truth the whole stack
+test surface). A :class:`Registry` is the source of truth a stack
 consults: communicator collectives, the pt2pt matching engine, and the
-ftagree component all read it. Epochs order failure knowledge the way
-PMIx event sequence numbers do.
+ftagree component all read their communicator's registry. The
+module-level functions operate on the process-wide default registry (the
+World Process Model); MPI-4 Sessions own private registries
+(``instance.c:361-720`` — per-instance state), so failure knowledge
+injected in one session never bleeds into another. Epochs order failure
+knowledge the way PMIx event sequence numbers do.
 """
 from __future__ import annotations
 
 import threading
 from typing import Callable, Dict, FrozenSet, List
 
-_lock = threading.Lock()
-_failed: Dict[int, str] = {}          # world rank -> reason
-_epoch = 0
-_listeners: List[Callable[[int, str], None]] = []
+
+class Registry:
+    """One failure-knowledge domain (per instance/session)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._failed: Dict[int, str] = {}      # world rank -> reason
+        self._epoch = 0
+        self._listeners: List[Callable[[int, str], None]] = []
+
+    def fail_rank(self, world_rank: int, reason: str = "injected") -> None:
+        """Report rank failure (detector ingress + fault injection)."""
+        with self._lock:
+            if world_rank in self._failed:
+                return
+            self._failed[world_rank] = reason
+            self._epoch += 1
+            listeners = list(self._listeners)
+        for cb in listeners:
+            cb(world_rank, reason)
+
+    def any_failed(self) -> bool:
+        """Fast-path check for the per-call FT guards (hot path: every
+        collective entry)."""
+        return bool(self._failed)
+
+    def is_failed(self, world_rank: int) -> bool:
+        return world_rank in self._failed
+
+    def failed_ranks(self) -> FrozenSet[int]:
+        with self._lock:
+            return frozenset(self._failed)
+
+    def failure_reason(self, world_rank: int) -> str:
+        return self._failed.get(world_rank, "")
+
+    def epoch(self) -> int:
+        return self._epoch
+
+    def add_listener(self, cb: Callable[[int, str], None]) -> None:
+        """Register a failure-event callback (the PMIx event-handler
+        role)."""
+        with self._lock:
+            self._listeners.append(cb)
+
+    def probe_devices(self, devices, world_ranks=None) -> List[int]:
+        """Health-check each rank's device with a trivial computation;
+        mark ranks whose device errors as failed. Returns newly failed
+        *world* ranks. ``world_ranks[i]`` is the world rank owning
+        ``devices[i]`` (identity when omitted — correct only for
+        COMM_WORLD-shaped device lists). (The active side of the
+        detector; in the reference the PRRTE daemon notices a dead
+        process and PMIx fans the event out.)"""
+        import jax
+        import numpy as np
+        if world_ranks is None:
+            world_ranks = range(len(devices))
+        newly = []
+        for w, d in zip(world_ranks, devices):
+            if self.is_failed(w):
+                continue
+            try:
+                x = jax.device_put(np.ones((1,), np.float32), d)
+                float(np.asarray(x)[0])
+            except Exception as e:      # noqa: BLE001 — any device error
+                self.fail_rank(w, f"device probe: {type(e).__name__}")
+                newly.append(w)
+        return newly
+
+    def _reset(self) -> None:
+        with self._lock:
+            self._failed.clear()
+            self._listeners.clear()
+            self._epoch = 0
+
+
+# -- process-wide default domain (World Process Model) ---------------------
+_default = Registry()
+
+
+def default_registry() -> Registry:
+    return _default
 
 
 def fail_rank(world_rank: int, reason: str = "injected") -> None:
-    """Report rank failure (detector ingress + fault injection API)."""
-    global _epoch
-    with _lock:
-        if world_rank in _failed:
-            return
-        _failed[world_rank] = reason
-        _epoch += 1
-        listeners = list(_listeners)
-    for cb in listeners:
-        cb(world_rank, reason)
+    _default.fail_rank(world_rank, reason)
 
 
 def any_failed() -> bool:
-    """Fast-path check for the per-call FT guards (hot path: every
-    collective entry)."""
-    return bool(_failed)
+    return _default.any_failed()
 
 
 def is_failed(world_rank: int) -> bool:
-    return world_rank in _failed
+    return _default.is_failed(world_rank)
 
 
 def failed_ranks() -> FrozenSet[int]:
-    with _lock:
-        return frozenset(_failed)
+    return _default.failed_ranks()
 
 
 def failure_reason(world_rank: int) -> str:
-    return _failed.get(world_rank, "")
+    return _default.failure_reason(world_rank)
 
 
 def epoch() -> int:
-    return _epoch
+    return _default.epoch()
 
 
 def add_listener(cb: Callable[[int, str], None]) -> None:
-    """Register a failure-event callback (the PMIx event-handler role)."""
-    with _lock:
-        _listeners.append(cb)
+    _default.add_listener(cb)
 
 
 def probe_devices(devices, world_ranks=None) -> List[int]:
-    """Health-check each rank's device with a trivial computation; mark
-    ranks whose device errors as failed. Returns newly failed *world*
-    ranks. ``world_ranks[i]`` is the world rank owning ``devices[i]``
-    (identity when omitted — correct only for COMM_WORLD-shaped device
-    lists). (The active side of the detector; in the reference the PRRTE
-    daemon notices a dead process and PMIx fans the event out.)"""
-    import jax
-    import numpy as np
-    if world_ranks is None:
-        world_ranks = range(len(devices))
-    newly = []
-    for w, d in zip(world_ranks, devices):
-        if is_failed(w):
-            continue
-        try:
-            x = jax.device_put(np.ones((1,), np.float32), d)
-            float(np.asarray(x)[0])
-        except Exception as e:          # noqa: BLE001 — any device error
-            fail_rank(w, f"device probe: {type(e).__name__}")
-            newly.append(w)
-    return newly
+    return _default.probe_devices(devices, world_ranks)
 
 
 def _reset_for_tests() -> None:
-    global _epoch
-    with _lock:
-        _failed.clear()
-        _listeners.clear()
-        _epoch = 0
+    _default._reset()
