@@ -67,11 +67,14 @@ FIXED_RULES: Dict[str, List[Sequence]] = {
 # coll_base_allreduce.c:291-294).
 REORDERING = frozenset({
     "ring", "ring_segmented", "hier", "recursive_doubling",
-    "rabenseifner", "rabenseifner_root",
+    "rabenseifner", "rabenseifner_root", "knomial",
 })
 
 # Algorithms only defined for power-of-two communicator sizes.
 POW2_ONLY = frozenset({"recursive_doubling"})
+
+# Algorithms only defined for even communicator sizes.
+EVEN_ONLY = frozenset({"neighborexchange"})
 
 
 def _match(rules: List[Sequence], comm_size: int, nbytes: int) -> str:
@@ -102,9 +105,12 @@ def decide(func: str, comm_size: int, nbytes: int, multihost: bool,
         rules = dynamic.get(func, {}).get("algorithm_rules")
     if rules:
         return _match(rules, comm_size, nbytes)
-    if multihost and func == "allreduce":
+    if multihost and func in ("allreduce", "bcast", "allgather",
+                              "reduce_scatter_block", "barrier"):
         # Multi-host: the two-tier composition keeps bulk traffic on
-        # ICI and only the scattered chunk on DCN (coll/han's role).
+        # ICI and only chunk-sized exchanges on DCN (coll/han's role).
+        # The xla module demotes to 'direct' where hier doesn't apply
+        # (ragged groups, non-sum reduce_scatter).
         return "hier"
     if func in _SYMMETRIC_FALLBACK:
         if multihost:

@@ -60,7 +60,11 @@ class FtAgreeModule:
 
     def _alive_mask(self) -> List[bool]:
         wr = self.comm.group.world_ranks
-        return [not ft.is_failed(w) for w in wr]
+        # the communicator's failure domain (a session's private
+        # registry, or the process default) — NOT the module globals,
+        # so session-injected failures stay in their instance
+        reg = getattr(self.comm, "_ft", ft)
+        return [not reg.is_failed(w) for w in wr]
 
     def agree(self, flags: Sequence[int]) -> Tuple[int, List[int]]:
         """Returns (agreed_value, failed_local_ranks). The caller (the

@@ -64,6 +64,11 @@ class ScheduleRequest(Request):
         self._state = state
         self._rounds = deque(rounds)
         self._finalize = finalize
+        # Rounds execute later from the progress engine; they must
+        # observe the MCA var scopes of the CREATING context (a session
+        # collective's deferred fused round would otherwise read the
+        # global store and ignore the session's algorithm overrides).
+        self._scopes = var.current_scopes()
         module._ensure_progress_cb()
         module._active.append(self)
 
@@ -79,7 +84,11 @@ class ScheduleRequest(Request):
             return 0
         if self._rounds:
             rnd = self._rounds.popleft()
-            self._state = rnd(self._state)
+            if self._scopes:
+                with var.scopes_active(self._scopes):
+                    self._state = rnd(self._state)
+            else:
+                self._state = rnd(self._state)
             return 1
         leaves = [a for a in jax.tree_util.tree_leaves(self._state)
                   if isinstance(a, jax.Array)]

@@ -162,6 +162,23 @@ _scope_stack: "_contextvars.ContextVar[tuple]" = _contextvars.ContextVar(
     "ompi_tpu_var_scopes", default=())
 
 
+def current_scopes() -> tuple:
+    """Snapshot of the active scope stack — for deferred work (e.g.
+    nonblocking-collective rounds run later by the progress engine)
+    that must observe the scopes of its *creation* context."""
+    return _scope_stack.get()
+
+
+@_contextlib.contextmanager
+def scopes_active(stack: tuple):
+    """Re-establish a snapshot taken with :func:`current_scopes`."""
+    tok = _scope_stack.set(stack)
+    try:
+        yield
+    finally:
+        _scope_stack.reset(tok)
+
+
 @_contextlib.contextmanager
 def scope(s: "VarScope"):
     """Activate a VarScope for the dynamic extent (decision layers and

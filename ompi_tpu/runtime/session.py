@@ -108,7 +108,8 @@ for _name in ("allreduce", "reduce", "bcast", "allgather", "gather",
               "alltoallw", "iallreduce", "ibcast", "ireduce",
               "iallgather", "igather", "iscatter", "ialltoall",
               "ibarrier", "dup", "split", "split_type", "create",
-              "create_cart", "create_graph", "shrink"):
+              "create_cart", "create_graph", "shrink",
+              "allreduce_bind", "allreduce_init", "bcast_init"):
     setattr(SessionCommunicator, _name, _scoped(_name))
 
 

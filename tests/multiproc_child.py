@@ -55,6 +55,19 @@ def main() -> None:
     got = world.shard(y2, 2 * pi)
     assert np.allclose(got, expect), (got, expect)
 
+    # hier bcast + reduce_scatter_block across the REAL process
+    # boundary (round-3: hier beyond allreduce, decision row selects it
+    # because spans_processes is genuinely true here)
+    alg = world.c_coll["allreduce"].device._algorithm("bcast", 4096)
+    assert alg == "hier", alg
+    xb = world.put(np.arange(4 * 5, dtype=np.float32).reshape(4, 5))
+    yb = world.bcast(xb, root=1)
+    assert np.allclose(world.shard(yb, 2 * pi),
+                       np.arange(5, dtype=np.float32) + 5)
+    xr = world.put(np.ones((4, 4, 3), np.float32))
+    yr = world.reduce_scatter_block(xr, MPI.SUM)
+    assert np.allclose(world.shard(yr, 2 * pi), 4.0)
+
     # No silent wrong answers (round-2 VERDICT missing #2): stacked
     # pt2pt / RMA / SHMEM must raise the clean multi-controller guard,
     # not hand back another controller's stale dict state.

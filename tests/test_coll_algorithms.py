@@ -57,7 +57,8 @@ def test_allreduce_max_via_recursive_doubling(mpi, world, alg):
     assert np.allclose(y[0], np.max(rows, axis=0))
 
 
-@pytest.mark.parametrize("name", ["ring", "bruck"])
+@pytest.mark.parametrize("name", ["ring", "bruck", "neighborexchange",
+                                  "two_procs"])
 def test_allgather_algorithms(mpi, world, alg, name):
     rows, x = _rank_data(world, (3,), seed=1)
     alg("allgather", name)
@@ -67,7 +68,8 @@ def test_allgather_algorithms(mpi, world, alg, name):
         assert np.allclose(y[r], want)
 
 
-@pytest.mark.parametrize("name", ["binomial", "scatter_allgather"])
+@pytest.mark.parametrize("name", ["binomial", "knomial", "chain",
+                                  "pipeline", "scatter_allgather"])
 def test_bcast_algorithms(mpi, world, alg, name):
     rows, x = _rank_data(world, (6,), seed=2)
     root = 3
@@ -141,3 +143,44 @@ def test_non_commutative_falls_back_to_direct(mpi, world, alg):
     alg("allreduce", "ring")
     y = np.asarray(world.allreduce(x, f))
     assert np.allclose(y[0], rows[world.size - 1], atol=1e-6)
+
+
+@pytest.mark.parametrize("root", [0, 5])
+def test_reduce_knomial(mpi, world, alg, root):
+    rows, x = _rank_data(world, (4,), seed=11)
+    alg("reduce", "knomial")
+    y = np.asarray(world.reduce(x, mpi.SUM, root))
+    assert np.allclose(y[root], np.sum(rows, axis=0), atol=1e-4)
+    y2 = np.asarray(world.reduce(x, mpi.MAX, root))
+    assert np.allclose(y2[root], np.max(rows, axis=0))
+
+
+def test_barrier_tree(mpi, world, alg):
+    alg("barrier", "tree")
+    for _ in range(3):
+        world.barrier()
+
+
+def test_neighborexchange_demotes_on_odd_size(mpi, world, alg):
+    """EVEN_ONLY gate: an odd-size sub-communicator silently runs the
+    direct lowering instead."""
+    n = world.size
+    sub = world.split([0] * 3 + [1] * (n - 3))[0]   # size 3
+    alg("allgather", "neighborexchange")
+    rows = [np.full((2,), float(r)) for r in range(3)]
+    y = np.asarray(sub.allgather(sub.stack(rows)))
+    for r in range(3):
+        assert np.allclose(y[r], np.stack(rows))
+
+
+def test_pipeline_bcast_segments(mpi, world, alg):
+    """Pipeline uses multiple segments once the payload passes segsize."""
+    alg("bcast", "pipeline")
+    var.var_set("coll_xla_segsize", 64)
+    try:
+        rows, x = _rank_data(world, (256,), seed=3)
+        y = np.asarray(world.bcast(x, root=1))
+        for r in range(world.size):
+            assert np.allclose(y[r], rows[1], atol=1e-6)
+    finally:
+        var.var_set("coll_xla_segsize", 1 << 20)

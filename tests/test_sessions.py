@@ -95,6 +95,53 @@ def test_ft_registry_isolation(world):
         np.testing.assert_allclose(np.asarray(ys)[0], float(shrunk.size))
 
 
+def test_session_agree_uses_session_registry(world):
+    """coll/ftagree must consult the communicator's failure domain:
+    a session-injected failure makes agree() raise in THAT session and
+    nowhere else (ULFM contract + instance isolation)."""
+    with Session() as s1, Session() as s2:
+        c1 = s1.comm_create_from_group(s1.group_from_pset("mpi://WORLD"))
+        c2 = s2.comm_create_from_group(s2.group_from_pset("mpi://WORLD"))
+        s1.ft_registry.fail_rank(0, "injected in s1")
+        with pytest.raises(MPI.MPIError) as ei:
+            c1.agree([~0] * c1.size)
+        assert hasattr(ei.value, "agreed_value")
+        assert c2.agree([~0] * c2.size) == ~0      # s2 unaffected
+        assert world.agree([~0] * world.size) == ~0
+
+
+def test_session_scope_reaches_deferred_nbc_rounds(world):
+    """A session's algorithm override must govern the nonblocking
+    fused path even though its round executes later from the progress
+    engine (the deferred-decision escape found in review)."""
+    with Session() as s:
+        s.var_set("coll_xla_allreduce_algorithm", "ring")
+        c = s.comm_create_from_group(s.group_from_pset("mpi://WORLD"))
+        x = c.alloc((1 << 15,), np.float32, fill=1.0)   # > fused_min
+        req = c.iallreduce(x, MPI.SUM)
+        req.wait()
+        np.testing.assert_allclose(np.asarray(req.get())[0],
+                                   float(c.size), rtol=1e-5)
+        dev = c.c_coll["allreduce"].device
+        assert any(k[0] == "allreduce" and "ring" in k
+                   for k in dev._cache), list(dev._cache)
+
+
+def test_session_bound_handle_uses_session_algorithm(world):
+    """allreduce_bind on a SessionCommunicator warms with the
+    session's algorithm choice, not the global one."""
+    with Session() as s:
+        s.var_set("coll_xla_allreduce_algorithm", "recursive_doubling")
+        c = s.comm_create_from_group(s.group_from_pset("mpi://WORLD"))
+        x = c.alloc((16,), np.float32, fill=2.0)
+        h = c.allreduce_bind(x, MPI.SUM)
+        np.testing.assert_allclose(np.asarray(h(x))[0], 2.0 * c.size,
+                                   rtol=1e-5)
+        dev = c.c_coll["allreduce"].device
+        assert any(k[0] == "allreduce" and "recursive_doubling" in k
+                   for k in dev._cache), list(dev._cache)
+
+
 def test_instance_refcount(world):
     r0 = instance_refcount()
     s1 = Session()
