@@ -883,6 +883,16 @@ class XlaCollModule:
         return inner
 
     # -- collectives -----------------------------------------------------
+    def bind_allreduce(self, example, op):
+        """Pre-bound hot-path handle: warm the decision + compile for
+        ``example``'s (shape, dtype, op), then return a callable that
+        is the cached executable plus the sharding fast check — the
+        module owns the memo key, so callers never duplicate it."""
+        x = self._to_mesh(example)
+        self.allreduce(x, op)            # warm: decide + compile + memo
+        fn = self._fast[("allreduce", x.shape, x.dtype, op.uid)][1]
+        return lambda buf: fn(self._to_mesh(buf))
+
     def allreduce(self, x, op):
         x = self._to_mesh(x)
         # Hot-path memo: everything below (decision tables, dynamic

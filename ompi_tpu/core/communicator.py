@@ -746,14 +746,10 @@ class Communicator:
         self._validate_op(op)
         mod = self._coll("allreduce")
         dev = getattr(mod, "device", mod)
-        to_mesh = getattr(dev, "_to_mesh", None)
-        if to_mesh is None:              # host module won selection
+        bind = getattr(dev, "bind_allreduce", None)
+        if bind is None:                 # host module won selection
             return lambda buf: mod.allreduce(buf, op)
-        x = to_mesh(example)
-        dev.allreduce(x, op)             # warm: decide + compile + cache
-        fk = ("allreduce", x.shape, x.dtype, op.uid)
-        fn = dev._fast[fk][1]
-        return lambda buf: fn(to_mesh(buf))
+        return bind(example, op)
 
     def bcast_init(self, buf, root: int = 0, **kw) -> Request:
         return Request(persistent_start=lambda: self.ibcast(buf, root, **kw))

@@ -46,13 +46,36 @@ class PartitionedSend(Request):
             raise MPIError(ERR_ARG, f"partition {i} out of range")
         if not self.ready[i]:
             self.ready[i] = True
+            # memchecker (opal memchecker role): per MPI-4, partition i
+            # is LIBRARY-owned from pready(i) until operation
+            # completion. Our engine copies eagerly, so a later user
+            # write is harmless HERE — but it is non-portable MPI, and
+            # catching exactly that is the memchecker's job.
+            from ompi_tpu.utils import memchecker
+            memchecker.inflight(self.parts[i],
+                                f"partition {i} after pready")
             # Partitioned fragments ride their own matching channel with
             # structured (tag, partition) tags — no arithmetic encoding,
             # no possible collision with user int tags.
             self.comm._pml.send(self.parts[i], self.src, self.dest,
                                 (self.tag, i), channel=CH_PART)
         if all(self.ready):
+            # completion: verify the ownership discipline was respected
+            # on EVERY partition — releasing each tracked entry even
+            # when one fails (a stranded id-keyed entry could later
+            # fire a spurious error on an unrelated buffer reusing the
+            # address) — then complete; the violation is a diagnostic,
+            # the transfer itself happened.
+            from ompi_tpu.utils import memchecker
+            errors = []
+            for i, p in enumerate(self.parts):
+                try:
+                    memchecker.verify(p)
+                except memchecker.MemcheckError as e:
+                    errors.append(f"partition {i}: {e}")
             self._complete = True
+            if errors:
+                raise memchecker.MemcheckError("; ".join(errors))
 
     def pready_range(self, lo: int, hi: int) -> None:
         for i in range(lo, hi + 1):

@@ -15,9 +15,12 @@ state plus device-to-device shard movement. An eager send's payload is
 referenced (device arrays are immutable — no copy needed, the analogue of
 ob1's eager-copy without the memcpy); matching is O(queue) Python. The
 protocol switch (eager vs rendezvous vs RDMA, ``pml_ob1_sendreq.h:389``)
-collapses: every transfer is an HBM-resident reference handoff until a
-rank actually reads it. Partitioned pt2pt rides a separate matching
-*channel* so its internal fragments can never cross-match user tags.
+survives with real teeth: payloads above ``pml_stacked_eager_limit``
+are MOVED to the destination rank's device at send time (a PJRT D2D
+transfer — bytes cross the fabric), the rendezvous/RDMA-put tier; see
+the MatchingEngine class doc. Partitioned pt2pt rides a separate
+matching *channel* so its internal fragments can never cross-match user
+tags.
 
 This engine is SINGLE-CONTROLLER ONLY: in a stacked multi-controller
 world a rank's shard may live on another process, so the dict handoff
@@ -40,6 +43,21 @@ PROC_NULL = -2
 
 CH_P2P = 0          # ordinary sends/recvs (int tags)
 CH_PART = 1         # partitioned pt2pt fragments (tuple tags)
+
+
+def _register_vars() -> None:
+    from ompi_tpu.mca import var
+    var.var_register(
+        "pml", "stacked", "eager_limit", vtype="int",
+        default=1 << 16,
+        help="Device payloads above this many bytes are transferred "
+             "to the destination rank's device at send time (the "
+             "rendezvous/RDMA-put tier, a PJRT D2D move over the "
+             "fabric); smaller ones are eager reference handoffs, "
+             "mirroring btl_eager_limit's protocol switch")
+
+
+_register_vars()
 
 
 class _Msg:
@@ -104,16 +122,17 @@ class PtpRequest(Request):
             raise MPIError(ERR_REVOKED,
                            "pending receive on a revoked communicator")
         from ompi_tpu.runtime import ft
+        reg = getattr(comm, "_ft", ft)   # the comm's failure domain
         src = self.status.source
         if src == ANY_SOURCE:
             unacked = [w for w in comm.group.world_ranks
-                       if ft.is_failed(w)
+                       if reg.is_failed(w)
                        and w not in comm._acked_failures]
             if unacked:
                 raise MPIError(ERR_PROC_FAILED,
                                f"wildcard receive with unacknowledged "
                                f"failed world rank(s) {unacked}")
-        elif 0 <= src < comm.size and ft.is_failed(
+        elif 0 <= src < comm.size and reg.is_failed(
                 comm.group.world_ranks[src]):
             raise MPIError(ERR_PROC_FAILED,
                            f"receive peer rank {src} has failed")
@@ -145,10 +164,26 @@ class MatchingEngine:
     ob1-recvfrag role — integer descriptors in native queues, payloads
     held here by handle) when the native library is available, else pure
     Python. ``OMPI_TPU_DISABLE_NATIVE_MATCH=1`` forces the Python path
-    (the tests run both and assert identical behavior)."""
+    (the tests run both and assert identical behavior).
+
+    Protocol switch (``pml_ob1_sendreq.h:389-460``): device payloads at
+    or below ``pml_stacked_eager_limit`` are reference handoffs (the
+    eager path — device arrays are immutable, so the reference's eager
+    copy costs nothing); above it, the payload is MOVED to the
+    destination rank's device at send time via a PJRT D2D transfer —
+    bytes genuinely cross the fabric (ICI on TPU), the rendezvous/RDMA-
+    put analogue, so the receiving rank's later reads are device-local
+    instead of pulling a remote buffer at use time. Host arrays are
+    always eager-copied (the snapshot below)."""
 
     def __init__(self, comm):
         self.comm = comm
+        import threading
+        # Matching is check-then-act over shared queues; the GIL makes
+        # single ops atomic but not the compound sequences — a lock
+        # keeps MPI_THREAD_MULTIPLE honest (the reference guards ob1's
+        # match with the comm matching lock for the same reason).
+        self._mlock = threading.RLock()
         self.unexpected: Dict[Tuple[int, int], Deque[_Msg]] = {}
         self.posted: List[_PostedRecv] = []
         # Per-peer traffic accounting (the pml/monitoring role): the
@@ -195,6 +230,35 @@ class MatchingEngine:
     def _q(self, dest: int, src: int) -> Deque[_Msg]:
         return self.unexpected.setdefault((dest, src), deque())
 
+    def _protocol_switch(self, data, dest: int):
+        """Eager vs rendezvous for device payloads (see class doc)."""
+        try:
+            import jax
+        except Exception:                # pragma: no cover
+            return data
+        if not isinstance(data, jax.Array):
+            return data
+        from ompi_tpu.mca import var
+        from ompi_tpu.runtime import spc
+        limit = var.var_get("pml_stacked_eager_limit", 1 << 16)
+        nbytes = int(getattr(data, "nbytes", 0) or 0)
+        devs = getattr(self.comm, "devices", None)
+        if nbytes <= limit or devs is None or not (0 <= dest < len(devs)):
+            spc.record("pml_eager", 1)
+            return data
+        target = devs[dest]
+        try:
+            cur = list(data.devices())
+        except Exception:
+            cur = []
+        if cur == [target]:
+            spc.record("pml_eager", 1)   # already resident at dest
+            return data
+        spc.record("pml_rndv", 1)
+        # the fabric-touching put: PJRT moves the bytes to the
+        # destination rank's device NOW (ICI on TPU hardware)
+        return jax.device_put(data, target)
+
     # -- send side -----------------------------------------------------
     def send(self, data: Any, src: int, dest: int, tag,
              synchronous: bool = False, channel: int = CH_P2P) -> Request:
@@ -214,6 +278,8 @@ class MatchingEngine:
             # returns; mutable host arrays are snapshotted (the eager
             # copy). Device arrays are immutable — reference suffices.
             data = data.copy()
+        else:
+            data = self._protocol_switch(data, dest)
         if channel == CH_P2P:
             # Internal fragments (partitioned channel, vprotocol replay)
             # are not user messages; keep the profile matrix honest.
@@ -221,43 +287,56 @@ class MatchingEngine:
             t[0] += 1
             t[1] += int(getattr(data, "nbytes", 0) or 0)
         msg = _Msg(src, dest, tag, data, synchronous, channel)
-        if self._lib is not None:
-            mh = self._handle()
-            r = self._lib.ompi_tpu_match_send(
-                self._h, src, dest, self._tag_id(tag), channel, mh,
-                0 if synchronous else 1)
-            if r >= 0:                       # matched a posted receive
-                self._reqs.pop(r).deliver(msg)
-                req = Request.completed()
-                req.status.count = 1
-                return req
-            if not synchronous:
-                self._msgs[mh] = msg
-        else:
-            for i, pr in enumerate(self.posted):
-                if pr.matches(msg):
-                    self.posted.pop(i)
-                    pr.req.deliver(msg)
+        with self._mlock:
+            if self._lib is not None:
+                mh = self._handle()
+                r = self._lib.ompi_tpu_match_send(
+                    self._h, src, dest, self._tag_id(tag), channel, mh,
+                    0 if synchronous else 1)
+                if r >= 0:                   # matched a posted receive
+                    self._reqs.pop(r).deliver(msg)
                     req = Request.completed()
                     req.status.count = 1
                     return req
+                if not synchronous:
+                    self._msgs[mh] = msg
+            else:
+                for i, pr in enumerate(self.posted):
+                    if pr.matches(msg):
+                        self.posted.pop(i)
+                        pr.req.deliver(msg)
+                        req = Request.completed()
+                        req.status.count = 1
+                        return req
+                if not synchronous:
+                    # enqueue INSIDE the lock: a concurrent irecv that
+                    # found the queue empty must not post between our
+                    # scan and this append, or message and receive
+                    # strand in opposite queues (the check-then-act
+                    # race the matching lock exists to close)
+                    self._q(dest, src).append(msg)
         if synchronous:
             # MPI_Ssend completes only once the receive has started; in a
             # single-controller world an unmatched synchronous send can
-            # never complete — surface the deadlock. (The native core was
-            # told not to enqueue it.)
+            # never complete — surface the deadlock. (Neither backend
+            # enqueued it.)
             raise MPIError(
                 ERR_PENDING,
                 "ssend would deadlock: no matching receive posted "
                 "(post irecv first)")
-        if self._lib is None:
-            self._q(dest, src).append(msg)
         return Request.completed()
 
     # -- receive side --------------------------------------------------
     def _match_unexpected(self, dest: int, source: int, tag,
                           channel: int = CH_P2P,
                           remove: bool = True) -> Optional[_Msg]:
+        with self._mlock:
+            return self._match_unexpected_locked(dest, source, tag,
+                                                 channel, remove)
+
+    def _match_unexpected_locked(self, dest: int, source: int, tag,
+                                 channel: int = CH_P2P,
+                                 remove: bool = True) -> Optional[_Msg]:
         if self._lib is not None:
             mh = self._lib.ompi_tpu_match_take(
                 self._h, dest, source, self._tag_id(tag), channel,
@@ -287,16 +366,21 @@ class MatchingEngine:
         if source == PROC_NULL:
             req.deliver(_Msg(PROC_NULL, dest, tag, None))
             return req
-        msg = self._match_unexpected(dest, source, tag, channel)
+        with self._mlock:
+            msg = self._match_unexpected_locked(dest, source, tag,
+                                                channel)
+            if msg is None:
+                if self._lib is not None:
+                    rh = self._handle()
+                    self._reqs[rh] = req
+                    self._lib.ompi_tpu_match_post(
+                        self._h, dest, source, self._tag_id(tag),
+                        channel, rh)
+                else:
+                    self.posted.append(
+                        _PostedRecv(source, dest, tag, channel, req))
         if msg is not None:
             req.deliver(msg)
-        elif self._lib is not None:
-            rh = self._handle()
-            self._reqs[rh] = req
-            self._lib.ompi_tpu_match_post(
-                self._h, dest, source, self._tag_id(tag), channel, rh)
-        else:
-            self.posted.append(_PostedRecv(source, dest, tag, channel, req))
         return req
 
     def recv(self, dest: int, source: int, tag) -> Tuple[Any, Status]:

@@ -68,6 +68,9 @@ def init(requested: int = THREAD_SINGLE,
     if _state["initialized"]:
         raise MPIError(ERR_OTHER, "MPI already initialized")
     _register_base_vars()
+    from ompi_tpu.pml import stacked as _pml_stacked  # noqa: F401
+    # (imports register the pml MCA vars — components register at open,
+    # mca_base convention)
 
     if var.var_get("mpi_base_distributed", False):
         kw = {}

@@ -1,0 +1,153 @@
+"""Memchecker — user-buffer state tracking around communication calls.
+
+Behavioral spec: ``opal/mca/memchecker/valgrind``
+(``memchecker_valgrind_module.c``): the reference wraps
+``VALGRIND_MAKE_MEM_*`` to mark user buffers *undefined* while a pending
+operation owns them (a nonblocking send's buffer must not be modified, a
+nonblocking receive's buffer must not be read) and *defined* again at
+completion, so valgrind flags the misuse at the exact racing access.
+
+TPU-native re-design: there is no valgrind to delegate to, and device
+arrays are immutable — the entire class of "modified a buffer the
+library still owns" races only exists for HOST (numpy) buffers. The
+checker therefore tracks host buffers by id with content fingerprints:
+
+- ``inflight(buf, why)``   — the library holds a read obligation
+  (partitioned send between ``pready`` and completion, a pending ssend):
+  a fingerprint is taken; ``verify(buf)`` at completion raises
+  ``MemcheckError`` if the user mutated the buffer meanwhile.
+- ``undefined(buf, why)``  — the library holds a write obligation (a
+  posted receive's target): ``check_readable(buf)`` raises until
+  ``defined(buf)``.
+
+Enabled with the MCA var ``mpi_memchecker_enable`` (off by default —
+fingerprinting is a full buffer read, exactly like the reference's
+memchecker being a debug-build feature). All entry points are no-ops
+when disabled, so hot paths stay clean.
+"""
+from __future__ import annotations
+
+import threading
+import zlib
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from ompi_tpu.mca import var
+
+
+class MemcheckError(RuntimeError):
+    """A tracked buffer was used while the library owned it."""
+
+
+def _register() -> None:
+    var.var_register("mpi", "memchecker", "enable", vtype="bool",
+                     default=False,
+                     help="Track host buffer ownership around pt2pt "
+                          "calls: detect user modification of in-flight "
+                          "send buffers and reads of not-yet-delivered "
+                          "receive buffers (the opal memchecker role; "
+                          "debug feature, costs a buffer read per mark)")
+
+
+_register()
+
+_lock = threading.Lock()
+# id(buf) -> ("inflight", fingerprint, why) | ("undefined", None, why)
+_tracked: Dict[int, Tuple[str, Optional[int], str]] = {}
+_violations = 0
+
+
+def enabled() -> bool:
+    return bool(var.var_get("mpi_memchecker_enable", False))
+
+
+def _fp(buf: np.ndarray) -> int:
+    return zlib.crc32(np.ascontiguousarray(buf).tobytes())
+
+
+def _host(buf: Any) -> Optional[np.ndarray]:
+    return buf if isinstance(buf, np.ndarray) else None
+
+
+def inflight(buf: Any, why: str = "pending send") -> None:
+    """Library takes a read obligation on ``buf``."""
+    if not enabled():
+        return
+    a = _host(buf)
+    if a is None:
+        return                       # device arrays are immutable
+    with _lock:
+        _tracked[id(a)] = ("inflight", _fp(a), why)
+
+
+def undefined(buf: Any, why: str = "pending receive") -> None:
+    """Library takes a write obligation on ``buf``: contents are
+    undefined for the user until ``defined``."""
+    if not enabled():
+        return
+    a = _host(buf)
+    if a is None:
+        return
+    with _lock:
+        _tracked[id(a)] = ("undefined", None, why)
+
+
+def verify(buf: Any) -> None:
+    """Completion of a read obligation: raise if the user mutated the
+    buffer while the library owned it (the race valgrind would flag at
+    the mutating store)."""
+    if not enabled():
+        return
+    a = _host(buf)
+    if a is None:
+        return
+    with _lock:
+        ent = _tracked.pop(id(a), None)
+    if ent is None or ent[0] != "inflight":
+        return
+    if _fp(a) != ent[1]:
+        global _violations
+        with _lock:
+            _violations += 1
+        raise MemcheckError(
+            f"send buffer modified while in flight ({ent[2]}): MPI "
+            f"forbids touching a buffer the library still owns")
+
+
+def defined(buf: Any) -> None:
+    """Completion of a write obligation: the buffer is the user's
+    again."""
+    if not enabled():
+        return
+    a = _host(buf)
+    if a is not None:
+        with _lock:
+            _tracked.pop(id(a), None)
+
+
+def check_readable(buf: Any) -> None:
+    """Raise if ``buf`` is currently undefined (a posted receive's
+    target that has not completed)."""
+    if not enabled():
+        return
+    a = _host(buf)
+    if a is None:
+        return
+    with _lock:
+        ent = _tracked.get(id(a))
+    if ent is not None and ent[0] == "undefined":
+        raise MemcheckError(
+            f"read of an undefined buffer ({ent[2]}): contents are "
+            f"unspecified until the operation completes")
+
+
+def violations() -> int:
+    return _violations
+
+
+def _reset_for_tests() -> None:
+    global _violations
+    with _lock:
+        _tracked.clear()
+        _violations = 0
