@@ -52,10 +52,10 @@ class Win:
             from ompi_tpu.core.errhandler import ERR_INTERN
             raise MPIError(
                 ERR_INTERN,
-                "RMA windows are single-controller only: this "
-                "communicator spans processes. Multi-controller RMA is "
-                "not implemented; use collectives or the per-rank "
-                "execution model's pt2pt instead.")
+                "stacked RMA windows are single-controller only: this "
+                "communicator spans processes. For cross-process RMA "
+                "use the per-rank execution model's RankWindow "
+                "(ompi_tpu.osc.perrank, under mpirun --per-rank).")
         if buffer is not None:
             if buffer.ndim < 2 or buffer.shape[0] != comm.size:
                 raise MPIError(ERR_ARG,

@@ -1,0 +1,245 @@
+"""osc/perrank — one-sided RMA windows for the per-rank execution model.
+
+Behavioral spec: ``ompi/mca/osc/rdma`` — put/get/accumulate against a
+remote exposure region (``osc_rdma_comm.c`` fragments the transfer and
+targets the peer's registered memory), active-target ``fence`` epochs,
+and passive-target ``lock/unlock`` built on remote atomics
+(``osc_rdma_lock.h``); ``osc/sm`` services the same interface over
+shared memory.
+
+TPU-native re-design (round 3): in the per-rank model every rank is an
+OS process, so a window is a LOCAL exposure region (numpy buffer) plus
+an active-message handler registered with the process Router: an
+origin's put/get/accumulate/fetch_op/compare_and_swap is one framed
+message over btl/tcp, applied to the target's region ON THE TARGET'S
+READER THREAD under the window lock (true one-sided progress: the
+target's application thread never participates — the property the
+reference gets from hardware RDMA and agents). Every operation is
+acked, so origin-side completion == remote completion; ``fence`` is
+then simply a comm barrier. Passive-target ``lock/unlock`` run a
+FIFO grant queue at the target (exclusive vs shared), with grants
+delivered as acks — the osc/rdma lock protocol reduced to its
+observable semantics.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ompi_tpu.core.errhandler import ERR_ARG, ERR_RANK, MPIError
+
+LOCK_EXCLUSIVE = 1
+LOCK_SHARED = 2
+
+_ACC_OPS = {
+    "sum": np.add,
+    "prod": np.multiply,
+    "max": np.maximum,
+    "min": np.minimum,
+    "replace": None,                    # MPI_REPLACE
+    "no_op": False,                     # MPI_NO_OP (fetch only)
+    "band": np.bitwise_and,
+    "bor": np.bitwise_or,
+    "bxor": np.bitwise_xor,
+}
+
+
+class RankWindow:
+    """An RMA window whose caller is one rank (collective creation)."""
+
+    def __init__(self, comm, size: int, dtype=np.float32,
+                 name: str = ""):
+        self.comm = comm
+        self.size = int(size)
+        self.dtype = np.dtype(dtype)
+        # window id must agree across ranks: creation is collective ON
+        # THIS communicator, so the sequence lives on the comm — a
+        # process-global counter would diverge when ranks have created
+        # different numbers of windows on OTHER comms
+        if not hasattr(comm, "_win_seq"):
+            comm._win_seq = itertools.count(0)
+        seq = next(comm._win_seq)
+        self.wid = ("win", comm.cid, seq)
+        self.name = name or f"win#{seq}"
+        self.local = np.zeros(self.size, self.dtype)
+        self._lock = threading.Lock()
+        # passive-target lock state (target side)
+        self._holders: List[Tuple[int, int]] = []   # (origin, type)
+        self._waiters: List[Tuple[int, int, int]] = []  # (+ack id)
+        self.comm.router.register_rma(self.wid, self._handle)
+        self.comm.barrier()             # expose epoch starts everywhere
+
+    # ------------------------------------------------------------------
+    def _check_target(self, rank: int) -> int:
+        if not (0 <= rank < self.comm.size):
+            raise MPIError(ERR_RANK, f"bad target rank {rank}")
+        return self.comm.world_rank_of(rank)
+
+    def _rpc(self, target: int, header: dict, payload: Any = None,
+             timeout: float = 120):
+        """One acked active message to ``target``'s window handler."""
+        from ompi_tpu.btl.tcp import encode_payload
+        router = self.comm.router
+        aid, ent = router.new_ack()
+        header.update(rma=True, wid=self.wid, ack_id=aid,
+                      origin=router.rank)
+        raw = b""
+        if payload is not None:
+            header["desc"], raw = encode_payload(payload)
+        router.endpoint.send_frame(self._check_target(target), header,
+                                   raw)
+        if not ent[0].wait(timeout):
+            router.cancel_ack(aid)
+            raise MPIError(ERR_ARG, f"RMA {header.get('op')} to rank "
+                                    f"{target} timed out")
+        return ent[1]
+
+    # -- origin-side API -------------------------------------------------
+    def put(self, data, target: int, disp: int = 0) -> None:
+        arr = np.asarray(data, dtype=self.dtype).ravel()
+        self._bounds(disp, arr.size)
+        self._rpc(target, {"op": "put", "disp": int(disp)}, arr)
+
+    def get(self, target: int, disp: int = 0, count: int = 1):
+        self._bounds(disp, count)
+        return self._rpc(target, {"op": "get", "disp": int(disp),
+                                  "count": int(count)})
+
+    def accumulate(self, data, target: int, disp: int = 0,
+                   op: str = "sum") -> None:
+        if op not in _ACC_OPS or _ACC_OPS[op] is False:
+            raise MPIError(ERR_ARG, f"bad accumulate op {op!r}")
+        arr = np.asarray(data, dtype=self.dtype).ravel()
+        self._bounds(disp, arr.size)
+        self._rpc(target, {"op": "acc", "disp": int(disp), "acc": op},
+                  arr)
+
+    def get_accumulate(self, data, target: int, disp: int = 0,
+                       op: str = "sum"):
+        if op not in _ACC_OPS:           # no_op is legal here (fetch)
+            raise MPIError(ERR_ARG, f"bad accumulate op {op!r}")
+        arr = np.asarray(data, dtype=self.dtype).ravel()
+        self._bounds(disp, arr.size)
+        return self._rpc(target, {"op": "getacc", "disp": int(disp),
+                                  "acc": op}, arr)
+
+    def fetch_and_op(self, value, target: int, disp: int = 0,
+                     op: str = "sum"):
+        out = self.get_accumulate(np.asarray([value], self.dtype),
+                                  target, disp, op)
+        return out[0]
+
+    def compare_and_swap(self, compare, origin, target: int,
+                         disp: int = 0):
+        self._bounds(disp, 1)
+        # compare travels IN the typed payload next to the origin value
+        # (a float() round-trip would corrupt int64 values > 2**53)
+        return self._rpc(target, {"op": "cas", "disp": int(disp)},
+                         np.asarray([origin, compare], self.dtype))[0]
+
+    # -- synchronization ---------------------------------------------
+    def fence(self) -> None:
+        """Active target: all ops are remotely complete when acked, so
+        the epoch boundary is the comm barrier."""
+        self.comm.barrier()
+
+    def lock(self, target: int, lock_type: int = LOCK_EXCLUSIVE) -> None:
+        self._rpc(target, {"op": "lock", "lt": int(lock_type)})
+
+    def unlock(self, target: int) -> None:
+        self._rpc(target, {"op": "unlock"})
+
+    def flush(self, target: int = -1) -> None:
+        pass                            # every op is acked: always flushed
+
+    def free(self) -> None:
+        self.comm.barrier()
+        self.comm.router.unregister_rma(self.wid)
+
+    def _bounds(self, disp: int, count: int) -> None:
+        if disp < 0 or disp + count > self.size:
+            raise MPIError(ERR_ARG,
+                           f"window access [{disp}, {disp + count}) "
+                           f"outside [0, {self.size})")
+
+    # -- target-side handler (runs on btl reader threads) --------------
+    def _handle(self, header: dict, raw: bytes) -> None:
+        from ompi_tpu.btl.tcp import decode_payload
+        router = self.comm.router
+        origin_world = header["origin"]          # world rank of origin
+        op = header["op"]
+        aid = header["ack_id"]
+        data = (decode_payload(header["desc"], raw)
+                if "desc" in header else None)
+        if op == "lock":
+            self._lock_request(origin_world, header["lt"], aid)
+            return
+        reply = None
+        with self._lock:
+            if op == "put":
+                d = header["disp"]
+                self.local[d:d + data.size] = data
+            elif op == "get":
+                d, c = header["disp"], header["count"]
+                reply = self.local[d:d + c].copy()
+            elif op == "acc":
+                d = header["disp"]
+                fn = _ACC_OPS[header["acc"]]
+                seg = self.local[d:d + data.size]
+                self.local[d:d + data.size] = (
+                    data if fn is None else fn(seg, data))
+            elif op == "getacc":
+                d = header["disp"]
+                seg = self.local[d:d + data.size]
+                reply = seg.copy()
+                fn = _ACC_OPS.get(header["acc"])
+                if fn is not False:      # MPI_NO_OP fetches only
+                    self.local[d:d + data.size] = (
+                        data if fn is None else fn(seg, data))
+            elif op == "cas":
+                d = header["disp"]
+                reply = np.array([self.local[d]], self.dtype)
+                if self.local[d] == data[1]:     # typed compare
+                    self.local[d] = data[0]
+            elif op == "unlock":
+                self._unlock(origin_world, aid)
+                return
+        router.send_ack(origin_world, aid, reply)
+
+    # -- passive-target lock queue (target side, non-blocking) --------
+    def _lock_request(self, origin: int, lt: int, aid: int) -> None:
+        with self._lock:
+            grant = (not self._holders if lt == LOCK_EXCLUSIVE
+                     else all(t == LOCK_SHARED
+                              for _, t in self._holders))
+            if grant and not self._waiters:
+                self._holders.append((origin, lt))
+            else:
+                self._waiters.append((origin, lt, aid))
+                return
+        self.comm.router.send_ack(origin, aid)   # grant
+
+    def _unlock(self, origin: int, aid: int) -> None:
+        # caller holds self._lock
+        self._holders = [(o, t) for (o, t) in self._holders
+                         if o != origin]
+        grants = []
+        while self._waiters:
+            o, t, a = self._waiters[0]
+            ok = (not self._holders if t == LOCK_EXCLUSIVE
+                  else all(ht == LOCK_SHARED
+                           for _, ht in self._holders))
+            if not ok:
+                break
+            self._waiters.pop(0)
+            self._holders.append((o, t))
+            grants.append((o, a))
+            if t == LOCK_EXCLUSIVE:
+                break
+        router = self.comm.router
+        router.send_ack(origin, aid)             # unlock complete
+        for o, a in grants:
+            router.send_ack(o, a)                # deferred lock grants

@@ -46,9 +46,15 @@ class Router:
         self.nprocs = nprocs
         self._engines: Dict[Any, "PerRankEngine"] = {}
         self._pending: Dict[Any, List[Tuple[dict, bytes]]] = {}
-        self._acks: Dict[int, threading.Event] = {}
+        # ack id -> [Event, reply payload] (replies carry RMA get/fetch
+        # results back to the origin)
+        self._acks: Dict[int, list] = {}
         self._ack_ids = itertools.count(1)
         self._lock = threading.Lock()
+        # wid -> handler(header, raw) for one-sided targets (the osc
+        # active-message plane; handlers run on reader threads and must
+        # not block)
+        self._rma: Dict[Any, Any] = {}
         self.endpoint = TcpEndpoint(rank, nprocs, kv_set, kv_get,
                                     self._deliver)
 
@@ -63,20 +69,45 @@ class Router:
         with self._lock:
             self._engines.pop(cid, None)
 
-    def new_ack(self) -> Tuple[int, threading.Event]:
+    def new_ack(self) -> Tuple[int, list]:
+        """Returns (ack id, entry). The entry is ``[Event, reply]``;
+        _deliver pops the table slot and mutates THIS list, so waiters
+        read the reply from their own reference and nothing leaks —
+        one entry per ack regardless of who forgets to collect it."""
         aid = next(self._ack_ids)
-        ev = threading.Event()
+        ent = [threading.Event(), None]
         with self._lock:
-            self._acks[aid] = ev
-        return aid, ev
+            self._acks[aid] = ent
+        return aid, ent
+
+    def cancel_ack(self, aid: int) -> None:
+        """Drop a pending ack slot (timeout path)."""
+        with self._lock:
+            self._acks.pop(aid, None)
+
+    def register_rma(self, wid, handler) -> None:
+        with self._lock:
+            self._rma[wid] = handler
+
+    def unregister_rma(self, wid) -> None:
+        with self._lock:
+            self._rma.pop(wid, None)
 
     def _deliver(self, header: dict, raw: bytes) -> None:
         """Called from btl reader threads (and loopback sends)."""
         if header.get("ctl") == "ack":
             with self._lock:
-                ev = self._acks.pop(header["ack_id"], None)
-            if ev is not None:
-                ev.set()
+                ent = self._acks.pop(header["ack_id"], None)
+            if ent is not None:
+                if "desc" in header:
+                    ent[1] = decode_payload(header["desc"], raw)
+                ent[0].set()
+            return
+        if "rma" in header:
+            with self._lock:
+                h = self._rma.get(header["wid"])
+            if h is not None:
+                h(header, raw)
             return
         cid = header["cid"]
         with self._lock:
@@ -86,9 +117,13 @@ class Router:
                 return
         eng._incoming(header, raw)
 
-    def send_ack(self, world_rank: int, ack_id: int) -> None:
-        self.endpoint.send_frame(world_rank, {"ctl": "ack",
-                                              "ack_id": ack_id})
+    def send_ack(self, world_rank: int, ack_id: int,
+                 reply: Any = None) -> None:
+        header = {"ctl": "ack", "ack_id": ack_id}
+        raw = b""
+        if reply is not None:
+            header["desc"], raw = encode_payload(reply)
+        self.endpoint.send_frame(world_rank, header, raw)
 
     def close(self) -> None:
         self.endpoint.close()
@@ -209,14 +244,15 @@ class PerRankEngine:
         desc, raw = encode_payload(data)
         header = {"cid": self.comm.cid, "src": self.comm.rank(),
                   "tag": tag, "desc": desc}
-        ev = None
+        ent = aid = None
         if synchronous:
-            aid, ev = self.router.new_ack()
+            aid, ent = self.router.new_ack()
             header["ack_id"] = aid
             header["wsrc"] = self.comm.world_rank_of(self.comm.rank())
         self.router.endpoint.send_frame(self.comm.world_rank_of(dest),
                                         header, raw)
-        if ev is not None and not ev.wait(600):
+        if ent is not None and not ent[0].wait(600):
+            self.router.cancel_ack(aid)
             raise MPIError(ERR_PENDING,
                            "ssend timed out waiting for the receive")
         return Request.completed()

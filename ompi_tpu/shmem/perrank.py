@@ -1,0 +1,152 @@
+"""shmem/perrank — OpenSHMEM for the per-rank execution model.
+
+Behavioral spec: ``oshmem/`` — PEs address each other's symmetric heap
+with plain offsets (symmetry by construction: every PE allocates the
+same segments in the same order, ``memheap``); ``spml`` provides
+put/get with remote completion (``spml.h:229-330``); ``scoll/mpi``
+delegates collectives to the MPI stack; atomics through ``atomic/*``.
+
+TPU-native re-design: one PE == one OS process == one MPI rank. The
+symmetric heap is a :class:`RankWindow` exposure region per PE (the
+reference's mmap'd segment), so a "symmetric address" is an offset
+valid on every PE; put/get/atomics are the window's acked active
+messages over btl/tcp (target-side application on the reader thread —
+genuine one-sided progress, the spml put/get contract);
+``shmem_wait_until`` polls the LOCAL heap, which remote puts mutate
+asynchronously — the flag-polling idiom every SHMEM program is built
+on (and the structure the reference fork's switch barriers offload).
+Collectives delegate to the per-rank communicator (scoll/mpi's exact
+design).
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+import numpy as np
+
+from ompi_tpu.core import op as op_mod
+from ompi_tpu.core.errhandler import ERR_ARG, ERR_PENDING, MPIError
+from ompi_tpu.osc.perrank import RankWindow
+from ompi_tpu.shmem.api import (CMP_EQ, CMP_GE, CMP_GT, CMP_LE, CMP_LT,
+                                CMP_NE, _CMP_FNS)
+
+
+class ShmemRankCtx:
+    """A per-rank SHMEM context: my PE number is real, peers are other
+    processes."""
+
+    def __init__(self, comm, heap_size: int = 1 << 12,
+                 dtype=np.float32):
+        self.comm = comm
+        self.heap_size = int(heap_size)
+        self.win = RankWindow(comm, heap_size, dtype=dtype,
+                              name="symheap")
+        self._brk = 0
+
+    # -- PE identity ----------------------------------------------------
+    def my_pe(self) -> int:
+        return self.comm.rank()
+
+    def n_pes(self) -> int:
+        return self.comm.size
+
+    # -- symmetric allocation (shmem_malloc: collective, same offset
+    # everywhere — the memheap contract) --------------------------------
+    def malloc(self, count: int) -> int:
+        if self._brk + count > self.heap_size:
+            raise MPIError(ERR_ARG, "symmetric heap exhausted")
+        off = self._brk
+        self._brk += count
+        return off
+
+    # -- RMA (spml put/get) ----------------------------------------------
+    def put(self, dest_off: int, data, pe: int) -> None:
+        self.win.put(data, pe, dest_off)
+
+    def get(self, src_off: int, count: int, pe: int) -> np.ndarray:
+        return self.win.get(pe, src_off, count)
+
+    def p(self, off: int, value, pe: int) -> None:
+        self.win.put([value], pe, off)
+
+    def g(self, off: int, pe: int):
+        return self.win.get(pe, off, 1)[0]
+
+    # -- atomics (oshmem/mca/atomic) ---------------------------------
+    def atomic_add(self, off: int, value, pe: int) -> None:
+        self.win.accumulate([value], pe, off, op="sum")
+
+    def atomic_fetch_add(self, off: int, value, pe: int):
+        return self.win.fetch_and_op(value, pe, off, op="sum")
+
+    def atomic_fetch(self, off: int, pe: int):
+        return self.win.fetch_and_op(0, pe, off, op="no_op")
+
+    def atomic_set(self, off: int, value, pe: int) -> None:
+        self.win.accumulate([value], pe, off, op="replace")
+
+    def atomic_compare_swap(self, off: int, cond, value, pe: int):
+        return self.win.compare_and_swap(cond, value, pe, off)
+
+    # -- ordering / sync -------------------------------------------------
+    def fence(self) -> None:
+        """shmem_fence/quiet: every put is acked, so ordering and
+        remote completion already hold."""
+
+    quiet = fence
+
+    def barrier_all(self) -> None:
+        self.comm.barrier()
+
+    def wait_until(self, off: int, cmp: int, value,
+                   timeout: float = 60) -> None:
+        """Poll the LOCAL heap until the comparison holds — the flag
+        that a remote PE's put/atomic flips (shmem_wait_until)."""
+        fn = _CMP_FNS[cmp]
+        deadline = time.monotonic() + timeout
+        poll = 0.0002
+        while True:
+            with self.win._lock:
+                cur = self.win.local[off]
+            if fn(cur, value):
+                return
+            if time.monotonic() > deadline:
+                raise MPIError(ERR_PENDING,
+                               f"shmem_wait_until timed out "
+                               f"(local[{off}]={cur})")
+            time.sleep(poll)
+            poll = min(poll * 2, 0.005)
+
+    def test(self, off: int, cmp: int, value) -> bool:
+        with self.win._lock:
+            return bool(_CMP_FNS[cmp](self.win.local[off], value))
+
+    # -- collectives (scoll/mpi: delegate to the MPI stack) -----------
+    def broadcast(self, off: int, count: int, root_pe: int) -> None:
+        with self.win._lock:
+            seg = self.win.local[off:off + count].copy()
+        out = self.comm.bcast(seg, root=root_pe)
+        with self.win._lock:
+            self.win.local[off:off + count] = out
+
+    def collect(self, src_off: int, count: int) -> np.ndarray:
+        with self.win._lock:
+            seg = self.win.local[src_off:src_off + count].copy()
+        return np.concatenate(self.comm.allgather(seg))
+
+    def reduce(self, off: int, count: int,
+               op: op_mod.Op = op_mod.SUM) -> np.ndarray:
+        with self.win._lock:
+            seg = self.win.local[off:off + count].copy()
+        return np.asarray(self.comm.allreduce(seg, op))
+
+    def finalize(self) -> None:
+        self.win.free()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.finalize()
+        return False

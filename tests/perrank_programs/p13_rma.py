@@ -1,0 +1,80 @@
+"""One-sided RMA across real processes: put/get/accumulate/fetch_op/
+compare_and_swap against a remote window, fence epochs, passive-target
+lock/unlock. The target's application thread never cooperates — true
+one-sided progress over the btl/tcp active-message plane."""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"   # must beat any sitecustomize platform pin
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np               # noqa: E402
+import ompi_tpu as MPI           # noqa: E402
+from ompi_tpu.osc.perrank import LOCK_EXCLUSIVE, RankWindow  # noqa: E402
+
+MPI.Init()
+world = MPI.get_comm_world()
+r, n = world.rank(), world.size
+
+win = RankWindow(world, 16, np.float32)
+
+# active-target epoch: everyone puts its rank into slot r of rank 0
+win.fence()
+win.put(np.array([float(r + 1)]), target=0, disp=r)
+win.fence()
+if r == 0:
+    assert np.allclose(win.local[:n],
+                       np.arange(1, n + 1, dtype=np.float32)), win.local
+
+# accumulate: everyone adds 1 into slot 8 of rank n-1
+win.fence()
+win.accumulate([1.0], target=n - 1, disp=8, op="sum")
+win.fence()
+if r == n - 1:
+    assert win.local[8] == float(n), win.local[8]
+
+# get reads a remote region one-sidedly
+got = win.get(target=0, disp=0, count=n)
+assert np.allclose(got, np.arange(1, n + 1, dtype=np.float32)), got
+
+# fetch_and_op serializes a shared counter at rank 0 slot 12
+old = win.fetch_and_op(1.0, target=0, disp=12, op="sum")
+assert 0.0 <= old < n
+win.fence()
+if r == 0:
+    assert win.local[12] == float(n)
+
+# compare_and_swap: exactly one rank wins the election at slot 15
+prev = win.compare_and_swap(0.0, float(r + 1), target=0, disp=15)
+wins = world.allreduce(1 if prev == 0.0 else 0, MPI.SUM)
+assert wins == 1, wins
+
+# passive target: serialize read-modify-write under an exclusive lock
+win.fence()
+for _ in range(3):
+    win.lock(1, LOCK_EXCLUSIVE)
+    cur = win.get(target=1, disp=3, count=1)[0]
+    win.put([cur + 1.0], target=1, disp=3)
+    win.unlock(1)
+world.barrier()
+if r == 1:
+    assert win.local[3] == float(3 * n), win.local[3]
+
+win.free()
+
+# cross-comm wid agreement: ranks with DIFFERENT window-creation
+# histories (a subcomm window on evens only) must still agree on the
+# next world window's id — the sequence is per-comm, not per-process
+sub = world.split(color=r % 2)
+if r % 2 == 0:
+    wsub = RankWindow(sub, 4, np.float32)
+    wsub.put([float(r + 50)], target=0, disp=0)
+    wsub.fence()
+    wsub.free()
+w2 = RankWindow(world, 4, np.float32)
+w2.put([float(r)], target=(r + 1) % n, disp=0)
+w2.fence()
+assert w2.local[0] == float((r - 1) % n), w2.local
+w2.free()
+sub.free()
+
+MPI.Finalize()
+print(f"OK p13_rma rank={r}/{n}", flush=True)

@@ -34,6 +34,8 @@ CASES = [
     ("p10_split.py", 4),
     ("p11_scan_reduce.py", 3),
     ("p12_ssend_mprobe.py", 2),
+    ("p13_rma.py", 3),
+    ("p14_shmem.py", 3),
 ]
 
 
