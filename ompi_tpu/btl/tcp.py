@@ -113,7 +113,14 @@ class TcpEndpoint:
                 praw = self._read_exact(conn, plen) if plen else b""
                 if hraw is None or praw is None:
                     return
-                self.sink(pickle.loads(hraw), praw)
+                try:
+                    self.sink(pickle.loads(hraw), praw)
+                except Exception:        # noqa: BLE001
+                    # a failing handler must not kill the reader (that
+                    # would silently drop every later frame from this
+                    # peer); handlers report their own errors
+                    import traceback
+                    traceback.print_exc()
         except OSError:
             return
         finally:

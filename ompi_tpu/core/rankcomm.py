@@ -626,6 +626,104 @@ class RankCommunicator:
             parent=self, errhandler=self.errhandler,
             info=info or self.info)
 
+    # -- process topologies (textbook cart surface) --------------------
+    def create_cart(self, dims: Sequence[int],
+                    periods: Optional[Sequence[bool]] = None,
+                    reorder: bool = False
+                    ) -> Optional["RankCommunicator"]:
+        """MPI_Cart_create, textbook signature: callers beyond the cart
+        size get None (MPI_COMM_NULL)."""
+        import math
+        from ompi_tpu.topo import CartTopology
+        dims = list(dims)
+        n = math.prod(dims)
+        if n > self.size:
+            self._err(ERR_ARG, f"cart size {n} exceeds comm size")
+        sub = self.split(0 if self._rank < n else UNDEFINED)
+        if sub is None:
+            return None
+        sub.topo = CartTopology(dims, list(periods) if periods
+                                else [False] * len(dims))
+        sub.name = f"{self.name}.cart"
+        return sub
+
+    def _cart(self):
+        from ompi_tpu.topo import CartTopology
+        if not isinstance(self.topo, CartTopology):
+            from ompi_tpu.core.errhandler import ERR_TOPOLOGY
+            self._err(ERR_TOPOLOGY,
+                      "communicator has no cartesian topology")
+        return self.topo
+
+    def cart_coords(self, rank: Optional[int] = None):
+        return self._cart().coords(self._rank if rank is None else rank)
+
+    def cart_rank(self, coords: Sequence[int]) -> int:
+        return self._cart().rank(coords)
+
+    def cart_shift(self, direction: int, disp: int = 1):
+        """MPI_Cart_shift for THIS rank: (source, dest)."""
+        return self._cart().shift(self._rank, direction, disp)
+
+    def neighbor_allgather(self, data: Any) -> List[Any]:
+        """MPI_Neighbor_allgather, textbook: exchange ``data`` with each
+        topology neighbor; returns received buffers in neighbor order
+        (None at invalid slots — alignment is never shifted). Balanced
+        eager sendrecv per slot: every edge endpoint sends once and
+        receives once per slot pair, FIFO keeps duplicate edges
+        ordered."""
+        self._check()
+        if self.topo is None:
+            from ompi_tpu.core.errhandler import ERR_TOPOLOGY
+            self._err(ERR_TOPOLOGY, "no topology attached")
+        # post ALL receives, then send ALL, then wait — a sequential
+        # per-slot wait deadlocks on periodic rings of size >= 3 (each
+        # rank's slot-0 wait needs a frame its neighbor only sends
+        # after ITS slot-0 wait: a cycle)
+        nbrs = list(self.topo.neighbors(self._rank))
+        t = self._tag()
+        reqs = [self._coll_pml.irecv(nb, t)
+                if 0 <= nb < self.size else None for nb in nbrs]
+        for nb in nbrs:
+            if 0 <= nb < self.size:
+                self._coll_pml.send(data, nb, t)
+        out: List[Any] = []
+        for q in reqs:
+            if q is None:
+                out.append(None)
+            else:
+                q.wait()
+                out.append(q.get())
+        return out
+
+    def neighbor_alltoall(self, chunks: Sequence[Any]) -> List[Any]:
+        """MPI_Neighbor_alltoall, textbook: chunk j goes to my j-th
+        neighbor; returns one buffer per neighbor slot (None at invalid
+        slots)."""
+        self._check()
+        if self.topo is None:
+            from ompi_tpu.core.errhandler import ERR_TOPOLOGY
+            self._err(ERR_TOPOLOGY, "no topology attached")
+        nbrs = list(self.topo.neighbors(self._rank))
+        if len(chunks) != len(nbrs):
+            self._err(ERR_COUNT, "need one chunk per neighbor slot")
+        t = self._tag()
+        reqs: List[Optional[RankRequest]] = []
+        for nb in nbrs:
+            reqs.append(self._coll_pml.irecv(nb, t)
+                        if 0 <= nb < self.size else None)
+        for nb, c in zip(nbrs, chunks):
+            if 0 <= nb < self.size:
+                self._coll_pml.send(c, nb, t)
+        out: List[Any] = []
+        for q in reqs:
+            if q is None:
+                out.append(None)
+            else:
+                q.wait()
+                out.append(q.get())
+        return out
+
     def create(self, group: Group) -> Optional["RankCommunicator"]:
         self._check()
         seq = next(self._create_seq)

@@ -70,7 +70,11 @@ class RankWindow:
         self._holders: List[Tuple[int, int]] = []   # (origin, type)
         self._waiters: List[Tuple[int, int, int]] = []  # (+ack id)
         self.comm.router.register_rma(self.wid, self._handle)
-        self.comm.barrier()             # expose epoch starts everywhere
+        # per-process window sizes may legitimately differ (MPI_Win):
+        # exchange them so origin-side bounds checks use the TARGET's
+        # exposure size (the osc_rdma region-table role); doubles as
+        # the expose-epoch barrier
+        self.sizes = [int(x) for x in self.comm.allgather(self.size)]
 
     # ------------------------------------------------------------------
     def _check_target(self, rank: int) -> int:
@@ -95,16 +99,23 @@ class RankWindow:
             router.cancel_ack(aid)
             raise MPIError(ERR_ARG, f"RMA {header.get('op')} to rank "
                                     f"{target} timed out")
-        return ent[1]
+        reply = ent[1]
+        if isinstance(reply, dict) and "rma_error" in reply:
+            # target-side failure travels back as an error reply, so
+            # the origin raises promptly instead of timing out
+            raise MPIError(ERR_ARG,
+                           f"RMA {header.get('op')} failed at rank "
+                           f"{target}: {reply['rma_error']}")
+        return reply
 
     # -- origin-side API -------------------------------------------------
     def put(self, data, target: int, disp: int = 0) -> None:
         arr = np.asarray(data, dtype=self.dtype).ravel()
-        self._bounds(disp, arr.size)
+        self._bounds(disp, arr.size, target)
         self._rpc(target, {"op": "put", "disp": int(disp)}, arr)
 
     def get(self, target: int, disp: int = 0, count: int = 1):
-        self._bounds(disp, count)
+        self._bounds(disp, count, target)
         return self._rpc(target, {"op": "get", "disp": int(disp),
                                   "count": int(count)})
 
@@ -113,7 +124,7 @@ class RankWindow:
         if op not in _ACC_OPS or _ACC_OPS[op] is False:
             raise MPIError(ERR_ARG, f"bad accumulate op {op!r}")
         arr = np.asarray(data, dtype=self.dtype).ravel()
-        self._bounds(disp, arr.size)
+        self._bounds(disp, arr.size, target)
         self._rpc(target, {"op": "acc", "disp": int(disp), "acc": op},
                   arr)
 
@@ -122,7 +133,7 @@ class RankWindow:
         if op not in _ACC_OPS:           # no_op is legal here (fetch)
             raise MPIError(ERR_ARG, f"bad accumulate op {op!r}")
         arr = np.asarray(data, dtype=self.dtype).ravel()
-        self._bounds(disp, arr.size)
+        self._bounds(disp, arr.size, target)
         return self._rpc(target, {"op": "getacc", "disp": int(disp),
                                   "acc": op}, arr)
 
@@ -134,7 +145,7 @@ class RankWindow:
 
     def compare_and_swap(self, compare, origin, target: int,
                          disp: int = 0):
-        self._bounds(disp, 1)
+        self._bounds(disp, 1, target)
         # compare travels IN the typed payload next to the origin value
         # (a float() round-trip would corrupt int64 values > 2**53)
         return self._rpc(target, {"op": "cas", "disp": int(disp)},
@@ -159,14 +170,29 @@ class RankWindow:
         self.comm.barrier()
         self.comm.router.unregister_rma(self.wid)
 
-    def _bounds(self, disp: int, count: int) -> None:
-        if disp < 0 or disp + count > self.size:
+    def _bounds(self, disp: int, count: int,
+                target: Optional[int] = None) -> None:
+        limit = (self.sizes[target] if target is not None
+                 else self.size)
+        if disp < 0 or disp + count > limit:
             raise MPIError(ERR_ARG,
                            f"window access [{disp}, {disp + count}) "
-                           f"outside [0, {self.size})")
+                           f"outside [0, {limit}) at rank "
+                           f"{target if target is not None else 'self'}")
 
     # -- target-side handler (runs on btl reader threads) --------------
     def _handle(self, header: dict, raw: bytes) -> None:
+        # runs on a btl reader thread: NOTHING may escape (an uncaught
+        # exception would kill the reader and silently drop every later
+        # frame from that peer) — failures reply as rma_error
+        try:
+            self._handle_inner(header, raw)
+        except Exception as e:          # noqa: BLE001
+            self.comm.router.send_ack(
+                header["origin"], header["ack_id"],
+                {"rma_error": f"{type(e).__name__}: {e}"})
+
+    def _handle_inner(self, header: dict, raw: bytes) -> None:
         from ompi_tpu.btl.tcp import decode_payload
         router = self.comm.router
         origin_world = header["origin"]          # world rank of origin
@@ -181,9 +207,13 @@ class RankWindow:
         with self._lock:
             if op == "put":
                 d = header["disp"]
+                if d + data.size > self.size:
+                    raise MPIError(ERR_ARG, "put past exposure region")
                 self.local[d:d + data.size] = data
             elif op == "get":
                 d, c = header["disp"], header["count"]
+                if d + c > self.size:
+                    raise MPIError(ERR_ARG, "get past exposure region")
                 reply = self.local[d:d + c].copy()
             elif op == "acc":
                 d = header["disp"]

@@ -76,5 +76,24 @@ assert w2.local[0] == float((r - 1) % n), w2.local
 w2.free()
 sub.free()
 
+# regression: asymmetric window sizes — origin checks the TARGET's
+# exposure size and a target-side failure raises promptly (error
+# reply), never wedging the connection
+w3 = RankWindow(world, 16 if r == 0 else 4, np.float32)
+assert w3.sizes[0] == 16 and all(s == 4 for s in w3.sizes[1:])
+if r == 1:
+    w3.put([1.0] * 8, target=0, disp=2)     # fits 0's larger region
+try:
+    w3.put([1.0], target=1, disp=10)        # past 1's exposure
+    raise SystemExit("no bounds error for remote window")
+except MPI.MPIError:
+    pass
+w3.fence()
+# the connection survived the rejected op: normal traffic still flows
+w3.put([float(r)], target=0, disp=r)
+w3.fence()
+w3.free()
+print(f"OK p13b_asym rank={r}/{n}", flush=True)
+
 MPI.Finalize()
 print(f"OK p13_rma rank={r}/{n}", flush=True)

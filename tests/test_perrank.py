@@ -36,6 +36,8 @@ CASES = [
     ("p12_ssend_mprobe.py", 2),
     ("p13_rma.py", 3),
     ("p14_shmem.py", 3),
+    ("p15_cart_halo.py", 4),
+    ("p16_master_worker.py", 4),
 ]
 
 
