@@ -65,11 +65,16 @@ class TcpEndpoint:
     def __init__(self, rank: int, nprocs: int,
                  kv_set: Callable[[str, str], None],
                  kv_get: Callable[[str], str],
-                 sink: Callable[[dict, bytes], None]):
+                 sink: Callable[[dict, bytes], None],
+                 on_peer_lost: Optional[Callable[[int], None]] = None):
         self.rank = rank
         self.nprocs = nprocs
         self._kv_get = kv_get
         self.sink = sink
+        # failure-detector ingress (the PRRTE-daemon-notices-a-dead-
+        # process role): called with the peer rank when an identified
+        # inbound connection hits EOF/error before close()
+        self.on_peer_lost = on_peer_lost
         self._peers: Dict[int, socket.socket] = {}
         self._peer_locks: Dict[int, threading.Lock] = {}
         self._lock = threading.Lock()
@@ -101,33 +106,48 @@ class TcpEndpoint:
             t.start()
 
     def _read_loop(self, conn: socket.socket) -> None:
+        peer = -1                            # set by the hello frame
         try:
             while not self._closed:
                 head = self._read_exact(conn, _LEN.size)
                 if head is None:
-                    return
+                    break
                 magic, hlen, plen = _LEN.unpack(head)
                 if magic != MAGIC:
-                    return                   # corrupt stream: drop conn
+                    peer = -1                # corrupt stream: drop the
+                    break                    # conn, NOT a death report
                 hraw = self._read_exact(conn, hlen)
                 praw = self._read_exact(conn, plen) if plen else b""
                 if hraw is None or praw is None:
-                    return
+                    break
                 try:
-                    self.sink(pickle.loads(hraw), praw)
-                except Exception:        # noqa: BLE001
-                    # a failing handler must not kill the reader (that
-                    # would silently drop every later frame from this
-                    # peer); handlers report their own errors
+                    header = pickle.loads(hraw)
+                    if header.get("ctl") == "hello":
+                        peer = header["peer"]   # identify the sender
+                        continue
+                    self.sink(header, praw)
+                except Exception:            # noqa: BLE001
+                    # a malformed frame or failing handler must not
+                    # kill the reader (the finally would then falsely
+                    # report a LIVE peer dead); framing stays aligned —
+                    # the lengths were already consumed exactly
                     import traceback
                     traceback.print_exc()
         except OSError:
-            return
+            pass
         finally:
             try:
                 conn.close()
             except OSError:
                 pass
+            # EOF/error on an identified inbound connection while the
+            # endpoint is alive == the peer process died (graceful
+            # shutdown closes AFTER the fini fence, with _closed set)
+            if peer >= 0 and not self._closed and self.on_peer_lost:
+                try:
+                    self.on_peer_lost(peer)
+                except Exception:            # noqa: BLE001
+                    pass
 
     @staticmethod
     def _read_exact(conn: socket.socket, n: int) -> Optional[bytes]:
@@ -152,10 +172,17 @@ class TcpEndpoint:
         with self._lock:
             # lost race: keep the first connection
             cur = self._peers.setdefault(peer, s)
-            if cur is not s:
-                s.close()
+            won = cur is s
             self._peer_locks.setdefault(peer, threading.Lock())
-            return cur
+        if not won:
+            s.close()        # never sent a byte: unidentified, no
+            return cur       # false positive at the peer's detector
+        # identify ourselves so the peer's failure detector knows whose
+        # EOF this connection's death would be
+        hraw = pickle.dumps({"ctl": "hello", "peer": self.rank})
+        with self._peer_locks[peer]:
+            s.sendall(_LEN.pack(MAGIC, len(hraw), 0) + hraw)
+        return s
 
     def send_frame(self, peer: int, header: dict,
                    payload: bytes = b"") -> None:

@@ -736,6 +736,82 @@ class RankCommunicator:
             name=f"{self.name}.create", parent=self,
             errhandler=self.errhandler)
 
+    # -- ULFM over real process death (mpiext/ftmpi semantics) ---------
+    # The failure detector is the btl/tcp connection monitor (an
+    # identified peer's EOF == PMIx failure event); these methods are
+    # the MPIX_Comm_* recovery surface for the per-rank world.
+    def get_failed(self) -> List[int]:
+        """MPIX_Comm_get_failed: comm-local ranks known dead."""
+        from ompi_tpu.runtime import ft
+        return [r for r in range(self.size)
+                if ft.is_failed(self.group.world_ranks[r])]
+
+    # reserved shrink-exchange tag (outside the per-collective sequence
+    # so a survivor retrying after a stale leader election still
+    # matches the true leader's collection)
+    _SHRINK_TAG = 1 << 30
+
+    def shrink(self, timeout: float = 20) -> "RankCommunicator":
+        """MPIX_Comm_shrink: survivors agree on the failed set (leader
+        collects each survivor's view — a silent rank is itself
+        suspected, the ftagree suspicion rule — and redistributes) and
+        build the survivor communicator. Collective among survivors.
+        Retried when a survivor's stale failure view elected a dead
+        leader (detection is asynchronous; the failed first exchange
+        itself surfaces the death, and the retry settles)."""
+        last: Optional[MPIError] = None
+        for _ in range(3):
+            try:
+                return self._shrink_once(timeout)
+            except MPIError as e:
+                last = e
+                import time
+                time.sleep(0.2)          # let the detector settle
+        raise last
+
+    def _shrink_once(self, timeout: float) -> "RankCommunicator":
+        # NO draw from _create_seq here: ranks may take different
+        # numbers of retry attempts, and divergent draws would desync
+        # every later dup/split cid. The child cid derives from the
+        # AGREED failed set instead (same on every survivor, distinct
+        # per failure epoch).
+        t = self._SHRINK_TAG
+        my_failed = set(self.get_failed())
+        alive_guess = [r for r in range(self.size)
+                       if r not in my_failed]
+        leader = alive_guess[0]
+        if self._rank == leader:
+            union = set(my_failed)
+            for r in alive_guess:
+                if r == leader:
+                    continue
+                try:
+                    data, _ = self._coll_pml.recv(r, t, timeout=timeout)
+                    union |= set(int(x) for x in data)
+                except MPIError:
+                    union.add(r)        # silent: suspect it too
+            final = sorted(union)
+            for r in range(self.size):
+                if r not in union and r != leader:
+                    try:
+                        self._coll_pml.send(final, r, t)
+                    except MPIError:
+                        pass            # died since; it is in no group
+        else:
+            self._coll_pml.send(sorted(my_failed), leader, t)
+            # the leader may serially spend up to `timeout` on each
+            # silent rank before answering: wait proportionally longer
+            data, _ = self._coll_pml.recv(
+                leader, t, timeout=timeout * max(2, len(alive_guess)))
+            final = [int(x) for x in data]
+        survivors = [r for r in range(self.size) if r not in final]
+        g = Group([self.group.world_ranks[r] for r in survivors])
+        return RankCommunicator(
+            g, self._my_world, self.router,
+            cid=("shrink", self.cid, tuple(final)),
+            name=f"{self.name}.shrink", parent=self,
+            errhandler=self.errhandler)
+
     def free(self) -> None:
         self._pml.close()
         self._coll_pml.close()

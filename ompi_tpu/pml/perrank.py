@@ -55,8 +55,58 @@ class Router:
         # active-message plane; handlers run on reader threads and must
         # not block)
         self._rma: Dict[Any, Any] = {}
+        self._closing = False
+        self._departed: set = set()      # peers that said goodbye
         self.endpoint = TcpEndpoint(rank, nprocs, kv_set, kv_get,
-                                    self._deliver)
+                                    self._deliver,
+                                    on_peer_lost=self._peer_lost)
+
+    def wire_up(self) -> None:
+        """Eagerly connect to every peer (the reference's add_procs
+        endpoint setup). Besides first-send latency, this is what makes
+        the failure detector COMPLETE: each pair then has identified
+        connections in both directions, so a process death is observed
+        by every survivor — not just the peers the victim happened to
+        message. (At real scale this would be lazy wire-up plus an
+        obituary gossip; eager is right for the worlds one host runs.)"""
+        for peer in range(self.nprocs):
+            if peer != self.rank:
+                try:
+                    self.endpoint._connect(peer)
+                except Exception:        # noqa: BLE001 — peer may be
+                    pass                 # dead already; detector covers
+
+    # -- failure detection (ULFM over real process death) --------------
+    def begin_shutdown(self) -> None:
+        """Called at finalize: announce graceful departure to every
+        connected peer (a 'bye' obituary-suppressor — without it, a
+        fast survivor's close after a failure would look like a second
+        death to slower survivors), then stop treating EOFs as
+        failures locally."""
+        for peer in list(self.endpoint._peers):
+            try:
+                self.endpoint.send_frame(peer, {"ctl": "bye",
+                                                "peer": self.rank})
+            except Exception:            # noqa: BLE001
+                pass
+        self._closing = True
+
+    def _peer_lost(self, world_rank: int) -> None:
+        """An identified peer connection died: the ULFM event. Mark the
+        rank failed in the process default registry and complete every
+        pending receive that could have matched it in error
+        (ompi/request/req_ft.c behavior over a REAL dead process)."""
+        if self._closing or world_rank in self._departed:
+            return                       # graceful exit, not death
+        from ompi_tpu.runtime import ft
+        ft.fail_rank(world_rank, "peer connection lost")
+        with self._lock:
+            engines = list(self._engines.values())
+        for eng in engines:
+            try:
+                eng._peer_failed(world_rank)
+            except Exception:            # noqa: BLE001
+                pass
 
     def register(self, cid, engine: "PerRankEngine") -> None:
         with self._lock:
@@ -95,6 +145,10 @@ class Router:
 
     def _deliver(self, header: dict, raw: bytes) -> None:
         """Called from btl reader threads (and loopback sends)."""
+        if header.get("ctl") == "bye":
+            with self._lock:
+                self._departed.add(header["peer"])
+            return
         if header.get("ctl") == "ack":
             with self._lock:
                 ent = self._acks.pop(header["ack_id"], None)
@@ -126,6 +180,7 @@ class Router:
         self.endpoint.send_frame(world_rank, header, raw)
 
     def close(self) -> None:
+        self._closing = True
         self.endpoint.close()
 
 
@@ -148,6 +203,7 @@ class RankRequest(Request):
         super().__init__(arrays=[])
         self._complete = False
         self._event = threading.Event()
+        self._error: Optional[BaseException] = None
         self.status = Status(source=src, tag=tag)
 
     def _deliver(self, msg: _Msg) -> None:
@@ -158,13 +214,24 @@ class RankRequest(Request):
         self._complete = True
         self._event.set()
 
+    def _fail(self, err: BaseException) -> None:
+        """ULFM (req_ft.c): complete the pending request in error —
+        the matching send can never arrive from a dead peer."""
+        self._error = err
+        self._complete = True
+        self._event.set()
+
     def test(self):
+        if self._complete and self._error is not None:
+            raise self._error
         return (True, self.status) if self._complete else (False, None)
 
     def wait(self, timeout: Optional[float] = None):
         if not self._event.wait(timeout if timeout is not None else 600):
             raise MPIError(ERR_PENDING,
                            "recv timed out waiting for a matching send")
+        if self._error is not None:
+            raise self._error
         return self.status
 
 
@@ -241,6 +308,13 @@ class PerRankEngine:
         if not isinstance(tag, int) or tag < 0:
             raise MPIError(ERR_TAG, f"send tag must be an int >= 0, "
                                     f"got {tag!r}")
+        from ompi_tpu.runtime import ft
+        if ft.is_failed(self.comm.world_rank_of(dest)):
+            # symmetric with the recv fail-fast: no silent buffering
+            # into a dead socket, no raw OSError later
+            from ompi_tpu.core.errhandler import ERR_PROC_FAILED
+            raise MPIError(ERR_PROC_FAILED,
+                           f"send peer rank {dest} has failed")
         desc, raw = encode_payload(data)
         header = {"cid": self.comm.cid, "src": self.comm.rank(),
                   "tag": tag, "desc": desc}
@@ -271,7 +345,46 @@ class PerRankEngine:
         if msg is not None:
             self._ack(msg)
             req._deliver(msg)
+            return req
+        # a receive posted AFTER the peer's death can never match
+        # (req_ft.c: fail fast instead of hanging); in-flight failures
+        # are flushed by _peer_failed
+        if source != ANY_SOURCE and 0 <= source < self.comm.size:
+            from ompi_tpu.runtime import ft
+            if ft.is_failed(self.comm.world_rank_of(source)):
+                self._drop_posted(req)
+                from ompi_tpu.core.errhandler import ERR_PROC_FAILED
+                req._fail(MPIError(ERR_PROC_FAILED,
+                                   f"receive peer rank {source} has "
+                                   f"failed"))
         return req
+
+    def _drop_posted(self, req: RankRequest) -> None:
+        with self._lock:
+            self.posted = [e for e in self.posted if e[2] is not req]
+
+    def _peer_failed(self, world_rank: int) -> None:
+        """Complete pending NAMED receives on the dead peer in error.
+        Wildcard (ANY_SOURCE) receives stay posted and matchable — a
+        live sender may still satisfy them (the reference's
+        PROC_FAILED_PENDING keeps the request completable,
+        req_ft.c; failing them outright would strand an in-flight
+        message from a healthy peer). A wildcard that only the dead
+        peer could have matched eventually times out."""
+        local = next((i for i in range(self.comm.size)
+                      if self.comm.world_rank_of(i) == world_rank), None)
+        if local is None:
+            return
+        from ompi_tpu.core.errhandler import ERR_PROC_FAILED
+        with self._lock:
+            hit = [e for e in self.posted if e[0] == local]
+            self.posted = [e for e in self.posted if e not in hit]
+        for (_, _, req) in hit:
+            req._fail(MPIError(
+                ERR_PROC_FAILED,
+                f"peer rank {local} died while this receive was "
+                f"pending (shrink or restrict to live peers to "
+                f"continue)"))
 
     def recv(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
              timeout: Optional[float] = None) -> Tuple[Any, Status]:

@@ -151,8 +151,10 @@ def _init_per_rank(requested: int) -> int:
                                  cid=("self", rank),
                                  name="MPI_COMM_SELF")
     # init fence (ompi_mpi_init.c:434-447): nobody proceeds until every
-    # rank's endpoint is published.
+    # rank's endpoint is published; then wire every pair eagerly
+    # (add_procs — also completes the failure detector's coverage).
     client.wait_at_barrier("ompi_tpu_init", 120_000)
+    router.wire_up()
 
     INFO_ENV.set("command", os.environ.get("_", ""))
     INFO_ENV.set("maxprocs", str(nprocs))
@@ -169,18 +171,24 @@ def finalize() -> None:
     if not _state["initialized"] or _state["finalized"]:
         raise MPIError(ERR_OTHER, "MPI not initialized or already finalized")
     # Drain async work so "all communication is complete at finalize".
+    # With known-dead peers the drain barrier can never complete (a
+    # live peer may itself be blocked on the dead one): skip it.
+    from ompi_tpu.runtime import ft as _ftmod
     try:
         w = _state["world"]
-        if w is not None and not w._freed:
+        if w is not None and not w._freed and not _ftmod.any_failed():
             w.barrier()
     except Exception:
         pass
     router = _state.pop("router", None)
     if router is not None:
-        try:
-            _kv_client().wait_at_barrier("ompi_tpu_fini", 120_000)
-        except Exception:
-            pass
+        router.begin_shutdown()      # later EOFs are teardown, not death
+        from ompi_tpu.runtime import ft as _ft
+        if not _ft.any_failed():     # a dead rank can never reach the
+            try:                     # fini fence; survivors skip it
+                _kv_client().wait_at_barrier("ompi_tpu_fini", 120_000)
+            except Exception:
+                pass
         router.close()
     _state["finalized"] = True
     _state["world"] = None

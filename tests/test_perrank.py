@@ -61,3 +61,16 @@ def test_perrank_program(prog, n):
     count = res.stdout.count(marker)
     assert count == n, f"expected {n} '{marker}' lines, got {count}:\n" \
                        f"{res.stdout}"
+
+
+def test_perrank_ulfm_survives_real_death():
+    """Rank n-1 os._exit()s mid-run; the survivors detect it through
+    the connection monitor, their pending receives error, shrink()
+    agrees on the survivor set, and the shrunk communicator computes.
+    The job exits nonzero (the victim's code + jax's own shutdown
+    barrier noise) — what matters is every survivor completing."""
+    res = _run("p17_ulfm.py", 4)
+    assert res.returncode != 0          # the victim really died
+    count = res.stdout.count("OK p17_ulfm")
+    assert count == 3, f"expected 3 survivor OKs, got {count}:\n" \
+                       f"{res.stdout}\n--- err\n{res.stderr[-3000:]}"
