@@ -1,0 +1,255 @@
+"""dpm/perrank — dynamic process management across SEPARATE jobs.
+
+Behavioral spec: ``ompi/dpm`` — ``MPI_Open_port`` publishes a network
+address, ``MPI_Comm_accept``/``MPI_Comm_connect`` rendezvous two
+independent MPI jobs into an intercommunicator, over which ordinary
+point-to-point addresses the REMOTE group (``dpm_dpm.c`` connect/accept
+over PMIx; the reference wires full cross-job connectivity through the
+modex).
+
+TPU-native re-design: two per-rank jobs own two separate coordination
+services (two PMIx universes), so the bridge is its own TCP link
+between the accept root and the connect root. Cross-job traffic is
+root-relayed: a non-root sender ships an envelope to its root's Router
+(handled on a READER thread, like the RMA plane — the root's
+application thread never participates), the root forwards it over the
+bridge, and the remote root re-injects it into its job's engine
+registry, where it matches like any local frame. Root-relay is the
+honest first tier (the reference's fully-wired equivalent would open
+per-pair sockets from the modex); the relay is documented, not hidden
+— ``BridgeInterComm`` reports it in ``repr``.
+
+Surface: ``open_port() -> "host:port"``; ``comm_accept(port, comm)`` /
+``comm_connect(port, comm)`` (collective over the local comm) return a
+:class:`BridgeInterComm` with ``remote_size``, ``send``/``recv``/
+``irecv``/``probe`` addressing REMOTE ranks, and ``disconnect``.
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+from typing import Any, Optional, Tuple
+
+from ompi_tpu.btl.tcp import MAGIC, _LEN, encode_payload
+from ompi_tpu.core.errhandler import ERR_ARG, ERR_PORT, MPIError
+from ompi_tpu.pml.perrank import ANY_SOURCE, ANY_TAG, PerRankEngine
+
+
+class _Port:
+    """An open MPI port: a listening socket bound to an ephemeral
+    address (MPI_Open_port)."""
+
+    def __init__(self):
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(4)
+        host, port = self.sock.getsockname()
+        self.name = f"{host}:{port}"
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+_ports = {}
+
+
+def open_port() -> str:
+    p = _Port()
+    _ports[p.name] = p
+    return p.name
+
+
+def close_port(name: str) -> None:
+    p = _ports.pop(name, None)
+    if p is not None:
+        p.close()
+
+
+class _ICView:
+    """Engine-comm shim for the intercomm's receive side: frames carry
+    REMOTE-group source ranks; delivery happens into the local rank's
+    private engine registered under the intercomm cid. ``no_peer_map``
+    tells the failure detector that LOCAL peer deaths have no rank
+    mapping here (the remote group's liveness is the bridge's story)."""
+
+    no_peer_map = True
+
+    def __init__(self, icid, local_comm, remote_size: int):
+        self.cid = ("ic", icid, local_comm.rank())
+        self._comm = local_comm
+        self.size = remote_size      # source-rank bound (remote group)
+
+    def rank(self):
+        return self._comm.rank()
+
+    def world_rank_of(self, local):
+        return self._comm.world_rank_of(self._comm.rank())
+
+
+class BridgeInterComm:
+    """An intercommunicator spanning two independently-launched jobs."""
+
+    def __init__(self, local_comm, icid: str, remote_size: int,
+                 bridge: Optional[socket.socket], root: int):
+        self.local_comm = local_comm
+        self.icid = icid
+        self.remote_size = remote_size
+        self.root = root
+        self._bridge = bridge                     # root only
+        self._blk = threading.Lock()
+        self._disconnected = False
+        router = local_comm.router
+        self._router = router
+        # my receive engine: remote frames land here
+        self._engine = PerRankEngine(
+            _ICView(icid, local_comm, remote_size), router)
+        if bridge is not None:
+            # the root registers (a) the outbound relay handler other
+            # local ranks target and (b) the bridge reader that fans
+            # inbound remote frames out to local ranks — both run on
+            # reader threads (one-sided progress)
+            router.register_rma(("icrelay", icid), self._relay_out)
+            t = threading.Thread(target=self._bridge_reader,
+                                 daemon=True,
+                                 name=f"ic-bridge-{icid}")
+            t.start()
+
+    # -- send path -----------------------------------------------------
+    def send(self, data: Any, remote_rank: int, tag: int = 0) -> None:
+        if self._disconnected:
+            raise MPIError(ERR_ARG, "intercomm is disconnected")
+        if not (0 <= remote_rank < self.remote_size):
+            raise MPIError(ERR_ARG, f"bad remote rank {remote_rank}")
+        desc, raw = encode_payload(data)
+        env = {"dest": remote_rank, "src": self.local_comm.rank(),
+               "tag": tag, "desc": desc}
+        if self._bridge is not None:
+            self._bridge_write(env, raw)
+        else:
+            # relay through my root's Router (reader-thread handler)
+            header = {"rma": True, "wid": ("icrelay", self.icid),
+                      "env": env, "origin": self._router.rank,
+                      "ack_id": 0}
+            self._router.endpoint.send_frame(
+                self.local_comm.world_rank_of(self.root), header, raw)
+
+    # -- receive path (remote-group sources) ---------------------------
+    def recv(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
+             timeout: Optional[float] = None):
+        return self._engine.recv(source, tag, timeout)
+
+    def irecv(self, source: int = ANY_SOURCE, tag: int = ANY_TAG):
+        return self._engine.irecv(source, tag)
+
+    def iprobe(self, source: int = ANY_SOURCE, tag: int = ANY_TAG):
+        return self._engine.iprobe(source, tag)
+
+    # -- plumbing ------------------------------------------------------
+    def _bridge_write(self, env: dict, raw: bytes) -> None:
+        hraw = pickle.dumps(env)
+        with self._blk:
+            self._bridge.sendall(
+                _LEN.pack(MAGIC, len(hraw), len(raw)) + hraw + raw)
+
+    def _relay_out(self, header: dict, raw: bytes) -> None:
+        """Root handler for local non-root senders (reader thread)."""
+        self._bridge_write(header["env"], raw)
+
+    def _bridge_reader(self) -> None:
+        """Root: fan inbound remote frames out to the addressed local
+        rank's intercomm engine (re-wrapped as a local frame)."""
+        conn = self._bridge
+        from ompi_tpu.btl.tcp import TcpEndpoint
+
+        def read_exact(n: int) -> Optional[bytes]:
+            return TcpEndpoint._read_exact(conn, n)
+
+        while not self._disconnected:
+            try:
+                head = read_exact(_LEN.size)
+                if head is None:
+                    return
+                magic, hlen, plen = _LEN.unpack(head)
+                if magic != MAGIC:
+                    return
+                env = pickle.loads(read_exact(hlen))
+                raw = read_exact(plen) if plen else b""
+                dest = env["dest"]
+                local_header = {
+                    "cid": ("ic", self.icid, dest),
+                    "src": env["src"], "tag": env["tag"],
+                    "desc": env["desc"],
+                }
+                self._router.endpoint.send_frame(
+                    self.local_comm.world_rank_of(dest),
+                    local_header, raw)
+            except OSError:
+                return
+
+    def disconnect(self) -> None:
+        """MPI_Comm_disconnect: collective over the local comm."""
+        self.local_comm.barrier()
+        self._disconnected = True
+        if self._bridge is not None:
+            self._router.unregister_rma(("icrelay", self.icid))
+            try:
+                self._bridge.close()
+            except OSError:
+                pass
+        self._engine.close()
+
+    def __repr__(self):
+        return (f"BridgeInterComm(local={self.local_comm.size}, "
+                f"remote={self.remote_size}, root-relayed)")
+
+
+def _handshake(sock: socket.socket, my_size: int) -> int:
+    sock.sendall(struct.pack("!I", my_size))
+    raw = b""
+    while len(raw) < 4:
+        chunk = sock.recv(4 - len(raw))
+        if not chunk:
+            raise MPIError(ERR_PORT, "bridge handshake failed")
+        raw += chunk
+    return struct.unpack("!I", raw)[0]
+
+
+def comm_accept(port_name: str, comm, root: int = 0) -> BridgeInterComm:
+    """MPI_Comm_accept: collective over ``comm``; the root accepts one
+    connection on its open port and the jobs exchange group sizes."""
+    icid = port_name
+    if comm.rank() == root:
+        p = _ports.get(port_name)
+        if p is None:
+            raise MPIError(ERR_PORT, f"port {port_name!r} is not open "
+                                     f"in this process")
+        conn, _ = p.sock.accept()
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        remote = _handshake(conn, comm.size)
+        comm.bcast(remote, root=root)
+        return BridgeInterComm(comm, icid, remote, conn, root)
+    remote = comm.bcast(None, root=root)
+    return BridgeInterComm(comm, icid, remote, None, root)
+
+
+def comm_connect(port_name: str, comm, root: int = 0,
+                 timeout: float = 60) -> BridgeInterComm:
+    """MPI_Comm_connect: collective over ``comm``; the root dials the
+    advertised port."""
+    icid = port_name
+    if comm.rank() == root:
+        host, port = port_name.rsplit(":", 1)
+        conn = socket.create_connection((host, int(port)),
+                                        timeout=timeout)
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        remote = _handshake(conn, comm.size)
+        comm.bcast(remote, root=root)
+        return BridgeInterComm(comm, icid, remote, conn, root)
+    remote = comm.bcast(None, root=root)
+    return BridgeInterComm(comm, icid, remote, None, root)

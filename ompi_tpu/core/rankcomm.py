@@ -759,11 +759,13 @@ class RankCommunicator:
         Retried when a survivor's stale failure view elected a dead
         leader (detection is asynchronous; the failed first exchange
         itself surfaces the death, and the retry settles)."""
-        last: Optional[MPIError] = None
+        last: Optional[BaseException] = None
         for _ in range(3):
             try:
                 return self._shrink_once(timeout)
-            except MPIError as e:
+            except (MPIError, OSError) as e:
+                # OSError: a send raced the detector onto a just-dead
+                # leader's broken socket (EPIPE beats the EOF callback)
                 last = e
                 import time
                 time.sleep(0.2)          # let the detector settle
@@ -795,7 +797,7 @@ class RankCommunicator:
                 if r not in union and r != leader:
                     try:
                         self._coll_pml.send(final, r, t)
-                    except MPIError:
+                    except (MPIError, OSError):
                         pass            # died since; it is in no group
         else:
             self._coll_pml.send(sorted(my_failed), leader, t)

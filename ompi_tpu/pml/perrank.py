@@ -249,6 +249,11 @@ class PerRankEngine:
         self.unexpected: Dict[int, Deque[_Msg]] = {}   # src -> FIFO
         self._arrival: Deque[int] = deque()            # src arrival order
         self.posted: List[Tuple[int, int, RankRequest]] = []
+        # per-peer traffic accounting (the pml/monitoring role): THIS
+        # rank's sends/receives by comm-local peer, consumed by
+        # tools/profile's matrix (each rank holds its own rows in a
+        # per-rank world; aggregate with comm.allgather)
+        self.traffic: Dict[Tuple[int, int], List[int]] = {}
         router.register(comm.cid, self)
 
     # -- wire side -----------------------------------------------------
@@ -316,7 +321,11 @@ class PerRankEngine:
             raise MPIError(ERR_PROC_FAILED,
                            f"send peer rank {dest} has failed")
         desc, raw = encode_payload(data)
-        header = {"cid": self.comm.cid, "src": self.comm.rank(),
+        me = self.comm.rank()
+        t = self.traffic.setdefault((me, dest), [0, 0])
+        t[0] += 1
+        t[1] += len(raw)
+        header = {"cid": self.comm.cid, "src": me,
                   "tag": tag, "desc": desc}
         ent = aid = None
         if synchronous:
@@ -371,6 +380,8 @@ class PerRankEngine:
         req_ft.c; failing them outright would strand an in-flight
         message from a healthy peer). A wildcard that only the dead
         peer could have matched eventually times out."""
+        if getattr(self.comm, "no_peer_map", False):
+            return                   # intercomm engine: local deaths
         local = next((i for i in range(self.comm.size)
                       if self.comm.world_rank_of(i) == world_rank), None)
         if local is None:

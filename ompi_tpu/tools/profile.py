@@ -21,7 +21,12 @@ def pt2pt_matrix(comm, what: str = "bytes") -> np.ndarray:
     idx = 1 if what == "bytes" else 0
     n = comm.size
     m = np.zeros((n, n), dtype=np.int64)
+    # stacked comms: the controller-local engine holds every rank's
+    # rows; per-rank comms: THIS process's engine holds its own rows
+    # (aggregate across ranks with comm.allgather of the matrix)
     eng = getattr(comm, "_pml_engine", None)
+    if eng is None and getattr(comm, "is_per_rank", False):
+        eng = comm._pml
     if eng is not None:
         for (src, dest), counts in eng.traffic.items():
             if 0 <= src < n and 0 <= dest < n:

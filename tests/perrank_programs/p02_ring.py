@@ -23,5 +23,13 @@ else:
     assert st.source == left
     world.send(np.concatenate([token, [r]]), right, tag=7)
 
+# monitoring: my traffic rows show the ring edges (tools/profile over
+# the per-rank engine); aggregate across ranks via allgather
+from ompi_tpu.tools import profile as prof
+mine = prof.pt2pt_matrix(world, "messages")
+rows = world.allgather(mine)
+total = sum(rows)
+assert total[r, right] == 1 and total[left, r] == 1, total
+
 MPI.Finalize()
 print(f"OK p02_ring rank={r}/{n}", flush=True)

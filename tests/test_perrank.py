@@ -63,6 +63,30 @@ def test_perrank_program(prog, n):
                        f"{res.stdout}"
 
 
+def test_cross_job_connect_accept(tmp_path):
+    """TWO independently-launched mpirun jobs (two coordination
+    services) rendezvous via Open_port/Comm_accept/Comm_connect and
+    exchange pt2pt both directions including non-root ranks."""
+    port_file = str(tmp_path / "port.txt")
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("JAX_", "XLA_"))}
+    prog = os.path.join(_PROGS, "p18_connect.py")
+    jobs = []
+    for role in ("accept", "connect"):
+        cmd = [sys.executable, _MPIRUN, "--per-rank", "-n", "2",
+               "--timeout", "150", prog, role, port_file]
+        jobs.append(subprocess.Popen(cmd, env=env,
+                                     stdout=subprocess.PIPE,
+                                     stderr=subprocess.PIPE, text=True,
+                                     cwd=_REPO))
+    outs = [j.communicate(timeout=220) for j in jobs]
+    for (out, err), j, role in zip(outs, jobs,
+                                   ("accept", "connect")):
+        assert j.returncode == 0, \
+            f"{role} rc={j.returncode}\n{out}\n--- err\n{err[-3000:]}"
+        assert out.count(f"OK p18_connect {role}") == 2, out
+
+
 def test_perrank_ulfm_survives_real_death():
     """Rank n-1 os._exit()s mid-run; the survivors detect it through
     the connection monitor, their pending receives error, shrink()
