@@ -294,6 +294,16 @@ def _ab_matrix_child() -> None:
     out["osu_allreduce_8B_us"] = round(_osu(
         lambda: world.allreduce(small, MPI.SUM), 100, rtt,
         chunk) * 1e6, 2)
+
+    # BASELINE plan item 5: MPI_IN_PLACE and derived-datatype variants
+    out["osu_allreduce_inplace_8B_us"] = round(_osu(
+        lambda: world.allreduce(MPI.IN_PLACE, MPI.SUM, recvbuf=small),
+        50, rtt, chunk) * 1e6, 2)
+    vec = MPI.FLOAT.create_vector(count=4, blocklength=2, stride=4)
+    vbuf = world.alloc((16,), np.float32, fill=1.0)
+    out["osu_allreduce_vector_dtype_us"] = round(_osu(
+        lambda: world.allreduce(vbuf, MPI.SUM, datatype=vec, count=1),
+        20, rtt, chunk) * 1e6, 2)
     try:
         out.update(_overlap_pct(world, MPI))
     except Exception as e:              # noqa: BLE001

@@ -35,9 +35,9 @@ def _ensure_components() -> None:
     if _components_loaded:
         return
     # Importing registers each component with the framework.
-    from ompi_tpu.coll import (adapt, basic, ftagree, han,  # noqa: F401
-                               monitoring, nbc, self_, sync, tuned, xhc,
-                               xla)
+    from ompi_tpu.coll import (acoll, adapt, basic,  # noqa: F401
+                               ftagree, han, monitoring, nbc, self_,
+                               sync, tuned, xhc, xla)
     _components_loaded = True
 
 

@@ -1,0 +1,50 @@
+"""coll/acoll — TPU-generation-aware tuning hints (the reference's
+arch-aware component re-targeted from Zen cache domains to TPU
+interconnect generations)."""
+import pytest
+
+import ompi_tpu as MPI
+from ompi_tpu.coll import acoll
+from ompi_tpu.mca import var
+
+
+def test_generation_detection():
+    assert acoll.detect_generation("TPU v4") == "v4"
+    assert acoll.detect_generation("TPU v5p") == "v5p"     # not "v5"
+    assert acoll.detect_generation("TPU v5 lite") == "v5 lite"
+    assert acoll.detect_generation("TPU v5e") == "v5e"
+    assert acoll.detect_generation("TPU v6e") == "v6"
+    assert acoll.detect_generation("cpu") == "cpu"
+    assert acoll.detect_generation("GoldenGate-9000") is None
+
+
+def test_hints_installed_at_default_precedence(world):
+    """On the host mesh the detector matched 'cpu'; the install never
+    overrides an explicit setting (precedence contract)."""
+    assert var.var_get("coll_acoll_detected") == "cpu"
+    # explicit set wins and stays won
+    var.var_set("coll_xla_segsize", 12345)
+    try:
+        acoll.AcollComponent._hints_done = False
+        comp = acoll.AcollComponent()
+        comp._ensure_hints()
+        assert var.var_get("coll_xla_segsize") == 12345
+        assert var.var_source("coll_xla_segsize") == var.SOURCE_SET
+    finally:
+        # restore the PRE-TEST state including the source tag (a plain
+        # var_set would leave the var at SOURCE_SET for the session)
+        v = var._registry.get("coll_xla_segsize")
+        v.value, v.source = 1 << 20, var.SOURCE_DEFAULT
+        var.bump_epoch()
+        acoll.AcollComponent._hints_done = True
+
+
+def test_acoll_never_wins_selection(world):
+    """Hints provider only: no vtable slot is served by acoll."""
+    assert all(getattr(m, "__module__", "") != "ompi_tpu.coll.acoll"
+               for m in world.c_coll.values())
+
+
+def test_hint_table_shape():
+    for gen, (segsize, arity) in acoll.GENERATION_HINTS.items():
+        assert segsize >= 1 << 20 and arity in (2, 4), gen
