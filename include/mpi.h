@@ -1,0 +1,208 @@
+/* mpi.h — public C ABI of the ompi_tpu framework.
+ *
+ * Textbook MPI programs (#include <mpi.h>, compile with tools/mpicc,
+ * launch with `mpirun --per-rank -n N ./a.out`) run against the
+ * TPU-native per-rank runtime: rank() == process_index, pt2pt over the
+ * btl active-message plane, collectives over XLA or the textbook
+ * algorithms in coll/.
+ *
+ * Behavioral spec: the reference's installed mpi.h (generated from
+ * ompi/include/mpi.h.in) — handle model, predefined constants, and the
+ * MPI-3.1 calling conventions of the subset below. Handles here are
+ * integer tokens resolved by the binding layer (ompi_tpu/api/cabi.py),
+ * the same indirection the reference uses for Fortran handles.
+ */
+#ifndef OMPI_TPU_MPI_H
+#define OMPI_TPU_MPI_H
+
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* ---- handles (integer tokens; values match api/cabi.py tables) ---- */
+typedef long MPI_Comm;
+typedef long MPI_Datatype;
+typedef long MPI_Op;
+typedef long MPI_Request;
+typedef long MPI_Errhandler;
+
+#define MPI_COMM_NULL   ((MPI_Comm)0)
+#define MPI_COMM_WORLD  ((MPI_Comm)1)
+#define MPI_COMM_SELF   ((MPI_Comm)2)
+
+#define MPI_DATATYPE_NULL       ((MPI_Datatype)0)
+#define MPI_CHAR                ((MPI_Datatype)1)
+#define MPI_SIGNED_CHAR         ((MPI_Datatype)2)
+#define MPI_UNSIGNED_CHAR       ((MPI_Datatype)3)
+#define MPI_BYTE                ((MPI_Datatype)4)
+#define MPI_SHORT               ((MPI_Datatype)5)
+#define MPI_UNSIGNED_SHORT      ((MPI_Datatype)6)
+#define MPI_INT                 ((MPI_Datatype)7)
+#define MPI_UNSIGNED            ((MPI_Datatype)8)
+#define MPI_LONG                ((MPI_Datatype)9)
+#define MPI_UNSIGNED_LONG       ((MPI_Datatype)10)
+#define MPI_LONG_LONG_INT       ((MPI_Datatype)11)
+#define MPI_LONG_LONG           MPI_LONG_LONG_INT
+#define MPI_UNSIGNED_LONG_LONG  ((MPI_Datatype)12)
+#define MPI_FLOAT               ((MPI_Datatype)13)
+#define MPI_DOUBLE              ((MPI_Datatype)14)
+#define MPI_C_BOOL              ((MPI_Datatype)15)
+#define MPI_INT8_T              ((MPI_Datatype)16)
+#define MPI_INT16_T             ((MPI_Datatype)17)
+#define MPI_INT32_T             ((MPI_Datatype)18)
+#define MPI_INT64_T             ((MPI_Datatype)19)
+#define MPI_UINT8_T             ((MPI_Datatype)20)
+#define MPI_UINT16_T            ((MPI_Datatype)21)
+#define MPI_UINT32_T            ((MPI_Datatype)22)
+#define MPI_UINT64_T            ((MPI_Datatype)23)
+
+#define MPI_OP_NULL ((MPI_Op)0)
+#define MPI_SUM     ((MPI_Op)1)
+#define MPI_PROD    ((MPI_Op)2)
+#define MPI_MAX     ((MPI_Op)3)
+#define MPI_MIN     ((MPI_Op)4)
+#define MPI_LAND    ((MPI_Op)5)
+#define MPI_LOR     ((MPI_Op)6)
+#define MPI_LXOR    ((MPI_Op)7)
+#define MPI_BAND    ((MPI_Op)8)
+#define MPI_BOR     ((MPI_Op)9)
+#define MPI_BXOR    ((MPI_Op)10)
+
+#define MPI_REQUEST_NULL ((MPI_Request)0)
+
+#define MPI_ERRORS_ARE_FATAL ((MPI_Errhandler)1)
+#define MPI_ERRORS_RETURN    ((MPI_Errhandler)2)
+
+/* ---- special values ---- */
+#define MPI_ANY_SOURCE  (-1)
+#define MPI_ANY_TAG     (-1)
+#define MPI_PROC_NULL   (-2)
+#define MPI_ROOT        (-3)
+#define MPI_UNDEFINED   (-32766)
+#define MPI_IN_PLACE    ((void *)1)
+
+#define MPI_MAX_PROCESSOR_NAME  256
+#define MPI_MAX_ERROR_STRING    256
+
+/* ---- error classes (core/errhandler.py values) ---- */
+#define MPI_SUCCESS       0
+#define MPI_ERR_BUFFER    1
+#define MPI_ERR_COUNT     2
+#define MPI_ERR_TYPE      3
+#define MPI_ERR_TAG       4
+#define MPI_ERR_COMM      5
+#define MPI_ERR_RANK      6
+#define MPI_ERR_REQUEST   7
+#define MPI_ERR_ROOT      8
+#define MPI_ERR_GROUP     9
+#define MPI_ERR_OP        10
+#define MPI_ERR_TOPOLOGY  11
+#define MPI_ERR_DIMS      12
+#define MPI_ERR_ARG       13
+#define MPI_ERR_UNKNOWN   14
+#define MPI_ERR_TRUNCATE  15
+#define MPI_ERR_OTHER     16
+#define MPI_ERR_INTERN    17
+#define MPI_ERR_PENDING   18
+#define MPI_ERR_IN_STATUS 19
+#define MPI_ERR_REVOKED   72
+#define MPI_ERR_PROC_FAILED 75
+#define MPI_ERR_LASTCODE  100
+
+/* ---- thread levels ---- */
+#define MPI_THREAD_SINGLE     0
+#define MPI_THREAD_FUNNELED   1
+#define MPI_THREAD_SERIALIZED 2
+#define MPI_THREAD_MULTIPLE   3
+
+/* ---- status ---- */
+typedef struct MPI_Status {
+    int MPI_SOURCE;
+    int MPI_TAG;
+    int MPI_ERROR;
+    int _count;               /* element count, for MPI_Get_count */
+} MPI_Status;
+
+#define MPI_STATUS_IGNORE   ((MPI_Status *)0)
+#define MPI_STATUSES_IGNORE ((MPI_Status *)0)
+
+/* ---- world lifecycle ---- */
+int MPI_Init(int *argc, char ***argv);
+int MPI_Init_thread(int *argc, char ***argv, int required, int *provided);
+int MPI_Finalize(void);
+int MPI_Initialized(int *flag);
+int MPI_Finalized(int *flag);
+int MPI_Abort(MPI_Comm comm, int errorcode);
+int MPI_Get_processor_name(char *name, int *resultlen);
+int MPI_Error_string(int errorcode, char *string, int *resultlen);
+double MPI_Wtime(void);
+double MPI_Wtick(void);
+
+/* ---- communicators ---- */
+int MPI_Comm_rank(MPI_Comm comm, int *rank);
+int MPI_Comm_size(MPI_Comm comm, int *size);
+int MPI_Comm_dup(MPI_Comm comm, MPI_Comm *newcomm);
+int MPI_Comm_split(MPI_Comm comm, int color, int key, MPI_Comm *newcomm);
+int MPI_Comm_free(MPI_Comm *comm);
+int MPI_Comm_set_errhandler(MPI_Comm comm, MPI_Errhandler errhandler);
+
+/* ---- point-to-point ---- */
+int MPI_Send(const void *buf, int count, MPI_Datatype datatype, int dest,
+             int tag, MPI_Comm comm);
+int MPI_Ssend(const void *buf, int count, MPI_Datatype datatype, int dest,
+              int tag, MPI_Comm comm);
+int MPI_Recv(void *buf, int count, MPI_Datatype datatype, int source,
+             int tag, MPI_Comm comm, MPI_Status *status);
+int MPI_Sendrecv(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
+                 int dest, int sendtag, void *recvbuf, int recvcount,
+                 MPI_Datatype recvtype, int source, int recvtag,
+                 MPI_Comm comm, MPI_Status *status);
+int MPI_Isend(const void *buf, int count, MPI_Datatype datatype, int dest,
+              int tag, MPI_Comm comm, MPI_Request *request);
+int MPI_Irecv(void *buf, int count, MPI_Datatype datatype, int source,
+              int tag, MPI_Comm comm, MPI_Request *request);
+int MPI_Wait(MPI_Request *request, MPI_Status *status);
+int MPI_Waitall(int count, MPI_Request array_of_requests[],
+                MPI_Status array_of_statuses[]);
+int MPI_Test(MPI_Request *request, int *flag, MPI_Status *status);
+int MPI_Probe(int source, int tag, MPI_Comm comm, MPI_Status *status);
+int MPI_Iprobe(int source, int tag, MPI_Comm comm, int *flag,
+               MPI_Status *status);
+int MPI_Get_count(const MPI_Status *status, MPI_Datatype datatype,
+                  int *count);
+
+/* ---- collectives ---- */
+int MPI_Barrier(MPI_Comm comm);
+int MPI_Bcast(void *buffer, int count, MPI_Datatype datatype, int root,
+              MPI_Comm comm);
+int MPI_Reduce(const void *sendbuf, void *recvbuf, int count,
+               MPI_Datatype datatype, MPI_Op op, int root, MPI_Comm comm);
+int MPI_Allreduce(const void *sendbuf, void *recvbuf, int count,
+                  MPI_Datatype datatype, MPI_Op op, MPI_Comm comm);
+int MPI_Gather(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
+               void *recvbuf, int recvcount, MPI_Datatype recvtype,
+               int root, MPI_Comm comm);
+int MPI_Scatter(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
+                void *recvbuf, int recvcount, MPI_Datatype recvtype,
+                int root, MPI_Comm comm);
+int MPI_Allgather(const void *sendbuf, int sendcount,
+                  MPI_Datatype sendtype, void *recvbuf, int recvcount,
+                  MPI_Datatype recvtype, MPI_Comm comm);
+int MPI_Alltoall(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
+                 void *recvbuf, int recvcount, MPI_Datatype recvtype,
+                 MPI_Comm comm);
+int MPI_Scan(const void *sendbuf, void *recvbuf, int count,
+             MPI_Datatype datatype, MPI_Op op, MPI_Comm comm);
+int MPI_Exscan(const void *sendbuf, void *recvbuf, int count,
+               MPI_Datatype datatype, MPI_Op op, MPI_Comm comm);
+int MPI_Reduce_scatter_block(const void *sendbuf, void *recvbuf,
+                             int recvcount, MPI_Datatype datatype,
+                             MPI_Op op, MPI_Comm comm);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* OMPI_TPU_MPI_H */

@@ -1,0 +1,1011 @@
+/* mpi_cabi.c — the MPI C ABI over the ompi_tpu per-rank runtime.
+ *
+ * This is the binding layer the reference generates into ompi/mpi/c/
+ * (468 one-screen wrappers over the core), re-designed for a runtime
+ * whose core is Python/JAX: each MPI_* function marshals C buffers into
+ * flat calls on ompi_tpu.api.cabi (int handles, memoryviews, bytes) via
+ * the CPython C API.  No numpy headers, no JAX headers — the embedded
+ * interpreter owns all of that; this file owns process-level concerns:
+ * interpreter bring-up, the GIL, request bookkeeping for user receive
+ * buffers, status structs, and errhandler semantics
+ * (ERRORS_ARE_FATAL prints + exits, ERRORS_RETURN returns the class —
+ * ompi/errhandler behavior).
+ *
+ * GIL discipline: MPI_Init initializes the interpreter and immediately
+ * releases the GIL (PyEval_SaveThread); every call re-acquires it with
+ * PyGILState_Ensure.  Between MPI calls the application computes with
+ * no interpreter involvement, while the runtime's btl reader threads
+ * are free to take the GIL and progress incoming messages — the
+ * opal_progress role falls to them.
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <time.h>
+#include <unistd.h>
+
+#include "../include/mpi.h"
+
+/* ------------------------------------------------------------------ */
+/* interpreter state                                                   */
+/* ------------------------------------------------------------------ */
+static PyObject *g_mod;                 /* ompi_tpu.api.cabi */
+static int g_owns_interp;               /* we called Py_InitializeEx */
+static MPI_Errhandler g_errh = MPI_ERRORS_ARE_FATAL;
+
+static const size_t DT_SIZE[] = {
+    0, 1, 1, 1, 1, 2, 2, 4, 4, 8, 8, 8, 8, 4, 8, 1,
+    1, 2, 4, 8, 1, 2, 4, 8,
+};
+#define DT_MAX ((long)(sizeof(DT_SIZE) / sizeof(DT_SIZE[0]) - 1))
+
+static size_t dt_size(MPI_Datatype dt)
+{
+    return (dt >= 1 && dt <= DT_MAX) ? DT_SIZE[dt] : 0;
+}
+
+typedef struct {
+    long pyh;                           /* glue request handle */
+    void *buf;                          /* receive buffer (NULL: send) */
+    size_t cap;                         /* receive capacity in bytes */
+} req_entry;
+
+/* ------------------------------------------------------------------ */
+/* bring-up                                                            */
+/* ------------------------------------------------------------------ */
+static int ensure_module(void)
+{
+    if (g_mod)
+        return 0;
+    g_mod = PyImport_ImportModule("ompi_tpu.api.cabi");
+    if (!g_mod) {
+#ifdef OMPI_TPU_ROOT
+        /* mpicc bakes in the repo root; a program launched outside
+         * mpirun (no PYTHONPATH) can still find the package. */
+        PyErr_Clear();
+        PyObject *sys_path = PySys_GetObject("path");
+        PyObject *root = PyUnicode_FromString(OMPI_TPU_ROOT);
+        if (sys_path && root)
+            PyList_Append(sys_path, root);
+        Py_XDECREF(root);
+        g_mod = PyImport_ImportModule("ompi_tpu.api.cabi");
+#endif
+    }
+    return g_mod ? 0 : -1;
+}
+
+/* Called with the GIL held and a Python exception set.  Returns the
+ * error code to hand back (ERRORS_RETURN) or exits (ERRORS_ARE_FATAL). */
+static int handle_error(const char *func)
+{
+    PyObject *type, *value, *tb;
+    PyErr_Fetch(&type, &value, &tb);
+    int code = MPI_ERR_OTHER;
+    if (g_mod && value) {
+        PyObject *c = PyObject_CallMethod(g_mod, "exc_code", "O", value);
+        if (c) {
+            code = (int)PyLong_AsLong(c);
+            Py_DECREF(c);
+        } else {
+            PyErr_Clear();
+        }
+    }
+    if (g_errh == MPI_ERRORS_RETURN) {
+        Py_XDECREF(type);
+        Py_XDECREF(value);
+        Py_XDECREF(tb);
+        return code;
+    }
+    fprintf(stderr, "*** %s: MPI error class %d — aborting "
+                    "(MPI_ERRORS_ARE_FATAL)\n", func, code);
+    PyErr_Restore(type, value, tb);
+    PyErr_Print();
+    exit(code > 0 && code < 126 ? code : 1);
+}
+
+#define GIL_BEGIN PyGILState_STATE _gst = PyGILState_Ensure()
+#define GIL_END   PyGILState_Release(_gst)
+
+/* Marshal helpers ---------------------------------------------------- */
+
+static PyObject *mem_ro(const void *buf, size_t n)
+{
+    /* Zero-length views still need a valid pointer. */
+    static char dummy;
+    return PyMemoryView_FromMemory(
+        (char *)(n ? buf : (const void *)&dummy), (Py_ssize_t)n,
+        PyBUF_READ);
+}
+
+static void set_status(MPI_Status *st, int src, int tag, int count)
+{
+    if (!st)
+        return;
+    st->MPI_SOURCE = src;
+    st->MPI_TAG = tag;
+    st->MPI_ERROR = MPI_SUCCESS;
+    st->_count = count;
+}
+
+/* Parse a (bytes, src, tag, nbytes) tuple, copy payload into buf.
+ * Counts cross the ABI in BYTES (the status->_ucount convention);
+ * MPI_Get_count converts into the caller datatype's units.  Returns 0,
+ * or MPI_ERR_TRUNCATE if the message exceeds cap (status then reports
+ * the bytes actually delivered). */
+static int copy_msg(PyObject *r, void *buf, size_t cap, MPI_Status *st)
+{
+    PyObject *payload = PyTuple_GetItem(r, 0);
+    int src = (int)PyLong_AsLong(PyTuple_GetItem(r, 1));
+    int tag = (int)PyLong_AsLong(PyTuple_GetItem(r, 2));
+    char *p;
+    Py_ssize_t n;
+    if (PyBytes_AsStringAndSize(payload, &p, &n) < 0)
+        return MPI_ERR_INTERN;
+    int rc = MPI_SUCCESS;
+    if ((size_t)n > cap) {
+        n = (Py_ssize_t)cap;
+        rc = MPI_ERR_TRUNCATE;
+    }
+    if (buf && n)
+        memcpy(buf, p, (size_t)n);
+    set_status(st, src, tag, (int)n);
+    return rc;
+}
+
+/* Copy a plain bytes result into buf (collective outputs). */
+static int copy_bytes(PyObject *bytes, void *buf, size_t cap)
+{
+    char *p;
+    Py_ssize_t n;
+    if (PyBytes_AsStringAndSize(bytes, &p, &n) < 0)
+        return MPI_ERR_INTERN;
+    if ((size_t)n > cap)
+        return MPI_ERR_TRUNCATE;
+    if (buf && n)
+        memcpy(buf, p, (size_t)n);
+    return MPI_SUCCESS;
+}
+
+/* ------------------------------------------------------------------ */
+/* world lifecycle                                                     */
+/* ------------------------------------------------------------------ */
+int MPI_Init_thread(int *argc, char ***argv, int required, int *provided)
+{
+    (void)argc;
+    (void)argv;
+    if (!Py_IsInitialized()) {
+        Py_InitializeEx(0);
+        g_owns_interp = 1;
+    }
+    /* We hold the GIL here whether we initialized or were embedded. */
+    PyGILState_STATE gst = PyGILState_Ensure();
+    int rc = MPI_SUCCESS;
+    if (ensure_module() < 0) {
+        PyErr_Print();
+        fprintf(stderr, "*** MPI_Init: cannot import ompi_tpu.api.cabi "
+                        "(is PYTHONPATH set? launch via mpirun)\n");
+        exit(1);
+    }
+    PyObject *r = PyObject_CallMethod(g_mod, "init", "i", required);
+    if (!r) {
+        rc = handle_error("MPI_Init");
+    } else {
+        if (provided)
+            *provided = (int)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    PyGILState_Release(gst);
+    if (g_owns_interp == 1) {
+        /* Release the main thread's GIL so runtime reader threads can
+         * progress while the C program computes. */
+        PyEval_SaveThread();
+        g_owns_interp = 2;
+    }
+    return rc;
+}
+
+int MPI_Init(int *argc, char ***argv)
+{
+    int provided;
+    return MPI_Init_thread(argc, argv, MPI_THREAD_SINGLE, &provided);
+}
+
+int MPI_Finalize(void)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "finalize", NULL);
+    if (!r)
+        rc = handle_error("MPI_Finalize");
+    else
+        Py_DECREF(r);
+    GIL_END;
+    return rc;
+}
+
+static int flag_query(const char *fn, int *flag)
+{
+    if (!Py_IsInitialized() || !g_mod) {
+        *flag = 0;
+        return MPI_SUCCESS;
+    }
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, fn, NULL);
+    if (!r)
+        rc = handle_error(fn);
+    else {
+        *flag = (int)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int MPI_Initialized(int *flag)
+{
+    return flag_query("initialized", flag);
+}
+
+int MPI_Finalized(int *flag)
+{
+    return flag_query("finalized", flag);
+}
+
+int MPI_Abort(MPI_Comm comm, int errorcode)
+{
+    if (Py_IsInitialized() && g_mod) {
+        GIL_BEGIN;
+        PyObject *r = PyObject_CallMethod(g_mod, "abort", "li",
+                                          (long)comm, errorcode);
+        Py_XDECREF(r);          /* abort os._exit()s; not reached */
+        GIL_END;
+    }
+    _exit(errorcode > 0 && errorcode < 256 ? errorcode : 1);
+}
+
+int MPI_Get_processor_name(char *name, int *resultlen)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "processor_name", NULL);
+    if (!r) {
+        rc = handle_error("MPI_Get_processor_name");
+    } else {
+        const char *s = PyUnicode_AsUTF8(r);
+        snprintf(name, MPI_MAX_PROCESSOR_NAME, "%s", s ? s : "unknown");
+        *resultlen = (int)strlen(name);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int MPI_Error_string(int errorcode, char *string, int *resultlen)
+{
+    if (Py_IsInitialized() && g_mod) {
+        GIL_BEGIN;
+        PyObject *r = PyObject_CallMethod(g_mod, "error_str", "i",
+                                          errorcode);
+        if (r) {
+            const char *s = PyUnicode_AsUTF8(r);
+            snprintf(string, MPI_MAX_ERROR_STRING, "%s",
+                     s ? s : "MPI error");
+            *resultlen = (int)strlen(string);
+            Py_DECREF(r);
+            GIL_END;
+            return MPI_SUCCESS;
+        }
+        PyErr_Clear();
+        GIL_END;
+    }
+    snprintf(string, MPI_MAX_ERROR_STRING, "MPI error class %d",
+             errorcode);
+    *resultlen = (int)strlen(string);
+    return MPI_SUCCESS;
+}
+
+double MPI_Wtime(void)
+{
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return (double)ts.tv_sec + (double)ts.tv_nsec * 1e-9;
+}
+
+double MPI_Wtick(void)
+{
+    return 1e-9;
+}
+
+/* ------------------------------------------------------------------ */
+/* communicators                                                       */
+/* ------------------------------------------------------------------ */
+static int int_query(const char *fn, MPI_Comm comm, int *out)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, fn, "l", (long)comm);
+    if (!r)
+        rc = handle_error(fn);
+    else {
+        *out = (int)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int MPI_Comm_rank(MPI_Comm comm, int *rank)
+{
+    return int_query("comm_rank", comm, rank);
+}
+
+int MPI_Comm_size(MPI_Comm comm, int *size)
+{
+    return int_query("comm_size", comm, size);
+}
+
+int MPI_Comm_dup(MPI_Comm comm, MPI_Comm *newcomm)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "comm_dup", "l", (long)comm);
+    if (!r)
+        rc = handle_error("MPI_Comm_dup");
+    else {
+        *newcomm = (MPI_Comm)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int MPI_Comm_split(MPI_Comm comm, int color, int key, MPI_Comm *newcomm)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "comm_split", "lii",
+                                      (long)comm, color, key);
+    if (!r)
+        rc = handle_error("MPI_Comm_split");
+    else {
+        *newcomm = (MPI_Comm)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int MPI_Comm_free(MPI_Comm *comm)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "comm_free", "l",
+                                      (long)*comm);
+    if (!r)
+        rc = handle_error("MPI_Comm_free");
+    else {
+        *comm = MPI_COMM_NULL;
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int MPI_Comm_set_errhandler(MPI_Comm comm, MPI_Errhandler errhandler)
+{
+    if (errhandler != MPI_ERRORS_ARE_FATAL
+        && errhandler != MPI_ERRORS_RETURN)
+        return MPI_ERR_ARG;
+    /* Propagate into the Python layer too: its communicator-level
+     * errhandler fires first, and must raise (not SystemExit) for the
+     * real error class to reach ERRORS_RETURN callers. */
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "comm_set_errhandler", "li",
+                                      (long)comm, (int)errhandler);
+    if (!r)
+        rc = handle_error("MPI_Comm_set_errhandler");
+    else
+        Py_DECREF(r);
+    GIL_END;
+    if (rc == MPI_SUCCESS)
+        g_errh = errhandler;    /* shim side: process-scoped */
+    return rc;
+}
+
+/* ------------------------------------------------------------------ */
+/* point-to-point                                                      */
+/* ------------------------------------------------------------------ */
+static int send_common(const void *buf, int count, MPI_Datatype dt,
+                       int dest, int tag, MPI_Comm comm, int sync,
+                       const char *fn)
+{
+    size_t esz = dt_size(dt);
+    if (!esz || count < 0)
+        return MPI_ERR_TYPE;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "send", "lNliii", (long)comm,
+        mem_ro(buf, (size_t)count * esz), (long)dt, dest, tag, sync);
+    if (!r)
+        rc = handle_error(fn);
+    else
+        Py_DECREF(r);
+    GIL_END;
+    return rc;
+}
+
+int MPI_Send(const void *buf, int count, MPI_Datatype datatype, int dest,
+             int tag, MPI_Comm comm)
+{
+    return send_common(buf, count, datatype, dest, tag, comm, 0,
+                       "MPI_Send");
+}
+
+int MPI_Ssend(const void *buf, int count, MPI_Datatype datatype, int dest,
+              int tag, MPI_Comm comm)
+{
+    return send_common(buf, count, datatype, dest, tag, comm, 1,
+                       "MPI_Ssend");
+}
+
+int MPI_Recv(void *buf, int count, MPI_Datatype datatype, int source,
+             int tag, MPI_Comm comm, MPI_Status *status)
+{
+    size_t esz = dt_size(datatype);
+    if (!esz || count < 0)
+        return MPI_ERR_TYPE;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "recv", "liil", (long)comm,
+                                      source, tag, (long)datatype);
+    if (!r)
+        rc = handle_error("MPI_Recv");
+    else {
+        rc = copy_msg(r, buf, (size_t)count * esz, status);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int MPI_Sendrecv(const void *sendbuf, int sendcount,
+                 MPI_Datatype sendtype, int dest, int sendtag,
+                 void *recvbuf, int recvcount, MPI_Datatype recvtype,
+                 int source, int recvtag, MPI_Comm comm,
+                 MPI_Status *status)
+{
+    size_t ssz = dt_size(sendtype), rsz = dt_size(recvtype);
+    if (!ssz || !rsz || sendcount < 0 || recvcount < 0)
+        return MPI_ERR_TYPE;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "sendrecv", "lNliiiil", (long)comm,
+        mem_ro(sendbuf, (size_t)sendcount * ssz), (long)sendtype, dest,
+        sendtag, source, recvtag, (long)recvtype);
+    if (!r)
+        rc = handle_error("MPI_Sendrecv");
+    else {
+        rc = copy_msg(r, recvbuf, (size_t)recvcount * rsz, status);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int MPI_Isend(const void *buf, int count, MPI_Datatype datatype, int dest,
+              int tag, MPI_Comm comm, MPI_Request *request)
+{
+    size_t esz = dt_size(datatype);
+    if (!esz || count < 0)
+        return MPI_ERR_TYPE;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "isend", "lNlii", (long)comm,
+        mem_ro(buf, (size_t)count * esz), (long)datatype, dest, tag);
+    if (!r) {
+        rc = handle_error("MPI_Isend");
+    } else {
+        req_entry *e = (req_entry *)malloc(sizeof(req_entry));
+        e->pyh = PyLong_AsLong(r);
+        e->buf = NULL;
+        e->cap = 0;
+        *request = (MPI_Request)(intptr_t)e;
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int MPI_Irecv(void *buf, int count, MPI_Datatype datatype, int source,
+              int tag, MPI_Comm comm, MPI_Request *request)
+{
+    size_t esz = dt_size(datatype);
+    if (!esz || count < 0)
+        return MPI_ERR_TYPE;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "irecv", "liil", (long)comm,
+                                      source, tag, (long)datatype);
+    if (!r) {
+        rc = handle_error("MPI_Irecv");
+    } else {
+        req_entry *e = (req_entry *)malloc(sizeof(req_entry));
+        e->pyh = PyLong_AsLong(r);
+        e->buf = buf;
+        e->cap = (size_t)count * esz;
+        *request = (MPI_Request)(intptr_t)e;
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int MPI_Wait(MPI_Request *request, MPI_Status *status)
+{
+    if (!request || *request == MPI_REQUEST_NULL) {
+        set_status(status, MPI_ANY_SOURCE, MPI_ANY_TAG, 0);
+        return MPI_SUCCESS;
+    }
+    req_entry *e = (req_entry *)(intptr_t)*request;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "wait", "l", e->pyh);
+    if (!r)
+        rc = handle_error("MPI_Wait");
+    else {
+        rc = copy_msg(r, e->buf, e->cap, status);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    free(e);
+    *request = MPI_REQUEST_NULL;
+    return rc;
+}
+
+int MPI_Waitall(int count, MPI_Request array_of_requests[],
+                MPI_Status array_of_statuses[])
+{
+    int rc = MPI_SUCCESS;
+    for (int i = 0; i < count; i++) {
+        int r = MPI_Wait(&array_of_requests[i],
+                         array_of_statuses ? &array_of_statuses[i]
+                                           : MPI_STATUS_IGNORE);
+        if (r != MPI_SUCCESS)
+            rc = r;
+    }
+    return rc;
+}
+
+int MPI_Test(MPI_Request *request, int *flag, MPI_Status *status)
+{
+    if (!request || *request == MPI_REQUEST_NULL) {
+        *flag = 1;
+        set_status(status, MPI_ANY_SOURCE, MPI_ANY_TAG, 0);
+        return MPI_SUCCESS;
+    }
+    *flag = 0;
+    req_entry *e = (req_entry *)(intptr_t)*request;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "test", "l", e->pyh);
+    if (!r) {
+        /* the request completed IN ERROR (ULFM peer death): it is
+         * done — report completion, free it, surface the class, so an
+         * ERRORS_RETURN poll loop can drain instead of spinning */
+        rc = handle_error("MPI_Test");
+        *flag = 1;
+        free(e);
+        *request = MPI_REQUEST_NULL;
+        if (status)
+            status->MPI_ERROR = rc;
+    } else {
+        *flag = (int)PyLong_AsLong(PyTuple_GetItem(r, 0));
+        if (*flag) {
+            PyObject *msg = PyTuple_GetSlice(r, 1, 5);
+            rc = copy_msg(msg, e->buf, e->cap, status);
+            Py_DECREF(msg);
+            free(e);
+            *request = MPI_REQUEST_NULL;
+        }
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int MPI_Probe(int source, int tag, MPI_Comm comm, MPI_Status *status)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "probe", "lii", (long)comm,
+                                      source, tag);
+    if (!r)
+        rc = handle_error("MPI_Probe");
+    else {
+        set_status(status,
+                   (int)PyLong_AsLong(PyTuple_GetItem(r, 0)),
+                   (int)PyLong_AsLong(PyTuple_GetItem(r, 1)),
+                   (int)PyLong_AsLong(PyTuple_GetItem(r, 2)));
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int MPI_Iprobe(int source, int tag, MPI_Comm comm, int *flag,
+               MPI_Status *status)
+{
+    *flag = 0;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "iprobe", "lii", (long)comm,
+                                      source, tag);
+    if (!r)
+        rc = handle_error("MPI_Iprobe");
+    else {
+        *flag = (int)PyLong_AsLong(PyTuple_GetItem(r, 0));
+        if (*flag)
+            set_status(status,
+                       (int)PyLong_AsLong(PyTuple_GetItem(r, 1)),
+                       (int)PyLong_AsLong(PyTuple_GetItem(r, 2)),
+                       (int)PyLong_AsLong(PyTuple_GetItem(r, 3)));
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int MPI_Get_count(const MPI_Status *status, MPI_Datatype datatype,
+                  int *count)
+{
+    if (!status)
+        return MPI_ERR_ARG;
+    size_t esz = dt_size(datatype);
+    if (!esz)
+        return MPI_ERR_TYPE;
+    /* _count carries bytes; convert into the caller datatype's units,
+     * MPI_UNDEFINED when the message is not an integral number. */
+    if ((size_t)status->_count % esz) {
+        *count = MPI_UNDEFINED;
+        return MPI_SUCCESS;
+    }
+    *count = (int)((size_t)status->_count / esz);
+    return MPI_SUCCESS;
+}
+
+/* ------------------------------------------------------------------ */
+/* collectives                                                         */
+/* ------------------------------------------------------------------ */
+int MPI_Barrier(MPI_Comm comm)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "barrier", "l", (long)comm);
+    if (!r)
+        rc = handle_error("MPI_Barrier");
+    else
+        Py_DECREF(r);
+    GIL_END;
+    return rc;
+}
+
+int MPI_Bcast(void *buffer, int count, MPI_Datatype datatype, int root,
+              MPI_Comm comm)
+{
+    size_t esz = dt_size(datatype);
+    if (!esz || count < 0)
+        return MPI_ERR_TYPE;
+    size_t nbytes = (size_t)count * esz;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "bcast", "lNli", (long)comm,
+                                      mem_ro(buffer, nbytes),
+                                      (long)datatype, root);
+    if (!r)
+        rc = handle_error("MPI_Bcast");
+    else {
+        rc = copy_bytes(r, buffer, nbytes);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+/* sendbuf/recvbuf pair with MPI_IN_PLACE support: in place means the
+ * input IS recvbuf (allreduce.c.in:54,78-79). */
+static const void *pick_in(const void *sendbuf, const void *recvbuf)
+{
+    return sendbuf == MPI_IN_PLACE ? recvbuf : sendbuf;
+}
+
+int MPI_Allreduce(const void *sendbuf, void *recvbuf, int count,
+                  MPI_Datatype datatype, MPI_Op op, MPI_Comm comm)
+{
+    size_t esz = dt_size(datatype);
+    if (!esz || count < 0)
+        return MPI_ERR_TYPE;
+    size_t nbytes = (size_t)count * esz;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "allreduce", "lNll", (long)comm,
+        mem_ro(pick_in(sendbuf, recvbuf), nbytes), (long)datatype,
+        (long)op);
+    if (!r)
+        rc = handle_error("MPI_Allreduce");
+    else {
+        rc = copy_bytes(r, recvbuf, nbytes);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int MPI_Reduce(const void *sendbuf, void *recvbuf, int count,
+               MPI_Datatype datatype, MPI_Op op, int root, MPI_Comm comm)
+{
+    size_t esz = dt_size(datatype);
+    if (!esz || count < 0)
+        return MPI_ERR_TYPE;
+    size_t nbytes = (size_t)count * esz;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "reduce", "lNlli", (long)comm,
+        mem_ro(pick_in(sendbuf, recvbuf), nbytes), (long)datatype,
+        (long)op, root);
+    if (!r)
+        rc = handle_error("MPI_Reduce");
+    else {
+        if (PyBytes_Size(r) > 0)        /* root only */
+            rc = copy_bytes(r, recvbuf, nbytes);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int MPI_Gather(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
+               void *recvbuf, int recvcount, MPI_Datatype recvtype,
+               int root, MPI_Comm comm)
+{
+    int size, rank;
+    int qrc = MPI_Comm_size(comm, &size);
+    if (qrc == MPI_SUCCESS)
+        qrc = MPI_Comm_rank(comm, &rank);
+    if (qrc != MPI_SUCCESS)
+        return qrc;
+    /* recvtype/recvcount are significant at the root only (MPI-3.1);
+     * MPI_IN_PLACE at the root means its contribution already sits in
+     * recvbuf's own slot. */
+    size_t rsz = 0;
+    if (rank == root) {
+        rsz = dt_size(recvtype);
+        if (!rsz || recvcount < 0)
+            return MPI_ERR_TYPE;
+        if (sendbuf == MPI_IN_PLACE) {
+            sendbuf = (const char *)recvbuf
+                + (size_t)rank * (size_t)recvcount * rsz;
+            sendcount = recvcount;
+            sendtype = recvtype;
+        }
+    } else if (sendbuf == MPI_IN_PLACE) {
+        return MPI_ERR_BUFFER;
+    }
+    size_t ssz = dt_size(sendtype);
+    if (!ssz || sendcount < 0)
+        return MPI_ERR_TYPE;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "gather", "lNlil", (long)comm,
+        mem_ro(sendbuf, (size_t)sendcount * ssz), (long)sendtype, root,
+        (long)(rank == root ? recvtype : 0));
+    if (!r)
+        rc = handle_error("MPI_Gather");
+    else {
+        if (PyBytes_Size(r) > 0)        /* root only */
+            rc = copy_bytes(r, recvbuf,
+                            (size_t)size * (size_t)recvcount * rsz);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int MPI_Scatter(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
+                void *recvbuf, int recvcount, MPI_Datatype recvtype,
+                int root, MPI_Comm comm)
+{
+    int size, rank;
+    int qrc = MPI_Comm_size(comm, &size);
+    if (qrc == MPI_SUCCESS)
+        qrc = MPI_Comm_rank(comm, &rank);
+    if (qrc != MPI_SUCCESS)
+        return qrc;
+    /* sendtype/sendcount significant at the root only; MPI_IN_PLACE
+     * as the root's recvbuf means "my chunk stays where it is". */
+    size_t rsz = 0;
+    int in_place = recvbuf == MPI_IN_PLACE;
+    if (in_place && rank != root)
+        return MPI_ERR_BUFFER;
+    if (!in_place) {
+        rsz = dt_size(recvtype);
+        if (!rsz || recvcount < 0)
+            return MPI_ERR_TYPE;
+    }
+    size_t ssz = 0, in_bytes = 0;
+    if (rank == root) {
+        ssz = dt_size(sendtype);
+        if (!ssz || sendcount < 0)
+            return MPI_ERR_TYPE;
+        in_bytes = (size_t)size * (size_t)sendcount * ssz;
+    }
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "scatter", "lNliil", (long)comm,
+        mem_ro(sendbuf, in_bytes),
+        (long)(rank == root ? sendtype : 0), sendcount, root,
+        (long)(in_place ? 0 : recvtype));
+    if (!r)
+        rc = handle_error("MPI_Scatter");
+    else {
+        if (!in_place)
+            rc = copy_bytes(r, recvbuf, (size_t)recvcount * rsz);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int MPI_Allgather(const void *sendbuf, int sendcount,
+                  MPI_Datatype sendtype, void *recvbuf, int recvcount,
+                  MPI_Datatype recvtype, MPI_Comm comm)
+{
+    size_t rsz = dt_size(recvtype);
+    if (!rsz || recvcount < 0)
+        return MPI_ERR_TYPE;
+    int size, rank;
+    int qrc = MPI_Comm_size(comm, &size);
+    if (qrc == MPI_SUCCESS)
+        qrc = MPI_Comm_rank(comm, &rank);
+    if (qrc != MPI_SUCCESS)
+        return qrc;
+    if (sendbuf == MPI_IN_PLACE) {
+        /* my contribution already sits in recvbuf's rank-th slot */
+        sendbuf = (const char *)recvbuf
+            + (size_t)rank * (size_t)recvcount * rsz;
+        sendcount = recvcount;
+        sendtype = recvtype;
+    }
+    size_t ssz = dt_size(sendtype);
+    if (!ssz || sendcount < 0)
+        return MPI_ERR_TYPE;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "allgather", "lNll", (long)comm,
+        mem_ro(sendbuf, (size_t)sendcount * ssz), (long)sendtype,
+        (long)recvtype);
+    if (!r)
+        rc = handle_error("MPI_Allgather");
+    else {
+        rc = copy_bytes(r, recvbuf,
+                        (size_t)size * (size_t)recvcount * rsz);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int MPI_Alltoall(const void *sendbuf, int sendcount,
+                 MPI_Datatype sendtype, void *recvbuf, int recvcount,
+                 MPI_Datatype recvtype, MPI_Comm comm)
+{
+    size_t rsz = dt_size(recvtype);
+    if (!rsz || recvcount < 0)
+        return MPI_ERR_TYPE;
+    int size;
+    int qrc = MPI_Comm_size(comm, &size);
+    if (qrc != MPI_SUCCESS)
+        return qrc;
+    if (sendbuf == MPI_IN_PLACE) {
+        /* in-place alltoall: the input matrix IS recvbuf */
+        sendbuf = recvbuf;
+        sendcount = recvcount;
+        sendtype = recvtype;
+    }
+    size_t ssz = dt_size(sendtype);
+    if (!ssz || sendcount < 0)
+        return MPI_ERR_TYPE;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "alltoall", "lNlil", (long)comm,
+        mem_ro(sendbuf, (size_t)size * (size_t)sendcount * ssz),
+        (long)sendtype, sendcount, (long)recvtype);
+    if (!r)
+        rc = handle_error("MPI_Alltoall");
+    else {
+        rc = copy_bytes(r, recvbuf,
+                        (size_t)size * (size_t)recvcount * rsz);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+static int scan_common(const void *sendbuf, void *recvbuf, int count,
+                       MPI_Datatype datatype, MPI_Op op, MPI_Comm comm,
+                       const char *fn, const char *pyfn)
+{
+    size_t esz = dt_size(datatype);
+    if (!esz || count < 0)
+        return MPI_ERR_TYPE;
+    size_t nbytes = (size_t)count * esz;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, pyfn, "lNll", (long)comm,
+        mem_ro(pick_in(sendbuf, recvbuf), nbytes), (long)datatype,
+        (long)op);
+    if (!r)
+        rc = handle_error(fn);
+    else {
+        rc = copy_bytes(r, recvbuf, nbytes);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int MPI_Scan(const void *sendbuf, void *recvbuf, int count,
+             MPI_Datatype datatype, MPI_Op op, MPI_Comm comm)
+{
+    return scan_common(sendbuf, recvbuf, count, datatype, op, comm,
+                       "MPI_Scan", "scan");
+}
+
+int MPI_Exscan(const void *sendbuf, void *recvbuf, int count,
+               MPI_Datatype datatype, MPI_Op op, MPI_Comm comm)
+{
+    return scan_common(sendbuf, recvbuf, count, datatype, op, comm,
+                       "MPI_Exscan", "exscan");
+}
+
+int MPI_Reduce_scatter_block(const void *sendbuf, void *recvbuf,
+                             int recvcount, MPI_Datatype datatype,
+                             MPI_Op op, MPI_Comm comm)
+{
+    size_t esz = dt_size(datatype);
+    if (!esz || recvcount < 0)
+        return MPI_ERR_TYPE;
+    int size;
+    int qrc = MPI_Comm_size(comm, &size);
+    if (qrc != MPI_SUCCESS)
+        return qrc;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "reduce_scatter_block", "lNlli", (long)comm,
+        mem_ro(pick_in(sendbuf, recvbuf),
+               (size_t)size * (size_t)recvcount * esz),
+        (long)datatype, (long)op, recvcount);
+    if (!r)
+        rc = handle_error("MPI_Reduce_scatter_block");
+    else {
+        rc = copy_bytes(r, recvbuf, (size_t)recvcount * esz);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}

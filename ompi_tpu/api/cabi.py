@@ -1,0 +1,435 @@
+"""C-ABI glue — flat, scalar-typed entry points for ``native/mpi_cabi.c``.
+
+The C shim (``libtpumpi.so``) embeds CPython, imports this module once,
+and calls these functions with memoryviews over the caller's C buffers.
+Everything here is deliberately *flat*: int handles instead of objects,
+``bytes`` instead of arrays, positional scalars instead of kwargs — so
+the C side stays a thin marshalling layer (``PyObject_CallMethod`` with
+format strings) and never touches numpy headers.
+
+Behavioral spec: the reference's C bindings are one-screen wrappers that
+validate args and dispatch into the core (`ompi/mpi/c/send.c.in`,
+`allreduce.c.in:54-117`); this module is their TPU-native counterpart —
+the "binding layer" between a C ABI and the per-rank runtime. Handle
+tables mirror the reference's fortran-handle indirection
+(`ompi/mpi/fortran/base/` f2c tables): predefined handles are small
+fixed ints, dynamically-created objects get monotonically-increasing
+slots.
+
+Error contract: glue functions raise :class:`MPIError`; the C shim maps
+``exc.error_class`` to the MPI error code and applies the communicator's
+errhandler semantics (ERRORS_ARE_FATAL prints + aborts, ERRORS_RETURN
+returns the code — `ompi/errhandler/errhandler.h` behavior).
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from ompi_tpu.core import op as op_mod
+from ompi_tpu.core.errhandler import (ERR_ARG, ERR_COMM, ERR_OP,
+                                      ERR_REQUEST, ERR_TYPE, MPIError,
+                                      error_string)
+
+# ---------------------------------------------------------------------
+# handle tables (mpi.h constants must match these values)
+# ---------------------------------------------------------------------
+COMM_NULL = 0
+COMM_WORLD = 1
+COMM_SELF = 2
+_FIRST_DYNAMIC = 16
+
+_lock = threading.Lock()
+_comms: Dict[int, Any] = {}
+_requests: Dict[int, Tuple[Any, int]] = {}   # handle -> (Request, dtype)
+_next_comm = itertools.count(_FIRST_DYNAMIC)
+_next_req = itertools.count(1)
+
+# mpi.h MPI_Datatype constants -> numpy dtypes
+_DT = {
+    1: np.dtype(np.int8),      # MPI_CHAR
+    2: np.dtype(np.int8),      # MPI_SIGNED_CHAR
+    3: np.dtype(np.uint8),     # MPI_UNSIGNED_CHAR
+    4: np.dtype(np.uint8),     # MPI_BYTE
+    5: np.dtype(np.int16),     # MPI_SHORT
+    6: np.dtype(np.uint16),    # MPI_UNSIGNED_SHORT
+    7: np.dtype(np.int32),     # MPI_INT
+    8: np.dtype(np.uint32),    # MPI_UNSIGNED
+    9: np.dtype(np.int64),     # MPI_LONG
+    10: np.dtype(np.uint64),   # MPI_UNSIGNED_LONG
+    11: np.dtype(np.int64),    # MPI_LONG_LONG
+    12: np.dtype(np.uint64),   # MPI_UNSIGNED_LONG_LONG
+    13: np.dtype(np.float32),  # MPI_FLOAT
+    14: np.dtype(np.float64),  # MPI_DOUBLE
+    15: np.dtype(np.bool_),    # MPI_C_BOOL
+    16: np.dtype(np.int8),     # MPI_INT8_T
+    17: np.dtype(np.int16),    # MPI_INT16_T
+    18: np.dtype(np.int32),    # MPI_INT32_T
+    19: np.dtype(np.int64),    # MPI_INT64_T
+    20: np.dtype(np.uint8),    # MPI_UINT8_T
+    21: np.dtype(np.uint16),   # MPI_UINT16_T
+    22: np.dtype(np.uint32),   # MPI_UINT32_T
+    23: np.dtype(np.uint64),   # MPI_UINT64_T
+}
+
+# mpi.h MPI_Op constants -> predefined ops (op.c:73-80 table)
+_OPS = {
+    1: op_mod.SUM, 2: op_mod.PROD, 3: op_mod.MAX, 4: op_mod.MIN,
+    5: op_mod.LAND, 6: op_mod.LOR, 7: op_mod.LXOR,
+    8: op_mod.BAND, 9: op_mod.BOR, 10: op_mod.BXOR,
+}
+
+
+def _comm(h: int):
+    if h in (COMM_WORLD, COMM_SELF):
+        from ompi_tpu.runtime import init as rt
+        return rt.comm_world() if h == COMM_WORLD else rt.comm_self()
+    with _lock:
+        c = _comms.get(h)
+    if c is None:
+        raise MPIError(ERR_COMM, f"invalid communicator handle {h}")
+    return c
+
+
+def _register_comm(c) -> int:
+    with _lock:
+        h = next(_next_comm)
+        _comms[h] = c
+    return h
+
+
+def _dtype(dt: int) -> np.dtype:
+    d = _DT.get(dt)
+    if d is None:
+        raise MPIError(ERR_TYPE, f"invalid datatype handle {dt}")
+    return d
+
+
+def _op(o: int) -> op_mod.Op:
+    p = _OPS.get(o)
+    if p is None:
+        raise MPIError(ERR_OP, f"invalid op handle {o}")
+    return p
+
+
+def _arr(view, dt: int) -> np.ndarray:
+    """Copy a C buffer into a numpy array of the handle's dtype."""
+    return np.frombuffer(view, dtype=_dtype(dt)).copy()
+
+
+def _out(x: Any, dt: int) -> bytes:
+    """Result -> raw bytes in the receiver's declared dtype."""
+    a = np.asarray(x)
+    d = _dtype(dt)
+    if a.dtype != d:
+        a = a.astype(d)
+    return a.tobytes()
+
+
+def _status(st, payload: Optional[bytes] = None) -> Tuple[int, int, int]:
+    """(source, tag, nbytes) — counts cross the ABI in BYTES; the C
+    side's MPI_Get_count divides by the caller datatype's extent (the
+    status->_ucount convention)."""
+    if st is None:
+        return (-1, -1, 0)
+    nb = int(getattr(st, "nbytes", -1))
+    if nb < 0:
+        nb = len(payload) if payload is not None else int(st.count)
+    return (int(st.source), int(st.tag), nb)
+
+
+# ---------------------------------------------------------------------
+# world lifecycle
+# ---------------------------------------------------------------------
+def init(required: int) -> int:
+    """MPI_Init / MPI_Init_thread from a C main(): same env-driven
+    bring-up the Python per-rank programs get (mpirun --per-rank sets
+    OMPI_TPU_MCA_* + coordination-service vars)."""
+    import os
+    # A sitecustomize may pin jax_platforms to a TPU plugin, overriding
+    # the JAX_PLATFORMS env var the launcher set; re-assert it.
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+        try:
+            jax.config.update("jax_platforms", plat)
+        except Exception:               # noqa: BLE001 — older jax
+            pass
+    from ompi_tpu.runtime import init as rt
+    return rt.init(required)
+
+
+def finalize() -> None:
+    from ompi_tpu.runtime import init as rt
+    rt.finalize()
+
+
+def initialized() -> int:
+    from ompi_tpu.runtime import init as rt
+    return int(rt.initialized())
+
+
+def finalized() -> int:
+    from ompi_tpu.runtime import init as rt
+    return int(rt.finalized())
+
+
+def abort(h: int, code: int) -> None:
+    import os
+    import sys
+    sys.stderr.write(f"MPI_Abort: rank aborting with code {code}\n")
+    sys.stderr.flush()
+    os._exit(code if 0 < code < 256 else 1)
+
+
+def error_str(code: int) -> str:
+    return error_string(code)
+
+
+def processor_name() -> str:
+    import socket
+    return socket.gethostname()
+
+
+# ---------------------------------------------------------------------
+# communicator queries / algebra
+# ---------------------------------------------------------------------
+def comm_rank(h: int) -> int:
+    return int(_comm(h).rank())
+
+
+def comm_size(h: int) -> int:
+    return int(_comm(h).size)
+
+
+def comm_dup(h: int) -> int:
+    return _register_comm(_comm(h).dup())
+
+
+def comm_split(h: int, color: int, key: int) -> int:
+    sub = _comm(h).split(color, key)
+    if sub is None:                      # MPI_UNDEFINED color
+        return COMM_NULL
+    return _register_comm(sub)
+
+
+def comm_set_errhandler(h: int, which: int) -> None:
+    """Propagate the C-side errhandler choice into the Python layer —
+    without this, the communicator's default ERRORS_ARE_FATAL hook
+    would print its abort banner and raise SystemExit before the C
+    shim's ERRORS_RETURN path ever saw the real error class."""
+    from ompi_tpu.core import errhandler as eh
+    c = _comm(h)
+    c.errhandler = (eh.ERRORS_RETURN if which == 2
+                    else eh.ERRORS_ARE_FATAL)
+
+
+def comm_free(h: int) -> None:
+    if h in (COMM_WORLD, COMM_SELF):
+        raise MPIError(ERR_COMM, "cannot free a predefined communicator")
+    with _lock:
+        c = _comms.pop(h, None)
+    if c is None:
+        raise MPIError(ERR_COMM, f"invalid communicator handle {h}")
+    if hasattr(c, "free"):
+        try:
+            c.free()
+        except Exception:                # noqa: BLE001 — already freed
+            pass
+
+
+# ---------------------------------------------------------------------
+# point-to-point
+# ---------------------------------------------------------------------
+def send(h: int, view, dt: int, dest: int, tag: int, sync: int) -> None:
+    c = _comm(h)
+    data = _arr(view, dt)
+    if sync:
+        c.ssend(data, dest, tag)
+    else:
+        c.send(data, dest, tag)
+
+
+def recv(h: int, source: int, tag: int, dt: int
+         ) -> Tuple[bytes, int, int, int]:
+    data, st = _comm(h).recv(source, tag)
+    out = b"" if data is None else _out(data, dt)
+    src, t, cnt = _status(st, out)
+    return out, src, t, cnt
+
+
+def sendrecv(h: int, view, dt: int, dest: int, stag: int,
+             source: int, rtag: int, rdt: int
+             ) -> Tuple[bytes, int, int, int]:
+    c = _comm(h)
+    data, st = c.sendrecv(_arr(view, dt), dest, source,
+                          sendtag=stag, recvtag=rtag)
+    out = b"" if data is None else _out(data, rdt)
+    src, t, cnt = _status(st, out)
+    return out, src, t, cnt
+
+
+def isend(h: int, view, dt: int, dest: int, tag: int) -> int:
+    req = _comm(h).isend(_arr(view, dt), dest, tag)
+    with _lock:
+        rh = next(_next_req)
+        _requests[rh] = (req, dt)
+    return rh
+
+
+def irecv(h: int, source: int, tag: int, dt: int) -> int:
+    req = _comm(h).irecv(source, tag)
+    with _lock:
+        rh = next(_next_req)
+        _requests[rh] = (req, dt)
+    return rh
+
+
+def _take_req(rh: int) -> Tuple[Any, int]:
+    with _lock:
+        ent = _requests.get(rh)
+    if ent is None:
+        raise MPIError(ERR_REQUEST, f"invalid request handle {rh}")
+    return ent
+
+
+def wait(rh: int) -> Tuple[bytes, int, int, int]:
+    req, dt = _take_req(rh)
+    try:
+        st = req.wait()
+    except BaseException:
+        # completed in error (ULFM peer death, recv timeout): the C
+        # side frees its entry unconditionally, so this table must too
+        # or errored requests leak forever
+        with _lock:
+            _requests.pop(rh, None)
+        raise
+    data = req.get() if hasattr(req, "get") else None
+    with _lock:
+        _requests.pop(rh, None)
+    out = b"" if data is None else _out(data, dt)
+    src, t, cnt = _status(st, out)
+    return out, src, t, cnt
+
+
+def test(rh: int) -> Tuple[int, bytes, int, int, int]:
+    req, dt = _take_req(rh)
+    try:
+        done, st = req.test()
+    except BaseException:
+        with _lock:
+            _requests.pop(rh, None)     # completed in error: reclaim
+        raise
+    if not done:
+        return 0, b"", -1, -1, 0
+    data = req.get() if hasattr(req, "get") else None
+    with _lock:
+        _requests.pop(rh, None)
+    out = b"" if data is None else _out(data, dt)
+    src, t, cnt = _status(st, out)
+    return 1, out, src, t, cnt
+
+
+def probe(h: int, source: int, tag: int) -> Tuple[int, int, int]:
+    return _status(_comm(h).probe(source, tag))
+
+
+def iprobe(h: int, source: int, tag: int) -> Tuple[int, int, int, int]:
+    ok, st = _comm(h).iprobe(source, tag)
+    if not ok:
+        return 0, -1, -1, 0
+    return (1,) + _status(st)
+
+
+# ---------------------------------------------------------------------
+# collectives — counts are element counts of the C call; buffers arrive
+# as memoryviews sized count*dtype. Root-only outputs return b"" on
+# non-roots (the C side only copies when nonempty).
+# ---------------------------------------------------------------------
+def barrier(h: int) -> None:
+    _comm(h).barrier()
+
+
+def bcast(h: int, view, dt: int, root: int) -> bytes:
+    c = _comm(h)
+    data = _arr(view, dt) if c.rank() == root else None
+    return _out(c.bcast(data, root), dt)
+
+
+def reduce(h: int, view, dt: int, o: int, root: int) -> bytes:
+    c = _comm(h)
+    r = c.reduce(_arr(view, dt), _op(o), root)
+    return b"" if r is None else _out(r, dt)
+
+
+def allreduce(h: int, view, dt: int, o: int) -> bytes:
+    return _out(_comm(h).allreduce(_arr(view, dt), _op(o)), dt)
+
+
+def gather(h: int, view, sdt: int, root: int, rdt: int) -> bytes:
+    """rdt is the receive datatype, significant (and validated) at the
+    root only — 0 elsewhere (MPI-3.1 significance rules)."""
+    c = _comm(h)
+    rows = c.gather(_arr(view, sdt), root)
+    if rows is None:
+        return b""
+    return _out(np.concatenate([np.atleast_1d(r) for r in rows]), rdt)
+
+
+def scatter(h: int, view, sdt: int, sendcount: int, root: int,
+            rdt: int) -> bytes:
+    """sdt/sendcount significant at root only; rdt == 0 means the
+    caller asked for no output copy (MPI_IN_PLACE at the root)."""
+    c = _comm(h)
+    chunks: Optional[list] = None
+    if c.rank() == root:
+        a = _arr(view, sdt)
+        chunks = [a[i * sendcount:(i + 1) * sendcount]
+                  for i in range(c.size)]
+    got = c.scatter(chunks, root)
+    return b"" if rdt == 0 else _out(got, rdt)
+
+
+def allgather(h: int, view, sdt: int, rdt: int) -> bytes:
+    rows = _comm(h).allgather(_arr(view, sdt))
+    return _out(np.concatenate([np.atleast_1d(r) for r in rows]), rdt)
+
+
+def alltoall(h: int, view, sdt: int, percount: int, rdt: int) -> bytes:
+    c = _comm(h)
+    a = _arr(view, sdt)
+    chunks = [a[i * percount:(i + 1) * percount] for i in range(c.size)]
+    out = c.alltoall(chunks)
+    return _out(np.concatenate([np.atleast_1d(r) for r in out]), rdt)
+
+
+def scan(h: int, view, dt: int, o: int) -> bytes:
+    return _out(_comm(h).scan(_arr(view, dt), _op(o)), dt)
+
+
+def exscan(h: int, view, dt: int, o: int) -> bytes:
+    c = _comm(h)
+    r = c.exscan(_arr(view, dt), _op(o))
+    if r is None:                        # rank 0: result undefined
+        return _out(np.zeros_like(_arr(view, dt)), dt)
+    return _out(r, dt)
+
+
+def reduce_scatter_block(h: int, view, dt: int, o: int,
+                         recvcount: int) -> bytes:
+    c = _comm(h)
+    a = _arr(view, dt)
+    chunks = [a[i * recvcount:(i + 1) * recvcount] for i in range(c.size)]
+    return _out(c.reduce_scatter_block(chunks, _op(o)), dt)
+
+
+def exc_code(exc: BaseException) -> int:
+    """Map a glue exception to an MPI error code for the C shim."""
+    if isinstance(exc, MPIError):
+        return int(exc.error_class)
+    if isinstance(exc, (ValueError, TypeError)):
+        return ERR_ARG
+    return 16                            # ERR_OTHER

@@ -33,7 +33,9 @@ from ompi_tpu.mca.base import Component
 
 # generation -> (segsize bytes, ladder arity). Keys are matched as
 # substrings of the PJRT device_kind (e.g. "TPU v5 lite", "TPU v4").
-GENERATION_HINTS: Dict[str, Tuple[int, int]] = {
+# arity None = leave coll_xhc_levels alone (xhc's locality-derived
+# ladder stays in charge).
+GENERATION_HINTS: Dict[str, Tuple[int, Optional[int]]] = {
     # 3-D torus, 6 links/chip: deeper pipelines pay off -> larger segs
     "v4": (4 << 20, 4),
     "v5p": (4 << 20, 4),
@@ -42,8 +44,9 @@ GENERATION_HINTS: Dict[str, Tuple[int, int]] = {
     "v5e": (1 << 20, 2),
     # wider links: fewer, larger segments
     "v6": (8 << 20, 4),
-    # host backend stands in during tests; keep the measured defaults
-    "cpu": (1 << 20, 2),
+    # host backend stands in during tests: no ICI generation to encode,
+    # so no ladder hint — xhc keeps its locality fallback
+    "cpu": (1 << 20, None),
 }
 
 
@@ -105,7 +108,9 @@ class AcollComponent(Component):
         # levels var is empty; the generation hint supplies a uniform
         # arity ladder instead (still overridable by any explicit
         # coll_xhc_levels setting)
-        if var.var_source("coll_xhc_levels") == var.SOURCE_DEFAULT:
+        if (arity is not None
+                and var.var_source("coll_xhc_levels")
+                == var.SOURCE_DEFAULT):
             var.var_set("coll_xhc_levels", str(arity),
                         source=var.SOURCE_DEFAULT)
 

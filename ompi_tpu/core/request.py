@@ -21,18 +21,23 @@ import jax
 class Status:
     """MPI_Status: source, tag, error, element count."""
 
-    __slots__ = ("source", "tag", "error", "count", "cancelled")
+    __slots__ = ("source", "tag", "error", "count", "cancelled",
+                 "nbytes")
 
     ANY_SOURCE = -1
     ANY_TAG = -1
 
     def __init__(self, source: int = -1, tag: int = -1, error: int = 0,
-                 count: int = 0):
+                 count: int = 0, nbytes: int = -1):
         self.source = source
         self.tag = tag
         self.error = error
         self.count = count
         self.cancelled = False
+        # payload size in bytes (-1 = unknown): what the reference
+        # stores in status->_ucount so MPI_Get_count can convert into
+        # any caller datatype's units; the C ABI relies on it
+        self.nbytes = nbytes
 
     def get_count(self, datatype=None) -> int:
         if datatype is None or datatype.count == 0:

@@ -211,6 +211,7 @@ class RankRequest(Request):
         self.status.source = msg.src
         self.status.tag = msg.tag
         self.status.count = int(getattr(msg.data, "size", 1) or 1)
+        self.status.nbytes = int(getattr(msg.data, "nbytes", -1))
         self._complete = True
         self._event.set()
 
@@ -411,7 +412,8 @@ class PerRankEngine:
         if msg is None:
             return False, None
         return True, Status(source=msg.src, tag=msg.tag,
-                            count=int(getattr(msg.data, "size", 1) or 1))
+                            count=int(getattr(msg.data, "size", 1) or 1),
+                            nbytes=int(getattr(msg.data, "nbytes", -1)))
 
     def probe(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
               timeout: float = 600, poll: float = 0.0005) -> Status:
@@ -446,7 +448,9 @@ class PerRankEngine:
     def mrecv(msg: _Msg) -> Tuple[Any, Status]:
         return msg.data, Status(source=msg.src, tag=msg.tag,
                                 count=int(getattr(msg.data, "size", 1)
-                                          or 1))
+                                          or 1),
+                                nbytes=int(getattr(msg.data, "nbytes",
+                                                   -1)))
 
     def close(self) -> None:
         self.router.unregister(self.comm.cid)

@@ -1,0 +1,109 @@
+"""``mpicc`` — the compiler wrapper for the MPI C ABI.
+
+Behavioral spec: the reference's wrapper compilers are argv shims that
+splice in include/lib flags from wrapper-data text files
+(``ompi/tools/wrappers``).  Here the wrapper also owns building the
+bindings library itself (``native/mpi_cabi.c`` -> ``libtpumpi.so``),
+on demand and mtime-cached exactly like the native component loader —
+the framework never needs a separate install step.
+
+Usage::
+
+    python -m ompi_tpu.tools.mpicc prog.c -o prog      # compile+link
+    python -m ompi_tpu.tools.mpicc --showme            # print the flags
+
+The produced binaries embed CPython (the runtime's host language), so
+the link line carries the python embed flags; ``-rpath`` entries make
+the binaries runnable without LD_LIBRARY_PATH.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import sysconfig
+from typing import List, Optional
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_REPO_DIR = os.path.dirname(_PKG_DIR)
+_NATIVE_DIR = os.path.join(_REPO_DIR, "native")
+_INCLUDE_DIR = os.path.join(_REPO_DIR, "include")
+_SRC = os.path.join(_NATIVE_DIR, "mpi_cabi.c")
+_SO = os.path.join(_NATIVE_DIR, "libtpumpi.so")
+
+
+def _py_embed_flags() -> tuple:
+    inc = sysconfig.get_path("include")
+    libdir = sysconfig.get_config_var("LIBDIR") or "/usr/local/lib"
+    ldver = sysconfig.get_config_var("LDVERSION") \
+        or sysconfig.get_config_var("VERSION")
+    return inc, libdir, f"python{ldver}"
+
+
+def build_lib(cc: str = "gcc", force: bool = False) -> Optional[str]:
+    """Build native/libtpumpi.so from mpi_cabi.c (mtime-cached)."""
+    if not os.path.exists(_SRC):
+        return None
+    hdr = os.path.join(_INCLUDE_DIR, "mpi.h")
+    deps = [_SRC] + ([hdr] if os.path.exists(hdr) else [])
+    if (not force and os.path.exists(_SO)
+            and os.path.getmtime(_SO) >= max(os.path.getmtime(d)
+                                             for d in deps)):
+        return _SO
+    inc, libdir, pylib = _py_embed_flags()
+    # Build to a private temp path and rename into place: concurrent
+    # mpicc invocations (make -j) must never observe a half-written
+    # library on the shared path.
+    tmp = f"{_SO}.tmp.{os.getpid()}"
+    cmd = [cc, "-O2", "-shared", "-fPIC", "-std=c11", _SRC,
+           f"-I{inc}", f"-I{_INCLUDE_DIR}",
+           f"-DOMPI_TPU_ROOT=\"{_REPO_DIR}\"",
+           "-o", tmp,
+           f"-L{libdir}", f"-l{pylib}", "-ldl", "-lm",
+           f"-Wl,-rpath,{libdir}"]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=180)
+        os.replace(tmp, _SO)
+        return _SO
+    except subprocess.CalledProcessError as e:
+        sys.stderr.write(e.stderr.decode(errors="replace"))
+        return None
+    except OSError:
+        return None
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+
+def wrapper_flags() -> List[str]:
+    """The flags mpicc splices into the user's compile line."""
+    _, libdir, pylib = _py_embed_flags()
+    return [f"-I{_INCLUDE_DIR}",
+            f"-L{_NATIVE_DIR}", "-ltpumpi",
+            f"-Wl,-rpath,{_NATIVE_DIR}",
+            f"-L{libdir}", f"-l{pylib}",
+            f"-Wl,-rpath,{libdir}"]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    cc = os.environ.get("OMPI_TPU_CC", "gcc")
+    if args and args[0] == "--showme":
+        print(" ".join([cc] + wrapper_flags()))
+        return 0
+    if build_lib(cc) is None:
+        sys.stderr.write("mpicc: failed to build libtpumpi.so\n")
+        return 1
+    cmd = [cc] + args + wrapper_flags()
+    try:
+        return subprocess.run(cmd).returncode
+    except OSError as e:
+        sys.stderr.write(f"mpicc: {e}\n")
+        return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,40 @@
+/* Textbook hello: Init / rank / size / processor name / allreduce of
+ * ranks / Finalize — the program every MPI tutorial starts with,
+ * compiled with mpicc and launched with mpirun --per-rank. */
+#include <mpi.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+int main(int argc, char **argv)
+{
+    MPI_Init(&argc, &argv);
+
+    int rank, size;
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &size);
+
+    char name[MPI_MAX_PROCESSOR_NAME];
+    int namelen;
+    MPI_Get_processor_name(name, &namelen);
+
+    int send = rank, sum = -1;
+    MPI_Allreduce(&send, &sum, 1, MPI_INT, MPI_SUM, MPI_COMM_WORLD);
+    if (sum != size * (size - 1) / 2) {
+        fprintf(stderr, "rank %d: bad allreduce sum %d\n", rank, sum);
+        MPI_Abort(MPI_COMM_WORLD, 2);
+    }
+
+    int flag = 0;
+    MPI_Initialized(&flag);
+    if (!flag) {
+        fprintf(stderr, "rank %d: Initialized said no\n", rank);
+        return 3;
+    }
+
+    MPI_Finalize();
+    MPI_Finalized(&flag);
+    if (!flag)
+        return 4;
+    printf("OK c01_hello rank=%d/%d host=%s\n", rank, size, name);
+    return 0;
+}

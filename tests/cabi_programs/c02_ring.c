@@ -1,0 +1,53 @@
+/* Natural-order send/recv ring: every rank but 0 receives BEFORE it
+ * sends — the per-rank runtime's blocking receive genuinely blocks on
+ * a message produced by another OS process.  Exercises MPI_Send,
+ * MPI_Recv with a real MPI_Status, MPI_Get_count, MPI_Ssend, and
+ * MPI_Wtime. */
+#include <mpi.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+int main(int argc, char **argv)
+{
+    MPI_Init(&argc, &argv);
+
+    int rank, size;
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &size);
+    int right = (rank + 1) % size, left = (rank - 1 + size) % size;
+
+    double t0 = MPI_Wtime();
+    long token[64];
+    MPI_Status st;
+    int count;
+    if (rank == 0) {
+        token[0] = 0;
+        MPI_Send(token, 1, MPI_LONG, right, 7, MPI_COMM_WORLD);
+        MPI_Recv(token, 64, MPI_LONG, left, 7, MPI_COMM_WORLD, &st);
+        MPI_Get_count(&st, MPI_LONG, &count);
+        if (st.MPI_SOURCE != left || st.MPI_TAG != 7 || count != size) {
+            fprintf(stderr, "bad status src=%d tag=%d count=%d\n",
+                    st.MPI_SOURCE, st.MPI_TAG, count);
+            MPI_Abort(MPI_COMM_WORLD, 2);
+        }
+        long sum = 0;
+        for (int i = 0; i < count; i++)
+            sum += token[i];
+        if (sum != (long)size * (size - 1) / 2)
+            MPI_Abort(MPI_COMM_WORLD, 3);
+    } else {
+        MPI_Recv(token, 64, MPI_LONG, left, 7, MPI_COMM_WORLD, &st);
+        MPI_Get_count(&st, MPI_LONG, &count);
+        token[count] = rank;
+        /* synchronous send for the last hop: completes only once the
+         * receive matched (the rendezvous-ACK handshake) */
+        MPI_Ssend(token, count + 1, MPI_LONG, right, 7, MPI_COMM_WORLD);
+    }
+    double dt = MPI_Wtime() - t0;
+    if (dt < 0)
+        MPI_Abort(MPI_COMM_WORLD, 4);
+
+    MPI_Finalize();
+    printf("OK c02_ring rank=%d/%d\n", rank, size);
+    return 0;
+}

@@ -1,0 +1,115 @@
+/* Collective surface: barrier, bcast, reduce, IN_PLACE allreduce,
+ * gather/scatter, allgather, alltoall, scan/exscan,
+ * reduce_scatter_block — each verified numerically on every rank. */
+#include <mpi.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#define CHECK(cond, code)                                            \
+    do {                                                             \
+        if (!(cond)) {                                               \
+            fprintf(stderr, "rank %d: check failed at line %d\n",    \
+                    rank, __LINE__);                                 \
+            MPI_Abort(MPI_COMM_WORLD, code);                         \
+        }                                                            \
+    } while (0)
+
+int main(int argc, char **argv)
+{
+    MPI_Init(&argc, &argv);
+    int rank, size;
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &size);
+
+    MPI_Barrier(MPI_COMM_WORLD);
+
+    /* bcast */
+    double v[4] = {0, 0, 0, 0};
+    if (rank == 0) {
+        v[0] = 1.5; v[1] = -2.0; v[2] = 3.25; v[3] = 4.0;
+    }
+    MPI_Bcast(v, 4, MPI_DOUBLE, 0, MPI_COMM_WORLD);
+    CHECK(v[0] == 1.5 && v[3] == 4.0, 2);
+
+    /* reduce (max) at root 0 */
+    int mine = 10 + rank, top = -1;
+    MPI_Reduce(&mine, &top, 1, MPI_INT, MPI_MAX, 0, MPI_COMM_WORLD);
+    if (rank == 0)
+        CHECK(top == 10 + size - 1, 3);
+
+    /* IN_PLACE allreduce */
+    float x[2] = {(float)rank, 1.0f};
+    MPI_Allreduce(MPI_IN_PLACE, x, 2, MPI_FLOAT, MPI_SUM,
+                  MPI_COMM_WORLD);
+    CHECK(x[0] == (float)(size * (size - 1) / 2), 4);
+    CHECK(x[1] == (float)size, 5);
+
+    /* gather at root (recvtype significant at root ONLY — non-roots
+     * legally pass MPI_DATATYPE_NULL), then scatter back (sendtype
+     * significant at root only) */
+    int *all = NULL;
+    if (rank == 0) {
+        all = (int *)malloc((size_t)size * sizeof(int));
+        MPI_Gather(&rank, 1, MPI_INT, all, 1, MPI_INT, 0,
+                   MPI_COMM_WORLD);
+        for (int i = 0; i < size; i++)
+            CHECK(all[i] == i, 6);
+    } else {
+        MPI_Gather(&rank, 1, MPI_INT, NULL, 0, MPI_DATATYPE_NULL, 0,
+                   MPI_COMM_WORLD);
+    }
+    int got = -1;
+    if (rank == 0)
+        MPI_Scatter(all, 1, MPI_INT, &got, 1, MPI_INT, 0,
+                    MPI_COMM_WORLD);
+    else
+        MPI_Scatter(NULL, 0, MPI_DATATYPE_NULL, &got, 1, MPI_INT, 0,
+                    MPI_COMM_WORLD);
+    CHECK(got == rank, 7);
+    free(all);
+
+    /* allgather, then the MPI_IN_PLACE variant (my slot pre-filled) */
+    int *every = (int *)malloc((size_t)size * sizeof(int));
+    int token = rank * rank;
+    MPI_Allgather(&token, 1, MPI_INT, every, 1, MPI_INT, MPI_COMM_WORLD);
+    for (int i = 0; i < size; i++)
+        CHECK(every[i] == i * i, 8);
+    every[rank] = rank + 1000;
+    MPI_Allgather(MPI_IN_PLACE, 0, MPI_DATATYPE_NULL, every, 1, MPI_INT,
+                  MPI_COMM_WORLD);
+    for (int i = 0; i < size; i++)
+        CHECK(every[i] == i + 1000, 13);
+    free(every);
+
+    /* alltoall: rank r sends value r*size+i to rank i */
+    int *sbuf = (int *)malloc((size_t)size * sizeof(int));
+    int *rbuf = (int *)malloc((size_t)size * sizeof(int));
+    for (int i = 0; i < size; i++)
+        sbuf[i] = rank * size + i;
+    MPI_Alltoall(sbuf, 1, MPI_INT, rbuf, 1, MPI_INT, MPI_COMM_WORLD);
+    for (int i = 0; i < size; i++)
+        CHECK(rbuf[i] == i * size + rank, 9);
+
+    /* scan + exscan */
+    long one = 1, pre = -1;
+    MPI_Scan(&one, &pre, 1, MPI_LONG, MPI_SUM, MPI_COMM_WORLD);
+    CHECK(pre == rank + 1, 10);
+    MPI_Exscan(&one, &pre, 1, MPI_LONG, MPI_SUM, MPI_COMM_WORLD);
+    if (rank > 0)
+        CHECK(pre == rank, 11);
+
+    /* reduce_scatter_block: block r of the elementwise sum */
+    for (int i = 0; i < size; i++)
+        sbuf[i] = i;
+    int blk = -1;
+    MPI_Reduce_scatter_block(sbuf, &blk, 1, MPI_INT, MPI_SUM,
+                             MPI_COMM_WORLD);
+    CHECK(blk == rank * size, 12);
+    free(sbuf);
+    free(rbuf);
+
+    MPI_Finalize();
+    printf("OK c03_coll rank=%d/%d\n", rank, size);
+    return 0;
+}

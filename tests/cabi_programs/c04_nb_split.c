@@ -1,0 +1,116 @@
+/* Nonblocking pt2pt + communicator algebra: Isend/Irecv/Waitall, Test
+ * polling, Probe/Iprobe, Sendrecv halo, Comm_split into odd/even
+ * sub-communicators, Comm_dup/free, and the ERRORS_RETURN errhandler
+ * path (an invalid rank must return an error code, not abort). */
+#include <mpi.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+#define CHECK(cond, code)                                            \
+    do {                                                             \
+        if (!(cond)) {                                               \
+            fprintf(stderr, "rank %d: check failed at line %d\n",    \
+                    rank, __LINE__);                                 \
+            MPI_Abort(MPI_COMM_WORLD, code);                         \
+        }                                                            \
+    } while (0)
+
+int main(int argc, char **argv)
+{
+    MPI_Init(&argc, &argv);
+    int rank, size;
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &size);
+    int right = (rank + 1) % size, left = (rank - 1 + size) % size;
+
+    /* nonblocking exchange with both neighbors */
+    int out_r = rank * 2, out_l = rank * 3, in_l = -1, in_r = -1;
+    MPI_Request reqs[4];
+    MPI_Status sts[4];
+    MPI_Irecv(&in_l, 1, MPI_INT, left, 1, MPI_COMM_WORLD, &reqs[0]);
+    MPI_Irecv(&in_r, 1, MPI_INT, right, 2, MPI_COMM_WORLD, &reqs[1]);
+    MPI_Isend(&out_r, 1, MPI_INT, right, 1, MPI_COMM_WORLD, &reqs[2]);
+    MPI_Isend(&out_l, 1, MPI_INT, left, 2, MPI_COMM_WORLD, &reqs[3]);
+    MPI_Waitall(4, reqs, sts);
+    CHECK(in_l == left * 2, 2);
+    CHECK(in_r == right * 3, 3);
+    CHECK(sts[0].MPI_SOURCE == left && sts[0].MPI_TAG == 1, 4);
+
+    /* Test-poll a pending receive, then satisfy it */
+    int payload = -1;
+    MPI_Request r2;
+    MPI_Irecv(&payload, 1, MPI_INT, left, 5, MPI_COMM_WORLD, &r2);
+    int done = 0;
+    MPI_Test(&r2, &done, MPI_STATUS_IGNORE);   /* may or may not be */
+    int tosend = 100 + rank;
+    MPI_Send(&tosend, 1, MPI_INT, right, 5, MPI_COMM_WORLD);
+    MPI_Wait(&r2, MPI_STATUS_IGNORE);
+    CHECK(payload == 100 + left, 5);
+
+    /* Probe before receiving sizes the buffer (textbook idiom) */
+    if (rank == 0) {
+        long big[8] = {1, 2, 3, 4, 5, 6, 7, 8};
+        MPI_Send(big, 8, MPI_LONG, right, 9, MPI_COMM_WORLD);
+    }
+    if (rank == (size > 1 ? 1 : 0)) {
+        MPI_Status pst;
+        MPI_Probe(0, 9, MPI_COMM_WORLD, &pst);
+        int n, nb;
+        MPI_Get_count(&pst, MPI_LONG, &n);
+        CHECK(n == 8, 6);
+        /* count converts into ANY caller datatype's units (the
+         * status->_ucount byte convention) */
+        MPI_Get_count(&pst, MPI_BYTE, &nb);
+        CHECK(nb == 64, 15);
+        long *buf = (long *)malloc((size_t)n * sizeof(long));
+        MPI_Recv(buf, n, MPI_LONG, 0, 9, MPI_COMM_WORLD,
+                 MPI_STATUS_IGNORE);
+        CHECK(buf[7] == 8, 7);
+        free(buf);
+    }
+
+    /* Sendrecv halo */
+    double h_out = rank + 0.5, h_in = -1;
+    MPI_Sendrecv(&h_out, 1, MPI_DOUBLE, right, 11, &h_in, 1, MPI_DOUBLE,
+                 left, 11, MPI_COMM_WORLD, MPI_STATUS_IGNORE);
+    CHECK(h_in == left + 0.5, 8);
+
+    /* split into parity sub-communicators */
+    MPI_Comm sub;
+    MPI_Comm_split(MPI_COMM_WORLD, rank % 2, rank, &sub);
+    int srank, ssize;
+    MPI_Comm_rank(sub, &srank);
+    MPI_Comm_size(sub, &ssize);
+    CHECK(srank == rank / 2, 9);
+    int ssum = -1, sme = rank;
+    MPI_Allreduce(&sme, &ssum, 1, MPI_INT, MPI_SUM, sub);
+    int expect = 0;
+    for (int i = rank % 2; i < size; i += 2)
+        expect += i;
+    CHECK(ssum == expect, 10);
+    MPI_Comm_free(&sub);
+    CHECK(sub == MPI_COMM_NULL, 11);
+
+    /* dup carries the group */
+    MPI_Comm dup;
+    MPI_Comm_dup(MPI_COMM_WORLD, &dup);
+    int drank;
+    MPI_Comm_rank(dup, &drank);
+    CHECK(drank == rank, 12);
+    MPI_Comm_free(&dup);
+
+    /* ERRORS_RETURN: invalid destination must come back as a code */
+    MPI_Comm_set_errhandler(MPI_COMM_WORLD, MPI_ERRORS_RETURN);
+    int junk = 0;
+    int rc = MPI_Send(&junk, 1, MPI_INT, size + 17, 0, MPI_COMM_WORLD);
+    CHECK(rc == MPI_ERR_RANK, 13);
+    char msg[MPI_MAX_ERROR_STRING];
+    int mlen;
+    MPI_Error_string(rc, msg, &mlen);
+    CHECK(mlen > 0, 14);
+    MPI_Comm_set_errhandler(MPI_COMM_WORLD, MPI_ERRORS_ARE_FATAL);
+
+    MPI_Finalize();
+    printf("OK c04_nb_split rank=%d/%d\n", rank, size);
+    return 0;
+}

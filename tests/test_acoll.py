@@ -23,6 +23,8 @@ def test_hints_installed_at_default_precedence(world):
     overrides an explicit setting (precedence contract)."""
     assert var.var_get("coll_acoll_detected") == "cpu"
     # explicit set wins and stays won
+    v = var._registry.get("coll_xla_segsize")
+    saved = (v.value, v.source)
     var.var_set("coll_xla_segsize", 12345)
     try:
         acoll.AcollComponent._hints_done = False
@@ -32,9 +34,9 @@ def test_hints_installed_at_default_precedence(world):
         assert var.var_source("coll_xla_segsize") == var.SOURCE_SET
     finally:
         # restore the PRE-TEST state including the source tag (a plain
-        # var_set would leave the var at SOURCE_SET for the session)
-        v = var._registry.get("coll_xla_segsize")
-        v.value, v.source = 1 << 20, var.SOURCE_DEFAULT
+        # var_set would leave the var at SOURCE_SET for the session,
+        # and a hardcoded default would clobber a live env override)
+        v.value, v.source = saved
         var.bump_epoch()
         acoll.AcollComponent._hints_done = True
 
@@ -47,4 +49,7 @@ def test_acoll_never_wins_selection(world):
 
 def test_hint_table_shape():
     for gen, (segsize, arity) in acoll.GENERATION_HINTS.items():
-        assert segsize >= 1 << 20 and arity in (2, 4), gen
+        assert segsize >= 1 << 20 and arity in (None, 2, 4), gen
+        # only real TPU generations carry a ladder hint; the host
+        # stand-in must leave xhc's locality fallback in charge
+        assert (arity is None) == (gen == "cpu"), gen
