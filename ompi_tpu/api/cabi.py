@@ -220,11 +220,23 @@ def comm_set_errhandler(h: int, which: int) -> None:
     """Propagate the C-side errhandler choice into the Python layer —
     without this, the communicator's default ERRORS_ARE_FATAL hook
     would print its abort banner and raise SystemExit before the C
-    shim's ERRORS_RETURN path ever saw the real error class."""
+    shim's ERRORS_RETURN path ever saw the real error class.
+
+    The C shim's g_errh is PROCESS-scoped (a documented simplification
+    of MPI's per-comm handlers), so this applies process-wide too —
+    world, self, and every live dynamic comm — keeping the two layers
+    in agreement: a mixed state (RETURN in C, FATAL on some comm in
+    Python) would turn that comm's errors into SystemExit mapped to
+    ERR_OTHER instead of their real class."""
     from ompi_tpu.core import errhandler as eh
-    c = _comm(h)
-    c.errhandler = (eh.ERRORS_RETURN if which == 2
-                    else eh.ERRORS_ARE_FATAL)
+    handler = eh.ERRORS_RETURN if which == 2 else eh.ERRORS_ARE_FATAL
+    _comm(h)                             # validate the handle
+    from ompi_tpu.runtime import init as rt
+    targets = [rt.comm_world(), rt.comm_self()]
+    with _lock:
+        targets.extend(_comms.values())
+    for c in targets:
+        c.errhandler = handler
 
 
 def comm_free(h: int) -> None:

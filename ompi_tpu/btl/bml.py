@@ -1,0 +1,229 @@
+"""bml/r2 — the BTL multiplexer: per-peer transport selection.
+
+Behavioral spec: ``ompi/mca/bml/r2`` over ``ompi/mca/bml/bml.h`` — each
+peer endpoint carries arrays of eligible BTLs (eager / send / rdma);
+the PML picks per message, small ones through the latency-best eager
+BTL (sm for same-host peers), large ones through the bandwidth path.
+
+TPU-native re-design: two planes exist in the per-rank world — the
+shared-memory rings (btl/sm, same-host eager) and framed TCP (btl/tcp,
+universal). This multiplexer exposes the exact TcpEndpoint surface the
+Router binds (``send_frame`` / ``_connect`` / ``_peers`` / ``close``),
+so the pml cannot tell it is riding a composite. Routing rule per
+frame: self -> sink loopback (btl/self); same-host peer AND the frame
+fits the ring -> sm; otherwise -> tcp. TCP connections are still wired
+eagerly to every peer — the connection monitor IS the failure
+detector, and sm rings cannot detect a dead peer.
+
+Locality (the hwloc relative-locality modex): every rank publishes its
+host + boot identity; peers sharing it are same-host. On the one-host
+test worlds everything is local, but the check is real — a multi-host
+job would route cross-host peers over tcp only.
+"""
+from __future__ import annotations
+
+import os
+import socket
+import uuid
+from typing import Callable, Dict, Optional
+
+from ompi_tpu.btl.sm import SmEndpoint
+from ompi_tpu.btl.tcp import TcpEndpoint
+from ompi_tpu.mca import var
+
+_BOOT_ID: Optional[str] = None
+
+
+def _host_identity() -> str:
+    """hostname + a per-boot token: two containers can share a
+    hostname without sharing /dev/shm."""
+    global _BOOT_ID
+    if _BOOT_ID is None:
+        try:
+            with open("/proc/sys/kernel/random/boot_id") as f:
+                _BOOT_ID = f.read().strip()
+        except OSError:
+            _BOOT_ID = uuid.uuid4().hex     # no proc: never matches,
+            #                                 sm safely disabled
+    return f"{socket.gethostname()}/{_BOOT_ID}"
+
+
+def register_params() -> None:
+    var.var_register("btl", "sm", "enable", vtype="bool", default=True,
+                     help="Use shared-memory rings for same-host "
+                          "pt2pt frames (bml routes the rest via tcp)")
+    var.var_register("btl", "sm", "ring_bytes", vtype="int",
+                     default=4 << 20,
+                     help="Per-peer SPSC ring capacity in bytes; frames "
+                          "that cannot fit route via tcp (the eager "
+                          "limit / protocol switch)")
+    var.var_register("btl", "sm", "min_bytes", vtype="int",
+                     default=32 << 10,
+                     help="Smallest payload routed through the sm "
+                          "bandwidth plane; smaller frames stay on the "
+                          "tcp latency plane (socket wakeup beats any "
+                          "poll cadence a GIL runtime can offer)")
+
+
+class BmlEndpoint:
+    """Composite endpoint: TcpEndpoint surface, sm fast path.
+
+    Ordering: two transports per peer would break MPI's non-overtaking
+    rule (a small sm frame could pass a large tcp frame sent earlier),
+    so every outbound frame is stamped with a per-destination sequence
+    number and the receive side delivers strictly in sequence, holding
+    early arrivals back — ob1's recv-fragment sequencing
+    (``pml_ob1_recvfrag.c:296-330``) at the bml boundary.
+    """
+
+    def __init__(self, rank: int, nprocs: int,
+                 kv_set: Callable[[str, str], None],
+                 kv_get: Callable[[str], str],
+                 sink: Callable[[dict, bytes], None],
+                 on_peer_lost: Optional[Callable[[int], None]] = None):
+        register_params()
+        self.rank = rank
+        self.nprocs = nprocs
+        self._kv_get = kv_get
+        self.sink = sink
+        import itertools
+        import threading
+        self._send_seq: Dict[int, "itertools.count"] = {
+            p: itertools.count(1) for p in range(nprocs)}
+        self._expect: Dict[int, int] = {}
+        self._held: Dict[int, Dict[int, tuple]] = {}
+        self._ready: Dict[int, object] = {}      # src -> deque
+        self._draining: Dict[int, bool] = {}
+        self._order_lock = threading.Lock()
+        self.tcp = TcpEndpoint(rank, nprocs, kv_set, kv_get,
+                               self._ordered_sink,
+                               on_peer_lost=on_peer_lost)
+        kv_set(f"ompi_tpu/btl/host/{rank}", _host_identity())
+        self.sm: Optional[SmEndpoint] = None
+        if var.var_get("btl_sm_enable", True) and nprocs > 1 \
+                and not os.environ.get("OMPI_TPU_DISABLE_SM"):
+            try:
+                self.sm = SmEndpoint(
+                    rank, nprocs, kv_set, kv_get, self._ordered_sink,
+                    ring_bytes=int(var.var_get("btl_sm_ring_bytes",
+                                               1 << 20)))
+            except Exception:            # noqa: BLE001 — no /dev/shm
+                self.sm = None           # etc: tcp carries everything
+        self._same_host: Dict[int, bool] = {}
+        self._sm_min = int(var.var_get("btl_sm_min_bytes", 32 << 10))
+        # per-transport frame counts (the hook/comm_method selection
+        # table's data source)
+        self.stats = {"sm": 0, "tcp": 0, "self": 0}
+
+    # -- the TcpEndpoint surface the Router binds ----------------------
+    @property
+    def _peers(self):
+        return self.tcp._peers
+
+    def _connect(self, peer: int):
+        return self.tcp._connect(peer)
+
+    def _is_same_host(self, peer: int) -> bool:
+        cached = self._same_host.get(peer)
+        if cached is not None:
+            return cached
+        try:
+            theirs = self._kv_get(f"ompi_tpu/btl/host/{peer}")
+            if isinstance(theirs, bytes):
+                theirs = theirs.decode()
+            same = theirs == _host_identity()
+        except Exception:                # noqa: BLE001
+            same = False
+        self._same_host[peer] = same
+        return same
+
+    def _ordered_sink(self, header: dict, payload: bytes) -> None:
+        """Deliver frames per-sender in sequence-number order; early
+        arrivals (fast transport overtook the slow one) are held until
+        their predecessors land. The sink itself runs OUTSIDE the
+        order lock (it can trigger ack sends that block on a full
+        ring); per-sender order is kept by a single-drainer queue."""
+        if header.get("ctl") == "_smpoke":
+            # transport doorbell: the peer parked payload-bearing
+            # records in our shared-memory rings; drain them on this
+            # (blocking, already-awake) reader thread
+            if self.sm is not None:
+                self.sm.drain(header.get("peer"))
+            return
+        sq = header.pop("_sq", None)
+        if sq is None:                   # unsequenced (foreign) frame
+            self.sink(header, payload)
+            return
+        src, seq = sq
+        from collections import deque
+        with self._order_lock:
+            exp = self._expect.setdefault(src, 1)
+            held = self._held.setdefault(src, {})
+            ready = self._ready.setdefault(src, deque())
+            if seq != exp:
+                held[seq] = (header, payload)
+                return                   # predecessors still in flight
+            ready.append((header, payload))
+            exp += 1
+            while exp in held:
+                ready.append(held.pop(exp))
+                exp += 1
+            self._expect[src] = exp
+            if self._draining.get(src):
+                return                   # the active drainer takes it
+            self._draining[src] = True
+        while True:
+            with self._order_lock:
+                if not ready:
+                    self._draining[src] = False
+                    return
+                h, p = ready.popleft()
+            try:
+                self.sink(h, p)
+            except Exception:            # noqa: BLE001
+                # one bad frame must drop only itself — an escaping
+                # exception would leave _draining stuck True and wedge
+                # this sender's stream forever (the tcp read loop makes
+                # the same promise)
+                import traceback
+                traceback.print_exc()
+
+    def send_frame(self, peer: int, header: dict,
+                   payload: bytes = b"") -> None:
+        if peer == self.rank:            # btl/self loopback
+            self.stats["self"] += 1
+            self.sink(header, payload)
+            return
+        header = dict(header)
+        header["_sq"] = (self.rank, next(self._send_seq[peer]))
+        if (self.sm is not None and len(payload) >= self._sm_min
+                and self._is_same_host(peer)):
+            from ompi_tpu.runtime import ft
+            pushed = False
+            try:
+                pushed = not ft.is_failed(peer) and \
+                    self.sm.try_send(peer, header, payload)
+            except Exception:            # noqa: BLE001 — ring closed
+                pushed = False           # mid-shutdown: tcp carries it
+            if pushed:
+                self.stats["sm"] += 1
+                # doorbell: a tiny unsequenced tcp frame whose blocking
+                # reader drains the ring at the peer. The frame is
+                # PUBLISHED already — a poke failure must NOT fall back
+                # to tcp (that would duplicate the sequence number and
+                # park the copy in _held forever); a dead peer's drain
+                # no longer matters, and a live peer's next poke or
+                # inbound frame drains the backlog.
+                try:
+                    self.tcp.send_frame(peer, {"ctl": "_smpoke",
+                                               "peer": self.rank})
+                except Exception:        # noqa: BLE001
+                    pass
+                return                   # sm bandwidth plane took it
+        self.stats["tcp"] += 1
+        self.tcp.send_frame(peer, header, payload)
+
+    def close(self) -> None:
+        if self.sm is not None:
+            self.sm.close()
+        self.tcp.close()

@@ -1,0 +1,272 @@
+"""btl/sm — shared-memory byte transport between same-host ranks.
+
+Behavioral spec: ``opal/mca/btl/sm`` — per-peer lock-free FIFOs over
+POSIX shared memory (``btl_sm_module.c:34-36,95-98``, ``btl_sm_fifo.h``):
+each (sender, receiver) pair owns a dedicated single-producer/
+single-consumer channel, the receiver polls its inbound set from the
+progress loop, and only frames up to the eager limit travel this path
+(larger ones switch protocol).
+
+TPU-native re-design: one SPSC ring buffer per ordered rank pair,
+backed by ``multiprocessing.shared_memory``. The receiver creates its
+inbound rings at init and publishes their names through the
+coordination-service KV (the modex); senders attach lazily on first
+send (the lazy endpoint connect). Frames reuse btl/tcp's wire format
+(magic + header-len + payload-len + pickled header + raw payload), so
+the matching engine cannot tell which transport delivered a frame.
+
+Wakeup model: the reference polls its fifos from opal_progress — free
+on dedicated cores, but in a GIL runtime a spinning poll thread
+convoys with the delivery path (measured: 8x worse ping-pong RTT than
+blocking sockets). So this btl is the BANDWIDTH plane only: payload
+bytes ride the ring, and the sender's bml follows each push with a
+tiny tcp "poke" whose blocking reader thread drains the rings — the
+latency plane stays the socket, the bulk bytes skip it. Drains are
+serialized by a consumer lock (the SPSC single-consumer contract).
+
+SPSC memory model: head (consumer-owned) and tail (producer-owned) are
+monotonically increasing u64 counters at fixed offsets; data writes
+happen before the tail store that publishes them, and each side only
+ever stores to its own counter — the classic lock-free SPSC contract
+(x86-TSO keeps the store order; CPython's opcode granularity means
+each 8-byte struct store is a single C memcpy).
+"""
+from __future__ import annotations
+
+import mmap
+import os
+import pickle
+import struct
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from ompi_tpu.btl.tcp import MAGIC, _LEN
+
+_HDR = struct.Struct("<QQ")          # head, tail (bytes consumed/produced)
+_REC = struct.Struct("<Q")           # per-record length prefix
+# head and tail each own a full 64-byte cache line: the producer's
+# tail stores must not invalidate the line the consumer's head loads
+# ride on (false sharing on the hot SPSC path)
+_TAIL_OFF = 64
+DATA_OFF = 128
+
+
+_SHM_DIR = "/dev/shm" if os.path.isdir("/dev/shm") else \
+    os.environ.get("TMPDIR", "/tmp")
+
+
+class Ring:
+    """SPSC byte ring over one shared-memory segment.
+
+    Layout: [head u64 @0][tail u64 @32][data @64 .. 64+capacity).
+    head/tail count BYTES consumed/produced since creation (monotonic,
+    never wrapped); the data offset is counter % capacity.
+
+    Backing is a raw mmap'd file under /dev/shm — NOT
+    ``multiprocessing.shared_memory``, whose resource-tracker child
+    process measurably degrades scheduling on small hosts (an extra
+    runnable process tripled same-host socket RTT on a 1-core box) and
+    whose 3.12 tracker unlinks segments on any attacher's exit.  The
+    creator owns the file and unlinks it at close.
+    """
+
+    def __init__(self, name: Optional[str], capacity: int = 1 << 20,
+                 create: bool = False):
+        self.capacity = capacity
+        size = DATA_OFF + capacity
+        if create:
+            name = name or f"ompi_tpu_sm_{os.getpid():x}_" \
+                           f"{os.urandom(6).hex()}"
+            path = os.path.join(_SHM_DIR, name)
+            self._fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_RDWR,
+                               0o600)
+            os.ftruncate(self._fd, size)
+        else:
+            path = os.path.join(_SHM_DIR, name)
+            self._fd = os.open(path, os.O_RDWR)
+        self.name = name
+        self._path = path
+        self._created = create
+        self._buf = mmap.mmap(self._fd, size)
+        if create:
+            self._buf[:DATA_OFF] = b"\0" * DATA_OFF
+
+    # -- counters ------------------------------------------------------
+    def _head(self) -> int:
+        return _REC.unpack_from(self._buf, 0)[0]
+
+    def _tail(self) -> int:
+        return _REC.unpack_from(self._buf, _TAIL_OFF)[0]
+
+    def _set_head(self, v: int) -> None:
+        _REC.pack_into(self._buf, 0, v)
+
+    def _set_tail(self, v: int) -> None:
+        _REC.pack_into(self._buf, _TAIL_OFF, v)
+
+    # -- producer side -------------------------------------------------
+    def fits(self, nbytes: int) -> bool:
+        """Can a record of nbytes EVER fit? (static check: the eager
+        limit; callers fall back to another btl when False)"""
+        return _REC.size + nbytes <= self.capacity
+
+    def push(self, record: bytes, timeout: float = 60.0) -> bool:
+        """Producer: append one length-prefixed record, waiting for the
+        consumer to drain space if needed. False on timeout."""
+        need = _REC.size + len(record)
+        if need > self.capacity:
+            return False
+        deadline = time.monotonic() + timeout
+        spins = 0
+        while self.capacity - (self._tail() - self._head()) < need:
+            spins += 1
+            if spins > 200:
+                if time.monotonic() > deadline:
+                    return False
+                time.sleep(0.00005)
+        tail = self._tail()
+        self._write(tail, _REC.pack(len(record)))
+        self._write(tail + _REC.size, record)
+        # publish AFTER the data is in place (SPSC contract)
+        self._set_tail(tail + need)
+        return True
+
+    def _write(self, counter: int, data: bytes) -> None:
+        off = counter % self.capacity
+        first = min(len(data), self.capacity - off)
+        base = DATA_OFF + off
+        self._buf[base:base + first] = data[:first]
+        if first < len(data):                    # wrap
+            rest = len(data) - first
+            self._buf[DATA_OFF:DATA_OFF + rest] = data[first:]
+
+    # -- consumer side -------------------------------------------------
+    def pop(self) -> Optional[bytes]:
+        """Consumer: take one record, or None if the ring is empty."""
+        head = self._head()
+        if self._tail() - head < _REC.size:
+            return None
+        n = _REC.unpack(self._read(head, _REC.size))[0]
+        record = self._read(head + _REC.size, n)
+        self._set_head(head + _REC.size + n)
+        return record
+
+    def _read(self, counter: int, n: int) -> bytes:
+        off = counter % self.capacity
+        first = min(n, self.capacity - off)
+        base = DATA_OFF + off
+        out = bytes(self._buf[base:base + first])
+        if first < n:
+            out += bytes(self._buf[DATA_OFF:DATA_OFF + n - first])
+        return out
+
+    def close(self) -> None:
+        try:
+            self._buf.close()
+        except Exception:                # noqa: BLE001
+            pass
+        try:
+            os.close(self._fd)
+        except OSError:
+            pass
+        if self._created:
+            try:
+                os.unlink(self._path)
+            except OSError:
+                pass
+
+
+class SmEndpoint:
+    """The rank's shared-memory plane: inbound rings (created here,
+    names modex'd) + lazily-attached outbound rings, one per peer.
+
+    Reuses btl/tcp's frame encoding so the sink sees identical
+    (header, payload) pairs regardless of transport.
+    """
+
+    def __init__(self, rank: int, nprocs: int,
+                 kv_set: Callable[[str, str], None],
+                 kv_get: Callable[[str], str],
+                 sink: Callable[[dict, bytes], None],
+                 ring_bytes: int = 1 << 20):
+        self.rank = rank
+        self.nprocs = nprocs
+        self._kv_get = kv_get
+        self.sink = sink
+        self.ring_bytes = ring_bytes
+        self._closed = False
+        self._out: Dict[int, Ring] = {}
+        self._out_lock = threading.Lock()
+        self._drain_lock = threading.Lock()  # single-consumer contract
+
+        # receiver-created inbound rings (the btl/sm FIFO per peer)
+        self._in: Dict[int, Ring] = {}
+        for src in range(nprocs):
+            if src == rank:
+                continue
+            ring = Ring(None, ring_bytes, create=True)
+            self._in[src] = ring
+            kv_set(f"ompi_tpu/btlsm/{rank}/{src}", ring.name)
+
+    # -- receive side --------------------------------------------------
+    def drain(self, src: Optional[int] = None) -> int:
+        """Pop and deliver every pending record (from one sender, or
+        all); called from the tcp reader thread that received the poke.
+        Returns the number of records delivered."""
+        if self._closed:
+            return 0
+        rings = ([self._in[src]] if src is not None and src in self._in
+                 else list(self._in.values()))
+        n = 0
+        with self._drain_lock:
+            for ring in rings:
+                rec = ring.pop()
+                while rec is not None:
+                    n += 1
+                    self._deliver(rec)
+                    rec = ring.pop()
+        return n
+
+    def _deliver(self, rec: bytes) -> None:
+        try:
+            magic, hlen, plen = _LEN.unpack_from(rec, 0)
+            if magic != MAGIC:
+                return
+            hraw = rec[_LEN.size:_LEN.size + hlen]
+            praw = rec[_LEN.size + hlen:_LEN.size + hlen + plen]
+            self.sink(pickle.loads(hraw), praw)
+        except Exception:                # noqa: BLE001
+            import traceback
+            traceback.print_exc()
+
+    # -- send side -----------------------------------------------------
+    def _attach(self, peer: int) -> Ring:
+        with self._out_lock:
+            ring = self._out.get(peer)
+            if ring is not None:
+                return ring
+        name = self._kv_get(f"ompi_tpu/btlsm/{peer}/{self.rank}")
+        if isinstance(name, bytes):
+            name = name.decode()
+        ring = Ring(name, self.ring_bytes)
+        with self._out_lock:
+            return self._out.setdefault(peer, ring)
+
+    def try_send(self, peer: int, header: dict, payload: bytes) -> bool:
+        """Send one frame if it fits the ring (the eager path); False
+        tells the caller (bml) to route via another btl."""
+        hraw = pickle.dumps(header)
+        rec = _LEN.pack(MAGIC, len(hraw), len(payload)) + hraw + payload
+        ring = self._attach(peer)
+        if not ring.fits(len(rec)):
+            return False
+        return ring.push(rec)
+
+    def close(self) -> None:
+        self._closed = True
+        with self._drain_lock:           # no drain mid-teardown
+            for ring in self._out.values():
+                ring.close()
+            for ring in self._in.values():
+                ring.close()

@@ -220,16 +220,31 @@ def _handshake(sock: socket.socket, my_size: int) -> int:
     return struct.unpack("!I", raw)[0]
 
 
-def comm_accept(port_name: str, comm, root: int = 0) -> BridgeInterComm:
+def comm_accept(port_name: str, comm, root: int = 0,
+                timeout: Optional[float] = None) -> BridgeInterComm:
     """MPI_Comm_accept: collective over ``comm``; the root accepts one
-    connection on its open port and the jobs exchange group sizes."""
+    connection on its open port and the jobs exchange group sizes.
+    ``timeout`` bounds the root's accept wait (None = block)."""
     icid = port_name
     if comm.rank() == root:
         p = _ports.get(port_name)
         if p is None:
             raise MPIError(ERR_PORT, f"port {port_name!r} is not open "
                                      f"in this process")
-        conn, _ = p.sock.accept()
+        if timeout is not None:
+            p.sock.settimeout(timeout)
+        try:
+            conn, _ = p.sock.accept()
+        except socket.timeout:
+            raise MPIError(ERR_PORT,
+                           f"no connection arrived on {port_name!r} "
+                           f"within {timeout}s") from None
+        finally:
+            # the listener persists in _ports for later accepts, which
+            # must see their own timeout (or the blocking default) —
+            # not this call's
+            if timeout is not None:
+                p.sock.settimeout(None)
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         remote = _handshake(conn, comm.size)
         comm.bcast(remote, root=root)

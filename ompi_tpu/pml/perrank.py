@@ -29,7 +29,7 @@ import threading
 from collections import deque
 from typing import Any, Deque, Dict, List, Optional, Tuple
 
-from ompi_tpu.btl.tcp import TcpEndpoint, decode_payload, encode_payload
+from ompi_tpu.btl.tcp import decode_payload, encode_payload
 from ompi_tpu.core.errhandler import ERR_PENDING, ERR_RANK, ERR_TAG, MPIError
 from ompi_tpu.core.request import Request, Status
 
@@ -57,7 +57,10 @@ class Router:
         self._rma: Dict[Any, Any] = {}
         self._closing = False
         self._departed: set = set()      # peers that said goodbye
-        self.endpoint = TcpEndpoint(rank, nprocs, kv_set, kv_get,
+        # the bml/r2 multiplexer: sm rings for same-host eager frames,
+        # tcp for the rest (and as the failure detector's wire)
+        from ompi_tpu.btl.bml import BmlEndpoint
+        self.endpoint = BmlEndpoint(rank, nprocs, kv_set, kv_get,
                                     self._deliver,
                                     on_peer_lost=self._peer_lost)
 

@@ -31,15 +31,16 @@ if role == "accept":
         port = world.bcast(port, root=0)
     else:
         port = world.bcast(None, root=0)
-    ic = dpm.comm_accept(port, world, root=0)
+    ic = dpm.comm_accept(port, world, root=0, timeout=150)
 else:
-    deadline = time.monotonic() + 60
+    deadline = time.monotonic() + 150   # 1-core CI: four jax
+    # imports serialize before the accept side can publish
     while not os.path.exists(port_file):
         if time.monotonic() > deadline:
             raise SystemExit("port file never appeared")
         time.sleep(0.1)
     port = open(port_file).read().strip()
-    ic = dpm.comm_connect(port, world, root=0)
+    ic = dpm.comm_connect(port, world, root=0, timeout=150)
 
 assert ic.remote_size == n, ic.remote_size
 

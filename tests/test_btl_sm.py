@@ -1,0 +1,123 @@
+"""btl/sm shared-memory rings + bml/r2 multiplexing.
+
+In-process unit tests for the SPSC ring (the btl/sm FIFO) and the bml
+sequencing logic, plus a real 2-process job interleaving sm and tcp
+frames from one sender (tests/perrank_programs/p19_sm_bml.py) to prove
+the non-overtaking rule survives transport mixing.
+"""
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from ompi_tpu.btl.sm import Ring
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_MPIRUN = os.path.join(_REPO, "ompi_tpu", "tools", "mpirun.py")
+
+
+def test_ring_roundtrip_and_wrap():
+    ring = Ring(None, capacity=256, create=True)
+    try:
+        # records repeatedly wrap the 256-byte data region
+        for i in range(100):
+            msg = bytes([i % 251]) * (40 + i % 60)
+            assert ring.push(msg, timeout=5)
+            got = ring.pop()
+            assert got == msg, i
+        assert ring.pop() is None
+    finally:
+        ring.close()
+
+
+def test_ring_rejects_oversized():
+    ring = Ring(None, capacity=128, create=True)
+    try:
+        assert not ring.fits(1000)
+        assert not ring.push(b"x" * 1000, timeout=0.1)
+        assert ring.push(b"y" * 32)
+        assert ring.pop() == b"y" * 32
+    finally:
+        ring.close()
+
+
+def test_ring_spsc_threaded_stress():
+    """One producer, one consumer, small capacity: heavy wrap +
+    backpressure traffic must deliver every record in order (the
+    lock-free FIFO contract of btl_sm_fifo.h)."""
+    ring = Ring(None, capacity=1 << 12, create=True)
+    N = 2000
+    got = []
+
+    def consume():
+        while len(got) < N:
+            rec = ring.pop()
+            if rec is None:
+                continue
+            got.append(rec)
+
+    t = threading.Thread(target=consume)
+    t.start()
+    try:
+        for i in range(N):
+            msg = (b"%06d" % i) * (1 + i % 40)
+            assert ring.push(msg, timeout=30), i
+        t.join(timeout=60)
+        assert not t.is_alive()
+        assert len(got) == N
+        for i, rec in enumerate(got):
+            assert rec == (b"%06d" % i) * (1 + i % 40), i
+    finally:
+        ring.close()
+
+
+def test_attach_by_name():
+    ring = Ring(None, capacity=1 << 12, create=True)
+    other = Ring(ring.name, capacity=1 << 12)
+    try:
+        assert other.push(b"hello over shm")
+        assert ring.pop() == b"hello over shm"
+    finally:
+        other.close()
+        ring.close()
+
+
+def test_bml_ordered_sink_reorders():
+    """Frames arriving out of sequence (fast transport overtook the
+    slow one) are held and delivered in order."""
+    from ompi_tpu.btl.bml import BmlEndpoint
+    delivered = []
+    ep = BmlEndpoint.__new__(BmlEndpoint)       # sequencing state only
+    import threading as _t
+    ep.sink = lambda h, p: delivered.append(h["i"])
+    ep._expect, ep._held, ep._ready, ep._draining = {}, {}, {}, {}
+    ep._order_lock = _t.Lock()
+    ep._ordered_sink({"i": 2, "_sq": (0, 2)}, b"")
+    ep._ordered_sink({"i": 3, "_sq": (0, 3)}, b"")
+    assert delivered == []                       # held: 1 not yet in
+    ep._ordered_sink({"i": 1, "_sq": (0, 1)}, b"")
+    assert delivered == [1, 2, 3]
+    # a second sender sequences independently
+    ep._ordered_sink({"i": 10, "_sq": (1, 1)}, b"")
+    assert delivered == [1, 2, 3, 10]
+    # unsequenced frames pass straight through
+    ep._ordered_sink({"i": 99}, b"")
+    assert delivered == [1, 2, 3, 10, 99]
+
+
+@pytest.mark.parametrize("n", [2])
+def test_sm_bml_job(n):
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("JAX_", "XLA_"))}
+    res = subprocess.run(
+        [sys.executable, _MPIRUN, "--per-rank", "-n", str(n),
+         "--timeout", "150",
+         os.path.join(_REPO, "tests", "perrank_programs",
+                      "p19_sm_bml.py")],
+        env=env, capture_output=True, text=True, timeout=200, cwd=_REPO)
+    assert res.returncode == 0, \
+        f"rc={res.returncode}\n--- out\n{res.stdout}\n--- err\n" \
+        f"{res.stderr[-4000:]}"
+    assert res.stdout.count("OK p19_sm_bml") == n, res.stdout
