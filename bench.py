@@ -151,9 +151,20 @@ def _overlap_pct(world, MPI, elems: int = 1 << 20) -> dict:
     t_both = float(np.median(t_both_l))
     t_cpu = float(np.median(t_cpu_l))
     overlap = (t_pure + t_cpu - t_both) / t_pure * 100.0
-    return {"iallreduce_overlap_pct": round(min(max(overlap, 0.0),
-                                                100.0), 1),
-            "iallreduce_4MB_us": round(t_pure * 1e6, 2)}
+    out = {"iallreduce_overlap_pct": round(min(max(overlap, 0.0),
+                                               100.0), 1),
+           "iallreduce_4MB_us": round(t_pure * 1e6, 2)}
+    import os as _os
+    cores = _os.cpu_count() or 1
+    if cores <= 2:
+        # the "device" here is the virtual CPU mesh: its compute and
+        # the injected host busy-loop share the same core(s), so the
+        # measured overlap is scheduler interleaving, not the async
+        # dispatch the design provides — on real TPU the comm runs on
+        # the chip while the host computes. Record the ceiling so the
+        # number is read honestly.
+        out["iallreduce_overlap_capped_by_host_cores"] = cores
+    return out
 
 
 def _calibrated_busy(seconds: float) -> float:
@@ -270,6 +281,44 @@ def _ab_matrix_child() -> None:
             kr[alg + "_error"] = f"{type(e).__name__}"
     var.var_set("coll_xla_reduce_algorithm", "auto")
     out["reduce_8B_ab"] = kr
+
+    # round-3 additions: bruck alltoall, recursive-halving
+    # reduce_scatter, recursive-doubling scan
+    a2a_s = world.alloc((n, 2), np.float32, fill=1.0)
+    at = {}
+    for alg in ("direct", "pairwise", "bruck"):
+        var.var_set("coll_xla_alltoall_algorithm", alg)
+        try:
+            at[alg + "_8B_us"] = round(_osu(
+                lambda: world.alltoall(a2a_s), 50, rtt, chunk) * 1e6, 1)
+        except Exception as e:          # noqa: BLE001
+            at[alg + "_error"] = f"{type(e).__name__}"
+    var.var_set("coll_xla_alltoall_algorithm", "auto")
+    out["alltoall_ab"] = at
+
+    rs = {}
+    for alg in ("direct", "ring", "recursive_halving"):
+        var.var_set("coll_xla_reduce_scatter_block_algorithm", alg)
+        try:
+            rs[alg + "_8B_us"] = round(_osu(
+                lambda: world.reduce_scatter_block(a2a_s, MPI.SUM), 50,
+                rtt, chunk) * 1e6, 1)
+        except Exception as e:          # noqa: BLE001
+            rs[alg + "_error"] = f"{type(e).__name__}"
+    var.var_set("coll_xla_reduce_scatter_block_algorithm", "auto")
+    out["reduce_scatter_8B_ab"] = rs
+
+    sc = {}
+    for alg in ("direct", "recursive_doubling"):
+        var.var_set("coll_xla_scan_algorithm", alg)
+        try:
+            sc[alg + "_8B_us"] = round(_osu(
+                lambda: world.scan(bsmall, MPI.SUM), 50, rtt,
+                chunk) * 1e6, 1)
+        except Exception as e:          # noqa: BLE001
+            sc[alg + "_error"] = f"{type(e).__name__}"
+    var.var_set("coll_xla_scan_algorithm", "auto")
+    out["scan_ab"] = sc
 
     # single-shot blocking rows next to the amortized ones (VERDICT r2
     # weak #3) — un-amortized dispatch-to-completion, RTT included

@@ -48,17 +48,23 @@ def _host_identity() -> str:
     return f"{socket.gethostname()}/{_BOOT_ID}"
 
 
+# single source of truth for the tuning defaults: register_params and
+# the var_get fallbacks below must never disagree
+_DEF_RING_BYTES = 4 << 20
+_DEF_MIN_BYTES = 32 << 10
+
+
 def register_params() -> None:
     var.var_register("btl", "sm", "enable", vtype="bool", default=True,
                      help="Use shared-memory rings for same-host "
                           "pt2pt frames (bml routes the rest via tcp)")
     var.var_register("btl", "sm", "ring_bytes", vtype="int",
-                     default=4 << 20,
+                     default=_DEF_RING_BYTES,
                      help="Per-peer SPSC ring capacity in bytes; frames "
                           "that cannot fit route via tcp (the eager "
                           "limit / protocol switch)")
     var.var_register("btl", "sm", "min_bytes", vtype="int",
-                     default=32 << 10,
+                     default=_DEF_MIN_BYTES,
                      help="Smallest payload routed through the sm "
                           "bandwidth plane; smaller frames stay on the "
                           "tcp latency plane (socket wakeup beats any "
@@ -106,11 +112,12 @@ class BmlEndpoint:
                 self.sm = SmEndpoint(
                     rank, nprocs, kv_set, kv_get, self._ordered_sink,
                     ring_bytes=int(var.var_get("btl_sm_ring_bytes",
-                                               1 << 20)))
+                                               _DEF_RING_BYTES)))
             except Exception:            # noqa: BLE001 — no /dev/shm
                 self.sm = None           # etc: tcp carries everything
         self._same_host: Dict[int, bool] = {}
-        self._sm_min = int(var.var_get("btl_sm_min_bytes", 32 << 10))
+        self._sm_min = int(var.var_get("btl_sm_min_bytes",
+                                       _DEF_MIN_BYTES))
         # per-transport frame counts (the hook/comm_method selection
         # table's data source)
         self.stats = {"sm": 0, "tcp": 0, "self": 0}

@@ -56,12 +56,24 @@ _SHM_DIR = "/dev/shm" if os.path.isdir("/dev/shm") else \
     os.environ.get("TMPDIR", "/tmp")
 
 
+def job_tag() -> str:
+    """Deterministic per-job token derived from the coordination
+    service address — the launcher computes the same value to sweep
+    leaked segments after reaping (``tools/mpirun.py``)."""
+    coord = os.environ.get("OMPI_TPU_MCA_mpi_base_coordinator", "")
+    if not coord:
+        return ""
+    import hashlib
+    return hashlib.md5(coord.encode()).hexdigest()[:10]
+
+
 class Ring:
     """SPSC byte ring over one shared-memory segment.
 
-    Layout: [head u64 @0][tail u64 @32][data @64 .. 64+capacity).
-    head/tail count BYTES consumed/produced since creation (monotonic,
-    never wrapped); the data offset is counter % capacity.
+    Layout: [head u64 @0][tail u64 @64][data @128 .. 128+capacity) —
+    offsets from _TAIL_OFF/DATA_OFF, each counter on its own cache
+    line.  head/tail count BYTES consumed/produced since creation
+    (monotonic, never wrapped); the data offset is counter % capacity.
 
     Backing is a raw mmap'd file under /dev/shm — NOT
     ``multiprocessing.shared_memory``, whose resource-tracker child
@@ -199,13 +211,28 @@ class SmEndpoint:
         self._out: Dict[int, Ring] = {}
         self._out_lock = threading.Lock()
         self._drain_lock = threading.Lock()  # single-consumer contract
+        # the SPSC ring admits ONE producer; sends can arrive from the
+        # app thread and tcp reader threads (RMA replies) concurrently,
+        # so each outbound ring gets a producer lock (tcp's per-peer
+        # _peer_locks discipline)
+        self._push_locks: Dict[int, threading.Lock] = {}
 
-        # receiver-created inbound rings (the btl/sm FIFO per peer)
+        # receiver-created inbound rings (the btl/sm FIFO per peer).
+        # Names carry the job tag so the launcher can sweep segments a
+        # killed rank leaked (the shmem-framework cleanup role) — a
+        # crash between create and close must not accrete in /dev/shm.
+        tag = job_tag()
         self._in: Dict[int, Ring] = {}
         for src in range(nprocs):
             if src == rank:
                 continue
-            ring = Ring(None, ring_bytes, create=True)
+            name = f"otpusm_{tag}_{rank}_{src}" if tag else None
+            if name:
+                try:                     # stale leftover from a crashed
+                    os.unlink(os.path.join(_SHM_DIR, name))  # same-tag
+                except OSError:          # job: reclaim the name
+                    pass
+            ring = Ring(name, ring_bytes, create=True)
             self._in[src] = ring
             kv_set(f"ompi_tpu/btlsm/{rank}/{src}", ring.name)
 
@@ -261,7 +288,10 @@ class SmEndpoint:
         ring = self._attach(peer)
         if not ring.fits(len(rec)):
             return False
-        return ring.push(rec)
+        with self._out_lock:
+            lock = self._push_locks.setdefault(peer, threading.Lock())
+        with lock:
+            return ring.push(rec)
 
     def close(self) -> None:
         self._closed = True

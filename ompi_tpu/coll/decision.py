@@ -84,10 +84,18 @@ FIXED_RULES: Dict[str, List[Sequence]] = {
 REORDERING = frozenset({
     "ring", "ring_segmented", "hier", "recursive_doubling",
     "rabenseifner", "rabenseifner_root", "knomial",
+    "recursive_halving",
 })
 
+# (collective, algorithm) pairs exempt from the REORDERING demotion:
+# the name reorders in one collective but is order-preserving in
+# another — scan's recursive doubling folds the contiguous left range
+# in front of the local value, so non-commutative combines are safe.
+ORDER_PRESERVING = frozenset({("scan", "recursive_doubling")})
+
 # Algorithms only defined for power-of-two communicator sizes.
-POW2_ONLY = frozenset({"recursive_doubling"})
+POW2_ONLY = frozenset({"recursive_doubling",
+                       "recursive_halving"})
 
 # Algorithms only defined for even communicator sizes.
 EVEN_ONLY = frozenset({"neighborexchange"})

@@ -153,7 +153,8 @@ class XlaCollModule:
             alg = decision.decide(
                 func, self.comm.size, nbytes, self._multihost(), dyn,
                 platform=getattr(self.comm.devices[0], "platform", ""))
-        if alg in decision.REORDERING and not commute:
+        if (alg in decision.REORDERING and not commute
+                and (func, alg) not in decision.ORDER_PRESERVING):
             return "direct"
         n = self.comm.size
         if alg in decision.POW2_ONLY and (n & (n - 1)) != 0:
@@ -749,6 +750,86 @@ class XlaCollModule:
                                                 keepdims=False)[None]
         return inner
 
+    def _rhalving_rsb_inner(self, op, n):
+        """Recursive-halving reduce_scatter
+        (ompi_coll_base_reduce_scatter_intra_recursivehalving): log2(n)
+        rounds; in round d each rank swaps the half of its working
+        buffer NOT containing its own block with partner r XOR d and
+        folds the received half in. Halves the live payload every
+        round (n-1 block-transfers total vs the ring's n-1 full
+        rounds), so the wire bytes match the ring but the round count
+        is logarithmic — the latency-regime choice. Power-of-two sizes
+        only (selection enforces); combine order normalized
+        (lower-group operand first) for cross-rank determinism."""
+        def inner(b):                    # (1, n, *s) -> (1, *s)
+            x = b[0]
+            r = jax.lax.axis_index(AXIS)
+            d = n // 2
+            while d >= 1:
+                m = x.shape[0] // 2
+                lo, hi = x[:m], x[m:]
+                upper = (r & d) != 0     # my block lives in the top half
+                to_send = jnp.where(upper, lo, hi)
+                perm = [(i, i ^ d) for i in range(n)]
+                recvd = jax.lax.ppermute(to_send, AXIS, perm=perm)
+                kept = jnp.where(upper, hi, lo)
+                x = jnp.where(upper, op.fn(recvd, kept),
+                              op.fn(kept, recvd))
+                d //= 2
+            return x                     # (1, *s): my reduced block
+        return inner
+
+    def _bruck_alltoall_inner(self, n):
+        """Bruck alltoall (ompi_coll_base_alltoall_intra_bruck):
+        ceil(log2 n) rounds instead of pairwise's n-1 — the
+        small-message latency algorithm. Phase 1 rotates the block
+        vector by the rank; phase 2 round k ships every block whose
+        index has bit k set to rank r+k; phase 3 un-rotates and
+        reverses into destination order."""
+        def inner(b):                    # (1, n, *s) -> (1, n, *s)
+            x = b[0]
+            r = jax.lax.axis_index(AXIS)
+            x = jnp.roll(x, -r, axis=0)  # phase 1: x[i] = data for r+i
+            k = 1
+            while k < n:
+                mask = np.array([(i & k) != 0 for i in range(n)])
+                maskb = jnp.asarray(
+                    mask.reshape((n,) + (1,) * (x.ndim - 1)))
+                perm = [(i, (i + k) % n) for i in range(n)]
+                recvd = jax.lax.ppermute(x, AXIS, perm=perm)
+                x = jnp.where(maskb, recvd, x)
+                k <<= 1
+            # phase 3: slot i now holds source (r - i) mod n's block
+            idx = jnp.mod(r - jnp.arange(n), n)
+            return x[idx][None]
+        return inner
+
+    def _rd_scan_inner(self, op, n, exclusive: bool):
+        """Recursive-doubling prefix scan
+        (ompi_coll_base_scan_intra_recursivedoubling): log2(n) rounds;
+        in round d each rank ships its running value UP the rank order
+        by d, and ranks >= d fold the received left-range partial in
+        front of their own. Moves log(n) chunks instead of the
+        allgather lowering's n-chunk gather. Exclusive variant shifts
+        the inclusive result up by one rank (rank 0's output follows
+        the direct lowering's convention: its own value)."""
+        def inner(b):                    # (1, *s) -> (1, *s)
+            r = jax.lax.axis_index(AXIS)
+            acc = b
+            d = 1
+            while d < n:
+                perm = [(i, i + d) for i in range(n - d)]
+                recvd = jax.lax.ppermute(acc, AXIS, perm=perm)
+                # ranks < d receive nothing (zeros); keep their acc
+                acc = jnp.where(r >= d, op.fn(recvd, acc), acc)
+                d *= 2
+            if not exclusive:
+                return acc
+            shifted = jax.lax.ppermute(
+                acc, AXIS, perm=[(i, i + 1) for i in range(n - 1)])
+            return jnp.where(r == 0, acc, shifted)
+        return inner
+
     def _dissemination_barrier_inner(self, n):
         """Dissemination barrier (ompi_coll_base_barrier_intra_bruck /
         scoll_basic's dissemination): ceil(log2 n) rounds; in round k
@@ -1138,6 +1219,8 @@ class XlaCollModule:
         def build():
             if alg == "pairwise":
                 inner = self._pairwise_alltoall_inner(n)
+            elif alg == "bruck" and n > 1:
+                inner = self._bruck_alltoall_inner(n)
             else:
                 def inner(b):               # (1, N, *s) -> (1, N, *s)
                     y = jax.lax.all_to_all(b[0], AXIS, split_axis=0,
@@ -1169,6 +1252,8 @@ class XlaCollModule:
                 inner = self._hier_rsb_inner(low, high, x.shape[2:])
             elif alg == "ring":
                 inner = self._ring_reduce_scatter_inner(op, n)
+            elif alg == "recursive_halving" and n > 1:
+                inner = self._rhalving_rsb_inner(op, n)
             elif op.xla_prim == "sum":
                 def inner(b):                   # (1, N, *s) -> (1, *s)
                     return jax.lax.psum_scatter(b[0], AXIS,
@@ -1201,30 +1286,43 @@ class XlaCollModule:
 
     def scan(self, x, op):
         x = self._to_mesh(x)
+        n = self.comm.size
+        alg = self._algorithm("scan", x.nbytes // max(n, 1), op.commute)
 
         def build():
-            def inner(b):                       # (1, *s) -> (1, *s)
-                g = jax.lax.all_gather(b[0], AXIS, axis=0, tiled=False)
-                pre = self._prefix(g, op)
-                idx = jax.lax.axis_index(AXIS)
-                return jax.lax.dynamic_slice_in_dim(pre, idx, 1, 0)
+            if alg == "recursive_doubling" and n > 1:
+                inner = self._rd_scan_inner(op, n, exclusive=False)
+            else:
+                def inner(b):                   # (1, *s) -> (1, *s)
+                    g = jax.lax.all_gather(b[0], AXIS, axis=0,
+                                           tiled=False)
+                    pre = self._prefix(g, op)
+                    idx = jax.lax.axis_index(AXIS)
+                    return jax.lax.dynamic_slice_in_dim(pre, idx, 1, 0)
             return self._smap(inner, x.ndim, x.ndim)
-        return self._compiled(self._key("scan", x, op.uid),
+        return self._compiled(self._key("scan", x, op.uid, alg),
                               build, x)(x)
 
     def exscan(self, x, op):
         x = self._to_mesh(x)
+        n = self.comm.size
+        alg = self._algorithm("scan", x.nbytes // max(n, 1), op.commute)
 
         def build():
-            def inner(b):
-                g = jax.lax.all_gather(b[0], AXIS, axis=0, tiled=False)
-                pre = self._prefix(g, op)
-                idx = jax.lax.axis_index(AXIS)
-                # Rank 0's recvbuf is undefined per MPI; clamp to row 0.
-                row = jnp.maximum(idx - 1, 0)
-                return jax.lax.dynamic_slice_in_dim(pre, row, 1, 0)
+            if alg == "recursive_doubling" and n > 1:
+                inner = self._rd_scan_inner(op, n, exclusive=True)
+            else:
+                def inner(b):
+                    g = jax.lax.all_gather(b[0], AXIS, axis=0,
+                                           tiled=False)
+                    pre = self._prefix(g, op)
+                    idx = jax.lax.axis_index(AXIS)
+                    # Rank 0's recvbuf is undefined per MPI; clamp to
+                    # row 0.
+                    row = jnp.maximum(idx - 1, 0)
+                    return jax.lax.dynamic_slice_in_dim(pre, row, 1, 0)
             return self._smap(inner, x.ndim, x.ndim)
-        return self._compiled(self._key("exscan", x, op.uid),
+        return self._compiled(self._key("exscan", x, op.uid, alg),
                               build, x)(x)
 
     def _barrier_arrays(self):
@@ -1315,9 +1413,11 @@ class XlaCollComponent(Component):
                  "ppermute, or scatter+allgather (large messages)")
         var.var_register(
             "coll", "xla", "alltoall_algorithm", vtype="str",
-            default="auto", enumerator=["auto", "direct", "pairwise"],
-            help="Alltoall lowering: fused XLA all_to_all or explicit "
-                 "pairwise exchange rounds")
+            default="auto",
+            enumerator=["auto", "direct", "pairwise", "bruck"],
+            help="Alltoall lowering: fused XLA all_to_all, explicit "
+                 "pairwise exchange rounds, or log-round Bruck "
+                 "(small-message latency)")
         var.var_register(
             "coll", "xla", "reduce_algorithm", vtype="str",
             default="auto",
@@ -1339,9 +1439,18 @@ class XlaCollComponent(Component):
         var.var_register(
             "coll", "xla", "reduce_scatter_block_algorithm", vtype="str",
             default="auto",
-            enumerator=["auto", "direct", "ring", "hier"],
-            help="Reduce_scatter_block lowering: fused psum_scatter or "
-                 "explicit accumulating ring")
+            enumerator=["auto", "direct", "ring", "recursive_halving",
+                        "hier"],
+            help="Reduce_scatter_block lowering: fused psum_scatter, "
+                 "explicit accumulating ring, or recursive halving "
+                 "(log rounds; power-of-two sizes)")
+        var.var_register(
+            "coll", "xla", "scan_algorithm", vtype="str",
+            default="auto",
+            enumerator=["auto", "direct", "recursive_doubling"],
+            help="Scan/exscan lowering: allgather + on-device prefix "
+                 "or recursive-doubling partial exchange (log-round, "
+                 "1/n the gather bytes)")
         var.var_register(
             "coll", "xla", "barrier_algorithm", vtype="str",
             default="auto",

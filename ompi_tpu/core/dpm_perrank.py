@@ -236,6 +236,10 @@ def comm_accept(port_name: str, comm, root: int = 0,
         try:
             conn, _ = p.sock.accept()
         except socket.timeout:
+            # the accept is COLLECTIVE: non-roots are blocked in the
+            # bcast below — broadcast the failure sentinel so every
+            # rank raises instead of only unblocking the root
+            comm.bcast(-1, root=root)
             raise MPIError(ERR_PORT,
                            f"no connection arrived on {port_name!r} "
                            f"within {timeout}s") from None
@@ -250,6 +254,8 @@ def comm_accept(port_name: str, comm, root: int = 0,
         comm.bcast(remote, root=root)
         return BridgeInterComm(comm, icid, remote, conn, root)
     remote = comm.bcast(None, root=root)
+    if remote == -1:                     # root's accept timed out
+        raise MPIError(ERR_PORT, "comm_accept timed out at the root")
     return BridgeInterComm(comm, icid, remote, None, root)
 
 

@@ -121,7 +121,24 @@ def run_per_rank(args, prog) -> int:
                 p.wait(timeout=5)
             except subprocess.TimeoutExpired:
                 p.kill()
+        _sweep_shm(coord)
     return rc
+
+
+def _sweep_shm(coord: str) -> None:
+    """Remove shared-memory ring segments this job's ranks leaked (a
+    killed rank never reaches its unlink) — the PRRTE session-cleanup
+    role for the btl/sm backing files."""
+    import glob
+    import hashlib
+    tag = hashlib.md5(coord.encode()).hexdigest()[:10]
+    shm_dir = "/dev/shm" if os.path.isdir("/dev/shm") else \
+        os.environ.get("TMPDIR", "/tmp")
+    for path in glob.glob(os.path.join(shm_dir, f"otpusm_{tag}_*")):
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
 
 
 def main(argv=None) -> None:

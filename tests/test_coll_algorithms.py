@@ -184,3 +184,89 @@ def test_pipeline_bcast_segments(mpi, world, alg):
             assert np.allclose(y[r], rows[1], atol=1e-6)
     finally:
         var.var_set("coll_xla_segsize", 1 << 20)
+
+
+def test_reduce_scatter_recursive_halving(mpi, world, alg):
+    n = world.size
+    rows = [np.random.default_rng(10 + r).standard_normal((n, 3))
+            .astype(np.float32) for r in range(n)]
+    x = world.stack(rows)
+    alg("reduce_scatter_block", "recursive_halving")
+    y = np.asarray(world.reduce_scatter_block(x, mpi.SUM))
+    want = np.sum(rows, axis=0)               # (n, 3)
+    for r in range(n):
+        assert np.allclose(y[r], want[r], atol=1e-4)
+
+
+def test_reduce_scatter_recursive_halving_max(mpi, world, alg):
+    # a non-sum commutative op through the same halving schedule
+    n = world.size
+    rows = [np.random.default_rng(20 + r).standard_normal((n, 2))
+            .astype(np.float32) for r in range(n)]
+    x = world.stack(rows)
+    alg("reduce_scatter_block", "recursive_halving")
+    y = np.asarray(world.reduce_scatter_block(x, mpi.MAX))
+    want = np.max(rows, axis=0)
+    for r in range(n):
+        assert np.allclose(y[r], want[r])
+
+
+def test_alltoall_bruck(mpi, world, alg):
+    n = world.size
+    rows = [np.arange(n * 2, dtype=np.float32).reshape(n, 2) + 100 * r
+            for r in range(n)]
+    x = world.stack(rows)
+    alg("alltoall", "bruck")
+    y = np.asarray(world.alltoall(x))
+    for r in range(n):
+        for s in range(n):
+            assert np.allclose(y[r, s], rows[s][r])
+
+
+@pytest.mark.parametrize("opname,ref", [("SUM", np.add),
+                                        ("MAX", np.maximum)])
+def test_scan_recursive_doubling(mpi, world, alg, opname, ref):
+    rows, x = _rank_data(world, (6,), seed=31)
+    alg("scan", "recursive_doubling")
+    y = np.asarray(world.scan(x, getattr(mpi, opname)))
+    acc = rows[0].copy()
+    assert np.allclose(y[0], acc, atol=1e-4)
+    for r in range(1, world.size):
+        acc = ref(acc, rows[r])
+        assert np.allclose(y[r], acc, atol=1e-4), r
+
+
+def test_exscan_recursive_doubling(mpi, world, alg):
+    rows, x = _rank_data(world, (4,), seed=32)
+    alg("scan", "recursive_doubling")
+    y = np.asarray(world.exscan(x, mpi.SUM))
+    acc = rows[0].copy()
+    for r in range(1, world.size):
+        assert np.allclose(y[r], acc, atol=1e-4), r
+        acc = acc + rows[r]
+
+
+def test_scan_rd_matches_direct_exactly_ordered(mpi, world, alg):
+    # rd-scan folds the contiguous left range IN FRONT of the local
+    # value, so it is order-preserving: valid for non-commutative
+    # combines (unlike the REORDERING allreduce schedules)
+    rows, x = _rank_data(world, (3,), seed=33)
+    alg("scan", "recursive_doubling")
+    y_rd = np.asarray(world.scan(x, mpi.SUM))
+    alg("scan", "direct")
+    y_dir = np.asarray(world.scan(x, mpi.SUM))
+    assert np.allclose(y_rd, y_dir, atol=1e-5)
+
+
+def test_scan_rd_allowed_for_non_commutative(mpi, world, alg):
+    # rd-scan is ORDER_PRESERVING: unlike the allreduce schedules, a
+    # non-commutative op must NOT demote it — and the ordered result
+    # must match the direct lowering's left fold.
+    f = mpi.op_create(lambda a, b: b, commute=False)   # right-take
+    rows, x = _rank_data(world, (3,), seed=41)
+    alg("scan", "recursive_doubling")
+    y = np.asarray(world.scan(x, f))
+    for r in range(world.size):
+        # left fold of right-take over ranks 0..r = rank r's own data
+        assert np.allclose(y[r], rows[r], atol=1e-6), r
+    assert ("scan", "recursive_doubling") in decision.ORDER_PRESERVING
