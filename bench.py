@@ -154,8 +154,7 @@ def _overlap_pct(world, MPI, elems: int = 1 << 20) -> dict:
     out = {"iallreduce_overlap_pct": round(min(max(overlap, 0.0),
                                                100.0), 1),
            "iallreduce_4MB_us": round(t_pure * 1e6, 2)}
-    import os as _os
-    cores = _os.cpu_count() or 1
+    cores = os.cpu_count() or 1
     if cores <= 2:
         # the "device" here is the virtual CPU mesh: its compute and
         # the injected host busy-loop share the same core(s), so the

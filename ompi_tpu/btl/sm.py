@@ -56,15 +56,19 @@ _SHM_DIR = "/dev/shm" if os.path.isdir("/dev/shm") else \
     os.environ.get("TMPDIR", "/tmp")
 
 
-def job_tag() -> str:
-    """Deterministic per-job token derived from the coordination
-    service address — the launcher computes the same value to sweep
-    leaked segments after reaping (``tools/mpirun.py``)."""
-    coord = os.environ.get("OMPI_TPU_MCA_mpi_base_coordinator", "")
-    if not coord:
-        return ""
+def tag_for(coord: str) -> str:
+    """Deterministic job token from a coordination-service address.
+    SHARED with the launcher's post-reap sweep (``tools/mpirun.py``
+    imports this) — the ring-name prefix and the sweep glob must never
+    diverge."""
     import hashlib
     return hashlib.md5(coord.encode()).hexdigest()[:10]
+
+
+def job_tag() -> str:
+    """This process's job token (empty outside a launched job)."""
+    coord = os.environ.get("OMPI_TPU_MCA_mpi_base_coordinator", "")
+    return tag_for(coord) if coord else ""
 
 
 class Ring:

@@ -93,6 +93,12 @@ REORDERING = frozenset({
 # in front of the local value, so non-commutative combines are safe.
 ORDER_PRESERVING = frozenset({("scan", "recursive_doubling")})
 
+# (collective, algorithm) pairs exempt from the POW2_ONLY demotion:
+# allreduce's recursive doubling is genuinely power-of-two-only, but
+# scan's variant (partial-permute rounds over range(n-d)) handles any
+# size.
+POW2_EXEMPT = frozenset({("scan", "recursive_doubling")})
+
 # Algorithms only defined for power-of-two communicator sizes.
 POW2_ONLY = frozenset({"recursive_doubling",
                        "recursive_halving"})

@@ -157,7 +157,8 @@ class XlaCollModule:
                 and (func, alg) not in decision.ORDER_PRESERVING):
             return "direct"
         n = self.comm.size
-        if alg in decision.POW2_ONLY and (n & (n - 1)) != 0:
+        if (alg in decision.POW2_ONLY and (n & (n - 1)) != 0
+                and (func, alg) not in decision.POW2_EXEMPT):
             return "direct"
         if alg in decision.EVEN_ONLY and n % 2 != 0:
             return "direct"

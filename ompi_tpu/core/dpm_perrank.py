@@ -250,12 +250,21 @@ def comm_accept(port_name: str, comm, root: int = 0,
             if timeout is not None:
                 p.sock.settimeout(None)
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        remote = _handshake(conn, comm.size)
+        try:
+            remote = _handshake(conn, comm.size)
+        except BaseException:
+            # same collective-hang class as the accept timeout: a
+            # connector that dies mid-handshake must not leave the
+            # non-roots parked in the bcast below
+            comm.bcast(-1, root=root)
+            raise
         comm.bcast(remote, root=root)
         return BridgeInterComm(comm, icid, remote, conn, root)
     remote = comm.bcast(None, root=root)
-    if remote == -1:                     # root's accept timed out
-        raise MPIError(ERR_PORT, "comm_accept timed out at the root")
+    if remote == -1:                     # root's accept/handshake failed
+        raise MPIError(ERR_PORT,
+                       "comm_accept failed at the root (timeout or "
+                       "handshake error)")
     return BridgeInterComm(comm, icid, remote, None, root)
 
 

@@ -56,6 +56,8 @@ class ShmemCtx:
         # window or hand out offsets beyond it.
         from ompi_tpu.native import get_lib
         self._lib = get_lib()
+        self._live: dict = {}            # offset -> nelems (memheap
+        #                                  allocation metadata)
         self._buddy = -1
         if (self._lib is not None and heap_size > 0
                 and heap_size & (heap_size - 1) == 0):
@@ -79,27 +81,77 @@ class ShmemCtx:
         """shmem_malloc: symmetric allocation — returns the symmetric
         offset, identical on every PE. Served by the native buddy
         allocator (oshmem/mca/memheap/buddy role: power-of-two blocks,
-        split/coalesce), falling back to a bump allocator."""
+        split/coalesce), falling back to a bump allocator. Live sizes
+        are tracked host-side (the memheap metadata) so realloc/free
+        know block extents on either path."""
         if self._buddy >= 0:
             addr = self._lib.ompi_tpu_buddy_alloc(self._buddy, nelems)
             if addr < 0:
                 raise MPIError(ERR_ARG, "symmetric heap exhausted")
+            self._live[int(addr)] = nelems
             return int(addr)
         if self._brk + nelems > self.heap_size:
             raise MPIError(ERR_ARG, "symmetric heap exhausted")
         addr = self._brk
         self._brk += nelems
+        self._live[addr] = nelems
         return addr
 
     def free(self, addr: int) -> None:
         """shmem_free: returns the block to the buddy allocator (no-op
         on the bump fallback)."""
+        if self._live.pop(addr, None) is None:
+            raise MPIError(ERR_ARG,
+                           f"shmem_free: invalid or double free at "
+                           f"offset {addr}")
         if self._buddy >= 0:
             rc = self._lib.ompi_tpu_buddy_free(self._buddy, addr)
             if rc != 0:
                 raise MPIError(ERR_ARG,
                                f"shmem_free: invalid or double free at "
                                f"offset {addr}")
+
+    def align(self, alignment: int, nelems: int) -> int:
+        """shmem_align: allocation whose symmetric offset is a multiple
+        of ``alignment`` (elements). The buddy allocator's power-of-two
+        blocks are naturally size-aligned; the bump path pads."""
+        if alignment <= 0 or alignment & (alignment - 1):
+            raise MPIError(ERR_ARG, "alignment must be a power of two")
+        if self._buddy >= 0:
+            # buddy blocks of 2^k elements sit at 2^k-aligned offsets:
+            # request a block at least max(alignment, nelems)
+            want = max(alignment, nelems)
+            addr = self.malloc(want)
+            return addr
+        pad = (-self._brk) % alignment
+        if self._brk + pad + nelems > self.heap_size:
+            raise MPIError(ERR_ARG, "symmetric heap exhausted")
+        self._brk += pad
+        return self.malloc(nelems)
+
+    def calloc(self, count: int) -> int:
+        """shmem_calloc: zero-initialized symmetric allocation (a
+        recycled block may carry stale content)."""
+        addr = self.malloc(count)
+        zero = np.zeros(count, dtype=self.heap.dtype)
+        for pe in range(self.n_pes):
+            self.put(pe, addr, zero)
+        return addr
+
+    def realloc(self, addr: int, nelems: int) -> int:
+        """shmem_realloc: symmetric resize — every PE's content (up to
+        the smaller extent) moves to the new block."""
+        old = self._live.get(addr)
+        if old is None:
+            raise MPIError(ERR_ARG,
+                           f"shmem_realloc: offset {addr} is not a "
+                           f"live allocation")
+        new = self.malloc(nelems)
+        keep = min(old, nelems)
+        for pe in range(self.n_pes):
+            self.put(pe, new, self.get(pe, addr, keep))
+        self.free(addr)
+        return new
 
     # -- RMA (spml put/get) --------------------------------------------
     def put(self, dest_pe: int, addr: int, data) -> None:
@@ -245,6 +297,123 @@ class ShmemCtx:
     def atomic_compare_swap(self, dest_pe: int, addr: int, cond, value):
         return self.heap.compare_and_swap(value, cond, dest_pe, addr)
 
+    def atomic_inc(self, dest_pe: int, addr: int) -> None:
+        """shmem_atomic_inc (shmem_inc.c)."""
+        self.atomic_add(dest_pe, addr, 1)
+
+    def atomic_fetch_inc(self, dest_pe: int, addr: int):
+        """shmem_atomic_fetch_inc (shmem_finc.c)."""
+        return self.atomic_fetch_add(dest_pe, addr, 1)
+
+    # bitwise AMOs (shmem_and/or/xor.c + fetching shmem_f{and,or,xor}.c)
+    def atomic_and(self, dest_pe: int, addr: int, value) -> None:
+        self.heap.accumulate(np.asarray([value]), dest_pe, op_mod.BAND,
+                             addr)
+
+    def atomic_or(self, dest_pe: int, addr: int, value) -> None:
+        self.heap.accumulate(np.asarray([value]), dest_pe, op_mod.BOR,
+                             addr)
+
+    def atomic_xor(self, dest_pe: int, addr: int, value) -> None:
+        self.heap.accumulate(np.asarray([value]), dest_pe, op_mod.BXOR,
+                             addr)
+
+    def atomic_fetch_and(self, dest_pe: int, addr: int, value):
+        return self.heap.fetch_and_op(value, dest_pe, op_mod.BAND, addr)
+
+    def atomic_fetch_or(self, dest_pe: int, addr: int, value):
+        return self.heap.fetch_and_op(value, dest_pe, op_mod.BOR, addr)
+
+    def atomic_fetch_xor(self, dest_pe: int, addr: int, value):
+        return self.heap.fetch_and_op(value, dest_pe, op_mod.BXOR, addr)
+
+    # -- accessibility / introspection ---------------------------------
+    def pe_accessible(self, pe: int) -> bool:
+        """shmem_pe_accessible.c: is ``pe`` a reachable PE?"""
+        return 0 <= pe < self.n_pes
+
+    def addr_accessible(self, addr: int, pe: int) -> bool:
+        """shmem_addr_accessible.c: is the symmetric offset valid on
+        ``pe``'s heap? (symmetry by construction: one bound check)"""
+        return self.pe_accessible(pe) and 0 <= addr < self.heap_size
+
+    @staticmethod
+    def info_get_version():
+        """shmem_info.c: the OpenSHMEM spec level implemented."""
+        return (1, 5)
+
+    @staticmethod
+    def info_get_name() -> str:
+        return "ompi_tpu-OpenSHMEM"
+
+    @staticmethod
+    def pcontrol(level: int = 1) -> None:
+        """shmem_pcontrol.c: profiling control — recorded as an SPC
+        event (the reference's hook point for PMPI-style tools)."""
+        from ompi_tpu.runtime import spc
+        spc.record("shmem_pcontrol", int(level))
+
+    def global_exit(self, status: int = 0) -> None:
+        """shmem_global_exit.c: terminate ALL PEs. Single-controller:
+        every PE lives in this process — one SystemExit is the whole
+        job."""
+        raise SystemExit(status)
+
+    # deprecated cache-management entry points (shmem_*cache*.c,
+    # shmem_udcflush*.c): kept callable, documented no-ops — exactly
+    # the reference's status for them since OpenSHMEM 1.3
+    def clear_cache_inv(self) -> None:
+        pass
+
+    def set_cache_inv(self) -> None:
+        pass
+
+    def udcflush(self) -> None:
+        pass
+
+    # -- multi-variable sync (shmem_{test,wait}_ivars.c, SHMEM 1.4) ----
+    def _ivar_state(self, pe: int, addrs, cmp: int, value):
+        fn = _CMP_FNS.get(cmp)
+        if fn is None:
+            raise MPIError(ERR_ARG, f"bad SHMEM_CMP constant: {cmp}")
+        return [bool(fn(self.g(pe, a), value)) for a in addrs]
+
+    def test_all(self, pe: int, addrs, cmp: int, value) -> bool:
+        return all(self._ivar_state(pe, addrs, cmp, value))
+
+    def test_any(self, pe: int, addrs, cmp: int, value):
+        """Index of ANY satisfied variable, or None."""
+        st = self._ivar_state(pe, addrs, cmp, value)
+        return st.index(True) if True in st else None
+
+    def test_some(self, pe: int, addrs, cmp: int, value):
+        """Indices of every satisfied variable (possibly empty)."""
+        st = self._ivar_state(pe, addrs, cmp, value)
+        return [i for i, ok in enumerate(st) if ok]
+
+    def wait_until_all(self, pe: int, addrs, cmp: int, value) -> None:
+        """Single-controller: like wait_until, an unsatisfied wait has
+        no concurrent producer and is surfaced as the deadlock it is."""
+        if not self.test_all(pe, addrs, cmp, value):
+            raise MPIError(ERR_PENDING,
+                           "shmem_wait_until_all would deadlock: "
+                           "conditions unsatisfied with no concurrent "
+                           "producer (perform the puts first)")
+
+    def wait_until_any(self, pe: int, addrs, cmp: int, value) -> int:
+        got = self.test_any(pe, addrs, cmp, value)
+        if got is None:
+            raise MPIError(ERR_PENDING,
+                           "shmem_wait_until_any would deadlock")
+        return got
+
+    def wait_until_some(self, pe: int, addrs, cmp: int, value):
+        got = self.test_some(pe, addrs, cmp, value)
+        if not got:
+            raise MPIError(ERR_PENDING,
+                           "shmem_wait_until_some would deadlock")
+        return got
+
     # -- ordering / completion -----------------------------------------
     def fence(self) -> None:
         self.heap.flush_all()
@@ -255,6 +424,19 @@ class ShmemCtx:
     # -- collectives (scoll; delegate to coll like scoll/mpi) ----------
     def barrier_all(self) -> None:
         self.comm.barrier()
+
+    def sync_all(self) -> None:
+        """shmem_sync.c: barrier WITHOUT the implied quiet (no
+        completion of pending puts) — pure arrival synchronization."""
+        self.comm.barrier()
+
+    def barrier(self, start: int, log_stride: int, size: int) -> None:
+        """Active-set barrier (the pre-teams shmem_barrier.c calling
+        convention): PEs {start + i*2^log_stride : i < size}. Includes
+        the implied quiet, then synchronizes the strided team."""
+        self.quiet()
+        self.team_world().split_strided(start, 1 << log_stride,
+                                        size).sync()
 
     def broadcast(self, addr: int, nelems: int, root_pe: int) -> None:
         self.team_world().broadcast(addr, nelems, root_pe)

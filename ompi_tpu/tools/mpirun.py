@@ -128,13 +128,15 @@ def run_per_rank(args, prog) -> int:
 def _sweep_shm(coord: str) -> None:
     """Remove shared-memory ring segments this job's ranks leaked (a
     killed rank never reaches its unlink) — the PRRTE session-cleanup
-    role for the btl/sm backing files."""
+    role for the btl/sm backing files. Tag and directory come from
+    btl/sm itself so the sweep can never diverge from the naming."""
     import glob
-    import hashlib
-    tag = hashlib.md5(coord.encode()).hexdigest()[:10]
-    shm_dir = "/dev/shm" if os.path.isdir("/dev/shm") else \
-        os.environ.get("TMPDIR", "/tmp")
-    for path in glob.glob(os.path.join(shm_dir, f"otpusm_{tag}_*")):
+    try:
+        from ompi_tpu.btl.sm import _SHM_DIR, tag_for
+    except Exception:                    # noqa: BLE001 — broken env:
+        return                           # nothing we can safely sweep
+    for path in glob.glob(os.path.join(_SHM_DIR,
+                                       f"otpusm_{tag_for(coord)}_*")):
         try:
             os.unlink(path)
         except OSError:

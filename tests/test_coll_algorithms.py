@@ -270,3 +270,21 @@ def test_scan_rd_allowed_for_non_commutative(mpi, world, alg):
         # left fold of right-take over ranks 0..r = rank r's own data
         assert np.allclose(y[r], rows[r], atol=1e-6), r
     assert ("scan", "recursive_doubling") in decision.ORDER_PRESERVING
+
+
+def test_scan_rd_on_odd_size_subcomm(mpi, world, alg):
+    # POW2_EXEMPT: scan's recursive doubling handles any size — an
+    # odd-sized sub-communicator must still run it (allreduce's
+    # same-named schedule stays pow2-only)
+    colors = [0, 0, 0] + [1] * (world.size - 3)
+    sub = world.split(colors)[0]
+    assert sub.size == 3
+    rows = [np.full(4, r + 1, np.float32) for r in range(3)]
+    x = sub.stack(rows)
+    alg("scan", "recursive_doubling")
+    y = np.asarray(sub.scan(x, mpi.SUM))
+    acc = rows[0].copy()
+    assert np.allclose(y[0], acc)
+    for r in range(1, 3):
+        acc = acc + rows[r]
+        assert np.allclose(y[r], acc), r

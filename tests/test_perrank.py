@@ -38,6 +38,7 @@ CASES = [
     ("p14_shmem.py", 3),
     ("p15_cart_halo.py", 4),
     ("p16_master_worker.py", 4),
+    ("p20_shmem_ext.py", 3),
 ]
 
 

@@ -130,3 +130,85 @@ def test_ptr_snapshot(ctx):
     ctx.put(2, a, np.float64([8, 9]))
     snap = ctx.ptr(2)
     assert np.allclose(snap[a:a + 2], [8, 9])
+
+
+@pytest.fixture
+def ictx(world):
+    """Integer-heap context for bitwise atomics."""
+    return ShmemCtx(world, heap_size=1 << 10, dtype=np.int64)
+
+
+def test_heap_calloc_realloc_align_free(ctx):
+    a = ctx.calloc(8)
+    assert np.allclose(ctx.get(1, a, 8), 0.0)
+    ctx.p(1, a, 42.0)
+    b = ctx.realloc(a, 16)                     # content moves
+    assert ctx.get(1, b, 1)[0] == 42.0
+    with pytest.raises(MPIError):
+        ctx.free(a)                            # a was freed by realloc
+    c = ctx.align(8, 4)
+    assert c % 8 == 0
+    ctx.free(c)
+    with pytest.raises(MPIError):
+        ctx.free(c)                            # double free surfaced
+
+
+def test_atomic_inc_and_fetch_inc(ctx):
+    a = ctx.malloc(1)
+    ctx.p(2, a, 10.0)
+    ctx.atomic_inc(2, a)
+    assert ctx.atomic_fetch_inc(2, a) == 11.0
+    assert ctx.g(2, a) == 12.0
+
+
+def test_bitwise_atomics(ictx):
+    a = ictx.malloc(1)
+    ictx.p(1, a, 0b1100)
+    ictx.atomic_and(1, a, 0b1010)
+    assert ictx.g(1, a) == 0b1000
+    ictx.atomic_or(1, a, 0b0001)
+    assert ictx.g(1, a) == 0b1001
+    old = ictx.atomic_fetch_xor(1, a, 0b1111)
+    assert old == 0b1001 and ictx.g(1, a) == 0b0110
+    assert ictx.atomic_fetch_and(1, a, 0b0010) == 0b0110
+    assert ictx.atomic_fetch_or(1, a, 0b1000) == 0b0010
+
+
+def test_ivars_test_and_wait(ctx):
+    offs = [ctx.malloc(1) for _ in range(3)]
+    ctx.p(0, offs[0], 5.0)
+    ctx.p(0, offs[2], 5.0)
+    assert not ctx.test_all(0, offs, CMP_EQ, 5.0)
+    assert ctx.test_any(0, offs, CMP_EQ, 5.0) == 0
+    assert ctx.test_some(0, offs, CMP_EQ, 5.0) == [0, 2]
+    ctx.p(0, offs[1], 5.0)
+    ctx.wait_until_all(0, offs, CMP_EQ, 5.0)   # satisfied
+    assert ctx.wait_until_any(0, offs, CMP_EQ, 5.0) == 0
+    assert ctx.wait_until_some(0, offs, CMP_NE, 9.0) == [0, 1, 2]
+    with pytest.raises(MPIError):
+        ctx.wait_until_all(0, offs, CMP_EQ, 99.0)  # deadlock surfaced
+
+
+def test_accessibility_info_pcontrol_cache(ctx):
+    assert ctx.pe_accessible(ctx.n_pes - 1)
+    assert not ctx.pe_accessible(ctx.n_pes)
+    a = ctx.malloc(2)
+    assert ctx.addr_accessible(a, 0)
+    assert not ctx.addr_accessible(1 << 30, 0)
+    assert ctx.info_get_version() == (1, 5)
+    assert "OpenSHMEM" in ctx.info_get_name()
+    ctx.pcontrol(2)                            # SPC-recorded no-op
+    ctx.clear_cache_inv()                      # deprecated no-ops
+    ctx.set_cache_inv()
+    ctx.udcflush()
+
+
+def test_active_set_barrier_and_sync(ctx):
+    ctx.sync_all()
+    # PEs {0, 2, 4, ...}: stride 2^1 active set
+    ctx.barrier(0, 1, ctx.n_pes // 2)
+
+
+def test_global_exit_raises_systemexit(ctx):
+    with pytest.raises(SystemExit):
+        ctx.global_exit(3)
