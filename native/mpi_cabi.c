@@ -47,6 +47,42 @@ static size_t dt_size(MPI_Datatype dt)
     return (dt >= 1 && dt <= DT_MAX) ? DT_SIZE[dt] : 0;
 }
 
+/* Derived datatypes live in the binding layer (handles >= 64); their
+ * extents come from glue queries.  PyGILState_Ensure nests safely, so
+ * these helpers are callable with or without the GIL held. */
+#define DT_FIRST_DYN 64
+
+static size_t dyn_query(const char *fn, MPI_Datatype dt)
+{
+    if (!g_mod)
+        return 0;
+    PyGILState_STATE g = PyGILState_Ensure();
+    size_t out = 0;
+    PyObject *r = PyObject_CallMethod(g_mod, fn, "l", (long)dt);
+    if (r) {
+        out = (size_t)PyLong_AsLong(r);
+        Py_DECREF(r);
+    } else {
+        PyErr_Clear();
+    }
+    PyGILState_Release(g);
+    return out;
+}
+
+/* full extent of one element (buffer sizing) */
+static size_t dt_extent(MPI_Datatype dt)
+{
+    return dt >= DT_FIRST_DYN ? dyn_query("type_extent_bytes", dt)
+                              : dt_size(dt);
+}
+
+/* significant bytes of one element (MPI_Get_count / MPI_Type_size) */
+static size_t dt_sig(MPI_Datatype dt)
+{
+    return dt >= DT_FIRST_DYN ? dyn_query("type_size_bytes", dt)
+                              : dt_size(dt);
+}
+
 typedef struct {
     long pyh;                           /* glue request handle */
     void *buf;                          /* receive buffer (NULL: send) */
@@ -140,6 +176,7 @@ static int copy_msg(PyObject *r, void *buf, size_t cap, MPI_Status *st)
     PyObject *payload = PyTuple_GetItem(r, 0);
     int src = (int)PyLong_AsLong(PyTuple_GetItem(r, 1));
     int tag = (int)PyLong_AsLong(PyTuple_GetItem(r, 2));
+    int cnt = (int)PyLong_AsLong(PyTuple_GetItem(r, 3));
     char *p;
     Py_ssize_t n;
     if (PyBytes_AsStringAndSize(payload, &p, &n) < 0)
@@ -151,7 +188,16 @@ static int copy_msg(PyObject *r, void *buf, size_t cap, MPI_Status *st)
     }
     if (buf && n)
         memcpy(buf, p, (size_t)n);
-    set_status(st, src, tag, (int)n);
+    /* Derived-type truncation happens in the binding layer (the
+     * returned buffer image is always exactly count x extent, so the
+     * cap check above can't see it): the glue's 5th tuple slot says. */
+    if (rc == MPI_SUCCESS && PyTuple_Size(r) >= 5
+        && PyLong_AsLong(PyTuple_GetItem(r, 4)))
+        rc = MPI_ERR_TRUNCATE;
+    /* cnt = SIGNIFICANT wire bytes (a derived type's delivered buffer
+     * image includes gap bytes the count must not); truncation reports
+     * what was actually delivered. */
+    set_status(st, src, tag, rc == MPI_SUCCESS ? cnt : (int)n);
     return rc;
 }
 
@@ -424,7 +470,7 @@ static int send_common(const void *buf, int count, MPI_Datatype dt,
                        int dest, int tag, MPI_Comm comm, int sync,
                        const char *fn)
 {
-    size_t esz = dt_size(dt);
+    size_t esz = dt_extent(dt);
     if (!esz || count < 0)
         return MPI_ERR_TYPE;
     GIL_BEGIN;
@@ -457,13 +503,17 @@ int MPI_Ssend(const void *buf, int count, MPI_Datatype datatype, int dest,
 int MPI_Recv(void *buf, int count, MPI_Datatype datatype, int source,
              int tag, MPI_Comm comm, MPI_Status *status)
 {
-    size_t esz = dt_size(datatype);
+    size_t esz = dt_extent(datatype);
     if (!esz || count < 0)
         return MPI_ERR_TYPE;
     GIL_BEGIN;
     int rc = MPI_SUCCESS;
-    PyObject *r = PyObject_CallMethod(g_mod, "recv", "liil", (long)comm,
-                                      source, tag, (long)datatype);
+    /* current content travels along only for derived types, which
+     * overlay into it; basic types never read it (skip the copy) */
+    size_t snap = datatype >= DT_FIRST_DYN ? (size_t)count * esz : 0;
+    PyObject *r = PyObject_CallMethod(g_mod, "recv", "liilN", (long)comm,
+                                      source, tag, (long)datatype,
+                                      mem_ro(buf, snap));
     if (!r)
         rc = handle_error("MPI_Recv");
     else {
@@ -480,15 +530,18 @@ int MPI_Sendrecv(const void *sendbuf, int sendcount,
                  int source, int recvtag, MPI_Comm comm,
                  MPI_Status *status)
 {
-    size_t ssz = dt_size(sendtype), rsz = dt_size(recvtype);
+    size_t ssz = dt_extent(sendtype), rsz = dt_extent(recvtype);
     if (!ssz || !rsz || sendcount < 0 || recvcount < 0)
         return MPI_ERR_TYPE;
     GIL_BEGIN;
     int rc = MPI_SUCCESS;
+    size_t snap = recvtype >= DT_FIRST_DYN
+        ? (size_t)recvcount * rsz : 0;
     PyObject *r = PyObject_CallMethod(
-        g_mod, "sendrecv", "lNliiiil", (long)comm,
+        g_mod, "sendrecv", "lNliiiilN", (long)comm,
         mem_ro(sendbuf, (size_t)sendcount * ssz), (long)sendtype, dest,
-        sendtag, source, recvtag, (long)recvtype);
+        sendtag, source, recvtag, (long)recvtype,
+        mem_ro(recvbuf, snap));
     if (!r)
         rc = handle_error("MPI_Sendrecv");
     else {
@@ -502,7 +555,7 @@ int MPI_Sendrecv(const void *sendbuf, int sendcount,
 int MPI_Isend(const void *buf, int count, MPI_Datatype datatype, int dest,
               int tag, MPI_Comm comm, MPI_Request *request)
 {
-    size_t esz = dt_size(datatype);
+    size_t esz = dt_extent(datatype);
     if (!esz || count < 0)
         return MPI_ERR_TYPE;
     GIL_BEGIN;
@@ -527,13 +580,15 @@ int MPI_Isend(const void *buf, int count, MPI_Datatype datatype, int dest,
 int MPI_Irecv(void *buf, int count, MPI_Datatype datatype, int source,
               int tag, MPI_Comm comm, MPI_Request *request)
 {
-    size_t esz = dt_size(datatype);
+    size_t esz = dt_extent(datatype);
     if (!esz || count < 0)
         return MPI_ERR_TYPE;
     GIL_BEGIN;
     int rc = MPI_SUCCESS;
-    PyObject *r = PyObject_CallMethod(g_mod, "irecv", "liil", (long)comm,
-                                      source, tag, (long)datatype);
+    size_t snap = datatype >= DT_FIRST_DYN ? (size_t)count * esz : 0;
+    PyObject *r = PyObject_CallMethod(g_mod, "irecv", "liilN", (long)comm,
+                                      source, tag, (long)datatype,
+                                      mem_ro(buf, snap));
     if (!r) {
         rc = handle_error("MPI_Irecv");
     } else {
@@ -668,7 +723,7 @@ int MPI_Get_count(const MPI_Status *status, MPI_Datatype datatype,
 {
     if (!status)
         return MPI_ERR_ARG;
-    size_t esz = dt_size(datatype);
+    size_t esz = dt_sig(datatype);
     if (!esz)
         return MPI_ERR_TYPE;
     /* _count carries bytes; convert into the caller datatype's units,
@@ -700,7 +755,7 @@ int MPI_Barrier(MPI_Comm comm)
 int MPI_Bcast(void *buffer, int count, MPI_Datatype datatype, int root,
               MPI_Comm comm)
 {
-    size_t esz = dt_size(datatype);
+    size_t esz = dt_extent(datatype);
     if (!esz || count < 0)
         return MPI_ERR_TYPE;
     size_t nbytes = (size_t)count * esz;
@@ -1004,6 +1059,272 @@ int MPI_Reduce_scatter_block(const void *sendbuf, void *recvbuf,
         rc = handle_error("MPI_Reduce_scatter_block");
     else {
         rc = copy_bytes(r, recvbuf, (size_t)recvcount * esz);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+/* ------------------------------------------------------------------ */
+/* derived datatypes (MPI_Type_*): constructed in the binding layer    */
+/* ------------------------------------------------------------------ */
+static int type_ctor(const char *fn, const char *fmt, MPI_Datatype *out,
+                     long a, long b, long c, long d)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, fn, fmt, a, b, c, d);
+    if (!r)
+        rc = handle_error(fn);
+    else {
+        *out = (MPI_Datatype)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int MPI_Type_contiguous(int count, MPI_Datatype oldtype,
+                        MPI_Datatype *newtype)
+{
+    return type_ctor("type_contiguous", "ll", newtype, (long)count,
+                     (long)oldtype, 0, 0);
+}
+
+int MPI_Type_vector(int count, int blocklength, int stride,
+                    MPI_Datatype oldtype, MPI_Datatype *newtype)
+{
+    return type_ctor("type_vector", "llll", newtype, (long)count,
+                     (long)blocklength, (long)stride, (long)oldtype);
+}
+
+int MPI_Type_commit(MPI_Datatype *datatype)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "type_commit", "l",
+                                      (long)*datatype);
+    if (!r)
+        rc = handle_error("MPI_Type_commit");
+    else
+        Py_DECREF(r);
+    GIL_END;
+    return rc;
+}
+
+int MPI_Type_free(MPI_Datatype *datatype)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "type_free", "l",
+                                      (long)*datatype);
+    if (!r)
+        rc = handle_error("MPI_Type_free");
+    else {
+        *datatype = MPI_DATATYPE_NULL;
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+/* dyn_query folds errors into 0, which is also a legal value for
+ * zero-count types — the introspection calls go through the glue with
+ * full error handling instead. */
+static int type_query(const char *fn, MPI_Datatype dt, long *out)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, fn, "l", (long)dt);
+    if (!r)
+        rc = handle_error(fn);
+    else {
+        *out = PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int MPI_Type_size(MPI_Datatype datatype, int *size)
+{
+    long s;
+    int rc = type_query("type_size_bytes", datatype, &s);
+    if (rc == MPI_SUCCESS)
+        *size = (int)s;
+    return rc;
+}
+
+int MPI_Type_get_extent(MPI_Datatype datatype, MPI_Aint *lb,
+                        MPI_Aint *extent)
+{
+    long e;
+    int rc = type_query("type_extent_bytes", datatype, &e);
+    if (rc == MPI_SUCCESS) {
+        if (lb)
+            *lb = 0;
+        *extent = (MPI_Aint)e;
+    }
+    return rc;
+}
+
+/* ------------------------------------------------------------------ */
+/* v-collectives (counts/displacements arrays; basic datatypes)        */
+/* ------------------------------------------------------------------ */
+static size_t v_extent(const int *counts, const int *displs, int size)
+{
+    size_t top = 0;
+    for (int i = 0; i < size; i++) {
+        size_t end = (size_t)displs[i] + (size_t)counts[i];
+        if (end > top)
+            top = end;
+    }
+    return top;
+}
+
+int MPI_Allgatherv(const void *sendbuf, int sendcount,
+                   MPI_Datatype sendtype, void *recvbuf,
+                   const int recvcounts[], const int displs[],
+                   MPI_Datatype recvtype, MPI_Comm comm)
+{
+    size_t ssz = dt_size(sendtype), rsz = dt_size(recvtype);
+    if (!ssz || !rsz || sendcount < 0)
+        return MPI_ERR_TYPE;
+    int size;
+    int qrc = MPI_Comm_size(comm, &size);
+    if (qrc != MPI_SUCCESS)
+        return qrc;
+    size_t cap = v_extent(recvcounts, displs, size) * rsz;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "allgatherv", "lNllNNN", (long)comm,
+        mem_ro(sendbuf, (size_t)sendcount * ssz), (long)sendtype,
+        (long)recvtype, mem_ro(recvcounts, (size_t)size * sizeof(int)),
+        mem_ro(displs, (size_t)size * sizeof(int)),
+        mem_ro(recvbuf, cap));
+    if (!r)
+        rc = handle_error("MPI_Allgatherv");
+    else {
+        rc = copy_bytes(r, recvbuf, cap);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int MPI_Gatherv(const void *sendbuf, int sendcount,
+                MPI_Datatype sendtype, void *recvbuf,
+                const int recvcounts[], const int displs[],
+                MPI_Datatype recvtype, int root, MPI_Comm comm)
+{
+    size_t ssz = dt_size(sendtype);
+    if (!ssz || sendcount < 0)
+        return MPI_ERR_TYPE;
+    int size, rank;
+    int qrc = MPI_Comm_size(comm, &size);
+    if (qrc == MPI_SUCCESS)
+        qrc = MPI_Comm_rank(comm, &rank);
+    if (qrc != MPI_SUCCESS)
+        return qrc;
+    size_t rsz = 0, cap = 0;
+    if (rank == root) {                  /* recv args root-significant */
+        rsz = dt_size(recvtype);
+        if (!rsz)
+            return MPI_ERR_TYPE;
+        cap = v_extent(recvcounts, displs, size) * rsz;
+    }
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "gatherv", "lNlilNNN", (long)comm,
+        mem_ro(sendbuf, (size_t)sendcount * ssz), (long)sendtype, root,
+        (long)(rank == root ? recvtype : 0),
+        mem_ro(recvcounts, rank == root
+               ? (size_t)size * sizeof(int) : 0),
+        mem_ro(displs, rank == root ? (size_t)size * sizeof(int) : 0),
+        mem_ro(recvbuf, cap));
+    if (!r)
+        rc = handle_error("MPI_Gatherv");
+    else {
+        if (PyBytes_Size(r) > 0)         /* root only */
+            rc = copy_bytes(r, recvbuf, cap);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int MPI_Scatterv(const void *sendbuf, const int sendcounts[],
+                 const int displs[], MPI_Datatype sendtype,
+                 void *recvbuf, int recvcount, MPI_Datatype recvtype,
+                 int root, MPI_Comm comm)
+{
+    size_t rsz = dt_size(recvtype);
+    if (!rsz || recvcount < 0)
+        return MPI_ERR_TYPE;
+    int size, rank;
+    int qrc = MPI_Comm_size(comm, &size);
+    if (qrc == MPI_SUCCESS)
+        qrc = MPI_Comm_rank(comm, &rank);
+    if (qrc != MPI_SUCCESS)
+        return qrc;
+    size_t ssz = 0, in_bytes = 0;
+    if (rank == root) {
+        ssz = dt_size(sendtype);
+        if (!ssz)
+            return MPI_ERR_TYPE;
+        in_bytes = v_extent(sendcounts, displs, size) * ssz;
+    }
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "scatterv", "lNlNNil", (long)comm,
+        mem_ro(sendbuf, in_bytes),
+        (long)(rank == root ? sendtype : 0),
+        mem_ro(sendcounts, rank == root
+               ? (size_t)size * sizeof(int) : 0),
+        mem_ro(displs, rank == root ? (size_t)size * sizeof(int) : 0),
+        root, (long)recvtype);
+    if (!r)
+        rc = handle_error("MPI_Scatterv");
+    else {
+        rc = copy_bytes(r, recvbuf, (size_t)recvcount * rsz);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int MPI_Alltoallv(const void *sendbuf, const int sendcounts[],
+                  const int sdispls[], MPI_Datatype sendtype,
+                  void *recvbuf, const int recvcounts[],
+                  const int rdispls[], MPI_Datatype recvtype,
+                  MPI_Comm comm)
+{
+    size_t ssz = dt_size(sendtype), rsz = dt_size(recvtype);
+    if (!ssz || !rsz)
+        return MPI_ERR_TYPE;
+    int size;
+    int qrc = MPI_Comm_size(comm, &size);
+    if (qrc != MPI_SUCCESS)
+        return qrc;
+    size_t in_bytes = v_extent(sendcounts, sdispls, size) * ssz;
+    size_t cap = v_extent(recvcounts, rdispls, size) * rsz;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "alltoallv", "lNlNNlNNN", (long)comm,
+        mem_ro(sendbuf, in_bytes), (long)sendtype,
+        mem_ro(sendcounts, (size_t)size * sizeof(int)),
+        mem_ro(sdispls, (size_t)size * sizeof(int)), (long)recvtype,
+        mem_ro(recvcounts, (size_t)size * sizeof(int)),
+        mem_ro(rdispls, (size_t)size * sizeof(int)),
+        mem_ro(recvbuf, cap));
+    if (!r)
+        rc = handle_error("MPI_Alltoallv");
+    else {
+        rc = copy_bytes(r, recvbuf, cap);
         Py_DECREF(r);
     }
     GIL_END;

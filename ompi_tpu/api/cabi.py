@@ -101,6 +101,134 @@ def _register_comm(c) -> int:
     return h
 
 
+# ---------------------------------------------------------------------
+# derived datatypes (handles >= 64): the convertor role for the C ABI.
+# A derived type is (base numpy dtype, element-offset pattern within
+# one extent, extent in base elements) — the typemap flattened. Pack
+# gathers the significant elements (only they travel, MPI semantics);
+# unpack overlays them into the receiver's existing buffer so gap
+# bytes stay untouched (opal convertor contract).
+# ---------------------------------------------------------------------
+_FIRST_DYN_TYPE = 64
+_dyn_types: Dict[int, "DerivedType"] = {}
+_next_dyn_type = itertools.count(_FIRST_DYN_TYPE)
+
+
+class DerivedType:
+    __slots__ = ("base", "idx", "extent")
+
+    def __init__(self, base: np.dtype, idx: np.ndarray, extent: int):
+        self.base = base
+        self.idx = idx                   # significant element offsets
+        self.extent = extent             # extent in base elements
+
+
+def _type_parts(dt: int):
+    """(base dtype, pattern, extent_elems) for basic OR derived."""
+    if dt >= _FIRST_DYN_TYPE:
+        t = _dyn_types.get(dt)
+        if t is None:
+            raise MPIError(ERR_TYPE, f"invalid datatype handle {dt}")
+        return t.base, t.idx, t.extent
+    return _dtype(dt), np.array([0], dtype=np.int64), 1
+
+
+def type_contiguous(count: int, oldtype: int) -> int:
+    """MPI_Type_contiguous: count copies of oldtype back to back."""
+    if count < 0:
+        raise MPIError(ERR_ARG, "negative count")
+    base, idx, ext = _type_parts(oldtype)
+    new_idx = np.concatenate([idx + k * ext for k in range(count)]) \
+        if count else np.array([], dtype=np.int64)
+    h = next(_next_dyn_type)
+    _dyn_types[h] = DerivedType(base, new_idx, count * ext)
+    return h
+
+
+def type_vector(count: int, blocklength: int, stride: int,
+                oldtype: int) -> int:
+    """MPI_Type_vector: count blocks of blocklength oldtypes, block
+    starts stride oldtypes apart. Negative strides (reversed layouts)
+    need a true lb/extent model this flattened representation lacks —
+    rejected rather than silently producing a negative extent."""
+    if count < 0 or blocklength < 0:
+        raise MPIError(ERR_ARG, "negative count/blocklength")
+    if stride < 0:
+        raise MPIError(ERR_ARG,
+                       "negative stride is not supported by this "
+                       "binding layer")
+    if count > 0 and 0 < stride < blocklength:
+        raise MPIError(ERR_ARG, "stride smaller than blocklength "
+                                "(overlapping blocks)")
+    base, idx, ext = _type_parts(oldtype)
+    blocks = []
+    for k in range(count):
+        for j in range(blocklength):
+            blocks.append(idx + (k * stride + j) * ext)
+    new_idx = (np.concatenate(blocks) if blocks
+               else np.array([], dtype=np.int64))
+    extent = ((count - 1) * stride + blocklength) * ext if count else 0
+    h = next(_next_dyn_type)
+    _dyn_types[h] = DerivedType(base, new_idx, extent)
+    return h
+
+
+def type_commit(dt: int) -> None:
+    _type_parts(dt)                      # validates the handle
+
+
+def type_free(dt: int) -> None:
+    if _dyn_types.pop(dt, None) is None:
+        raise MPIError(ERR_TYPE, f"invalid datatype handle {dt}")
+
+
+def type_extent_bytes(dt: int) -> int:
+    """Full extent of ONE element of this type, in bytes (buffer
+    sizing; MPI_Type_get_extent)."""
+    base, _, ext = _type_parts(dt)
+    return int(ext) * base.itemsize
+
+
+def type_size_bytes(dt: int) -> int:
+    """Significant bytes of ONE element (MPI_Type_size /
+    MPI_Get_count units)."""
+    base, idx, _ = _type_parts(dt)
+    return int(idx.size) * base.itemsize
+
+
+def _pack(view, dt: int, count: int) -> np.ndarray:
+    """Gather the significant elements of ``count`` type elements from
+    a full-extent buffer."""
+    base, idx, ext = _type_parts(dt)
+    a = np.frombuffer(view, dtype=base)
+    if dt < _FIRST_DYN_TYPE:
+        return a.copy()
+    all_idx = np.concatenate([idx + k * ext for k in range(count)]) \
+        if count else np.array([], dtype=np.int64)
+    return a[all_idx].copy()
+
+
+def _unpack(data, dt: int, count: int,
+            curbytes: bytes) -> Tuple[bytes, int]:
+    """Overlay received significant elements into the receiver's
+    current full-extent content; gaps keep their bytes. Returns
+    (buffer image, truncated flag) — a message larger than the posted
+    type signature is MPI_ERR_TRUNCATE even though the C-side cap
+    check only sees the (fixed-size) buffer image."""
+    base, idx, ext = _type_parts(dt)
+    flat = np.asarray(data).ravel()
+    if flat.dtype != base:
+        flat = flat.astype(base)
+    if dt < _FIRST_DYN_TYPE:
+        return flat.tobytes(), 0
+    cur = np.frombuffer(curbytes, dtype=base).copy()
+    all_idx = np.concatenate([idx + k * ext for k in range(count)]) \
+        if count else np.array([], dtype=np.int64)
+    n = min(flat.size, all_idx.size)
+    cur[all_idx[:n]] = flat[:n]
+    return cur.tobytes(), int(flat.size > all_idx.size)
+
+
 def _dtype(dt: int) -> np.dtype:
     d = _DT.get(dt)
     if d is None:
@@ -256,51 +384,71 @@ def comm_free(h: int) -> None:
 # ---------------------------------------------------------------------
 # point-to-point
 # ---------------------------------------------------------------------
+def _count_of(view, dt: int) -> int:
+    """Element count from the C-side buffer size (the C shim sizes
+    views as exactly count x extent)."""
+    ext = type_extent_bytes(dt)
+    return len(view) // ext if ext else 0
+
+
 def send(h: int, view, dt: int, dest: int, tag: int, sync: int) -> None:
     c = _comm(h)
-    data = _arr(view, dt)
+    data = _pack(view, dt, _count_of(view, dt))
     if sync:
         c.ssend(data, dest, tag)
     else:
         c.send(data, dest, tag)
 
 
-def recv(h: int, source: int, tag: int, dt: int
+def recv(h: int, source: int, tag: int, dt: int, curview
          ) -> Tuple[bytes, int, int, int]:
+    """``curview`` is the receive buffer's CURRENT content — derived
+    types overlay significant elements into it so gap bytes survive
+    (the convertor contract); basic types ignore it."""
     data, st = _comm(h).recv(source, tag)
-    out = b"" if data is None else _out(data, dt)
+    if data is None:
+        return b"", *_status(st), 0
+    out, trunc = _unpack(data, dt, _count_of(curview, dt),
+                         bytes(curview))
     src, t, cnt = _status(st, out)
-    return out, src, t, cnt
+    return out, src, t, cnt, trunc
 
 
 def sendrecv(h: int, view, dt: int, dest: int, stag: int,
-             source: int, rtag: int, rdt: int
+             source: int, rtag: int, rdt: int, curview
              ) -> Tuple[bytes, int, int, int]:
     c = _comm(h)
-    data, st = c.sendrecv(_arr(view, dt), dest, source,
-                          sendtag=stag, recvtag=rtag)
-    out = b"" if data is None else _out(data, rdt)
+    data, st = c.sendrecv(_pack(view, dt, _count_of(view, dt)), dest,
+                          source, sendtag=stag, recvtag=rtag)
+    if data is None:
+        return b"", *_status(st), 0
+    out, trunc = _unpack(data, rdt, _count_of(curview, rdt),
+                         bytes(curview))
     src, t, cnt = _status(st, out)
-    return out, src, t, cnt
+    return out, src, t, cnt, trunc
 
 
 def isend(h: int, view, dt: int, dest: int, tag: int) -> int:
-    req = _comm(h).isend(_arr(view, dt), dest, tag)
+    req = _comm(h).isend(_pack(view, dt, _count_of(view, dt)), dest,
+                         tag)
     with _lock:
         rh = next(_next_req)
-        _requests[rh] = (req, dt)
+        _requests[rh] = (req, dt, b"")
     return rh
 
 
-def irecv(h: int, source: int, tag: int, dt: int) -> int:
+def irecv(h: int, source: int, tag: int, dt: int, curview) -> int:
+    """The buffer snapshot is taken at POST time — MPI forbids the
+    application touching the buffer while the receive is pending, so
+    overlaying into the posted-time content at completion is sound."""
     req = _comm(h).irecv(source, tag)
     with _lock:
         rh = next(_next_req)
-        _requests[rh] = (req, dt)
+        _requests[rh] = (req, dt, bytes(curview))
     return rh
 
 
-def _take_req(rh: int) -> Tuple[Any, int]:
+def _take_req(rh: int) -> Tuple[Any, int, bytes]:
     with _lock:
         ent = _requests.get(rh)
     if ent is None:
@@ -309,7 +457,7 @@ def _take_req(rh: int) -> Tuple[Any, int]:
 
 
 def wait(rh: int) -> Tuple[bytes, int, int, int]:
-    req, dt = _take_req(rh)
+    req, dt, snap = _take_req(rh)
     try:
         st = req.wait()
     except BaseException:
@@ -322,13 +470,15 @@ def wait(rh: int) -> Tuple[bytes, int, int, int]:
     data = req.get() if hasattr(req, "get") else None
     with _lock:
         _requests.pop(rh, None)
-    out = b"" if data is None else _out(data, dt)
+    if data is None:
+        return b"", *_status(st), 0
+    out, trunc = _unpack(data, dt, _count_of(snap, dt), snap)
     src, t, cnt = _status(st, out)
-    return out, src, t, cnt
+    return out, src, t, cnt, trunc
 
 
 def test(rh: int) -> Tuple[int, bytes, int, int, int]:
-    req, dt = _take_req(rh)
+    req, dt, snap = _take_req(rh)
     try:
         done, st = req.test()
     except BaseException:
@@ -336,13 +486,15 @@ def test(rh: int) -> Tuple[int, bytes, int, int, int]:
             _requests.pop(rh, None)     # completed in error: reclaim
         raise
     if not done:
-        return 0, b"", -1, -1, 0
+        return 0, b"", -1, -1, 0, 0
     data = req.get() if hasattr(req, "get") else None
     with _lock:
         _requests.pop(rh, None)
-    out = b"" if data is None else _out(data, dt)
+    if data is None:
+        return 1, b"", *_status(st), 0
+    out, trunc = _unpack(data, dt, _count_of(snap, dt), snap)
     src, t, cnt = _status(st, out)
-    return 1, out, src, t, cnt
+    return 1, out, src, t, cnt, trunc
 
 
 def probe(h: int, source: int, tag: int) -> Tuple[int, int, int]:
@@ -367,8 +519,10 @@ def barrier(h: int) -> None:
 
 def bcast(h: int, view, dt: int, root: int) -> bytes:
     c = _comm(h)
-    data = _arr(view, dt) if c.rank() == root else None
-    return _out(c.bcast(data, root), dt)
+    cnt = _count_of(view, dt)
+    data = _pack(view, dt, cnt) if c.rank() == root else None
+    got = c.bcast(data, root)
+    return _unpack(got, dt, cnt, bytes(view))[0]
 
 
 def reduce(h: int, view, dt: int, o: int, root: int) -> bytes:
@@ -428,6 +582,66 @@ def exscan(h: int, view, dt: int, o: int) -> bytes:
     if r is None:                        # rank 0: result undefined
         return _out(np.zeros_like(_arr(view, dt)), dt)
     return _out(r, dt)
+
+
+def _ints(view) -> np.ndarray:
+    """A C int[] argument (counts/displs arrays)."""
+    return np.frombuffer(view, dtype=np.intc)
+
+
+def _overlay(rows, rdt: int, counts, displs, curview) -> bytes:
+    """Place per-rank segments at their displacements inside the
+    receiver's existing content (bytes between segments survive)."""
+    cur = np.frombuffer(curview, _dtype(rdt)).copy()
+    for i, row in enumerate(rows):
+        seg = np.asarray(row).ravel()[:counts[i]]
+        if seg.dtype != cur.dtype:
+            seg = seg.astype(cur.dtype)
+        cur[displs[i]:displs[i] + counts[i]] = seg
+    return cur.tobytes()
+
+
+def allgatherv(h: int, view, sdt: int, rdt: int, counts_view,
+               displs_view, curview) -> bytes:
+    """MPI_Allgatherv: rank i's contribution lands at displs[i] with
+    counts[i] elements; bytes between segments keep their content."""
+    c = _comm(h)
+    rows = c.allgather(_arr(view, sdt))
+    return _overlay(rows, rdt, _ints(counts_view), _ints(displs_view),
+                    curview)
+
+
+def gatherv(h: int, view, sdt: int, root: int, rdt: int, counts_view,
+            displs_view, curview) -> bytes:
+    c = _comm(h)
+    rows = c.gather(_arr(view, sdt), root)
+    if rows is None:
+        return b""
+    return _overlay(rows, rdt, _ints(counts_view), _ints(displs_view),
+                    curview)
+
+
+def scatterv(h: int, view, sdt: int, counts_view, displs_view,
+             root: int, rdt: int) -> bytes:
+    c = _comm(h)
+    chunks: Optional[list] = None
+    if c.rank() == root:
+        a = _arr(view, sdt)
+        counts, displs = _ints(counts_view), _ints(displs_view)
+        chunks = [a[displs[i]:displs[i] + counts[i]]
+                  for i in range(c.size)]
+    return _out(c.scatter(chunks, root), rdt)
+
+
+def alltoallv(h: int, view, sdt: int, scounts_view, sdispls_view,
+              rdt: int, rcounts_view, rdispls_view, curview) -> bytes:
+    c = _comm(h)
+    sc, sd = _ints(scounts_view), _ints(sdispls_view)
+    rc, rd = _ints(rcounts_view), _ints(rdispls_view)
+    a = _arr(view, sdt)
+    chunks = [a[sd[i]:sd[i] + sc[i]] for i in range(c.size)]
+    out = c.alltoall(chunks)
+    return _overlay(out, rdt, rc, rd, curview)
 
 
 def reduce_scatter_block(h: int, view, dt: int, o: int,

@@ -255,7 +255,12 @@ def comm_accept(port_name: str, comm, root: int = 0,
         except BaseException:
             # same collective-hang class as the accept timeout: a
             # connector that dies mid-handshake must not leave the
-            # non-roots parked in the bcast below
+            # non-roots parked in the bcast below — and the accepted
+            # socket must not leak a descriptor per failed attempt
+            try:
+                conn.close()
+            except OSError:
+                pass
             comm.bcast(-1, root=root)
             raise
         comm.bcast(remote, root=root)

@@ -1,0 +1,158 @@
+/* Derived datatypes + v-collectives: vector/contiguous construction,
+ * gap preservation on typed receive (the convertor contract), count
+ * conversion across datatypes, and Allgatherv/Gatherv/Scatterv/
+ * Alltoallv with per-rank counts and displacements. */
+#include <mpi.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+#define CHECK(cond, code)                                            \
+    do {                                                             \
+        if (!(cond)) {                                               \
+            fprintf(stderr, "rank %d: check failed at line %d\n",    \
+                    rank, __LINE__);                                 \
+            MPI_Abort(MPI_COMM_WORLD, code);                         \
+        }                                                            \
+    } while (0)
+
+int main(int argc, char **argv)
+{
+    MPI_Init(&argc, &argv);
+    int rank, size;
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &size);
+    int right = (rank + 1) % size, left = (rank - 1 + size) % size;
+
+    /* column of a 4x4 row-major matrix = vector(4 blocks of 1,
+     * stride 4) */
+    MPI_Datatype col;
+    MPI_Type_vector(4, 1, 4, MPI_DOUBLE, &col);
+    MPI_Type_commit(&col);
+    int tsize;
+    MPI_Aint lb, ext;
+    MPI_Type_size(col, &tsize);
+    MPI_Type_get_extent(col, &lb, &ext);
+    CHECK(tsize == 4 * (int)sizeof(double), 2);
+    CHECK(ext == 13 * (int)sizeof(double), 3);   /* 3*4+1 elements */
+
+    double m[16], recv4[4];
+    for (int i = 0; i < 16; i++)
+        m[i] = rank * 100 + i;
+    /* send my column 1 to the right as a vector; receive the left's
+     * column contiguously (typemap equivalence) */
+    MPI_Sendrecv(&m[1], 1, col, right, 21, recv4, 4, MPI_DOUBLE, left,
+                 21, MPI_COMM_WORLD, MPI_STATUS_IGNORE);
+    for (int i = 0; i < 4; i++)
+        CHECK(recv4[i] == left * 100 + 1 + 4 * i, 4);
+
+    /* typed RECEIVE: contiguous data lands in column 2; every gap
+     * element must keep its value */
+    double m2[16];
+    for (int i = 0; i < 16; i++)
+        m2[i] = -(double)i;
+    MPI_Status st;
+    MPI_Request rq;
+    MPI_Irecv(&m2[2], 1, col, left, 22, MPI_COMM_WORLD, &rq);
+    double four[4] = {1000 + rank, 2000 + rank, 3000 + rank,
+                      4000 + rank};
+    MPI_Send(four, 4, MPI_DOUBLE, right, 22, MPI_COMM_WORLD);
+    MPI_Wait(&rq, &st);
+    for (int i = 0; i < 4; i++)
+        CHECK(m2[2 + 4 * i] == (i + 1) * 1000 + left, 5);
+    for (int i = 0; i < 16; i++)
+        if (i % 4 != 2)
+            CHECK(m2[i] == -(double)i, 6);       /* gaps untouched */
+    int cnt;
+    MPI_Get_count(&st, col, &cnt);
+    CHECK(cnt == 1, 7);                          /* one vector element */
+    MPI_Get_count(&st, MPI_DOUBLE, &cnt);
+    CHECK(cnt == 4, 8);
+
+    /* contiguous-of-contiguous */
+    MPI_Datatype pair, quad;
+    MPI_Type_contiguous(2, MPI_INT, &pair);
+    MPI_Type_contiguous(2, pair, &quad);
+    MPI_Type_commit(&quad);
+    int qsend[4] = {rank, rank + 1, rank + 2, rank + 3}, qrecv[4];
+    MPI_Sendrecv(qsend, 1, quad, right, 23, qrecv, 1, quad, left, 23,
+                 MPI_COMM_WORLD, MPI_STATUS_IGNORE);
+    for (int i = 0; i < 4; i++)
+        CHECK(qrecv[i] == left + i, 9);
+    MPI_Type_free(&quad);
+    MPI_Type_free(&pair);
+    MPI_Type_free(&col);
+    CHECK(col == MPI_DATATYPE_NULL, 10);
+
+    /* Allgatherv: rank r contributes r+1 ints at displacement
+     * r*(r+1)/2 + r  (one gap slot between segments) */
+    int *counts = (int *)malloc((size_t)size * sizeof(int));
+    int *displs = (int *)malloc((size_t)size * sizeof(int));
+    int off = 0;
+    for (int i = 0; i < size; i++) {
+        counts[i] = i + 1;
+        displs[i] = off;
+        off += counts[i] + 1;            /* leave a gap slot */
+    }
+    int total = off;
+    int *vbuf = (int *)malloc((size_t)total * sizeof(int));
+    for (int i = 0; i < total; i++)
+        vbuf[i] = -7;                    /* sentinel in every gap */
+    int mine[8];
+    for (int i = 0; i <= rank; i++)
+        mine[i] = rank * 10 + i;
+    MPI_Allgatherv(mine, rank + 1, MPI_INT, vbuf, counts, displs,
+                   MPI_INT, MPI_COMM_WORLD);
+    for (int i = 0; i < size; i++)
+        for (int j = 0; j < counts[i]; j++)
+            CHECK(vbuf[displs[i] + j] == i * 10 + j, 11);
+    for (int i = 0; i < size; i++)
+        CHECK(vbuf[displs[i] + counts[i]] == -7, 12);  /* gaps */
+
+    /* Gatherv at root 0, then Scatterv back */
+    for (int i = 0; i < total; i++)
+        vbuf[i] = -9;
+    MPI_Gatherv(mine, rank + 1, MPI_INT, vbuf, counts, displs, MPI_INT,
+                0, MPI_COMM_WORLD);
+    if (rank == 0) {
+        for (int i = 0; i < size; i++)
+            for (int j = 0; j < counts[i]; j++)
+                CHECK(vbuf[displs[i] + j] == i * 10 + j, 13);
+    }
+    int back[8];
+    MPI_Scatterv(vbuf, counts, displs, MPI_INT, back, rank + 1,
+                 MPI_INT, 0, MPI_COMM_WORLD);
+    for (int j = 0; j <= rank; j++)
+        CHECK(back[j] == rank * 10 + j, 14);
+
+    /* Alltoallv: rank r sends (i+1) ints to rank i, packed */
+    int *sc = (int *)malloc((size_t)size * sizeof(int));
+    int *sd = (int *)malloc((size_t)size * sizeof(int));
+    int *rcn = (int *)malloc((size_t)size * sizeof(int));
+    int *rd = (int *)malloc((size_t)size * sizeof(int));
+    int so = 0, ro = 0;
+    for (int i = 0; i < size; i++) {
+        sc[i] = i + 1;
+        sd[i] = so;
+        so += sc[i];
+        rcn[i] = rank + 1;
+        rd[i] = ro;
+        ro += rcn[i];
+    }
+    int *sv = (int *)malloc((size_t)so * sizeof(int));
+    int *rv = (int *)malloc((size_t)ro * sizeof(int));
+    for (int i = 0; i < size; i++)
+        for (int j = 0; j < sc[i]; j++)
+            sv[sd[i] + j] = rank * 1000 + i * 10 + j;
+    MPI_Alltoallv(sv, sc, sd, MPI_INT, rv, rcn, rd, MPI_INT,
+                  MPI_COMM_WORLD);
+    for (int i = 0; i < size; i++)
+        for (int j = 0; j <= rank; j++)
+            CHECK(rv[rd[i] + j] == i * 1000 + rank * 10 + j, 15);
+
+    free(counts); free(displs); free(vbuf);
+    free(sc); free(sd); free(rcn); free(rd); free(sv); free(rv);
+
+    MPI_Finalize();
+    printf("OK c05_types_v rank=%d/%d\n", rank, size);
+    return 0;
+}

@@ -28,6 +28,7 @@ CASES = [
     ("c02_ring.c", 4),
     ("c03_coll.c", 3),
     ("c04_nb_split.c", 4),
+    ("c05_types_v.c", 3),
 ]
 
 
