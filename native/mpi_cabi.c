@@ -664,7 +664,7 @@ int MPI_Test(MPI_Request *request, int *flag, MPI_Status *status)
     } else {
         *flag = (int)PyLong_AsLong(PyTuple_GetItem(r, 0));
         if (*flag) {
-            PyObject *msg = PyTuple_GetSlice(r, 1, 5);
+            PyObject *msg = PyTuple_GetSlice(r, 1, 6);
             rc = copy_msg(msg, e->buf, e->cap, status);
             Py_DECREF(msg);
             free(e);

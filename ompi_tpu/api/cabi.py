@@ -44,7 +44,8 @@ _FIRST_DYNAMIC = 16
 
 _lock = threading.Lock()
 _comms: Dict[int, Any] = {}
-_requests: Dict[int, Tuple[Any, int]] = {}   # handle -> (Request, dtype)
+_requests: Dict[int, Tuple[Any, int, bytes]] = {}
+# handle -> (Request, dtype, posted-time buffer snapshot)
 _next_comm = itertools.count(_FIRST_DYNAMIC)
 _next_req = itertools.count(1)
 
@@ -157,7 +158,7 @@ def type_vector(count: int, blocklength: int, stride: int,
         raise MPIError(ERR_ARG,
                        "negative stride is not supported by this "
                        "binding layer")
-    if count > 0 and 0 < stride < blocklength:
+    if count > 1 and stride < blocklength:
         raise MPIError(ERR_ARG, "stride smaller than blocklength "
                                 "(overlapping blocks)")
     base, idx, ext = _type_parts(oldtype)
@@ -196,16 +197,33 @@ def type_size_bytes(dt: int) -> int:
     return int(idx.size) * base.itemsize
 
 
+_idx_cache: Dict[Tuple[int, int], np.ndarray] = {}
+
+
+def _full_idx(dt: int, count: int) -> np.ndarray:
+    """Significant-element offsets for ``count`` elements of ``dt``,
+    vectorized and cached — dynamic handles are never recycled
+    (monotonic counter), so (dt, count) keys cannot go stale."""
+    key = (dt, count)
+    got = _idx_cache.get(key)
+    if got is None:
+        _, idx, ext = _type_parts(dt)
+        got = (np.arange(count, dtype=np.int64)[:, None] * ext
+               + idx).ravel() if count else np.array([],
+                                                     dtype=np.int64)
+        if len(_idx_cache) < 4096:
+            _idx_cache[key] = got
+    return got
+
+
 def _pack(view, dt: int, count: int) -> np.ndarray:
     """Gather the significant elements of ``count`` type elements from
     a full-extent buffer."""
-    base, idx, ext = _type_parts(dt)
+    base, _, _ = _type_parts(dt)
     a = np.frombuffer(view, dtype=base)
     if dt < _FIRST_DYN_TYPE:
         return a.copy()
-    all_idx = np.concatenate([idx + k * ext for k in range(count)]) \
-        if count else np.array([], dtype=np.int64)
-    return a[all_idx].copy()
+    return a[_full_idx(dt, count)].copy()
 
 
 def _unpack(data, dt: int, count: int,
@@ -215,15 +233,14 @@ def _unpack(data, dt: int, count: int,
     (buffer image, truncated flag) — a message larger than the posted
     type signature is MPI_ERR_TRUNCATE even though the C-side cap
     check only sees the (fixed-size) buffer image."""
-    base, idx, ext = _type_parts(dt)
+    base, _, _ = _type_parts(dt)
     flat = np.asarray(data).ravel()
     if flat.dtype != base:
         flat = flat.astype(base)
     if dt < _FIRST_DYN_TYPE:
         return flat.tobytes(), 0
     cur = np.frombuffer(curbytes, dtype=base).copy()
-    all_idx = np.concatenate([idx + k * ext for k in range(count)]) \
-        if count else np.array([], dtype=np.int64)
+    all_idx = _full_idx(dt, count)
     n = min(flat.size, all_idx.size)
     cur[all_idx[:n]] = flat[:n]
     return cur.tobytes(), int(flat.size > all_idx.size)
@@ -401,7 +418,7 @@ def send(h: int, view, dt: int, dest: int, tag: int, sync: int) -> None:
 
 
 def recv(h: int, source: int, tag: int, dt: int, curview
-         ) -> Tuple[bytes, int, int, int]:
+         ) -> Tuple[bytes, int, int, int, int]:
     """``curview`` is the receive buffer's CURRENT content — derived
     types overlay significant elements into it so gap bytes survive
     (the convertor contract); basic types ignore it."""
@@ -416,7 +433,7 @@ def recv(h: int, source: int, tag: int, dt: int, curview
 
 def sendrecv(h: int, view, dt: int, dest: int, stag: int,
              source: int, rtag: int, rdt: int, curview
-             ) -> Tuple[bytes, int, int, int]:
+             ) -> Tuple[bytes, int, int, int, int]:
     c = _comm(h)
     data, st = c.sendrecv(_pack(view, dt, _count_of(view, dt)), dest,
                           source, sendtag=stag, recvtag=rtag)
@@ -456,7 +473,7 @@ def _take_req(rh: int) -> Tuple[Any, int, bytes]:
     return ent
 
 
-def wait(rh: int) -> Tuple[bytes, int, int, int]:
+def wait(rh: int) -> Tuple[bytes, int, int, int, int]:
     req, dt, snap = _take_req(rh)
     try:
         st = req.wait()
@@ -477,7 +494,7 @@ def wait(rh: int) -> Tuple[bytes, int, int, int]:
     return out, src, t, cnt, trunc
 
 
-def test(rh: int) -> Tuple[int, bytes, int, int, int]:
+def test(rh: int) -> Tuple[int, bytes, int, int, int, int]:
     req, dt, snap = _take_req(rh)
     try:
         done, st = req.test()

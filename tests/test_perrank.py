@@ -39,6 +39,7 @@ CASES = [
     ("p15_cart_halo.py", 4),
     ("p16_master_worker.py", 4),
     ("p20_shmem_ext.py", 3),
+    ("p21_mpiio.py", 3),
 ]
 
 
