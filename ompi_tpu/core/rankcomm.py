@@ -58,13 +58,15 @@ from ompi_tpu.runtime import spc
 AXIS = "mpi_r"
 
 
-class _CollChannel:
-    """The hidden collective-context view of a communicator: same ranks,
-    separate CID, so internal messages never match user receives."""
+class _HiddenChannel:
+    """A hidden matching-channel view of a communicator: same ranks,
+    separate CID, so internal/tool messages never match user receives.
+    Channels: "c" collectives, "part" partitioned pt2pt, "sync"
+    clock probes."""
 
-    def __init__(self, comm: "RankCommunicator"):
+    def __init__(self, comm: "RankCommunicator", prefix: str):
         self._comm = comm
-        self.cid = ("c", comm.cid)
+        self.cid = (prefix, comm.cid)
 
     @property
     def size(self) -> int:
@@ -75,6 +77,24 @@ class _CollChannel:
 
     def world_rank_of(self, local: int) -> int:
         return self._comm.world_rank_of(local)
+
+
+class _CollChannel(_HiddenChannel):
+    def __init__(self, comm: "RankCommunicator"):
+        super().__init__(comm, "c")
+
+
+def hidden_engine(comm: "RankCommunicator", prefix: str):
+    """The lazily-created matching engine for one hidden channel of
+    ``comm`` — created once (two engines on one CID would split
+    matching state), closed with the communicator."""
+    with comm._lock:
+        eng = comm._aux_pmls.get(prefix)
+        if eng is None:
+            eng = PerRankEngine(_HiddenChannel(comm, prefix),
+                                comm.router)
+            comm._aux_pmls[prefix] = eng
+    return eng
 
 
 class RankCommunicator:
@@ -105,6 +125,7 @@ class RankCommunicator:
         self._my_world = my_world_rank
         self._pml = PerRankEngine(self, router)
         self._coll_pml = PerRankEngine(_CollChannel(self), router)
+        self._aux_pmls: Dict[str, PerRankEngine] = {}   # hidden_engine
         self._seq = itertools.count(1)          # collective sequence
         self._create_seq = itertools.count(1)   # comm-creation sequence
         self._dev_fns: Dict[Any, Callable] = {}
@@ -817,6 +838,9 @@ class RankCommunicator:
     def free(self) -> None:
         self._pml.close()
         self._coll_pml.close()
+        for eng in self._aux_pmls.values():   # hidden channels too —
+            eng.close()                       # a leaked registration
+        self._aux_pmls.clear()                # would outlive the comm
         self._freed = True
 
     # -- attributes / naming -------------------------------------------

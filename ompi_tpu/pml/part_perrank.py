@@ -1,0 +1,190 @@
+"""Partitioned point-to-point for the per-rank world (MPI-4
+``MPI_Psend_init`` family).
+
+Behavioral spec: ``ompi/mca/part/persist`` — a persistent partitioned
+send whose buffer is contributed partition-by-partition
+(``MPI_Pready``), completing once every partition is transferred; the
+receive side exposes per-partition arrival (``MPI_Parrived``).
+
+Per-rank re-design: partitions ride the btl as independent fragments
+on a HIDDEN matching channel (own CID, the _CollChannel pattern — a
+user receive can never match a partition fragment), tagged
+``tag * MAX_PARTITIONS + k`` so the matching engine's (source, tag)
+lookup IS the per-partition arrival state: ``parrived`` is an iprobe,
+no extra bookkeeping. A partition is on the wire the moment its
+``pready`` runs — genuinely incremental transfer across OS processes,
+which is the entire point of the MPI-4 feature (early partitions
+overlap the production of late ones).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, List, Optional, Sequence
+
+from ompi_tpu.core.errhandler import ERR_ARG, ERR_PENDING, MPIError
+from ompi_tpu.core.rankcomm import hidden_engine
+from ompi_tpu.core.request import Request, Status
+
+MAX_PARTITIONS = 1 << 14
+
+
+def _part_engine(comm):
+    return hidden_engine(comm, "part")
+
+
+def _ptag(tag: int, k: int) -> int:
+    return tag * MAX_PARTITIONS + k
+
+
+class RankPartitionedSend(Request):
+    """MPI_Psend_init: persistent; each start() opens a new round of
+    pready contributions."""
+
+    def __init__(self, comm, parts: Sequence[Any], dest: int, tag: int):
+        super().__init__(arrays=[])
+        if not parts or len(parts) > MAX_PARTITIONS:
+            raise MPIError(ERR_ARG,
+                           f"1..{MAX_PARTITIONS} partitions required")
+        self.comm = comm
+        self.engine = _part_engine(comm)
+        self.parts = list(parts)
+        self.dest, self.tag = dest, tag
+        self.ready: List[bool] = [False] * len(parts)
+        self._started = False
+        self._complete = False
+        self._sent = 0
+        self._lock = threading.Lock()
+
+    @property
+    def partitions(self) -> int:
+        return len(self.parts)
+
+    def start(self) -> "RankPartitionedSend":
+        with self._lock:
+            self._started = True
+            self._complete = False
+            self.ready = [False] * len(self.parts)
+            self._sent = 0
+        return self
+
+    def pready(self, k: int) -> None:
+        """MPI_Pready: partition k's data is final — it leaves NOW."""
+        with self._lock:
+            if not self._started:
+                raise MPIError(ERR_PENDING, "pready before start")
+            if not 0 <= k < len(self.parts):
+                raise MPIError(ERR_ARG, f"bad partition {k}")
+            if self.ready[k]:
+                raise MPIError(ERR_ARG, f"partition {k} already ready")
+            self.ready[k] = True
+        self.engine.send(self.parts[k], self.dest,
+                         _ptag(self.tag, k))
+        # completion is counted AFTER the btl accepted the fragment —
+        # with concurrent pready threads (MPI-4's intended use), an
+        # all(ready) check taken before another thread's send would
+        # report completion while that partition is still unsent
+        with self._lock:
+            self._sent += 1
+            if self._sent == len(self.parts):
+                self._complete = True
+
+    def pready_range(self, lo: int, hi: int) -> None:
+        for k in range(lo, hi + 1):
+            self.pready(k)
+
+    def pready_list(self, ks: Sequence[int]) -> None:
+        for k in ks:
+            self.pready(k)
+
+    def test(self):
+        return ((True, Status(source=self.comm.rank(), tag=self.tag))
+                if self._complete else (False, None))
+
+    def wait(self, timeout: Optional[float] = None):
+        if not self._complete:
+            raise MPIError(ERR_PENDING,
+                           "partitioned send incomplete: partitions "
+                           "not all pready (a wait here would deadlock"
+                           " — the sender itself must contribute them)")
+        return Status(source=self.comm.rank(), tag=self.tag)
+
+
+class RankPartitionedRecv(Request):
+    """MPI_Precv_init: per-partition arrival via the matching engine's
+    unexpected queue (parrived == iprobe on the partition's tag)."""
+
+    def __init__(self, comm, nparts: int, source: int, tag: int):
+        super().__init__(arrays=[])
+        if not 1 <= nparts <= MAX_PARTITIONS:
+            raise MPIError(ERR_ARG,
+                           f"1..{MAX_PARTITIONS} partitions required")
+        self.comm = comm
+        self.engine = _part_engine(comm)
+        self.nparts = nparts
+        self.source, self.tag = source, tag
+        self._got: List[Any] = [None] * nparts
+        self._have: List[bool] = [False] * nparts
+        self._complete = False
+        self.status = Status(source=source, tag=tag)
+
+    def start(self) -> "RankPartitionedRecv":
+        self._got = [None] * self.nparts
+        self._have = [False] * self.nparts
+        self._complete = False
+        return self
+
+    def parrived(self, k: int) -> bool:
+        """MPI_Parrived: has partition k landed?"""
+        if not 0 <= k < self.nparts:
+            raise MPIError(ERR_ARG, f"bad partition {k}")
+        if self._have[k]:
+            return True
+        ok, _ = self.engine.iprobe(self.source, _ptag(self.tag, k))
+        if ok:
+            data, _ = self.engine.recv(self.source,
+                                       _ptag(self.tag, k))
+            self._got[k] = data
+            self._have[k] = True
+        return self._have[k]
+
+    def test(self):
+        if not self._complete:
+            if all(self.parrived(k) for k in range(self.nparts)):
+                self._finish()
+        return ((True, self.status) if self._complete else (False, None))
+
+    def wait(self, timeout: Optional[float] = None) -> Status:
+        """Blocks for real: late partitions are produced by another OS
+        process. ``timeout`` bounds the WHOLE wait, not each
+        partition's receive."""
+        import time
+        deadline = (time.monotonic() + timeout
+                    if timeout is not None else None)
+        for k in range(self.nparts):
+            if not self._have[k]:
+                left = (None if deadline is None
+                        else max(deadline - time.monotonic(), 0.001))
+                data, _ = self.engine.recv(self.source,
+                                           _ptag(self.tag, k),
+                                           timeout=left)
+                self._got[k] = data
+                self._have[k] = True
+        self._finish()
+        return self.status
+
+    def _finish(self) -> None:
+        self._result = list(self._got)
+        self._complete = True
+
+    def get(self):
+        return self._result
+
+
+def psend_init(comm, parts: Sequence[Any], dest: int,
+               tag: int = 0) -> RankPartitionedSend:
+    return RankPartitionedSend(comm, parts, dest, tag)
+
+
+def precv_init(comm, nparts: int, source: int,
+               tag: int = 0) -> RankPartitionedRecv:
+    return RankPartitionedRecv(comm, nparts, source, tag)

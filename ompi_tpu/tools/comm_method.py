@@ -31,14 +31,22 @@ def table(comm) -> Dict:
     per_func = getattr(comm, "_coll_winners", None)
     priorities = getattr(comm, "_coll_priorities", None)
     if per_func is None or priorities is None:
-        # Not selected yet (or a bare mock): run the shared helper.
-        from ompi_tpu.coll.framework import select_winners
-        winners, selected = select_winners(comm)
-        per_func = {f: comp.name for f, (comp, _m) in winners.items()}
-        priorities = [(comp.name, prio) for prio, comp, _m in selected]
+        if getattr(comm, "devices", None):
+            # Not selected yet (or a bare mock): run the shared helper.
+            from ompi_tpu.coll.framework import select_winners
+            winners, selected = select_winners(comm)
+            per_func = {f: comp.name
+                        for f, (comp, _m) in winners.items()}
+            priorities = [(comp.name, prio)
+                          for prio, comp, _m in selected]
+        else:
+            # per-rank communicator: collectives are the built-in
+            # textbook/XLA algorithms, not framework-selected modules
+            per_func = {"*": "rankcomm-builtin"}
+            priorities = []
     devices = list(getattr(comm, "devices", []) or [])
     procs = sorted({getattr(d, "process_index", 0) for d in devices})
-    return {
+    out = {
         "comm": getattr(comm, "name", None) or f"cid={comm.cid}",
         "size": comm.size,
         "platform": devices[0].platform if devices else "none",
@@ -50,6 +58,15 @@ def table(comm) -> Dict:
         "coll": dict(per_func),
         "priorities": list(priorities),
     }
+    # per-rank worlds: the bml's per-transport frame counts — which
+    # btl actually carried this rank's pt2pt traffic (the transport
+    # matrix the reference's comm_method hook prints)
+    router = getattr(comm, "router", None)
+    ep = getattr(router, "endpoint", None)
+    if ep is not None and hasattr(ep, "stats"):
+        out["pt2pt_transports"] = dict(ep.stats)
+        out["btl_sm"] = getattr(ep, "sm", None) is not None
+    return out
 
 
 def format_table(comm) -> str:

@@ -75,6 +75,37 @@ def sync_report(comm, rounds: int = 10,
     return rows
 
 
+def sync_report_perrank(comm, rounds: int = 10):
+    """The REAL mpisync in the per-rank world: every rank ping-pongs
+    rank 0's clock over pt2pt (one client at a time, mpigclock's
+    serialized measurement), keeping the smallest-RTT sample. Probe
+    traffic rides a hidden matching channel (never matches user
+    receives). Collective over ``comm``; every rank returns the full
+    table."""
+    import numpy as np
+
+    from ompi_tpu.core.rankcomm import hidden_engine
+    eng = hidden_engine(comm, "sync")
+    me, n = comm.rank(), comm.size
+    mine = (0.0, 0.0) if me == 0 else None
+    for r in range(1, n):
+        if me == r:
+            def remote_now() -> float:
+                eng.send(np.float64(0.0), 0, 1)
+                t, _ = eng.recv(0, 2)
+                return float(np.asarray(t).ravel()[0])
+            mine = measure_offset(remote_now, rounds)
+        elif me == 0:
+            for _ in range(max(rounds, 1)):
+                eng.recv(r, 1)
+                eng.send(np.float64(time.perf_counter()), r, 2)
+        comm.barrier()                   # one client at a time
+    rows = comm.allgather(mine)
+    return [{"rank": r, "offset_s": float(off), "rtt_s": float(rtt),
+             "clock": "rank0" if r == 0 else f"process_{r}"}
+            for r, (off, rtt) in enumerate(rows)]
+
+
 def main() -> None:
     import json
 

@@ -40,6 +40,7 @@ CASES = [
     ("p16_master_worker.py", 4),
     ("p20_shmem_ext.py", 3),
     ("p21_mpiio.py", 3),
+    ("p22_part_sync.py", 3),
 ]
 
 
