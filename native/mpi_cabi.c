@@ -1330,3 +1330,136 @@ int MPI_Alltoallv(const void *sendbuf, const int sendcounts[],
     GIL_END;
     return rc;
 }
+
+/* ------------------------------------------------------------------ */
+/* cartesian topologies (topo framework)                               */
+/* ------------------------------------------------------------------ */
+int MPI_Dims_create(int nnodes, int ndims, int dims[])
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "dims_create", "iiN", nnodes, ndims,
+        mem_ro(dims, (size_t)ndims * sizeof(int)));
+    if (!r)
+        rc = handle_error("MPI_Dims_create");
+    else {
+        rc = copy_bytes(r, dims, (size_t)ndims * sizeof(int));
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int MPI_Cart_create(MPI_Comm comm, int ndims, const int dims[],
+                    const int periods[], int reorder,
+                    MPI_Comm *comm_cart)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "cart_create", "lNNi", (long)comm,
+        mem_ro(dims, (size_t)ndims * sizeof(int)),
+        mem_ro(periods, (size_t)ndims * sizeof(int)), reorder);
+    if (!r)
+        rc = handle_error("MPI_Cart_create");
+    else {
+        *comm_cart = (MPI_Comm)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int MPI_Cart_coords(MPI_Comm comm, int rank, int maxdims, int coords[])
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "cart_coords", "li",
+                                      (long)comm, rank);
+    if (!r)
+        rc = handle_error("MPI_Cart_coords");
+    else {
+        rc = copy_bytes(r, coords, (size_t)maxdims * sizeof(int));
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int MPI_Cart_rank(MPI_Comm comm, const int coords[], int *rank)
+{
+    int nd;
+    int qrc = MPI_Cartdim_get(comm, &nd);
+    if (qrc != MPI_SUCCESS)
+        return qrc;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "cart_rank", "lN", (long)comm,
+        mem_ro(coords, (size_t)nd * sizeof(int)));
+    if (!r)
+        rc = handle_error("MPI_Cart_rank");
+    else {
+        *rank = (int)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int MPI_Cart_shift(MPI_Comm comm, int direction, int disp,
+                   int *rank_source, int *rank_dest)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "cart_shift", "lii",
+                                      (long)comm, direction, disp);
+    if (!r)
+        rc = handle_error("MPI_Cart_shift");
+    else {
+        *rank_source = (int)PyLong_AsLong(PyTuple_GetItem(r, 0));
+        *rank_dest = (int)PyLong_AsLong(PyTuple_GetItem(r, 1));
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int MPI_Cart_get(MPI_Comm comm, int maxdims, int dims[], int periods[],
+                 int coords[])
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "cart_get", "l",
+                                      (long)comm);
+    if (!r)
+        rc = handle_error("MPI_Cart_get");
+    else {
+        size_t cap = (size_t)maxdims * sizeof(int);
+        rc = copy_bytes(PyTuple_GetItem(r, 0), dims, cap);
+        if (rc == MPI_SUCCESS)
+            rc = copy_bytes(PyTuple_GetItem(r, 1), periods, cap);
+        if (rc == MPI_SUCCESS)
+            rc = copy_bytes(PyTuple_GetItem(r, 2), coords, cap);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int MPI_Cartdim_get(MPI_Comm comm, int *ndims)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "cartdim_get", "l",
+                                      (long)comm);
+    if (!r)
+        rc = handle_error("MPI_Cartdim_get");
+    else {
+        *ndims = (int)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}

@@ -361,6 +361,55 @@ def comm_split(h: int, color: int, key: int) -> int:
     return _register_comm(sub)
 
 
+def cart_create(h: int, dims_view, periods_view, reorder: int) -> int:
+    """MPI_Cart_create: dims/periods arrive as C int arrays; callers
+    beyond the cart size get COMM_NULL."""
+    dims = [int(d) for d in _ints(dims_view)]
+    periods = [bool(p) for p in _ints(periods_view)]
+    sub = _comm(h).create_cart(dims, periods, bool(reorder))
+    if sub is None:
+        return COMM_NULL
+    return _register_comm(sub)
+
+
+def cart_coords(h: int, rank: int) -> bytes:
+    """Coordinates of ``rank`` as C ints."""
+    return np.asarray(_comm(h).cart_coords(rank),
+                      dtype=np.intc).tobytes()
+
+
+def cart_rank(h: int, coords_view) -> int:
+    return int(_comm(h).cart_rank([int(c) for c in _ints(coords_view)]))
+
+
+def cart_shift(h: int, direction: int, disp: int) -> Tuple[int, int]:
+    src, dst = _comm(h).cart_shift(direction, disp)
+    return int(src), int(dst)
+
+
+def cart_get(h: int) -> Tuple[bytes, bytes, bytes]:
+    """(dims, periods, my coords) as C int arrays (MPI_Cart_get)."""
+    c = _comm(h)
+    cart = c._cart()
+    dims = np.asarray(cart.dims, dtype=np.intc)
+    periods = np.asarray([int(p) for p in cart.periods], dtype=np.intc)
+    coords = np.asarray(c.cart_coords(), dtype=np.intc)
+    return dims.tobytes(), periods.tobytes(), coords.tobytes()
+
+
+def cartdim_get(h: int) -> int:
+    return len(_comm(h)._cart().dims)
+
+
+def dims_create(nnodes: int, ndims: int, dims_view) -> bytes:
+    """MPI_Dims_create: balanced factorization honoring nonzero
+    entries in the caller's dims array."""
+    fixed = [int(d) for d in _ints(dims_view)]
+    from ompi_tpu.topo.cart import dims_create as _dc
+    return np.asarray(_dc(nnodes, ndims, fixed),
+                      dtype=np.intc).tobytes()
+
+
 def comm_set_errhandler(h: int, which: int) -> None:
     """Propagate the C-side errhandler choice into the Python layer —
     without this, the communicator's default ERRORS_ARE_FATAL hook

@@ -9,9 +9,11 @@ receive side exposes per-partition arrival (``MPI_Parrived``).
 Per-rank re-design: partitions ride the btl as independent fragments
 on a HIDDEN matching channel (own CID, the _CollChannel pattern — a
 user receive can never match a partition fragment), tagged
-``tag * MAX_PARTITIONS + k`` so the matching engine's (source, tag)
-lookup IS the per-partition arrival state: ``parrived`` is an iprobe,
-no extra bookkeeping. A partition is on the wire the moment its
+``(tag, init-order seq, k)`` flattened into one int so the matching
+engine's (source, tag) lookup IS the per-partition arrival state:
+``parrived`` is an iprobe, no extra bookkeeping, and two concurrently
+active requests on the same (peer, tag) pair match in initialization
+order (the MPI-4 channel-pairing rule) instead of cross-delivering. A partition is on the wire the moment its
 ``pready`` runs — genuinely incremental transfer across OS processes,
 which is the entire point of the MPI-4 feature (early partitions
 overlap the production of late ones).
@@ -26,14 +28,32 @@ from ompi_tpu.core.rankcomm import hidden_engine
 from ompi_tpu.core.request import Request, Status
 
 MAX_PARTITIONS = 1 << 14
+_SEQ_MOD = 1 << 20
 
 
 def _part_engine(comm):
     return hidden_engine(comm, "part")
 
 
-def _ptag(tag: int, k: int) -> int:
-    return tag * MAX_PARTITIONS + k
+def _channel_seq(comm, side: str, peer: int, tag: int) -> int:
+    """Init-order channel number for (peer, tag): MPI-4 matches
+    partitioned requests in initialization order per (comm, peer,
+    tag) — without this, two concurrently active requests on the same
+    pair would cross-deliver partitions. Sender and receiver advance
+    mirrored counters, so the i-th psend_init to (dest, tag) pairs
+    with the i-th precv_init from (source, tag)."""
+    with comm._lock:
+        table = getattr(comm, "_part_seq", None)
+        if table is None:
+            table = comm._part_seq = {}
+        key = (side, peer, tag)
+        seq = table.get(key, 0)
+        table[key] = seq + 1
+    return seq % _SEQ_MOD
+
+
+def _ptag(tag: int, seq: int, k: int) -> int:
+    return (tag * _SEQ_MOD + seq) * MAX_PARTITIONS + k
 
 
 class RankPartitionedSend(Request):
@@ -49,6 +69,7 @@ class RankPartitionedSend(Request):
         self.engine = _part_engine(comm)
         self.parts = list(parts)
         self.dest, self.tag = dest, tag
+        self.seq = _channel_seq(comm, "send", dest, tag)
         self.ready: List[bool] = [False] * len(parts)
         self._started = False
         self._complete = False
@@ -77,8 +98,16 @@ class RankPartitionedSend(Request):
             if self.ready[k]:
                 raise MPIError(ERR_ARG, f"partition {k} already ready")
             self.ready[k] = True
-        self.engine.send(self.parts[k], self.dest,
-                         _ptag(self.tag, k))
+        try:
+            self.engine.send(self.parts[k], self.dest,
+                             _ptag(self.tag, self.seq, k))
+        except BaseException:
+            # transfer failed (e.g. peer death): the partition was NOT
+            # contributed — roll back so a recovery path can retry (or
+            # cleanly abandon) instead of wedging on 'already ready'
+            with self._lock:
+                self.ready[k] = False
+            raise
         # completion is counted AFTER the btl accepted the fragment —
         # with concurrent pready threads (MPI-4's intended use), an
         # all(ready) check taken before another thread's send would
@@ -122,6 +151,7 @@ class RankPartitionedRecv(Request):
         self.engine = _part_engine(comm)
         self.nparts = nparts
         self.source, self.tag = source, tag
+        self.seq = _channel_seq(comm, "recv", source, tag)
         self._got: List[Any] = [None] * nparts
         self._have: List[bool] = [False] * nparts
         self._complete = False
@@ -139,10 +169,11 @@ class RankPartitionedRecv(Request):
             raise MPIError(ERR_ARG, f"bad partition {k}")
         if self._have[k]:
             return True
-        ok, _ = self.engine.iprobe(self.source, _ptag(self.tag, k))
+        ok, _ = self.engine.iprobe(self.source,
+                                   _ptag(self.tag, self.seq, k))
         if ok:
             data, _ = self.engine.recv(self.source,
-                                       _ptag(self.tag, k))
+                                       _ptag(self.tag, self.seq, k))
             self._got[k] = data
             self._have[k] = True
         return self._have[k]
@@ -165,7 +196,8 @@ class RankPartitionedRecv(Request):
                 left = (None if deadline is None
                         else max(deadline - time.monotonic(), 0.001))
                 data, _ = self.engine.recv(self.source,
-                                           _ptag(self.tag, k),
+                                           _ptag(self.tag, self.seq,
+                                                 k),
                                            timeout=left)
                 self._got[k] = data
                 self._have[k] = True

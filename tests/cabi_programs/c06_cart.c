@@ -1,0 +1,75 @@
+/* The textbook 2-D stencil skeleton: Dims_create, Cart_create with
+ * periodic wraparound, Cart_shift neighbors, Sendrecv halo exchange,
+ * coordinate round-trips. */
+#include <mpi.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+#define CHECK(cond, code)                                            \
+    do {                                                             \
+        if (!(cond)) {                                               \
+            fprintf(stderr, "rank %d: check failed at line %d\n",    \
+                    rank, __LINE__);                                 \
+            MPI_Abort(MPI_COMM_WORLD, code);                         \
+        }                                                            \
+    } while (0)
+
+int main(int argc, char **argv)
+{
+    MPI_Init(&argc, &argv);
+    int rank, size;
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &size);
+
+    int dims[2] = {0, 0};
+    MPI_Dims_create(size, 2, dims);
+    CHECK(dims[0] * dims[1] == size, 2);
+    int periods[2] = {1, 1};
+    MPI_Comm cart;
+    MPI_Cart_create(MPI_COMM_WORLD, 2, dims, periods, 0, &cart);
+    CHECK(cart != MPI_COMM_NULL, 3);
+
+    int ndims;
+    MPI_Cartdim_get(cart, &ndims);
+    CHECK(ndims == 2, 4);
+
+    int gdims[2], gperiods[2], mycoords[2];
+    MPI_Cart_get(cart, 2, gdims, gperiods, mycoords);
+    CHECK(gdims[0] == dims[0] && gdims[1] == dims[1], 5);
+    CHECK(gperiods[0] == 1 && gperiods[1] == 1, 6);
+
+    int crank;
+    MPI_Cart_rank(cart, mycoords, &crank);
+    int myrank;
+    MPI_Comm_rank(cart, &myrank);
+    CHECK(crank == myrank, 7);
+    int coords2[2];
+    MPI_Cart_coords(cart, myrank, 2, coords2);
+    CHECK(coords2[0] == mycoords[0] && coords2[1] == mycoords[1], 8);
+
+    /* halo exchange along each dimension: send my rank, expect the
+     * shift source's rank back */
+    for (int dim = 0; dim < 2; dim++) {
+        int src, dst;
+        MPI_Cart_shift(cart, dim, 1, &src, &dst);
+        CHECK(src >= 0 && dst >= 0, 9);          /* periodic: no NULL */
+        int out = myrank, in = -1;
+        MPI_Sendrecv(&out, 1, MPI_INT, dst, 30 + dim, &in, 1, MPI_INT,
+                     src, 30 + dim, cart, MPI_STATUS_IGNORE);
+        CHECK(in == src, 10);
+        /* and the negative direction */
+        MPI_Sendrecv(&out, 1, MPI_INT, src, 40 + dim, &in, 1, MPI_INT,
+                     dst, 40 + dim, cart, MPI_STATUS_IGNORE);
+        CHECK(in == dst, 11);
+    }
+
+    /* a collective on the cart communicator */
+    int sum = -1, one = 1;
+    MPI_Allreduce(&one, &sum, 1, MPI_INT, MPI_SUM, cart);
+    CHECK(sum == size, 12);
+
+    MPI_Comm_free(&cart);
+    MPI_Finalize();
+    printf("OK c06_cart rank=%d/%d\n", rank, size);
+    return 0;
+}

@@ -29,6 +29,7 @@ CASES = [
     ("c03_coll.c", 3),
     ("c04_nb_split.c", 4),
     ("c05_types_v.c", 3),
+    ("c06_cart.c", 4),
 ]
 
 
