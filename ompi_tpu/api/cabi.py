@@ -373,7 +373,8 @@ def cart_create(h: int, dims_view, periods_view, reorder: int) -> int:
 
 
 def cart_coords(h: int, rank: int) -> bytes:
-    """Coordinates of ``rank`` as C ints."""
+    """Coordinates of ``rank`` as C ints (explicit rank works on both
+    communicator flavors)."""
     return np.asarray(_comm(h).cart_coords(rank),
                       dtype=np.intc).tobytes()
 
@@ -383,7 +384,11 @@ def cart_rank(h: int, coords_view) -> int:
 
 
 def cart_shift(h: int, direction: int, disp: int) -> Tuple[int, int]:
-    src, dst = _comm(h).cart_shift(direction, disp)
+    c = _comm(h)
+    if hasattr(c, "router"):             # per-rank: implicit self rank
+        src, dst = c.cart_shift(direction, disp)
+    else:                                # single-controller signature
+        src, dst = c.cart_shift(c.rank(), direction, disp)
     return int(src), int(dst)
 
 
@@ -393,7 +398,7 @@ def cart_get(h: int) -> Tuple[bytes, bytes, bytes]:
     cart = c._cart()
     dims = np.asarray(cart.dims, dtype=np.intc)
     periods = np.asarray([int(p) for p in cart.periods], dtype=np.intc)
-    coords = np.asarray(c.cart_coords(), dtype=np.intc)
+    coords = np.asarray(c.cart_coords(c.rank()), dtype=np.intc)
     return dims.tobytes(), periods.tobytes(), coords.tobytes()
 
 

@@ -28,6 +28,7 @@ every piece of global state): each Session now owns, per
 from __future__ import annotations
 
 import itertools
+import os
 import threading
 from typing import Any, Dict, List, Optional
 
@@ -40,6 +41,13 @@ from ompi_tpu.runtime import ft
 
 _instance_lock = threading.Lock()
 _instance_refcount = 0
+
+# Per-rank comm_create_from_group call ordinals, keyed (tag, group):
+# process-global (NOT per-session) because the CID they feed must
+# agree across processes regardless of how many local Session objects
+# exist. SPMD collective-call order keeps the counters aligned.
+_pr_seq_lock = threading.Lock()
+_pr_create_seq: Dict[Any, int] = {}
 
 
 def _instance_retain() -> None:
@@ -132,7 +140,36 @@ class Session:
         self._cid_lock = threading.Lock()
         self._comms: List[Communicator] = []
         _instance_retain()
-        self._psets: Dict[str, List[int]] = {
+        # Per-rank world (one OS process == one rank): psets enumerate
+        # PROCESSES, and session communicators are RankCommunicators
+        # drawing CIDs from this session's private space. The router
+        # (endpoints, modex) is the shared instance state the refcount
+        # guards — exactly the reference's instance-owned RTE.
+        from ompi_tpu.runtime import init as _rt
+        self._router = _rt._state.get("router")
+        if self._router is None and os.environ.get(
+                "OMPI_TPU_MCA_mpi_base_per_rank"):
+            # A per-rank process without a live router: falling back
+            # to the device-pset path would build in-process comms
+            # whose "collectives" silently see only local data. The
+            # full Init-free instance bootstrap is not implemented —
+            # fail loudly instead of wrong answers.
+            raise MPIError(ERR_OTHER,
+                           "Session in a per-rank job requires the "
+                           "runtime to be up (call Init first; "
+                           "Init-free session bootstrap is not yet "
+                           "supported)")
+        if self._router is not None:
+            import jax as _jax
+            n = _jax.process_count()
+            self._my_world = _jax.process_index()
+            self._psets: Dict[str, List[int]] = {
+                "mpi://WORLD": list(range(n)),
+                "mpi://SELF": [self._my_world],
+            }
+            return
+        self._my_world = None
+        self._psets = {
             "mpi://WORLD": list(range(len(self.devices))),
             "mpi://SELF": [0],
         }
@@ -190,6 +227,30 @@ class Session:
                                tag: str = "",
                                info: Optional[Info] = None) -> Communicator:
         self._check()
+        if self._router is not None:
+            # Per-rank world: the CID must AGREE across processes, and
+            # sessions are process-local objects (a rank may create
+            # extra ones), so session identity CANNOT be part of it.
+            # MPI-4's own matching rule for comm_create_from_group is
+            # (group, tag) in collective-call order — we stamp
+            # ("s", tag, group, per-(tag, group) call ordinal), which
+            # every participant derives identically because the call
+            # is collective over the group. Sequential same-tag calls
+            # therefore get distinct channels too.
+            from ompi_tpu.core.rankcomm import RankCommunicator
+            if self._my_world not in group.world_ranks:
+                return None
+            gkey = tuple(group.world_ranks)
+            with _pr_seq_lock:
+                ordinal = _pr_create_seq.get((tag, gkey), 0)
+                _pr_create_seq[(tag, gkey)] = ordinal + 1
+            c = RankCommunicator(
+                group, self._my_world, self._router,
+                cid=("s", tag, gkey, ordinal),
+                name=tag or f"{self.name}.comm", info=info,
+                errhandler=self.errhandler)
+            self._comms.append(c)
+            return c
         devs = [self.devices[r] for r in group.world_ranks]
         return SessionCommunicator(
             group, devs, session=self,

@@ -41,11 +41,16 @@ def dims_create(nnodes: int, ndims: int,
         p += 1
     if n > 1:
         factors.append(n)
+    if factors and not free:
+        # every slot fixed but nnodes has leftover factors: silently
+        # returning dims whose product != nnodes would size a cart
+        # over a subset of the processes (MPI mandates an error)
+        raise MPIError(ERR_ARG,
+                       f"MPI_Dims_create: {nnodes} nodes are not "
+                       f"consistent with fully-fixed dims {out}")
     vals = {i: 1 for i in free}
     for f in sorted(factors, reverse=True):
-        i = min(free, key=lambda j: vals[j], default=None)
-        if i is None:
-            break
+        i = min(free, key=lambda j: vals[j])
         vals[i] *= f
     # MPI mandates the computed dimensions appear in non-increasing
     # order across the free slots.

@@ -41,6 +41,7 @@ CASES = [
     ("p20_shmem_ext.py", 3),
     ("p21_mpiio.py", 3),
     ("p22_part_sync.py", 3),
+    ("p23_sessions.py", 3),
 ]
 
 
