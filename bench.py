@@ -39,8 +39,9 @@ import sys
 import time
 
 # ---- child mode must configure the platform BEFORE jax import -------
-if "--ab-child" in sys.argv:
+if "--ab-child" in sys.argv or "--perrank-child" in sys.argv:
     os.environ["JAX_PLATFORMS"] = "cpu"
+if "--ab-child" in sys.argv:
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "")
         + " --xla_force_host_platform_device_count=8")
@@ -173,6 +174,100 @@ def _calibrated_busy(seconds: float) -> float:
     while time.perf_counter() - t0 < seconds:
         x = np.sqrt(x * x + 1e-9)
     return time.perf_counter() - t0
+
+
+def _perrank_child() -> None:
+    """One rank of a 2-process per-rank job (launched by the parent
+    via ``mpirun --per-rank``): pt2pt ping-pong latency, one-way
+    stream bandwidth, an 8 B allreduce over the btl algorithms, and
+    the bml transport counters. Rank 0 prints one JSON line."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import ompi_tpu as MPI
+    MPI.Init()
+    w = MPI.get_comm_world()
+    r, peer = w.rank(), 1 - w.rank()
+
+    token = np.zeros(1)
+    w.barrier()
+    t0 = time.perf_counter()
+    iters = 100
+    for _ in range(iters):
+        if r == 0:
+            w.send(token, peer, tag=9)
+            token, _ = w.recv(peer, tag=9)
+        else:
+            token, _ = w.recv(peer, tag=9)
+            w.send(token, peer, tag=9)
+    rtt_us = (time.perf_counter() - t0) / iters * 1e6
+
+    chunk = np.zeros((256 << 10) // 8, dtype=np.int64)
+    reps = 16
+    w.barrier()
+    t0 = time.perf_counter()
+    if r == 0:
+        for _ in range(reps):
+            w.send(chunk, peer, tag=11)
+        w.recv(peer, tag=12)
+        stream_gbps = reps * chunk.nbytes / (time.perf_counter()
+                                             - t0) / 1e9
+    else:
+        for _ in range(reps):
+            w.recv(0, tag=11)
+        w.send(np.array([1]), 0, tag=12)
+        stream_gbps = 0.0
+
+    w.barrier()
+    t0 = time.perf_counter()
+    for _ in range(50):
+        w.allreduce(np.float64(r), MPI.SUM)
+    allred_us = (time.perf_counter() - t0) / 50 * 1e6
+
+    from ompi_tpu.runtime.init import _state
+    stats = dict(_state["router"].endpoint.stats)
+    w.barrier()
+    MPI.Finalize()
+    if r == 0:
+        print(json.dumps({
+            "pingpong_8B_rtt_us": round(rtt_us, 1),
+            "stream_256KB_gbps": round(stream_gbps, 2),
+            "allreduce_8B_us": round(allred_us, 1),
+            "transports": stats,
+        }), flush=True)
+
+
+def _child_json(cmd, timeout: int, env: dict) -> dict:
+    """Run a child benchmark process and scrape its one JSON line
+    (shared by the ab-matrix and per-rank children)."""
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=timeout,
+            env=env, cwd=os.path.dirname(os.path.abspath(__file__)))
+        last = [ln for ln in proc.stdout.splitlines()
+                if ln.startswith("{")]
+        return (json.loads(last[-1]) if last
+                else {"error": (proc.stderr or "no output")[-300:]})
+    except Exception as e:              # noqa: BLE001
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+def _perrank_rows() -> dict:
+    """Launch two 2-process per-rank jobs — btl/sm enabled and
+    disabled — and report both (the same-host transport A/B; real OS
+    processes, so the numbers include genuine IPC)."""
+    out = {}
+    mpirun = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "ompi_tpu", "tools", "mpirun.py")
+    for label, extra in (("sm", []), ("tcp_only",
+                                      ["--mca", "btl_sm_enable", "0"])):
+        env = {k: v for k, v in os.environ.items()
+               if not k.startswith(("JAX_", "XLA_"))}
+        out[label] = _child_json(
+            [sys.executable, mpirun, "--per-rank", "-n", "2",
+             "--timeout", "120", *extra,
+             sys.executable, os.path.abspath(__file__),
+             "--perrank-child"], 180, env)
+    return out
 
 
 def _ab_matrix_child() -> None:
@@ -369,10 +464,16 @@ def main() -> None:
     ap.add_argument("--lat-iters", type=int, default=1000,
                     help="small-message amortization count")
     ap.add_argument("--no-ab", action="store_true",
-                    help="skip the 8-rank CPU-mesh A/B subprocess")
+                    help="skip the benchmark child processes (the 8-rank "
+                         "CPU-mesh A/B matrix AND the 2-process per-rank "
+                         "transport rows)")
     ap.add_argument("--ab-child", action="store_true")
+    ap.add_argument("--perrank-child", action="store_true")
     args = ap.parse_args()
 
+    if args.perrank_child:
+        _perrank_child()
+        return
     if args.ab_child:
         _ab_matrix_child()
         return
@@ -526,19 +627,14 @@ def main() -> None:
     # ---- 8-rank CPU-mesh A/B + multi-rank rows (single-chip runs) ---
     ab = None
     if n == 1 and not args.no_ab:
-        try:
-            env = {k: v for k, v in os.environ.items()
-                   if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--ab-child"],
-                capture_output=True, text=True, timeout=600, env=env,
-                cwd=os.path.dirname(os.path.abspath(__file__)))
-            last = [ln for ln in proc.stdout.splitlines()
-                    if ln.startswith("{")]
-            ab = (json.loads(last[-1]) if last
-                  else {"error": (proc.stderr or "no output")[-300:]})
-        except Exception as e:          # noqa: BLE001
-            ab = {"error": f"{type(e).__name__}: {e}"}
+        env = {k: v for k, v in os.environ.items()
+               if not k.startswith(("JAX_", "XLA_"))}
+        ab = _child_json(
+            [sys.executable, os.path.abspath(__file__), "--ab-child"],
+            600, env)
+
+    # ---- per-rank transport rows (2 real OS processes, btl A/B) -----
+    perrank = _perrank_rows() if (n == 1 and not args.no_ab) else None
 
     print(json.dumps({
         # throughput-derived: amortized pipelined dispatch minus the
@@ -565,6 +661,7 @@ def main() -> None:
         "correct": correct,
         **osu,
         **({"ab_matrix": ab} if ab is not None else {}),
+        **({"perrank": perrank} if perrank is not None else {}),
         "caveat": ("size-1 world: large-message path is identity-aliased "
                    "by XLA (algbw is an upper bound); >1-rank rows and "
                    "algorithm A/B come from the 8-rank CPU-mesh child"

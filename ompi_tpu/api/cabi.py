@@ -385,9 +385,9 @@ def cart_rank(h: int, coords_view) -> int:
 
 def cart_shift(h: int, direction: int, disp: int) -> Tuple[int, int]:
     c = _comm(h)
-    if hasattr(c, "router"):             # per-rank: implicit self rank
+    if getattr(c, "is_per_rank", False):  # implicit self-rank variant
         src, dst = c.cart_shift(direction, disp)
-    else:                                # single-controller signature
+    else:                                 # single-controller signature
         src, dst = c.cart_shift(c.rank(), direction, disp)
     return int(src), int(dst)
 

@@ -126,6 +126,14 @@ class RankCommunicator:
         self._pml = PerRankEngine(self, router)
         self._coll_pml = PerRankEngine(_CollChannel(self), router)
         self._aux_pmls: Dict[str, PerRankEngine] = {}   # hidden_engine
+        # ownership list (MPI-4 Sessions): a session-created comm
+        # carries the session's comm list so DERIVED comms
+        # (dup/split/cart/shrink) register too — finalize must quiesce
+        # the whole family, not just the direct creations
+        owners = getattr(parent, "_owner_list", None)
+        if owners is not None:
+            self._owner_list = owners
+            owners.append(self)
         self._seq = itertools.count(1)          # collective sequence
         self._create_seq = itertools.count(1)   # comm-creation sequence
         self._dev_fns: Dict[Any, Callable] = {}

@@ -249,6 +249,10 @@ class Session:
                 cid=("s", tag, gkey, ordinal),
                 name=tag or f"{self.name}.comm", info=info,
                 errhandler=self.errhandler)
+            # the ownership list rides parent linkage: derived comms
+            # (dup/split/cart/shrink) self-register so finalize
+            # quiesces the whole family
+            c._owner_list = self._comms
             self._comms.append(c)
             return c
         devs = [self.devices[r] for r in group.world_ranks]

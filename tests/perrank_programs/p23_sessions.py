@@ -50,6 +50,11 @@ cs = s1.comm_create_from_group(s1.group_from_pset("mpi://SELF"),
                                tag="self")
 assert cs.size == 1 and cs.rank() == 0
 
+# derived comms join the session's ownership list: finalize must
+# quiesce the whole family, not just direct creations
+c2d = c2.dup()
+assert float(np.asarray(c2d.allreduce(np.float64(1.0), MPI.SUM))) == n
+
 # finalize one session; the other and the world keep working
 world.barrier()
 s1.finalize()
@@ -58,6 +63,7 @@ assert float(np.asarray(tot2b)) == n
 wtot = world.allreduce(np.float64(2.0), MPI.SUM)
 assert float(np.asarray(wtot)) == 2 * n
 s2.finalize()
+assert c2d._freed and c2._freed      # the family was quiesced
 
 world.barrier()
 MPI.Finalize()
