@@ -236,6 +236,13 @@ def _perrank_child() -> None:
         }), flush=True)
 
 
+def _child_env() -> dict:
+    """Environment for benchmark children: the parent's platform pins
+    must not leak (children pick their own backend)."""
+    return {k: v for k, v in os.environ.items()
+            if not k.startswith(("JAX_", "XLA_"))}
+
+
 def _child_json(cmd, timeout: int, env: dict) -> dict:
     """Run a child benchmark process and scrape its one JSON line
     (shared by the ab-matrix and per-rank children)."""
@@ -260,13 +267,11 @@ def _perrank_rows() -> dict:
                           "ompi_tpu", "tools", "mpirun.py")
     for label, extra in (("sm", []), ("tcp_only",
                                       ["--mca", "btl_sm_enable", "0"])):
-        env = {k: v for k, v in os.environ.items()
-               if not k.startswith(("JAX_", "XLA_"))}
         out[label] = _child_json(
             [sys.executable, mpirun, "--per-rank", "-n", "2",
              "--timeout", "120", *extra,
              sys.executable, os.path.abspath(__file__),
-             "--perrank-child"], 180, env)
+             "--perrank-child"], 180, _child_env())
     return out
 
 
@@ -627,11 +632,9 @@ def main() -> None:
     # ---- 8-rank CPU-mesh A/B + multi-rank rows (single-chip runs) ---
     ab = None
     if n == 1 and not args.no_ab:
-        env = {k: v for k, v in os.environ.items()
-               if not k.startswith(("JAX_", "XLA_"))}
         ab = _child_json(
             [sys.executable, os.path.abspath(__file__), "--ab-child"],
-            600, env)
+            600, _child_env())
 
     # ---- per-rank transport rows (2 real OS processes, btl A/B) -----
     perrank = _perrank_rows() if (n == 1 and not args.no_ab) else None

@@ -1,0 +1,79 @@
+"""All-to-all sequence parallelism (the DeepSpeed-Ulysses schedule) —
+the second of the two canonical long-context strategies (ring
+attention is the first; `parallel/ring_attention.py`).
+
+Where ring attention circulates K/V blocks with ``ppermute`` neighbor
+traffic and recomputes softmax online, the all-to-all schedule
+RESHARDS: two ``all_to_all`` collectives convert a sequence-sharded
+layout ``(S/P, H, D)`` into a head-sharded one ``(S, H/P, D)``, each
+rank runs PLAIN full-sequence attention over its head subset, and a
+mirror ``all_to_all`` converts back. Communication volume is O(S*H*D/P)
+per rank independent of sequence length's square, and the attention
+kernel itself stays the unmodified dense one — the property that makes
+this the practical choice when H >= P and the fabric has good
+all-to-all bandwidth (ICI does; SURVEY.md §2.6 maps the alltoall
+family to ``jax.lax.all_to_all``).
+
+Trade-off vs ring (documented, not hidden): head-sharding requires the
+head count to be divisible by the mesh axis; peak activation memory is
+O(S) per rank for the attention matrix row (flash-style blocking can
+be layered inside), while ring attention keeps O(S/P) — ring for the
+longest contexts, all-to-all for bandwidth-bound regimes.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ompi_tpu.parallel.ingraph import InGraphComm
+
+_NEG = -1e30
+
+
+def ulysses_attention(q, k, v, sp: InGraphComm, *,
+                      causal: bool = True,
+                      scale: float | None = None):
+    """Exact full attention with the two-alltoall resharding schedule.
+
+    Args:
+      q, k, v: local sequence blocks ``(B, S_local, H, D)`` on the
+        ``sp`` axis (rank i holds global positions
+        [i*S_local, (i+1)*S_local)); H must be divisible by the axis
+        size.
+      sp: the sequence-parallel in-graph communicator (static size).
+      causal: apply the global causal mask.
+    Returns the local output block ``(B, S_local, H, D)``.
+    """
+    n = sp._size
+    if n is None:
+        raise ValueError("ulysses_attention needs InGraphComm(axis, "
+                         "size)")
+    B, S, H, D = q.shape
+    if H % n:
+        raise ValueError(f"head count {H} not divisible by the "
+                         f"sequence axis size {n} (use ring attention)")
+    if scale is None:
+        scale = D ** -0.5
+
+    def reshard_in(x):
+        # (B, S/P, H, D) -> (B, S, H/P, D): scatter heads, gather seq.
+        # all_to_all wants the split axis leading per-shard; axis
+        # numbers are per the (B, S, H, D) layout.
+        return sp.alltoall(x, split_axis=2, concat_axis=1)
+
+    def reshard_out(x):
+        # (B, S, H/P, D) -> (B, S/P, H, D): the mirror exchange.
+        return sp.alltoall(x, split_axis=1, concat_axis=2)
+
+    qg = reshard_in(q).astype(jnp.float32) * scale     # (B, S_g, h, D)
+    kg = reshard_in(k).astype(jnp.float32)
+    vg = reshard_in(v).astype(jnp.float32)
+
+    s = jnp.einsum("bqhd,bkhd->bhqk", qg, kg)          # full sequence
+    if causal:
+        S_g = qg.shape[1]
+        tri = jnp.tril(jnp.ones((S_g, S_g), jnp.bool_))[None, None]
+        s = jnp.where(tri, s, _NEG)
+    p = jnp.exp(s - s.max(axis=-1, keepdims=True))
+    p = p / p.sum(axis=-1, keepdims=True)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, vg)           # (B, S_g, h, D)
+    return reshard_out(o).astype(q.dtype)
