@@ -28,6 +28,10 @@ typedef long MPI_Op;
 typedef long MPI_Request;
 typedef long MPI_Errhandler;
 typedef long MPI_Aint;
+typedef long MPI_Group;
+
+#define MPI_GROUP_NULL  ((MPI_Group)0)
+#define MPI_GROUP_EMPTY ((MPI_Group)1)
 
 #define MPI_COMM_NULL   ((MPI_Comm)0)
 #define MPI_COMM_WORLD  ((MPI_Comm)1)
@@ -244,6 +248,34 @@ int MPI_Cart_shift(MPI_Comm comm, int direction, int disp,
 int MPI_Cart_get(MPI_Comm comm, int maxdims, int dims[], int periods[],
                  int coords[]);
 int MPI_Cartdim_get(MPI_Comm comm, int *ndims);
+
+/* ---- persistent point-to-point ---- */
+int MPI_Send_init(const void *buf, int count, MPI_Datatype datatype,
+                  int dest, int tag, MPI_Comm comm,
+                  MPI_Request *request);
+int MPI_Recv_init(void *buf, int count, MPI_Datatype datatype,
+                  int source, int tag, MPI_Comm comm,
+                  MPI_Request *request);
+int MPI_Start(MPI_Request *request);
+int MPI_Startall(int count, MPI_Request array_of_requests[]);
+int MPI_Request_free(MPI_Request *request);
+
+/* ---- groups ---- */
+int MPI_Comm_group(MPI_Comm comm, MPI_Group *group);
+int MPI_Group_size(MPI_Group group, int *size);
+int MPI_Group_rank(MPI_Group group, int *rank);
+int MPI_Group_incl(MPI_Group group, int n, const int ranks[],
+                   MPI_Group *newgroup);
+int MPI_Group_excl(MPI_Group group, int n, const int ranks[],
+                   MPI_Group *newgroup);
+int MPI_Group_union(MPI_Group group1, MPI_Group group2,
+                    MPI_Group *newgroup);
+int MPI_Group_intersection(MPI_Group group1, MPI_Group group2,
+                           MPI_Group *newgroup);
+int MPI_Group_difference(MPI_Group group1, MPI_Group group2,
+                         MPI_Group *newgroup);
+int MPI_Group_free(MPI_Group *group);
+int MPI_Comm_create(MPI_Comm comm, MPI_Group group, MPI_Comm *newcomm);
 
 #ifdef __cplusplus
 }

@@ -84,10 +84,26 @@ static size_t dt_sig(MPI_Datatype dt)
 }
 
 typedef struct {
-    long pyh;                           /* glue request handle */
+    long pyh;                           /* glue request handle (0 =
+                                         * inactive persistent) */
     void *buf;                          /* receive buffer (NULL: send) */
     size_t cap;                         /* receive capacity in bytes */
+    /* persistent requests (MPI_Send_init/Recv_init): creation args
+     * replayed by each MPI_Start */
+    int persistent;
+    int is_recv;
+    const void *sbuf;
+    int count;
+    MPI_Datatype dt;
+    int peer;
+    int tag;
+    MPI_Comm comm;
 } req_entry;
+
+static req_entry *req_new(void)
+{
+    return (req_entry *)calloc(1, sizeof(req_entry));
+}
 
 /* ------------------------------------------------------------------ */
 /* bring-up                                                            */
@@ -566,10 +582,8 @@ int MPI_Isend(const void *buf, int count, MPI_Datatype datatype, int dest,
     if (!r) {
         rc = handle_error("MPI_Isend");
     } else {
-        req_entry *e = (req_entry *)malloc(sizeof(req_entry));
+        req_entry *e = req_new();
         e->pyh = PyLong_AsLong(r);
-        e->buf = NULL;
-        e->cap = 0;
         *request = (MPI_Request)(intptr_t)e;
         Py_DECREF(r);
     }
@@ -592,7 +606,7 @@ int MPI_Irecv(void *buf, int count, MPI_Datatype datatype, int source,
     if (!r) {
         rc = handle_error("MPI_Irecv");
     } else {
-        req_entry *e = (req_entry *)malloc(sizeof(req_entry));
+        req_entry *e = req_new();
         e->pyh = PyLong_AsLong(r);
         e->buf = buf;
         e->cap = (size_t)count * esz;
@@ -610,6 +624,10 @@ int MPI_Wait(MPI_Request *request, MPI_Status *status)
         return MPI_SUCCESS;
     }
     req_entry *e = (req_entry *)(intptr_t)*request;
+    if (e->persistent && e->pyh == 0) {  /* inactive: immediate */
+        set_status(status, MPI_ANY_SOURCE, MPI_ANY_TAG, 0);
+        return MPI_SUCCESS;
+    }
     GIL_BEGIN;
     int rc = MPI_SUCCESS;
     PyObject *r = PyObject_CallMethod(g_mod, "wait", "l", e->pyh);
@@ -620,6 +638,10 @@ int MPI_Wait(MPI_Request *request, MPI_Status *status)
         Py_DECREF(r);
     }
     GIL_END;
+    if (e->persistent) {                 /* back to inactive, reusable */
+        e->pyh = 0;
+        return rc;
+    }
     free(e);
     *request = MPI_REQUEST_NULL;
     return rc;
@@ -648,17 +670,29 @@ int MPI_Test(MPI_Request *request, int *flag, MPI_Status *status)
     }
     *flag = 0;
     req_entry *e = (req_entry *)(intptr_t)*request;
+    if (e->persistent && e->pyh == 0) {  /* inactive: immediate */
+        *flag = 1;
+        set_status(status, MPI_ANY_SOURCE, MPI_ANY_TAG, 0);
+        return MPI_SUCCESS;
+    }
     GIL_BEGIN;
     int rc = MPI_SUCCESS;
     PyObject *r = PyObject_CallMethod(g_mod, "test", "l", e->pyh);
     if (!r) {
         /* the request completed IN ERROR (ULFM peer death): it is
-         * done — report completion, free it, surface the class, so an
-         * ERRORS_RETURN poll loop can drain instead of spinning */
+         * done — report completion, surface the class, so an
+         * ERRORS_RETURN poll loop can drain instead of spinning. A
+         * persistent request returns to INACTIVE (restartable after
+         * e.g. ULFM repair, matching the MPI_Wait error path); only
+         * ordinary requests are destroyed. */
         rc = handle_error("MPI_Test");
         *flag = 1;
-        free(e);
-        *request = MPI_REQUEST_NULL;
+        if (e->persistent) {
+            e->pyh = 0;
+        } else {
+            free(e);
+            *request = MPI_REQUEST_NULL;
+        }
         if (status)
             status->MPI_ERROR = rc;
     } else {
@@ -667,8 +701,12 @@ int MPI_Test(MPI_Request *request, int *flag, MPI_Status *status)
             PyObject *msg = PyTuple_GetSlice(r, 1, 6);
             rc = copy_msg(msg, e->buf, e->cap, status);
             Py_DECREF(msg);
-            free(e);
-            *request = MPI_REQUEST_NULL;
+            if (e->persistent) {
+                e->pyh = 0;              /* inactive, reusable */
+            } else {
+                free(e);
+                *request = MPI_REQUEST_NULL;
+            }
         }
         Py_DECREF(r);
     }
@@ -1461,5 +1499,253 @@ int MPI_Cartdim_get(MPI_Comm comm, int *ndims)
         Py_DECREF(r);
     }
     GIL_END;
+    return rc;
+}
+
+/* ------------------------------------------------------------------ */
+/* persistent point-to-point (MPI_Send_init / MPI_Recv_init / Start)   */
+/* ------------------------------------------------------------------ */
+int MPI_Send_init(const void *buf, int count, MPI_Datatype datatype,
+                  int dest, int tag, MPI_Comm comm,
+                  MPI_Request *request)
+{
+    if (!dt_extent(datatype) || count < 0)
+        return MPI_ERR_TYPE;
+    req_entry *e = req_new();
+    e->persistent = 1;
+    e->sbuf = buf;
+    e->count = count;
+    e->dt = datatype;
+    e->peer = dest;
+    e->tag = tag;
+    e->comm = comm;
+    *request = (MPI_Request)(intptr_t)e;
+    return MPI_SUCCESS;
+}
+
+int MPI_Recv_init(void *buf, int count, MPI_Datatype datatype,
+                  int source, int tag, MPI_Comm comm,
+                  MPI_Request *request)
+{
+    size_t esz = dt_extent(datatype);
+    if (!esz || count < 0)
+        return MPI_ERR_TYPE;
+    req_entry *e = req_new();
+    e->persistent = 1;
+    e->is_recv = 1;
+    e->buf = buf;
+    e->cap = (size_t)count * esz;
+    e->count = count;
+    e->dt = datatype;
+    e->peer = source;
+    e->tag = tag;
+    e->comm = comm;
+    *request = (MPI_Request)(intptr_t)e;
+    return MPI_SUCCESS;
+}
+
+int MPI_Start(MPI_Request *request)
+{
+    if (!request || *request == MPI_REQUEST_NULL)
+        return MPI_ERR_REQUEST;
+    req_entry *e = (req_entry *)(intptr_t)*request;
+    if (!e->persistent || e->pyh != 0)
+        return MPI_ERR_REQUEST;          /* not persistent, or active */
+    size_t esz = dt_extent(e->dt);
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r;
+    if (e->is_recv) {
+        size_t snap = e->dt >= DT_FIRST_DYN
+            ? (size_t)e->count * esz : 0;
+        r = PyObject_CallMethod(g_mod, "irecv", "liilN", (long)e->comm,
+                                e->peer, e->tag, (long)e->dt,
+                                mem_ro(e->buf, snap));
+    } else {
+        /* the buffer is re-read at EVERY start (persistent semantics:
+         * the app refills it between rounds) */
+        r = PyObject_CallMethod(g_mod, "isend", "lNlii", (long)e->comm,
+                                mem_ro(e->sbuf,
+                                       (size_t)e->count * esz),
+                                (long)e->dt, e->peer, e->tag);
+    }
+    if (!r)
+        rc = handle_error("MPI_Start");
+    else {
+        e->pyh = PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int MPI_Startall(int count, MPI_Request array_of_requests[])
+{
+    for (int i = 0; i < count; i++) {
+        int rc = MPI_Start(&array_of_requests[i]);
+        if (rc != MPI_SUCCESS)
+            return rc;
+    }
+    return MPI_SUCCESS;
+}
+
+int MPI_Request_free(MPI_Request *request)
+{
+    if (!request || *request == MPI_REQUEST_NULL)
+        return MPI_ERR_REQUEST;
+    req_entry *e = (req_entry *)(intptr_t)*request;
+    int rc = MPI_SUCCESS;
+    if (e->pyh != 0) {                   /* active: complete first */
+        rc = MPI_Wait(request, MPI_STATUS_IGNORE);
+        if (*request == MPI_REQUEST_NULL)
+            return rc;                   /* non-persistent: freed */
+        e = (req_entry *)(intptr_t)*request;
+    }
+    /* free means free — even when the drain completed in error (the
+     * caller is disposing of the request; leaking the entry and
+     * leaving a stale handle would give them nothing to retry with) */
+    free(e);
+    *request = MPI_REQUEST_NULL;
+    return rc;
+}
+
+/* ------------------------------------------------------------------ */
+/* groups (ompi/group algebra)                                         */
+/* ------------------------------------------------------------------ */
+static int group_call1(const char *fn, long a, long *out)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, fn, "l", a);
+    if (!r)
+        rc = handle_error(fn);
+    else {
+        *out = PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+static int group_call2(const char *fn, long a, long b, long *out)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, fn, "ll", a, b);
+    if (!r)
+        rc = handle_error(fn);
+    else {
+        *out = PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int MPI_Comm_group(MPI_Comm comm, MPI_Group *group)
+{
+    long g;
+    int rc = group_call1("comm_group", (long)comm, &g);
+    if (rc == MPI_SUCCESS)
+        *group = (MPI_Group)g;
+    return rc;
+}
+
+int MPI_Group_size(MPI_Group group, int *size)
+{
+    long v;
+    int rc = group_call1("group_size", (long)group, &v);
+    if (rc == MPI_SUCCESS)
+        *size = (int)v;
+    return rc;
+}
+
+int MPI_Group_rank(MPI_Group group, int *rank)
+{
+    long v;
+    int rc = group_call1("group_rank", (long)group, &v);
+    if (rc == MPI_SUCCESS)
+        *rank = (int)v;
+    return rc;
+}
+
+static int group_subset(const char *fn, MPI_Group group, int n,
+                        const int ranks[], MPI_Group *newgroup)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, fn, "lN", (long)group,
+        mem_ro(ranks, (size_t)n * sizeof(int)));
+    if (!r)
+        rc = handle_error(fn);
+    else {
+        *newgroup = (MPI_Group)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int MPI_Group_incl(MPI_Group group, int n, const int ranks[],
+                   MPI_Group *newgroup)
+{
+    return group_subset("group_incl", group, n, ranks, newgroup);
+}
+
+int MPI_Group_excl(MPI_Group group, int n, const int ranks[],
+                   MPI_Group *newgroup)
+{
+    return group_subset("group_excl", group, n, ranks, newgroup);
+}
+
+int MPI_Group_union(MPI_Group group1, MPI_Group group2,
+                    MPI_Group *newgroup)
+{
+    long g;
+    int rc = group_call2("group_union", (long)group1, (long)group2, &g);
+    if (rc == MPI_SUCCESS)
+        *newgroup = (MPI_Group)g;
+    return rc;
+}
+
+int MPI_Group_intersection(MPI_Group group1, MPI_Group group2,
+                           MPI_Group *newgroup)
+{
+    long g;
+    int rc = group_call2("group_intersection", (long)group1,
+                         (long)group2, &g);
+    if (rc == MPI_SUCCESS)
+        *newgroup = (MPI_Group)g;
+    return rc;
+}
+
+int MPI_Group_difference(MPI_Group group1, MPI_Group group2,
+                         MPI_Group *newgroup)
+{
+    long g;
+    int rc = group_call2("group_difference", (long)group1,
+                         (long)group2, &g);
+    if (rc == MPI_SUCCESS)
+        *newgroup = (MPI_Group)g;
+    return rc;
+}
+
+int MPI_Group_free(MPI_Group *group)
+{
+    long v;
+    int rc = group_call1("group_free", (long)*group, &v);
+    (void)v;
+    if (rc == MPI_SUCCESS)
+        *group = MPI_GROUP_NULL;
+    return rc;
+}
+
+int MPI_Comm_create(MPI_Comm comm, MPI_Group group, MPI_Comm *newcomm)
+{
+    long c;
+    int rc = group_call2("comm_create", (long)comm, (long)group, &c);
+    if (rc == MPI_SUCCESS)
+        *newcomm = (MPI_Comm)c;
     return rc;
 }

@@ -361,6 +361,93 @@ def comm_split(h: int, color: int, key: int) -> int:
     return _register_comm(sub)
 
 
+# ---------------------------------------------------------------------
+# groups (ompi/group algebra through the handle table)
+# ---------------------------------------------------------------------
+GROUP_NULL = 0
+GROUP_EMPTY = 1
+_FIRST_DYN_GROUP = 16
+_groups: Dict[int, Any] = {}
+_next_group = itertools.count(_FIRST_DYN_GROUP)
+
+
+def _group(gh: int):
+    if gh == GROUP_EMPTY:
+        from ompi_tpu.core.group import Group
+        return Group([])
+    with _lock:
+        g = _groups.get(gh)
+    if g is None:
+        raise MPIError(ERR_ARG, f"invalid group handle {gh}")
+    return g
+
+
+def _register_group(g) -> int:
+    with _lock:
+        gh = next(_next_group)
+        _groups[gh] = g
+    return gh
+
+
+def _my_world_rank() -> int:
+    from ompi_tpu.runtime import init as rt
+    w = rt.comm_world()
+    return w.world_rank_of(w.rank())
+
+
+def comm_group(h: int) -> int:
+    return _register_group(_comm(h).group)
+
+
+def group_size(gh: int) -> int:
+    return int(_group(gh).size)
+
+
+def group_rank(gh: int) -> int:
+    """Calling process's rank in the group (MPI_UNDEFINED = -32766 if
+    not a member, matching mpi.h)."""
+    return int(_group(gh).rank_of(_my_world_rank()))
+
+
+def group_incl(gh: int, ranks_view) -> int:
+    return _register_group(
+        _group(gh).incl([int(r) for r in _ints(ranks_view)]))
+
+
+def group_excl(gh: int, ranks_view) -> int:
+    return _register_group(
+        _group(gh).excl([int(r) for r in _ints(ranks_view)]))
+
+
+def group_union(a: int, b: int) -> int:
+    return _register_group(_group(a).union(_group(b)))
+
+
+def group_intersection(a: int, b: int) -> int:
+    return _register_group(_group(a).intersection(_group(b)))
+
+
+def group_difference(a: int, b: int) -> int:
+    return _register_group(_group(a).difference(_group(b)))
+
+
+def group_free(gh: int) -> int:
+    """Returns GROUP_NULL (the C shim parses an int result)."""
+    if gh != GROUP_EMPTY:
+        with _lock:
+            if _groups.pop(gh, None) is None:
+                raise MPIError(ERR_ARG, f"invalid group handle {gh}")
+    return GROUP_NULL
+
+
+def comm_create(h: int, gh: int) -> int:
+    """MPI_Comm_create: collective; non-members get COMM_NULL."""
+    sub = _comm(h).create(_group(gh))
+    if sub is None:
+        return COMM_NULL
+    return _register_comm(sub)
+
+
 def cart_create(h: int, dims_view, periods_view, reorder: int) -> int:
     """MPI_Cart_create: dims/periods arrive as C int arrays; callers
     beyond the cart size get COMM_NULL."""

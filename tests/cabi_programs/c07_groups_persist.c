@@ -1,0 +1,123 @@
+/* Group algebra (incl/excl/union/intersection/difference,
+ * MPI_Comm_create with non-member NULL) and persistent point-to-point
+ * (Send_init/Recv_init/Startall rounds through one request pair,
+ * Request_free). */
+#include <mpi.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+#define CHECK(cond, code)                                            \
+    do {                                                             \
+        if (!(cond)) {                                               \
+            fprintf(stderr, "rank %d: check failed at line %d\n",    \
+                    rank, __LINE__);                                 \
+            MPI_Abort(MPI_COMM_WORLD, code);                         \
+        }                                                            \
+    } while (0)
+
+int main(int argc, char **argv)
+{
+    MPI_Init(&argc, &argv);
+    int rank, size;
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &size);
+    CHECK(size >= 3, 1);
+
+    /* world group mirrors the communicator */
+    MPI_Group wg;
+    MPI_Comm_group(MPI_COMM_WORLD, &wg);
+    int gsz, grk;
+    MPI_Group_size(wg, &gsz);
+    MPI_Group_rank(wg, &grk);
+    CHECK(gsz == size && grk == rank, 2);
+
+    /* algebra: evens by incl, odds by excl, union back to world */
+    int nev = (size + 1) / 2;
+    int *evens = (int *)malloc((size_t)nev * sizeof(int));
+    for (int i = 0; i < nev; i++)
+        evens[i] = 2 * i;
+    MPI_Group ge, go, gu, gi, gd;
+    MPI_Group_incl(wg, nev, evens, &ge);
+    MPI_Group_excl(wg, nev, evens, &go);
+    int esz, osz;
+    MPI_Group_size(ge, &esz);
+    MPI_Group_size(go, &osz);
+    CHECK(esz == nev && osz == size - nev, 3);
+    MPI_Group_union(ge, go, &gu);
+    int usz;
+    MPI_Group_size(gu, &usz);
+    CHECK(usz == size, 4);
+    MPI_Group_intersection(ge, go, &gi);
+    int isz;
+    MPI_Group_size(gi, &isz);
+    CHECK(isz == 0, 5);
+    MPI_Group_difference(wg, go, &gd);
+    int dsz;
+    MPI_Group_size(gd, &dsz);
+    CHECK(dsz == nev, 6);
+
+    /* Group_rank returns MPI_UNDEFINED for non-members */
+    int erk;
+    MPI_Group_rank(ge, &erk);
+    if (rank % 2 == 0)
+        CHECK(erk == rank / 2, 7);
+    else
+        CHECK(erk == MPI_UNDEFINED, 8);
+
+    /* Comm_create: evens get a communicator, odds get COMM_NULL */
+    MPI_Comm ec;
+    MPI_Comm_create(MPI_COMM_WORLD, ge, &ec);
+    if (rank % 2 == 0) {
+        CHECK(ec != MPI_COMM_NULL, 9);
+        int er, es, sum;
+        MPI_Comm_rank(ec, &er);
+        MPI_Comm_size(ec, &es);
+        CHECK(er == rank / 2 && es == nev, 10);
+        int me = rank;
+        MPI_Allreduce(&me, &sum, 1, MPI_INT, MPI_SUM, ec);
+        int expect = 0;
+        for (int i = 0; i < size; i += 2)
+            expect += i;
+        CHECK(sum == expect, 11);
+        MPI_Comm_free(&ec);
+    } else {
+        CHECK(ec == MPI_COMM_NULL, 12);
+    }
+    MPI_Group_free(&ge);
+    MPI_Group_free(&go);
+    MPI_Group_free(&gu);
+    MPI_Group_free(&gi);
+    MPI_Group_free(&gd);
+    MPI_Group_free(&wg);
+    CHECK(wg == MPI_GROUP_NULL, 13);
+    free(evens);
+
+    /* persistent halo: one request pair reused across rounds, the
+     * send buffer re-read at every Start (the whole point) */
+    int right = (rank + 1) % size, left = (rank - 1 + size) % size;
+    double out = 0, in = -1;
+    MPI_Request reqs[2];
+    MPI_Send_init(&out, 1, MPI_DOUBLE, right, 4, MPI_COMM_WORLD,
+                  &reqs[0]);
+    MPI_Recv_init(&in, 1, MPI_DOUBLE, left, 4, MPI_COMM_WORLD,
+                  &reqs[1]);
+    /* wait on an INACTIVE persistent request returns immediately */
+    MPI_Wait(&reqs[0], MPI_STATUS_IGNORE);
+    CHECK(reqs[0] != MPI_REQUEST_NULL, 14);
+    for (int round = 0; round < 4; round++) {
+        out = rank * 100.0 + round;
+        MPI_Startall(2, reqs);
+        MPI_Status sts[2];
+        MPI_Waitall(2, reqs, sts);
+        CHECK(in == left * 100.0 + round, 15);
+        CHECK(sts[1].MPI_SOURCE == left, 16);
+        CHECK(reqs[0] != MPI_REQUEST_NULL, 17);   /* still reusable */
+    }
+    MPI_Request_free(&reqs[0]);
+    MPI_Request_free(&reqs[1]);
+    CHECK(reqs[0] == MPI_REQUEST_NULL, 18);
+
+    MPI_Finalize();
+    printf("OK c07_groups_persist rank=%d/%d\n", rank, size);
+    return 0;
+}

@@ -30,6 +30,7 @@ CASES = [
     ("c04_nb_split.c", 4),
     ("c05_types_v.c", 3),
     ("c06_cart.c", 4),
+    ("c07_groups_persist.c", 4),
 ]
 
 
