@@ -1,0 +1,126 @@
+"""Interposition-level MCA selection for per-rank communicators.
+
+The stacked world runs full framework selection (priority-sorted
+``comm_query`` per function, `coll/framework.py`); per-rank
+communicators carry their collective algorithms as bound methods
+(textbook p2p schedules + the XLA device path chosen per buffer), so
+the FRAMEWORK boundary that still applies to them is the reference's
+interposition tier: coll/sync (barrier every Nth operation, the
+flow-control debugging aid) and coll/monitoring (per-(comm, func)
+call/byte counters feeding the pvar/profile tools).
+
+This module applies those components to a RankCommunicator by wrapping
+and REBINDING its collective methods at construction, honoring the
+same MCA vars as the stacked components (``coll_sync_barrier_before``,
+``coll_monitoring_enable``) — one config plane, two execution models.
+The wrap order mirrors the stacked composer: monitoring outermost
+(counts what the app called), sync beneath it (its injected barrier is
+not itself counted). The base barrier is captured unwrapped, so sync's
+injections cannot recurse. Nonblocking collectives are sync-exempt —
+their worker threads would race the op counter across ranks, exactly
+why the stacked coll/sync skips i-slots — but ARE monitored, under
+their own names (the rankcomm i-methods call the class-level blocking
+implementations, bypassing these rebindings, so nothing
+double-counts).
+"""
+from __future__ import annotations
+
+import threading
+
+from ompi_tpu.mca import var
+
+# Reentrancy depth per layer: rankcomm collectives COMPOSE (allreduce =
+# reduce + bcast through the same bound methods), but the reference's
+# interposition sits at the vtable — the winner's internal traffic
+# never re-enters it. Only the outermost call is an application
+# operation; inner frames pass through unobserved.
+_tls = threading.local()
+
+PERRANK_COLL_FUNCS = (
+    "barrier", "bcast", "reduce", "allreduce", "gather", "scatter",
+    "allgather", "alltoall", "scan", "exscan", "reduce_scatter_block",
+    "neighbor_allgather", "neighbor_alltoall",
+)
+PERRANK_ICOLL_FUNCS = ("ibarrier", "ibcast", "iallreduce",
+                       "iallgather", "ireduce")
+
+
+def _wrap(comm, funcs, depth_attr: str, on_outermost) -> None:
+    """Rebind each method with a reentrancy-guarded shim: the
+    ``on_outermost(func, args, kw)`` hook fires only for the outermost
+    frame of this layer."""
+    def make(func, inner):
+        def call(*args, **kw):
+            depth = getattr(_tls, depth_attr, 0)
+            if depth == 0:
+                on_outermost(func, args, kw)
+            setattr(_tls, depth_attr, depth + 1)
+            try:
+                return inner(*args, **kw)
+            finally:
+                setattr(_tls, depth_attr, depth)
+        call.__name__ = func
+        return call
+    for f in funcs:
+        setattr(comm, f, make(f, getattr(comm, f)))
+
+
+def _payload_nbytes(args, kw) -> int:
+    """Bytes of the call's first buffer-ish argument: arrays directly,
+    chunk lists by summation, keyword buffers included."""
+    for cand in list(args) + list(kw.values()):
+        nb = getattr(cand, "nbytes", None)
+        if nb is not None:
+            return int(nb)
+        if isinstance(cand, (list, tuple)) and cand:
+            total = 0
+            for e in cand:
+                total += int(getattr(e, "nbytes", 0))
+            if total:
+                return total
+    return 0
+
+
+def interpose(comm) -> None:
+    """Wrap ``comm``'s collective methods per the enabled interposer
+    components. No-op (and no per-call overhead) when neither is on."""
+    # component register_params runs at framework OPEN (mca_base
+    # convention) — per-rank worlds don't run the stacked selection
+    # that normally opens the framework, so open it here for the MCA
+    # vars (and their env overrides) to exist
+    from ompi_tpu.coll.framework import _ensure_components, \
+        coll_framework
+    _ensure_components()
+    coll_framework.open()
+    every = int(var.var_get("coll_sync_barrier_before", 0) or 0)
+    if every < 0:
+        every = 0                        # stacked semantics: <=0 is off
+    mon = bool(var.var_get("coll_monitoring_enable", False))
+    comm._coll_interposers = []
+    if not every and not mon:
+        return
+
+    base_barrier = comm.barrier          # unwrapped: sync's injections
+    #                                      must not recurse or be
+    #                                      counted as app traffic
+    if every:
+        state = {"count": 0}
+
+        def sync_hook(func, args, kw):
+            state["count"] += 1
+            if state["count"] % every == 0 and func != "barrier":
+                base_barrier()
+        _wrap(comm, PERRANK_COLL_FUNCS, "sync_depth", sync_hook)
+        comm._coll_interposers.append("sync")
+
+    if mon:
+        from ompi_tpu.coll.monitoring import record
+
+        def mon_hook(func, args, kw):
+            record(comm.cid, func, _payload_nbytes(args, kw))
+        _wrap(comm, PERRANK_COLL_FUNCS, "mon_depth", mon_hook)
+        # i-collectives: monitored under their own names (the stacked
+        # table has separate i-slots); their worker threads run the
+        # CLASS implementations, so nothing here re-fires
+        _wrap(comm, PERRANK_ICOLL_FUNCS, "mon_depth", mon_hook)
+        comm._coll_interposers.append("monitoring")

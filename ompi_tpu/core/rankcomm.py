@@ -134,6 +134,11 @@ class RankCommunicator:
         if owners is not None:
             self._owner_list = owners
             owners.append(self)
+        # the interposition tier of the coll framework (sync /
+        # monitoring) applies to per-rank comms too — same MCA vars,
+        # same boundary, wrapping the bound collective methods
+        from ompi_tpu.coll.interpose_perrank import interpose
+        interpose(self)
         self._seq = itertools.count(1)          # collective sequence
         self._create_seq = itertools.count(1)   # comm-creation sequence
         self._dev_fns: Dict[Any, Callable] = {}
@@ -482,21 +487,26 @@ class RankCommunicator:
         threading.Thread(target=run, daemon=True).start()
         return req
 
+    # The i-variants run the CLASS-level implementations, bypassing any
+    # interposition rebindings (coll/interpose_perrank): the stacked
+    # coll/sync component excludes i-slots for the same reason — the
+    # worker thread's fresh thread-local depth would race the sync op
+    # counter across ranks and desynchronize injected barriers.
     def ibarrier(self) -> Request:
-        return self._nb(self.barrier)
+        return self._nb(RankCommunicator.barrier, self)
 
     def ibcast(self, data: Any = None, root: int = 0) -> Request:
-        return self._nb(self.bcast, data, root)
+        return self._nb(RankCommunicator.bcast, self, data, root)
 
     def iallreduce(self, data: Any, op: op_mod.Op = op_mod.SUM) -> Request:
-        return self._nb(self.allreduce, data, op)
+        return self._nb(RankCommunicator.allreduce, self, data, op)
 
     def iallgather(self, data: Any) -> Request:
-        return self._nb(self.allgather, data)
+        return self._nb(RankCommunicator.allgather, self, data)
 
     def ireduce(self, data: Any, op: op_mod.Op = op_mod.SUM,
                 root: int = 0) -> Request:
-        return self._nb(self.reduce, data, op, root)
+        return self._nb(RankCommunicator.reduce, self, data, op, root)
 
     # ==================================================================
     # Collectives — device tier (XLA over the global mesh)

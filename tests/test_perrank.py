@@ -116,3 +116,21 @@ def test_perrank_ulfm_survives_real_death():
     count = res.stdout.count("OK p17_ulfm")
     assert count == 3, f"expected 3 survivor OKs, got {count}:\n" \
                        f"{res.stdout}\n--- err\n{res.stderr[-3000:]}"
+
+
+def test_perrank_coll_interposition():
+    """coll/sync + coll/monitoring interpose on per-rank communicators
+    through the same MCA vars as the stacked world (outermost-call
+    counting: internal composition never double-counts)."""
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("JAX_", "XLA_"))}
+    cmd = [sys.executable, _MPIRUN, "--per-rank", "-n", "3",
+           "--timeout", "150",
+           "--mca", "coll_sync_barrier_before", "3",
+           "--mca", "coll_monitoring_enable", "1",
+           os.path.join(_PROGS, "p24_interpose.py")]
+    res = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                         timeout=200, cwd=_REPO)
+    assert res.returncode == 0, \
+        f"rc={res.returncode}\n{res.stdout}\n--- err\n{res.stderr[-4000:]}"
+    assert res.stdout.count("OK p24_interpose") == 3, res.stdout
