@@ -75,6 +75,9 @@ typedef long MPI_Group;
 #define MPI_BOR     ((MPI_Op)9)
 #define MPI_BXOR    ((MPI_Op)10)
 
+typedef void (MPI_User_function)(void *invec, void *inoutvec, int *len,
+                                 MPI_Datatype *datatype);
+
 #define MPI_REQUEST_NULL ((MPI_Request)0)
 
 #define MPI_ERRORS_ARE_FATAL ((MPI_Errhandler)1)
@@ -276,6 +279,10 @@ int MPI_Group_difference(MPI_Group group1, MPI_Group group2,
                          MPI_Group *newgroup);
 int MPI_Group_free(MPI_Group *group);
 int MPI_Comm_create(MPI_Comm comm, MPI_Group group, MPI_Comm *newcomm);
+
+/* ---- user-defined reduction operations ---- */
+int MPI_Op_create(MPI_User_function *user_fn, int commute, MPI_Op *op);
+int MPI_Op_free(MPI_Op *op);
 
 #ifdef __cplusplus
 }

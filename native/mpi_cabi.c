@@ -1749,3 +1749,38 @@ int MPI_Comm_create(MPI_Comm comm, MPI_Group group, MPI_Comm *newcomm)
         *newcomm = (MPI_Comm)c;
     return rc;
 }
+
+/* ------------------------------------------------------------------ */
+/* user-defined reduction operations (MPI_Op_create / MPI_Op_free)     */
+/* ------------------------------------------------------------------ */
+int MPI_Op_create(MPI_User_function *user_fn, int commute, MPI_Op *op)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "op_create_c", "Li",
+                                      (long long)(intptr_t)user_fn,
+                                      commute);
+    if (!r)
+        rc = handle_error("MPI_Op_create");
+    else {
+        *op = (MPI_Op)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int MPI_Op_free(MPI_Op *op)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "op_free", "l", (long)*op);
+    if (!r)
+        rc = handle_error("MPI_Op_free");
+    else {
+        *op = MPI_OP_NULL;
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}

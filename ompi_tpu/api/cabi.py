@@ -82,6 +82,66 @@ _OPS = {
     5: op_mod.LAND, 6: op_mod.LOR, 7: op_mod.LXOR,
     8: op_mod.BAND, 9: op_mod.BOR, 10: op_mod.BXOR,
 }
+# user-defined ops (MPI_Op_create): handles >= 32, combiner = a real C
+# function pointer invoked through ctypes on the HOST reduction tier
+_FIRST_DYN_OP = 32
+_next_dyn_op = itertools.count(_FIRST_DYN_OP)
+_op_ctx = threading.local()              # .dt: in-flight reduction's
+#                                          datatype handle
+
+
+def _handle_for_dtype(d: np.dtype) -> int:
+    for h, dt in _DT.items():
+        if dt == d:
+            return h
+    return 0
+
+
+def op_create_c(fn_ptr: int, commute: int) -> int:
+    """MPI_Op_create: wrap a C ``void (*)(void *invec, void *inoutvec,
+    int *len, MPI_Datatype *dt)`` as a framework Op. The callback runs
+    on the host reduction tier (per-rank textbook algorithms,
+    coll/basic, reduce_local) — the tier where the reference's user
+    ops run too; device-path collectives cannot trace a C pointer and
+    keep using the host fold for non-predefined ops."""
+    import ctypes
+    cb = ctypes.CFUNCTYPE(
+        None, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_long))(fn_ptr)
+
+    def combine(a, b):
+        # MPI user-fn contract: inoutvec[i] = invec[i] OP inoutvec[i],
+        # so a left fold a OP b passes invec=a, inoutvec=b
+        a_arr = np.ascontiguousarray(np.asarray(a))
+        b_arr = np.ascontiguousarray(np.asarray(b)).copy()
+        if a_arr.dtype != b_arr.dtype:
+            a_arr = a_arr.astype(b_arr.dtype)
+        ln = ctypes.c_int(int(b_arr.size))
+        # the caller's ACTUAL handle (set by the collective entry
+        # points): aliased handles (INT64_T vs LONG, BYTE vs
+        # UNSIGNED_CHAR) are indistinguishable from the dtype alone
+        h = getattr(_op_ctx, "dt", 0) or _handle_for_dtype(b_arr.dtype)
+        dth = ctypes.c_long(h)
+        cb(a_arr.ctypes.data, b_arr.ctypes.data,
+           ctypes.byref(ln), ctypes.byref(dth))
+        return b_arr
+
+    op = op_mod.op_create(combine, commute=bool(commute),
+                          name=f"c_user@{fn_ptr:#x}")
+    op._c_callback = cb                  # keep the CFUNCTYPE alive
+    h = next(_next_dyn_op)
+    with _lock:
+        _OPS[h] = op
+    return h
+
+
+def op_free(o: int) -> None:
+    if o < _FIRST_DYN_OP:
+        raise MPIError(ERR_OP, "cannot free a predefined op")
+    with _lock:
+        if _OPS.pop(o, None) is None:
+            raise MPIError(ERR_OP, f"invalid op handle {o}")
 
 
 def _comm(h: int):
@@ -685,12 +745,20 @@ def bcast(h: int, view, dt: int, root: int) -> bytes:
 
 def reduce(h: int, view, dt: int, o: int, root: int) -> bytes:
     c = _comm(h)
-    r = c.reduce(_arr(view, dt), _op(o), root)
+    _op_ctx.dt = dt
+    try:
+        r = c.reduce(_arr(view, dt), _op(o), root)
+    finally:
+        _op_ctx.dt = 0
     return b"" if r is None else _out(r, dt)
 
 
 def allreduce(h: int, view, dt: int, o: int) -> bytes:
-    return _out(_comm(h).allreduce(_arr(view, dt), _op(o)), dt)
+    _op_ctx.dt = dt
+    try:
+        return _out(_comm(h).allreduce(_arr(view, dt), _op(o)), dt)
+    finally:
+        _op_ctx.dt = 0
 
 
 def gather(h: int, view, sdt: int, root: int, rdt: int) -> bytes:
@@ -731,12 +799,20 @@ def alltoall(h: int, view, sdt: int, percount: int, rdt: int) -> bytes:
 
 
 def scan(h: int, view, dt: int, o: int) -> bytes:
-    return _out(_comm(h).scan(_arr(view, dt), _op(o)), dt)
+    _op_ctx.dt = dt
+    try:
+        return _out(_comm(h).scan(_arr(view, dt), _op(o)), dt)
+    finally:
+        _op_ctx.dt = 0
 
 
 def exscan(h: int, view, dt: int, o: int) -> bytes:
     c = _comm(h)
-    r = c.exscan(_arr(view, dt), _op(o))
+    _op_ctx.dt = dt
+    try:
+        r = c.exscan(_arr(view, dt), _op(o))
+    finally:
+        _op_ctx.dt = 0
     if r is None:                        # rank 0: result undefined
         return _out(np.zeros_like(_arr(view, dt)), dt)
     return _out(r, dt)
@@ -807,7 +883,11 @@ def reduce_scatter_block(h: int, view, dt: int, o: int,
     c = _comm(h)
     a = _arr(view, dt)
     chunks = [a[i * recvcount:(i + 1) * recvcount] for i in range(c.size)]
-    return _out(c.reduce_scatter_block(chunks, _op(o)), dt)
+    _op_ctx.dt = dt
+    try:
+        return _out(c.reduce_scatter_block(chunks, _op(o)), dt)
+    finally:
+        _op_ctx.dt = 0
 
 
 def exc_code(exc: BaseException) -> int:

@@ -477,6 +477,16 @@ class RankCommunicator:
         req.wait = wait
 
         def run():
+            # Worker threads must never fire the coll interposition
+            # hooks: the class-level collective bodies still reach
+            # wrapped instance methods (self.reduce/self.bcast), and a
+            # fresh thread-local depth would let sync's op counter
+            # race across threads and desynchronize injected barriers
+            # between ranks (i-slots are interposition-exempt, like
+            # the stacked coll/sync component).
+            from ompi_tpu.coll.interpose_perrank import _tls as _itls
+            _itls.sync_depth = 1
+            _itls.mon_depth = 1
             from ompi_tpu.pml.perrank import _Msg
             try:
                 req._deliver(_Msg(self._rank, 0, fn(*args)))

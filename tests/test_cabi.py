@@ -31,6 +31,7 @@ CASES = [
     ("c05_types_v.c", 3),
     ("c06_cart.c", 4),
     ("c07_groups_persist.c", 4),
+    ("c08_userop.c", 3),
 ]
 
 
