@@ -92,6 +92,16 @@ typedef void (MPI_User_function)(void *invec, void *inoutvec, int *len,
 #define MPI_IN_PLACE    ((void *)1)
 
 #define MPI_MAX_PROCESSOR_NAME  256
+#define MPI_MAX_LIBRARY_VERSION_STRING 256
+
+/* MPI_Comm_split_type types / MPI_Comm_compare results */
+#define MPI_COMM_TYPE_SHARED 1
+#define MPI_IDENT     0
+#define MPI_CONGRUENT 1
+#define MPI_SIMILAR   2
+#define MPI_UNEQUAL   3
+typedef long MPI_Info;
+#define MPI_INFO_NULL ((MPI_Info)0)
 #define MPI_MAX_ERROR_STRING    256
 
 /* ---- error classes (core/errhandler.py values) ---- */
@@ -283,6 +293,26 @@ int MPI_Comm_create(MPI_Comm comm, MPI_Group group, MPI_Comm *newcomm);
 /* ---- user-defined reduction operations ---- */
 int MPI_Op_create(MPI_User_function *user_fn, int commute, MPI_Op *op);
 int MPI_Op_free(MPI_Op *op);
+
+/* ---- request-set completion + remaining textbook surface ---- */
+int MPI_Testall(int count, MPI_Request array_of_requests[], int *flag,
+                MPI_Status array_of_statuses[]);
+int MPI_Testany(int count, MPI_Request array_of_requests[], int *indx,
+                int *flag, MPI_Status *status);
+int MPI_Waitany(int count, MPI_Request array_of_requests[], int *indx,
+                MPI_Status *status);
+int MPI_Waitsome(int incount, MPI_Request array_of_requests[],
+                 int *outcount, int array_of_indices[],
+                 MPI_Status array_of_statuses[]);
+int MPI_Bsend(const void *buf, int count, MPI_Datatype datatype,
+              int dest, int tag, MPI_Comm comm);
+int MPI_Rsend(const void *buf, int count, MPI_Datatype datatype,
+              int dest, int tag, MPI_Comm comm);
+int MPI_Comm_split_type(MPI_Comm comm, int split_type, int key,
+                        MPI_Info info, MPI_Comm *newcomm);
+int MPI_Comm_compare(MPI_Comm comm1, MPI_Comm comm2, int *result);
+int MPI_Get_version(int *version, int *subversion);
+int MPI_Get_library_version(char *version, int *resultlen);
 
 #ifdef __cplusplus
 }

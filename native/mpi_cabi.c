@@ -1784,3 +1784,174 @@ int MPI_Op_free(MPI_Op *op)
     GIL_END;
     return rc;
 }
+
+/* ------------------------------------------------------------------ */
+/* request-set completion + remaining textbook surface                 */
+/* ------------------------------------------------------------------ */
+int MPI_Testall(int count, MPI_Request array_of_requests[], int *flag,
+                MPI_Status array_of_statuses[])
+{
+    *flag = 1;
+    for (int i = 0; i < count; i++) {
+        /* Requests completed by an EARLIER Testall pass are
+         * REQUEST_NULL here; their status slot was filled correctly
+         * then and must not be clobbered with the empty status —
+         * skip them (they count as complete). */
+        if (array_of_requests[i] == MPI_REQUEST_NULL)
+            continue;
+        int f = 0;
+        int rc = MPI_Test(&array_of_requests[i], &f,
+                          array_of_statuses ? &array_of_statuses[i]
+                                            : MPI_STATUS_IGNORE);
+        if (rc != MPI_SUCCESS)
+            return rc;
+        if (!f)
+            *flag = 0;
+    }
+    return MPI_SUCCESS;
+}
+
+int MPI_Testany(int count, MPI_Request array_of_requests[], int *indx,
+                int *flag, MPI_Status *status)
+{
+    *flag = 0;
+    *indx = MPI_UNDEFINED;
+    int all_null = 1;
+    for (int i = 0; i < count; i++) {
+        if (array_of_requests[i] == MPI_REQUEST_NULL)
+            continue;
+        all_null = 0;
+        int f = 0;
+        int rc = MPI_Test(&array_of_requests[i], &f, status);
+        if (rc != MPI_SUCCESS) {
+            *indx = i;                   /* the caller must know WHICH
+                                          * request completed in error
+                                          * (ULFM repost bookkeeping) */
+            *flag = 1;
+            return rc;
+        }
+        if (f) {
+            *flag = 1;
+            *indx = i;
+            return MPI_SUCCESS;
+        }
+    }
+    if (all_null)
+        *flag = 1;                       /* standard: flag=1, UNDEFINED */
+    return MPI_SUCCESS;
+}
+
+int MPI_Waitany(int count, MPI_Request array_of_requests[], int *indx,
+                MPI_Status *status)
+{
+    for (;;) {
+        int flag = 0;
+        int rc = MPI_Testany(count, array_of_requests, indx, &flag,
+                             status);
+        if (rc != MPI_SUCCESS)
+            return rc;
+        if (flag)
+            return MPI_SUCCESS;
+        /* yield between polls: completion is produced by btl reader
+         * threads that need the GIL and the core */
+        struct timespec ts = {0, 200000};    /* 200 us */
+        nanosleep(&ts, NULL);
+    }
+}
+
+int MPI_Waitsome(int incount, MPI_Request array_of_requests[],
+                 int *outcount, int array_of_indices[],
+                 MPI_Status array_of_statuses[])
+{
+    *outcount = 0;
+    int all_null = 1;
+    for (int i = 0; i < incount; i++)
+        if (array_of_requests[i] != MPI_REQUEST_NULL)
+            all_null = 0;
+    if (all_null) {
+        *outcount = MPI_UNDEFINED;
+        return MPI_SUCCESS;
+    }
+    for (;;) {
+        for (int i = 0; i < incount; i++) {
+            if (array_of_requests[i] == MPI_REQUEST_NULL)
+                continue;
+            int f = 0;
+            int rc = MPI_Test(&array_of_requests[i], &f,
+                              array_of_statuses
+                                  ? &array_of_statuses[*outcount]
+                                  : MPI_STATUS_IGNORE);
+            if (rc != MPI_SUCCESS) {
+                /* record the erroring request: it WAS consumed */
+                array_of_indices[(*outcount)++] = i;
+                return rc;
+            }
+            if (f)
+                array_of_indices[(*outcount)++] = i;
+        }
+        if (*outcount > 0)
+            return MPI_SUCCESS;
+        struct timespec ts = {0, 200000};
+        nanosleep(&ts, NULL);
+    }
+}
+
+/* buffered/ready sends: the eager btl transport buffers every send, so
+ * both reduce to standard send (the reference's bsend also degenerates
+ * to eager below the buffer threshold; rsend's "receive must be
+ * posted" precondition is the caller's promise, not checked) */
+int MPI_Bsend(const void *buf, int count, MPI_Datatype datatype,
+              int dest, int tag, MPI_Comm comm)
+{
+    return MPI_Send(buf, count, datatype, dest, tag, comm);
+}
+
+int MPI_Rsend(const void *buf, int count, MPI_Datatype datatype,
+              int dest, int tag, MPI_Comm comm)
+{
+    return MPI_Send(buf, count, datatype, dest, tag, comm);
+}
+
+int MPI_Comm_split_type(MPI_Comm comm, int split_type, int key,
+                        MPI_Info info, MPI_Comm *newcomm)
+{
+    (void)info;
+    long c;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "comm_split_type", "lii",
+                                      (long)comm, split_type, key);
+    if (!r)
+        rc = handle_error("MPI_Comm_split_type");
+    else {
+        c = PyLong_AsLong(r);
+        *newcomm = (MPI_Comm)c;
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int MPI_Comm_compare(MPI_Comm comm1, MPI_Comm comm2, int *result)
+{
+    long v;
+    int rc = group_call2("comm_compare", (long)comm1, (long)comm2, &v);
+    if (rc == MPI_SUCCESS)
+        *result = (int)v;
+    return rc;
+}
+
+int MPI_Get_version(int *version, int *subversion)
+{
+    *version = 3;
+    *subversion = 1;
+    return MPI_SUCCESS;
+}
+
+int MPI_Get_library_version(char *version, int *resultlen)
+{
+    snprintf(version, MPI_MAX_LIBRARY_VERSION_STRING,
+             "ompi_tpu (TPU-native MPI over XLA/ICI), MPI 3.1 subset");
+    *resultlen = (int)strlen(version);
+    return MPI_SUCCESS;
+}

@@ -585,6 +585,28 @@ def comm_set_errhandler(h: int, which: int) -> None:
         c.errhandler = handler
 
 
+def comm_split_type(h: int, split_type: int, key: int) -> int:
+    sub = _comm(h).split_type(split_type, key)
+    if sub is None:                      # MPI_UNDEFINED
+        return COMM_NULL
+    return _register_comm(sub)
+
+
+def comm_compare(a: int, b: int) -> int:
+    """MPI_Comm_compare: IDENT(0) same object, CONGRUENT(1) same group
+    same order, SIMILAR(2) same members, UNEQUAL(3)."""
+    ca, cb = _comm(a), _comm(b)
+    if ca is cb:
+        return 0
+    ga = list(ca.group.world_ranks)
+    gb = list(cb.group.world_ranks)
+    if ga == gb:
+        return 1
+    if sorted(ga) == sorted(gb):
+        return 2
+    return 3
+
+
 def comm_free(h: int) -> None:
     if h in (COMM_WORLD, COMM_SELF):
         raise MPIError(ERR_COMM, "cannot free a predefined communicator")

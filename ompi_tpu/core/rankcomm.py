@@ -659,10 +659,14 @@ class RankCommunicator:
             return None
         if split_type == 2:                 # COMM_TYPE_HWTHREAD
             color = self._rank
-        else:                               # SHARED / NUMA: same host
+        elif split_type in (1, 3):          # SHARED / NUMA: same host
             import socket
             names = self.allgather(socket.gethostname())
             color = names.index(names[self._rank])
+        else:                               # match Communicator's
+            self._err(ERR_ARG,              # validation, not a silent
+                      f"unknown split_type {split_type}")  # SHARED
+            return None
         return self.split(color, key)
 
     def dup(self, info: Optional[Info] = None) -> "RankCommunicator":

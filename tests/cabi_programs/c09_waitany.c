@@ -1,0 +1,107 @@
+/* Master/worker with MPI_Waitany (the textbook dynamic-dispatch
+ * idiom), Testall/Waitsome, Bsend/Rsend, Comm_split_type(SHARED),
+ * Comm_compare, and library version queries. */
+#include <mpi.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#define CHECK(cond, code)                                            \
+    do {                                                             \
+        if (!(cond)) {                                               \
+            fprintf(stderr, "rank %d: check failed at line %d\n",    \
+                    rank, __LINE__);                                 \
+            MPI_Abort(MPI_COMM_WORLD, code);                         \
+        }                                                            \
+    } while (0)
+
+int main(int argc, char **argv)
+{
+    MPI_Init(&argc, &argv);
+    int rank, size;
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &size);
+    CHECK(size >= 3, 1);
+
+    if (rank == 0) {
+        /* master: one outstanding irecv per worker, service whichever
+         * finishes first until every worker sent 2 results */
+        int nw = size - 1;
+        MPI_Request *reqs = (MPI_Request *)
+            malloc((size_t)nw * sizeof(MPI_Request));
+        int *vals = (int *)malloc((size_t)nw * sizeof(int));
+        int *seen = (int *)calloc((size_t)nw, sizeof(int));
+        for (int w = 0; w < nw; w++)
+            MPI_Irecv(&vals[w], 1, MPI_INT, w + 1, 50, MPI_COMM_WORLD,
+                      &reqs[w]);
+        int done = 0;
+        while (done < 2 * nw) {
+            int idx;
+            MPI_Status st;
+            MPI_Waitany(nw, reqs, &idx, &st);
+            CHECK(idx != MPI_UNDEFINED, 2);
+            CHECK(st.MPI_SOURCE == idx + 1, 3);
+            CHECK(vals[idx] == (idx + 1) * 1000 + seen[idx], 4);
+            seen[idx]++;
+            done++;
+            if (seen[idx] < 2)
+                MPI_Irecv(&vals[idx], 1, MPI_INT, idx + 1, 50,
+                          MPI_COMM_WORLD, &reqs[idx]);
+            else
+                reqs[idx] = MPI_REQUEST_NULL;
+        }
+        free(reqs); free(vals); free(seen);
+    } else {
+        for (int i = 0; i < 2; i++) {
+            int v = rank * 1000 + i;
+            if (i == 0)
+                MPI_Bsend(&v, 1, MPI_INT, 0, 50, MPI_COMM_WORLD);
+            else
+                MPI_Rsend(&v, 1, MPI_INT, 0, 50, MPI_COMM_WORLD);
+        }
+    }
+    MPI_Barrier(MPI_COMM_WORLD);
+
+    /* Testall over a send/recv pair */
+    int a = rank, b = -1;
+    int right = (rank + 1) % size, left = (rank - 1 + size) % size;
+    MPI_Request pair[2];
+    MPI_Irecv(&b, 1, MPI_INT, left, 60, MPI_COMM_WORLD, &pair[0]);
+    MPI_Isend(&a, 1, MPI_INT, right, 60, MPI_COMM_WORLD, &pair[1]);
+    int flag = 0;
+    while (!flag)
+        MPI_Testall(2, pair, &flag, MPI_STATUSES_IGNORE);
+    CHECK(b == left, 5);
+
+    /* split_type SHARED: everyone is on one host here */
+    MPI_Comm node;
+    MPI_Comm_split_type(MPI_COMM_WORLD, MPI_COMM_TYPE_SHARED, rank,
+                        MPI_INFO_NULL, &node);
+    int nsz;
+    MPI_Comm_size(node, &nsz);
+    CHECK(nsz == size, 6);
+
+    /* compare: dup is CONGRUENT, node comm vs world here SIMILAR or
+     * CONGRUENT depending on ordering; world vs world is IDENT */
+    int cmp;
+    MPI_Comm_compare(MPI_COMM_WORLD, MPI_COMM_WORLD, &cmp);
+    CHECK(cmp == MPI_IDENT, 7);
+    MPI_Comm dup;
+    MPI_Comm_dup(MPI_COMM_WORLD, &dup);
+    MPI_Comm_compare(MPI_COMM_WORLD, dup, &cmp);
+    CHECK(cmp == MPI_CONGRUENT, 8);
+    MPI_Comm_free(&dup);
+    MPI_Comm_free(&node);
+
+    int ver, sub;
+    MPI_Get_version(&ver, &sub);
+    CHECK(ver == 3 && sub == 1, 9);
+    char lib[MPI_MAX_LIBRARY_VERSION_STRING];
+    int ll;
+    MPI_Get_library_version(lib, &ll);
+    CHECK(ll > 0 && strstr(lib, "ompi_tpu") != NULL, 10);
+
+    MPI_Finalize();
+    printf("OK c09_waitany rank=%d/%d\n", rank, size);
+    return 0;
+}

@@ -32,6 +32,7 @@ CASES = [
     ("c06_cart.c", 4),
     ("c07_groups_persist.c", 4),
     ("c08_userop.c", 3),
+    ("c09_waitany.c", 3),
 ]
 
 
