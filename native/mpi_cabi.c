@@ -1788,27 +1788,54 @@ int MPI_Op_free(MPI_Op *op)
 /* ------------------------------------------------------------------ */
 /* request-set completion + remaining textbook surface                 */
 /* ------------------------------------------------------------------ */
+static int req_peek_done(MPI_Request req)
+{
+    if (req == MPI_REQUEST_NULL)
+        return 1;
+    req_entry *e = (req_entry *)(intptr_t)req;
+    if (e->persistent && e->pyh == 0)
+        return 1;                        /* inactive: trivially done */
+    GIL_BEGIN;
+    int done = 0;
+    PyObject *r = PyObject_CallMethod(g_mod, "test_peek", "l", e->pyh);
+    if (r) {
+        done = (int)PyLong_AsLong(r);
+        Py_DECREF(r);
+    } else {
+        PyErr_Clear();
+        done = 1;                        /* broken handle: let the
+                                          * consuming path surface it */
+    }
+    GIL_END;
+    return done;
+}
+
 int MPI_Testall(int count, MPI_Request array_of_requests[], int *flag,
                 MPI_Status array_of_statuses[])
 {
-    *flag = 1;
+    /* The standard's contract: flag=false modifies NOTHING. A
+     * non-consuming peek pass decides; only when every request is
+     * ready does the consuming pass complete them and fill statuses
+     * (NULL slots get the empty status, as MPI_Test would). */
     for (int i = 0; i < count; i++) {
-        /* Requests completed by an EARLIER Testall pass are
-         * REQUEST_NULL here; their status slot was filled correctly
-         * then and must not be clobbered with the empty status —
-         * skip them (they count as complete). */
-        if (array_of_requests[i] == MPI_REQUEST_NULL)
-            continue;
-        int f = 0;
-        int rc = MPI_Test(&array_of_requests[i], &f,
-                          array_of_statuses ? &array_of_statuses[i]
-                                            : MPI_STATUS_IGNORE);
-        if (rc != MPI_SUCCESS)
-            return rc;
-        if (!f)
+        if (!req_peek_done(array_of_requests[i])) {
             *flag = 0;
+            return MPI_SUCCESS;
+        }
     }
-    return MPI_SUCCESS;
+    *flag = 1;
+    int rc = MPI_SUCCESS;
+    for (int i = 0; i < count; i++) {
+        int f = 0;
+        int r = MPI_Test(&array_of_requests[i], &f,
+                         array_of_statuses ? &array_of_statuses[i]
+                                           : MPI_STATUS_IGNORE);
+        if (r != MPI_SUCCESS && rc == MPI_SUCCESS)
+            rc = r;                      /* complete the rest anyway:
+                                          * all were ready; report the
+                                          * first error class */
+    }
+    return rc;
 }
 
 int MPI_Testany(int count, MPI_Request array_of_requests[], int *indx,
@@ -1836,8 +1863,11 @@ int MPI_Testany(int count, MPI_Request array_of_requests[], int *indx,
             return MPI_SUCCESS;
         }
     }
-    if (all_null)
-        *flag = 1;                       /* standard: flag=1, UNDEFINED */
+    if (all_null) {
+        *flag = 1;                       /* standard: flag=1, UNDEFINED,
+                                          * EMPTY status */
+        set_status(status, MPI_ANY_SOURCE, MPI_ANY_TAG, 0);
+    }
     return MPI_SUCCESS;
 }
 
@@ -1954,4 +1984,167 @@ int MPI_Get_library_version(char *version, int *resultlen)
              "ompi_tpu (TPU-native MPI over XLA/ICI), MPI 3.1 subset");
     *resultlen = (int)strlen(version);
     return MPI_SUCCESS;
+}
+
+/* ------------------------------------------------------------------ */
+/* nonblocking collectives + pack/unpack + sendrecv_replace            */
+/* ------------------------------------------------------------------ */
+static int icoll_request(PyObject *r, void *buf, size_t cap,
+                         MPI_Request *request, const char *fn)
+{
+    if (!r)
+        return handle_error(fn);
+    req_entry *e = req_new();
+    e->pyh = PyLong_AsLong(r);
+    e->buf = buf;
+    e->cap = cap;
+    Py_DECREF(r);
+    *request = (MPI_Request)(intptr_t)e;
+    return MPI_SUCCESS;
+}
+
+int MPI_Ibarrier(MPI_Comm comm, MPI_Request *request)
+{
+    GIL_BEGIN;
+    PyObject *r = PyObject_CallMethod(g_mod, "ibarrier", "l",
+                                      (long)comm);
+    int rc = icoll_request(r, NULL, 0, request, "MPI_Ibarrier");
+    GIL_END;
+    return rc;
+}
+
+int MPI_Ibcast(void *buffer, int count, MPI_Datatype datatype, int root,
+               MPI_Comm comm, MPI_Request *request)
+{
+    size_t esz = dt_extent(datatype);
+    if (!esz || count < 0)
+        return MPI_ERR_TYPE;
+    size_t nbytes = (size_t)count * esz;
+    GIL_BEGIN;
+    PyObject *r = PyObject_CallMethod(g_mod, "ibcast", "lNli",
+                                      (long)comm,
+                                      mem_ro(buffer, nbytes),
+                                      (long)datatype, root);
+    int rc = icoll_request(r, buffer, nbytes, request, "MPI_Ibcast");
+    GIL_END;
+    return rc;
+}
+
+int MPI_Iallreduce(const void *sendbuf, void *recvbuf, int count,
+                   MPI_Datatype datatype, MPI_Op op, MPI_Comm comm,
+                   MPI_Request *request)
+{
+    size_t esz = dt_extent(datatype);
+    if (!esz || count < 0)
+        return MPI_ERR_TYPE;
+    size_t nbytes = (size_t)count * esz;
+    GIL_BEGIN;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "iallreduce", "lNll", (long)comm,
+        mem_ro(sendbuf == MPI_IN_PLACE ? recvbuf : sendbuf, nbytes),
+        (long)datatype, (long)op);
+    int rc = icoll_request(r, recvbuf, nbytes, request,
+                           "MPI_Iallreduce");
+    GIL_END;
+    return rc;
+}
+
+int MPI_Pack(const void *inbuf, int incount, MPI_Datatype datatype,
+             void *outbuf, int outsize, int *position, MPI_Comm comm)
+{
+    (void)comm;
+    size_t esz = dt_extent(datatype);
+    if (!esz || incount < 0)
+        return MPI_ERR_TYPE;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "pack", "Nli", mem_ro(inbuf, (size_t)incount * esz),
+        (long)datatype, incount);
+    if (!r)
+        rc = handle_error("MPI_Pack");
+    else {
+        char *p;
+        Py_ssize_t n;
+        if (PyBytes_AsStringAndSize(r, &p, &n) == 0) {
+            if (*position + n > outsize)
+                rc = MPI_ERR_TRUNCATE;
+            else {
+                memcpy((char *)outbuf + *position, p, (size_t)n);
+                *position += (int)n;
+            }
+        }
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int MPI_Unpack(const void *inbuf, int insize, int *position,
+               void *outbuf, int outcount, MPI_Datatype datatype,
+               MPI_Comm comm)
+{
+    (void)comm;
+    size_t esz = dt_extent(datatype);
+    size_t sig = dt_sig(datatype);
+    if (!esz || outcount < 0)
+        return MPI_ERR_TYPE;
+    size_t need = sig * (size_t)outcount;
+    if (*position + (int)need > insize)
+        return MPI_ERR_TRUNCATE;
+    size_t extent_bytes = (size_t)outcount * esz;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "unpack", "NliN",
+        mem_ro((const char *)inbuf + *position, need), (long)datatype,
+        outcount,
+        mem_ro(outbuf, datatype >= DT_FIRST_DYN ? extent_bytes : 0));
+    if (!r)
+        rc = handle_error("MPI_Unpack");
+    else {
+        rc = copy_bytes(r, outbuf, extent_bytes);
+        if (rc == MPI_SUCCESS)
+            *position += (int)need;
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int MPI_Pack_size(int incount, MPI_Datatype datatype, MPI_Comm comm,
+                  int *size)
+{
+    (void)comm;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "pack_size", "li",
+                                      (long)datatype, incount);
+    if (!r)
+        rc = handle_error("MPI_Pack_size");
+    else {
+        *size = (int)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int MPI_Sendrecv_replace(void *buf, int count, MPI_Datatype datatype,
+                         int dest, int sendtag, int source, int recvtag,
+                         MPI_Comm comm, MPI_Status *status)
+{
+    size_t esz = dt_extent(datatype);
+    if (!esz || count < 0)
+        return MPI_ERR_TYPE;
+    /* the C-side temporary IS the replace semantics: send from the
+     * copy, receive into the caller's buffer */
+    size_t nbytes = (size_t)count * esz;
+    char *tmp = (char *)malloc(nbytes ? nbytes : 1);
+    memcpy(tmp, buf, nbytes);
+    int rc = MPI_Sendrecv(tmp, count, datatype, dest, sendtag, buf,
+                          count, datatype, source, recvtag, comm,
+                          status);
+    free(tmp);
+    return rc;
 }

@@ -757,6 +757,79 @@ def barrier(h: int) -> None:
     _comm(h).barrier()
 
 
+def _icoll_handle(req, dt: int, snap: bytes = b"") -> int:
+    with _lock:
+        rh = next(_next_req)
+        _requests[rh] = (req, dt, snap)
+    return rh
+
+
+def ibarrier(h: int) -> int:
+    """MPI_Ibarrier -> a request handle the existing wait/test paths
+    complete (payload empty)."""
+    return _icoll_handle(_comm(h).ibarrier(), 4)   # BYTE: no payload
+
+
+def ibcast(h: int, view, dt: int, root: int) -> int:
+    c = _comm(h)
+    cnt = _count_of(view, dt)
+    data = _pack(view, dt, cnt) if c.rank() == root else None
+    # the buffer snapshot makes derived-type completion unpack into a
+    # real extent image (same contract as the blocking bcast)
+    return _icoll_handle(c.ibcast(data, root), dt, bytes(view))
+
+
+def iallreduce(h: int, view, dt: int, o: int) -> int:
+    # notes: the fold runs on a worker thread, so a C user op's
+    # datatype handle comes from the dtype reverse map there (the
+    # thread-local _op_ctx only covers blocking reductions); for
+    # derived types the overlay base is the SEND buffer image (the
+    # recv buffer's gap bytes are not round-tripped through this path)
+    c = _comm(h)
+    snap = bytes(view)
+    req = c.iallreduce(_pack(view, dt, _count_of(view, dt)), _op(o))
+    return _icoll_handle(req, dt, snap)
+
+
+def test_peek(rh: int) -> int:
+    """Non-consuming completion probe: 1 if wait/test would complete
+    immediately (including completed-in-error). Lets MPI_Testall keep
+    the standard's all-or-nothing contract — no request is consumed
+    until every one is ready."""
+    req, _dt, _snap = _take_req(rh)
+    done = getattr(req, "_complete", False)
+    if not done:
+        try:
+            done, _ = req.test()
+        except BaseException:
+            return 1                     # completed in error: done
+        if done:
+            # the request completed just now — but test() on our
+            # request types does not deliver payloads, so nothing is
+            # consumed; the later consuming call replays it
+            return 1
+    return int(bool(done))
+
+
+def pack(view, dt: int, count: int) -> bytes:
+    """MPI_Pack: the significant bytes of count elements (contiguous
+    packing — the convertor's gather side)."""
+    return _pack(view, dt, count).tobytes()
+
+
+def unpack(data_view, dt: int, count: int, curview) -> bytes:
+    """MPI_Unpack: scatter packed elements into a full-extent buffer
+    image (gaps preserved for derived types)."""
+    base, _, _ = _type_parts(dt)
+    flat = np.frombuffer(data_view, dtype=base)
+    return _unpack(flat, dt, count, bytes(curview))[0]
+
+
+def pack_size(dt: int, count: int) -> int:
+    """MPI_Pack_size: an upper bound on packed bytes."""
+    return type_size_bytes(dt) * count
+
+
 def bcast(h: int, view, dt: int, root: int) -> bytes:
     c = _comm(h)
     cnt = _count_of(view, dt)

@@ -1,0 +1,99 @@
+/* Nonblocking collectives (Ibarrier/Ibcast/Iallreduce completing
+ * through Wait/Test), MPI_Pack/Unpack round-trips including a strided
+ * vector type, Pack_size, and Sendrecv_replace ring rotation. */
+#include <mpi.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+#define CHECK(cond, code)                                            \
+    do {                                                             \
+        if (!(cond)) {                                               \
+            fprintf(stderr, "rank %d: check failed at line %d\n",    \
+                    rank, __LINE__);                                 \
+            MPI_Abort(MPI_COMM_WORLD, code);                         \
+        }                                                            \
+    } while (0)
+
+int main(int argc, char **argv)
+{
+    MPI_Init(&argc, &argv);
+    int rank, size;
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &size);
+
+    /* ibcast + iallreduce overlap a bit of "compute", complete via
+     * Wait and Test */
+    double v[4] = {0, 0, 0, 0};
+    if (rank == 0) {
+        v[0] = 3.5; v[1] = -1.0; v[2] = 2.0; v[3] = 8.0;
+    }
+    MPI_Request rb;
+    MPI_Ibcast(v, 4, MPI_DOUBLE, 0, MPI_COMM_WORLD, &rb);
+    double acc = 0;
+    for (int i = 0; i < 1000; i++)
+        acc += i * 0.5;                  /* overlapped host compute */
+    MPI_Wait(&rb, MPI_STATUS_IGNORE);
+    CHECK(v[0] == 3.5 && v[3] == 8.0, 2);
+    CHECK(acc > 0, 3);
+
+    int mine = rank + 1, sum = -1;
+    MPI_Request ra;
+    MPI_Iallreduce(&mine, &sum, 1, MPI_INT, MPI_SUM, MPI_COMM_WORLD,
+                   &ra);
+    int done = 0;
+    while (!done)
+        MPI_Test(&ra, &done, MPI_STATUS_IGNORE);
+    CHECK(sum == size * (size + 1) / 2, 4);
+
+    MPI_Request rbar;
+    MPI_Ibarrier(MPI_COMM_WORLD, &rbar);
+    MPI_Wait(&rbar, MPI_STATUS_IGNORE);
+
+    /* pack two ints + a strided vector column, unpack and verify */
+    MPI_Datatype col;
+    MPI_Type_vector(3, 1, 4, MPI_DOUBLE, &col);
+    MPI_Type_commit(&col);
+    int psz_i, psz_c;
+    MPI_Pack_size(2, MPI_INT, MPI_COMM_WORLD, &psz_i);
+    MPI_Pack_size(1, col, MPI_COMM_WORLD, &psz_c);
+    CHECK(psz_i == 8, 5);
+    CHECK(psz_c == 3 * (int)sizeof(double), 6);
+
+    char packbuf[256];
+    int pos = 0;
+    int ints[2] = {7 + rank, 11};
+    double m[12];
+    for (int i = 0; i < 12; i++)
+        m[i] = rank * 100.0 + i;
+    MPI_Pack(ints, 2, MPI_INT, packbuf, sizeof packbuf, &pos,
+             MPI_COMM_WORLD);
+    MPI_Pack(&m[1], 1, col, packbuf, sizeof packbuf, &pos,
+             MPI_COMM_WORLD);
+    CHECK(pos == psz_i + psz_c, 7);
+
+    int upos = 0;
+    int ints2[2] = {0, 0};
+    double m2[12];
+    for (int i = 0; i < 12; i++)
+        m2[i] = -1.0;
+    MPI_Unpack(packbuf, pos, &upos, ints2, 2, MPI_INT, MPI_COMM_WORLD);
+    MPI_Unpack(packbuf, pos, &upos, &m2[1], 1, col, MPI_COMM_WORLD);
+    CHECK(ints2[0] == 7 + rank && ints2[1] == 11, 8);
+    for (int i = 0; i < 3; i++)
+        CHECK(m2[1 + 4 * i] == rank * 100.0 + 1 + 4 * i, 9);
+    CHECK(m2[0] == -1.0 && m2[2] == -1.0, 10);   /* gaps untouched */
+    MPI_Type_free(&col);
+
+    /* sendrecv_replace: rotate a token around the ring in place */
+    int right = (rank + 1) % size, left = (rank - 1 + size) % size;
+    long token = 1000 + rank;
+    MPI_Status st;
+    MPI_Sendrecv_replace(&token, 1, MPI_LONG, right, 70, left, 70,
+                         MPI_COMM_WORLD, &st);
+    CHECK(token == 1000 + left, 11);
+    CHECK(st.MPI_SOURCE == left, 12);
+
+    MPI_Finalize();
+    printf("OK c10_icoll_pack rank=%d/%d\n", rank, size);
+    return 0;
+}

@@ -33,6 +33,7 @@ CASES = [
     ("c07_groups_persist.c", 4),
     ("c08_userop.c", 3),
     ("c09_waitany.c", 3),
+    ("c10_icoll_pack.c", 3),
 ]
 
 
