@@ -1832,9 +1832,13 @@ int MPI_Testall(int count, MPI_Request array_of_requests[], int *flag,
                                            : MPI_STATUS_IGNORE);
         if (r != MPI_SUCCESS && rc == MPI_SUCCESS)
             rc = r;                      /* complete the rest anyway:
-                                          * all were ready; report the
-                                          * first error class */
+                                          * all were ready */
     }
+    /* multi-completion contract: with a statuses array the aggregate
+     * error is ERR_IN_STATUS and each slot's MPI_ERROR says which
+     * request failed; without one, the first class is all we have */
+    if (rc != MPI_SUCCESS && array_of_statuses)
+        rc = MPI_ERR_IN_STATUS;
     return rc;
 }
 
@@ -2090,7 +2094,9 @@ int MPI_Unpack(const void *inbuf, int insize, int *position,
     if (!esz || outcount < 0)
         return MPI_ERR_TYPE;
     size_t need = sig * (size_t)outcount;
-    if (*position + (int)need > insize)
+    /* size_t arithmetic end to end: an int cast of a >2 GiB payload
+     * would wrap negative and bypass the bounds check */
+    if ((size_t)*position + need > (size_t)insize)
         return MPI_ERR_TRUNCATE;
     size_t extent_bytes = (size_t)outcount * esz;
     GIL_BEGIN;
@@ -2141,6 +2147,8 @@ int MPI_Sendrecv_replace(void *buf, int count, MPI_Datatype datatype,
      * copy, receive into the caller's buffer */
     size_t nbytes = (size_t)count * esz;
     char *tmp = (char *)malloc(nbytes ? nbytes : 1);
+    if (!tmp)
+        return MPI_ERR_INTERN;
     memcpy(tmp, buf, nbytes);
     int rc = MPI_Sendrecv(tmp, count, datatype, dest, sendtag, buf,
                           count, datatype, source, recvtag, comm,

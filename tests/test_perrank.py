@@ -42,6 +42,7 @@ CASES = [
     ("p21_mpiio.py", 3),
     ("p22_part_sync.py", 3),
     ("p23_sessions.py", 3),
+    ("p25_thread_multiple.py", 2),
 ]
 
 
