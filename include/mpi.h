@@ -102,6 +102,10 @@ typedef void (MPI_User_function)(void *invec, void *inoutvec, int *len,
 #define MPI_UNEQUAL   3
 typedef long MPI_Info;
 #define MPI_INFO_NULL ((MPI_Info)0)
+typedef long MPI_Win;
+#define MPI_WIN_NULL ((MPI_Win)0)
+#define MPI_LOCK_EXCLUSIVE 1
+#define MPI_LOCK_SHARED    2
 #define MPI_MAX_ERROR_STRING    256
 
 /* ---- error classes (core/errhandler.py values) ---- */
@@ -333,6 +337,27 @@ int MPI_Pack_size(int incount, MPI_Datatype datatype, MPI_Comm comm,
 int MPI_Sendrecv_replace(void *buf, int count, MPI_Datatype datatype,
                          int dest, int sendtag, int source, int recvtag,
                          MPI_Comm comm, MPI_Status *status);
+
+/* ---- one-sided RMA (window-allocated memory) ---- */
+int MPI_Win_allocate(MPI_Aint size, int disp_unit, MPI_Info info,
+                     MPI_Comm comm, void *baseptr, MPI_Win *win);
+int MPI_Win_free(MPI_Win *win);
+int MPI_Win_fence(int assert_, MPI_Win win);
+int MPI_Win_lock(int lock_type, int rank, int assert_, MPI_Win win);
+int MPI_Win_unlock(int rank, MPI_Win win);
+int MPI_Put(const void *origin_addr, int origin_count,
+            MPI_Datatype origin_datatype, int target_rank,
+            MPI_Aint target_disp, int target_count,
+            MPI_Datatype target_datatype, MPI_Win win);
+int MPI_Get(void *origin_addr, int origin_count,
+            MPI_Datatype origin_datatype, int target_rank,
+            MPI_Aint target_disp, int target_count,
+            MPI_Datatype target_datatype, MPI_Win win);
+int MPI_Accumulate(const void *origin_addr, int origin_count,
+                   MPI_Datatype origin_datatype, int target_rank,
+                   MPI_Aint target_disp, int target_count,
+                   MPI_Datatype target_datatype, MPI_Op op,
+                   MPI_Win win);
 
 #ifdef __cplusplus
 }

@@ -2156,3 +2156,156 @@ int MPI_Sendrecv_replace(void *buf, int count, MPI_Datatype datatype,
     free(tmp);
     return rc;
 }
+
+/* ------------------------------------------------------------------ */
+/* one-sided RMA (MPI_Win_allocate family)                             */
+/* ------------------------------------------------------------------ */
+/* MPI_Win IS the glue window handle (a long): the disp-unit table
+ * lives with the window object in the binding layer, scaled by the
+ * TARGET's declared unit. */
+int MPI_Win_allocate(MPI_Aint size, int disp_unit, MPI_Info info,
+                     MPI_Comm comm, void *baseptr, MPI_Win *win)
+{
+    (void)info;
+    if (size < 0 || disp_unit <= 0)
+        return MPI_ERR_ARG;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "win_allocate", "lil",
+                                      (long)size, disp_unit,
+                                      (long)comm);
+    if (!r) {
+        rc = handle_error("MPI_Win_allocate");
+    } else {
+        *win = (MPI_Win)PyLong_AsLong(PyTuple_GetItem(r, 0));
+        /* the window's byte storage lives in the embedded
+         * interpreter; the C program addresses it directly — remote
+         * puts land in it asynchronously, visible after a fence */
+        *(void **)baseptr =
+            (void *)(intptr_t)PyLong_AsLongLong(PyTuple_GetItem(r, 1));
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+static int win_simple(const char *fn, MPI_Win win, const char *fmt,
+                      long a, long b)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, fn, fmt, (long)win, a, b);
+    if (!r)
+        rc = handle_error(fn);
+    else
+        Py_DECREF(r);
+    GIL_END;
+    return rc;
+}
+
+int MPI_Win_fence(int assert_, MPI_Win win)
+{
+    (void)assert_;
+    return win_simple("win_fence", win, "l", 0, 0);
+}
+
+int MPI_Win_lock(int lock_type, int rank, int assert_, MPI_Win win)
+{
+    (void)assert_;
+    /* "lll": varargs must be pushed as the type va_arg reads — an
+     * "i" code reading a pushed long is UB per C11 7.16.1.1 */
+    return win_simple("win_lock", win, "lll", (long)lock_type,
+                      (long)rank);
+}
+
+int MPI_Win_unlock(int rank, MPI_Win win)
+{
+    return win_simple("win_unlock", win, "ll", (long)rank, 0);
+}
+
+int MPI_Win_free(MPI_Win *win)
+{
+    int rc = win_simple("win_free", *win, "l", 0, 0);
+    *win = MPI_WIN_NULL;
+    return rc;
+}
+
+int MPI_Put(const void *origin_addr, int origin_count,
+            MPI_Datatype origin_datatype, int target_rank,
+            MPI_Aint target_disp, int target_count,
+            MPI_Datatype target_datatype, MPI_Win win)
+{
+    (void)target_count;
+    (void)target_datatype;               /* same-typemap subset */
+    size_t esz = dt_extent(origin_datatype);
+    if (!esz || origin_count < 0)
+        return MPI_ERR_TYPE;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "win_put", "lNlil", (long)win,
+        mem_ro(origin_addr, (size_t)origin_count * esz),
+        (long)origin_datatype, target_rank, (long)target_disp);
+    if (!r)
+        rc = handle_error("MPI_Put");
+    else
+        Py_DECREF(r);
+    GIL_END;
+    return rc;
+}
+
+int MPI_Get(void *origin_addr, int origin_count,
+            MPI_Datatype origin_datatype, int target_rank,
+            MPI_Aint target_disp, int target_count,
+            MPI_Datatype target_datatype, MPI_Win win)
+{
+    (void)target_count;
+    (void)target_datatype;               /* same-typemap subset */
+    size_t esz = dt_extent(origin_datatype);
+    if (!esz || origin_count < 0)
+        return MPI_ERR_TYPE;
+    size_t extent_bytes = esz * (size_t)origin_count;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    /* the glue returns the origin buffer IMAGE: derived layouts are
+     * overlaid into the current content (gap elements survive), same
+     * contract as the typed receive path */
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "win_get", "lilliN", (long)win, target_rank,
+        (long)target_disp, (long)origin_datatype, origin_count,
+        mem_ro(origin_addr,
+               origin_datatype >= DT_FIRST_DYN ? extent_bytes : 0));
+    if (!r)
+        rc = handle_error("MPI_Get");
+    else {
+        rc = copy_bytes(r, origin_addr, extent_bytes);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int MPI_Accumulate(const void *origin_addr, int origin_count,
+                   MPI_Datatype origin_datatype, int target_rank,
+                   MPI_Aint target_disp, int target_count,
+                   MPI_Datatype target_datatype, MPI_Op op, MPI_Win win)
+{
+    (void)target_count;
+    (void)target_datatype;               /* same-typemap subset */
+    size_t esz = dt_extent(origin_datatype);
+    if (!esz || origin_count < 0)
+        return MPI_ERR_TYPE;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "win_accumulate", "lNllil", (long)win,
+        mem_ro(origin_addr, (size_t)origin_count * esz),
+        (long)origin_datatype, (long)op, target_rank,
+        (long)target_disp);
+    if (!r)
+        rc = handle_error("MPI_Accumulate");
+    else
+        Py_DECREF(r);
+    GIL_END;
+    return rc;
+}

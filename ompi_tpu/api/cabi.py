@@ -985,6 +985,102 @@ def reduce_scatter_block(h: int, view, dt: int, o: int,
         _op_ctx.dt = 0
 
 
+# ---------------------------------------------------------------------
+# one-sided RMA (MPI_Win_allocate family): the window IS interpreter
+# memory whose address the C program holds — remote puts mutate it
+# asynchronously (reader-thread application), so direct loads after a
+# fence see them, the shared-memory window model of osc/sm.
+# ---------------------------------------------------------------------
+_wins: Dict[int, Any] = {}
+_next_win = itertools.count(1)
+
+
+def _win(wh: int):
+    with _lock:
+        w = _wins.get(wh)
+    if w is None:
+        raise MPIError(ERR_ARG, f"invalid window handle {wh}")
+    return w
+
+
+def win_allocate(nbytes: int, disp_unit: int, h: int
+                 ) -> Tuple[int, int]:
+    """Returns (window handle, base address). The base points at the
+    window's byte storage inside the embedded interpreter — stable for
+    the window's lifetime (handlers mutate it in place)."""
+    from ompi_tpu.osc.perrank import RankWindow
+    c = _comm(h)
+    win = RankWindow(c, max(int(nbytes), 1), dtype=np.uint8,
+                     name=f"cabi_win{nbytes}")
+    # displacement scaling uses the TARGET's declared unit (they may
+    # legitimately differ per rank — the same reason RankWindow
+    # allgathers per-rank sizes)
+    win._disp_units = [int(u) for u in
+                       c.allgather(np.int64(max(int(disp_unit), 1)))]
+    with _lock:
+        wh = next(_next_win)
+        _wins[wh] = win
+    return wh, int(win.local.ctypes.data)
+
+
+def win_free(wh: int) -> None:
+    with _lock:
+        w = _wins.pop(wh, None)
+    if w is None:
+        raise MPIError(ERR_ARG, f"invalid window handle {wh}")
+    w.free()
+
+
+def win_fence(wh: int) -> None:
+    _win(wh).fence()
+
+
+def win_lock(wh: int, lock_type: int, target: int) -> None:
+    _win(wh).lock(target, lock_type)
+
+
+def win_unlock(wh: int, target: int) -> None:
+    _win(wh).unlock(target)
+
+
+def _byte_disp(w, target: int, disp: int) -> int:
+    units = w._disp_units
+    if not 0 <= target < len(units):
+        raise MPIError(ERR_ARG, f"bad RMA target {target}")
+    return disp * units[target]
+
+
+def win_put(wh: int, view, dt: int, target: int, disp: int) -> None:
+    w = _win(wh)
+    a = _pack(view, dt, _count_of(view, dt))
+    w.put(a.view(np.uint8), target, _byte_disp(w, target, disp))
+
+
+def win_get(wh: int, target: int, disp: int, dt: int,
+            count: int, curview) -> bytes:
+    """Returns the origin buffer IMAGE: significant bytes fetched from
+    the target, overlaid into the origin's current content for derived
+    datatypes (gap elements keep their bytes, like the recv path)."""
+    w = _win(wh)
+    nbytes = type_size_bytes(dt) * count
+    raw = w.get(target, _byte_disp(w, target, disp), nbytes).tobytes()
+    base, _, _ = _type_parts(dt)
+    flat = np.frombuffer(raw, dtype=base)
+    return _unpack(flat, dt, count, bytes(curview))[0]
+
+
+def win_accumulate(wh: int, view, dt: int, o: int, target: int,
+                   disp: int) -> None:
+    w = _win(wh)
+    op = _op(o)
+    if not op.predefined:
+        raise MPIError(ERR_OP,
+                       "MPI_Accumulate requires a predefined op")
+    a = _pack(view, dt, _count_of(view, dt))
+    w.accumulate_typed(a, target, _byte_disp(w, target, disp),
+                       op=op.name)
+
+
 def exc_code(exc: BaseException) -> int:
     """Map a glue exception to an MPI error code for the C shim."""
     if isinstance(exc, MPIError):

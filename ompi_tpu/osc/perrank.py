@@ -34,6 +34,14 @@ from ompi_tpu.core.errhandler import ERR_ARG, ERR_RANK, MPIError
 LOCK_EXCLUSIVE = 1
 LOCK_SHARED = 2
 
+def _logical(npfn):
+    """MPI logical ops yield 0/1 IN THE OPERAND TYPE (a bool result
+    would change the element size under the typed byte-window view)."""
+    def fn(a, b):
+        return npfn(a, b).astype(np.asarray(b).dtype)
+    return fn
+
+
 _ACC_OPS = {
     "sum": np.add,
     "prod": np.multiply,
@@ -44,6 +52,9 @@ _ACC_OPS = {
     "band": np.bitwise_and,
     "bor": np.bitwise_or,
     "bxor": np.bitwise_xor,
+    "land": _logical(np.logical_and),
+    "lor": _logical(np.logical_or),
+    "lxor": _logical(np.logical_xor),
 }
 
 
@@ -137,6 +148,23 @@ class RankWindow:
         return self._rpc(target, {"op": "getacc", "disp": int(disp),
                                   "acc": op}, arr)
 
+    def accumulate_typed(self, data, target: int, byte_disp: int,
+                         op: str = "sum") -> None:
+        """Typed accumulate into a BYTE-addressed (uint8) window: the
+        value keeps its own dtype and the target combines through a
+        typed view of its byte storage — the C ABI's MPI_Accumulate
+        path, where the window is raw allocated memory and each call
+        brings its own datatype."""
+        if self.dtype != np.dtype(np.uint8):
+            raise MPIError(ERR_ARG,
+                           "accumulate_typed requires a byte window")
+        if op not in _ACC_OPS or _ACC_OPS[op] is False:
+            raise MPIError(ERR_ARG, f"bad accumulate op {op!r}")
+        arr = np.ascontiguousarray(np.asarray(data)).ravel()
+        self._bounds(byte_disp, arr.nbytes, target)
+        self._rpc(target, {"op": "acc", "disp": int(byte_disp),
+                           "acc": op}, arr)
+
     def fetch_and_op(self, value, target: int, disp: int = 0,
                      op: str = "sum"):
         out = self.get_accumulate(np.asarray([value], self.dtype),
@@ -218,9 +246,20 @@ class RankWindow:
             elif op == "acc":
                 d = header["disp"]
                 fn = _ACC_OPS[header["acc"]]
-                seg = self.local[d:d + data.size]
-                self.local[d:d + data.size] = (
-                    data if fn is None else fn(seg, data))
+                if self.dtype == np.uint8 and data.dtype != np.uint8:
+                    # typed accumulate into a BYTE-addressed window
+                    # (the C ABI's Win_allocate windows): combine
+                    # through a typed view of the byte storage, still
+                    # atomically on this reader thread
+                    nb = data.nbytes
+                    seg = self.local[d:d + nb].view(data.dtype)
+                    out = data if fn is None else fn(seg, data)
+                    self.local[d:d + nb] = \
+                        np.ascontiguousarray(out).view(np.uint8)
+                else:
+                    seg = self.local[d:d + data.size]
+                    self.local[d:d + data.size] = (
+                        data if fn is None else fn(seg, data))
             elif op == "getacc":
                 d = header["disp"]
                 seg = self.local[d:d + data.size]

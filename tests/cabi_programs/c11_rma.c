@@ -1,0 +1,104 @@
+/* One-sided RMA in C: MPI_Win_allocate hands back real memory remote
+ * puts land in (direct loads after the fence see them), typed
+ * MPI_Accumulate under passive-target locks, MPI_Get pulls remote
+ * window content. */
+#include <mpi.h>
+#include <stdio.h>
+
+#define CHECK(cond, code)                                            \
+    do {                                                             \
+        if (!(cond)) {                                               \
+            fprintf(stderr, "rank %d: check failed at line %d\n",    \
+                    rank, __LINE__);                                 \
+            MPI_Abort(MPI_COMM_WORLD, code);                         \
+        }                                                            \
+    } while (0)
+
+int main(int argc, char **argv)
+{
+    MPI_Init(&argc, &argv);
+    int rank, size;
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &size);
+    CHECK(size >= 2, 1);
+
+    /* one double slot per peer + one accumulate cell */
+    int slots = size + 1;
+    double *base = NULL;
+    MPI_Win win;
+    MPI_Win_allocate((MPI_Aint)(slots * sizeof(double)),
+                     sizeof(double), MPI_INFO_NULL, MPI_COMM_WORLD,
+                     &base, &win);
+    CHECK(base != NULL, 2);
+    for (int i = 0; i < slots; i++)
+        base[i] = 0.0;                   /* direct store: my window */
+
+    /* active-target epoch: everyone puts its rank into ITS slot on
+     * every peer's window */
+    MPI_Win_fence(0, win);
+    for (int p = 0; p < size; p++) {
+        if (p == rank)
+            continue;
+        double v = 10.0 + rank;
+        MPI_Put(&v, 1, MPI_DOUBLE, p, rank, 1, MPI_DOUBLE, win);
+    }
+    MPI_Win_fence(0, win);
+    MPI_Barrier(MPI_COMM_WORLD);
+    /* direct loads from MY window memory see the remote puts */
+    for (int p = 0; p < size; p++)
+        if (p != rank)
+            CHECK(base[p] == 10.0 + p, 3);
+
+    /* passive-target: everyone accumulates into rank 0's last cell */
+    double one = 1.5;
+    MPI_Win_lock(MPI_LOCK_EXCLUSIVE, 0, 0, win);
+    MPI_Accumulate(&one, 1, MPI_DOUBLE, 0, size, 1, MPI_DOUBLE,
+                   MPI_SUM, win);
+    MPI_Win_unlock(0, win);
+    MPI_Barrier(MPI_COMM_WORLD);
+    if (rank == 0)
+        CHECK(base[size] == 1.5 * size, 4);
+
+    /* MPI_Get pulls a remote slot */
+    double got = -1;
+    int peer = (rank + 1) % size;
+    MPI_Win_lock(MPI_LOCK_SHARED, peer, 0, win);
+    MPI_Get(&got, 1, MPI_DOUBLE, peer, rank, 1, MPI_DOUBLE, win);
+    MPI_Win_unlock(peer, win);
+    CHECK(got == 10.0 + rank, 5);        /* the value I put there */
+
+    MPI_Barrier(MPI_COMM_WORLD);
+    MPI_Win_free(&win);
+    CHECK(win == MPI_WIN_NULL, 6);
+
+    /* disp_units may legitimately DIFFER per rank: displacement must
+     * scale by the TARGET's declared unit. Rank 0 declares bytes;
+     * everyone else declares doubles. */
+    double *b2 = NULL;
+    MPI_Win w2;
+    MPI_Win_allocate((MPI_Aint)(4 * sizeof(double)),
+                     rank == 0 ? 1 : (int)sizeof(double),
+                     MPI_INFO_NULL, MPI_COMM_WORLD, &b2, &w2);
+    for (int i = 0; i < 4; i++)
+        b2[i] = 0.0;
+    MPI_Win_fence(0, w2);
+    if (rank == 1) {
+        double v = 77.5;
+        /* target 0 declared disp_unit=1: disp 16 means BYTE 16 */
+        MPI_Put(&v, 1, MPI_DOUBLE, 0, 2 * (MPI_Aint)sizeof(double), 1,
+                MPI_DOUBLE, w2);
+        /* target 2 (if present) declared doubles: disp 3 = slot 3 */
+        if (size > 2)
+            MPI_Put(&v, 1, MPI_DOUBLE, 2, 3, 1, MPI_DOUBLE, w2);
+    }
+    MPI_Win_fence(0, w2);
+    MPI_Barrier(MPI_COMM_WORLD);
+    if (rank == 0)
+        CHECK(b2[2] == 77.5, 7);
+    if (rank == 2)
+        CHECK(b2[3] == 77.5, 8);
+    MPI_Win_free(&w2);
+    MPI_Finalize();
+    printf("OK c11_rma rank=%d/%d\n", rank, size);
+    return 0;
+}

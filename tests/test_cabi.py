@@ -34,6 +34,7 @@ CASES = [
     ("c08_userop.c", 3),
     ("c09_waitany.c", 3),
     ("c10_icoll_pack.c", 3),
+    ("c11_rma.c", 3),
 ]
 
 
