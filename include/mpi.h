@@ -103,6 +103,17 @@ typedef void (MPI_User_function)(void *invec, void *inoutvec, int *len,
 typedef long MPI_Info;
 #define MPI_INFO_NULL ((MPI_Info)0)
 typedef long MPI_Win;
+typedef long MPI_File;
+typedef long long MPI_Offset;
+#define MPI_FILE_NULL ((MPI_File)0)
+
+/* MPI_File_open access modes */
+#define MPI_MODE_CREATE   1
+#define MPI_MODE_RDONLY   2
+#define MPI_MODE_WRONLY   4
+#define MPI_MODE_RDWR     8
+#define MPI_MODE_EXCL    64
+#define MPI_MODE_APPEND 128
 #define MPI_WIN_NULL ((MPI_Win)0)
 #define MPI_LOCK_EXCLUSIVE 1
 #define MPI_LOCK_SHARED    2
@@ -358,6 +369,31 @@ int MPI_Accumulate(const void *origin_addr, int origin_count,
                    MPI_Aint target_disp, int target_count,
                    MPI_Datatype target_datatype, MPI_Op op,
                    MPI_Win win);
+
+/* ---- MPI-IO (byte-addressed default view) ---- */
+int MPI_File_open(MPI_Comm comm, const char *filename, int amode,
+                  MPI_Info info, MPI_File *fh);
+int MPI_File_close(MPI_File *fh);
+int MPI_File_delete(const char *filename, MPI_Info info);
+int MPI_File_write_at(MPI_File fh, MPI_Offset offset, const void *buf,
+                      int count, MPI_Datatype datatype,
+                      MPI_Status *status);
+int MPI_File_read_at(MPI_File fh, MPI_Offset offset, void *buf,
+                     int count, MPI_Datatype datatype,
+                     MPI_Status *status);
+int MPI_File_write_at_all(MPI_File fh, MPI_Offset offset,
+                          const void *buf, int count,
+                          MPI_Datatype datatype, MPI_Status *status);
+int MPI_File_read_at_all(MPI_File fh, MPI_Offset offset, void *buf,
+                         int count, MPI_Datatype datatype,
+                         MPI_Status *status);
+int MPI_File_write_shared(MPI_File fh, const void *buf, int count,
+                          MPI_Datatype datatype, MPI_Status *status);
+int MPI_File_read_shared(MPI_File fh, void *buf, int count,
+                         MPI_Datatype datatype, MPI_Status *status);
+int MPI_File_get_size(MPI_File fh, MPI_Offset *size);
+int MPI_File_set_size(MPI_File fh, MPI_Offset size);
+int MPI_File_sync(MPI_File fh);
 
 #ifdef __cplusplus
 }

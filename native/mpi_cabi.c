@@ -2171,6 +2171,7 @@ int MPI_Win_allocate(MPI_Aint size, int disp_unit, MPI_Info info,
         return MPI_ERR_ARG;
     GIL_BEGIN;
     int rc = MPI_SUCCESS;
+    *win = MPI_WIN_NULL;                 /* defined on every path */
     PyObject *r = PyObject_CallMethod(g_mod, "win_allocate", "lil",
                                       (long)size, disp_unit,
                                       (long)comm);
@@ -2304,6 +2305,238 @@ int MPI_Accumulate(const void *origin_addr, int origin_count,
         (long)target_disp);
     if (!r)
         rc = handle_error("MPI_Accumulate");
+    else
+        Py_DECREF(r);
+    GIL_END;
+    return rc;
+}
+
+/* ------------------------------------------------------------------ */
+/* MPI-IO (MPI_File_* over the per-rank two-phase IO component)        */
+/* ------------------------------------------------------------------ */
+int MPI_File_open(MPI_Comm comm, const char *filename, int amode,
+                  MPI_Info info, MPI_File *fh)
+{
+    (void)info;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    *fh = MPI_FILE_NULL;                 /* defined on every path */
+    PyObject *r = PyObject_CallMethod(g_mod, "file_open", "lsi",
+                                      (long)comm, filename, amode);
+    if (!r)
+        rc = handle_error("MPI_File_open");
+    else {
+        *fh = (MPI_File)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+static int file_simple(const char *fn, MPI_File fh, long a)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, fn, "ll", (long)fh, a);
+    if (!r)
+        rc = handle_error(fn);
+    else
+        Py_DECREF(r);
+    GIL_END;
+    return rc;
+}
+
+int MPI_File_close(MPI_File *fh)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "file_close", "l",
+                                      (long)*fh);
+    if (!r)
+        rc = handle_error("MPI_File_close");
+    else
+        Py_DECREF(r);
+    GIL_END;
+    *fh = MPI_FILE_NULL;
+    return rc;
+}
+
+int MPI_File_delete(const char *filename, MPI_Info info)
+{
+    (void)info;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "file_delete", "s",
+                                      filename);
+    if (!r)
+        rc = handle_error("MPI_File_delete");
+    else
+        Py_DECREF(r);
+    GIL_END;
+    return rc;
+}
+
+static int file_write_common(const char *fn, MPI_File fh,
+                             MPI_Offset offset, const void *buf,
+                             int count, MPI_Datatype datatype,
+                             MPI_Status *status)
+{
+    size_t esz = dt_extent(datatype);
+    if (!esz || count < 0)
+        return MPI_ERR_TYPE;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, fn, "llNl", (long)fh, (long)offset,
+        mem_ro(buf, (size_t)count * esz), (long)datatype);
+    if (!r)
+        rc = handle_error(fn);
+    else {
+        set_status(status, 0, 0, (int)PyLong_AsLong(r));
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int MPI_File_write_at(MPI_File fh, MPI_Offset offset, const void *buf,
+                      int count, MPI_Datatype datatype,
+                      MPI_Status *status)
+{
+    return file_write_common("file_write_at", fh, offset, buf, count,
+                             datatype, status);
+}
+
+int MPI_File_write_at_all(MPI_File fh, MPI_Offset offset,
+                          const void *buf, int count,
+                          MPI_Datatype datatype, MPI_Status *status)
+{
+    return file_write_common("file_write_at_all", fh, offset, buf,
+                             count, datatype, status);
+}
+
+static int file_read_common(const char *fn, MPI_File fh,
+                            MPI_Offset offset, void *buf, int count,
+                            MPI_Datatype datatype, MPI_Status *status)
+{
+    size_t esz = dt_extent(datatype);
+    size_t sig = dt_sig(datatype);
+    if (!esz || count < 0)
+        return MPI_ERR_TYPE;
+    size_t extent_bytes = esz * (size_t)count;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, fn, "lllLN", (long)fh, (long)offset,
+        (long)(sig * (size_t)count), (long long)datatype,
+        mem_ro(buf, datatype >= DT_FIRST_DYN ? extent_bytes : 0));
+    if (!r)
+        rc = handle_error(fn);
+    else {
+        rc = copy_bytes(PyTuple_GetItem(r, 0), buf, extent_bytes);
+        /* a short read at EOF reports the bytes ACTUALLY read */
+        set_status(status, 0, 0,
+                   (int)PyLong_AsLong(PyTuple_GetItem(r, 1)));
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int MPI_File_read_at(MPI_File fh, MPI_Offset offset, void *buf,
+                     int count, MPI_Datatype datatype,
+                     MPI_Status *status)
+{
+    return file_read_common("file_read_at", fh, offset, buf, count,
+                            datatype, status);
+}
+
+int MPI_File_read_at_all(MPI_File fh, MPI_Offset offset, void *buf,
+                         int count, MPI_Datatype datatype,
+                         MPI_Status *status)
+{
+    return file_read_common("file_read_at_all", fh, offset, buf, count,
+                            datatype, status);
+}
+
+int MPI_File_write_shared(MPI_File fh, const void *buf, int count,
+                          MPI_Datatype datatype, MPI_Status *status)
+{
+    size_t esz = dt_extent(datatype);
+    if (!esz || count < 0)
+        return MPI_ERR_TYPE;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "file_write_shared", "lNl", (long)fh,
+        mem_ro(buf, (size_t)count * esz), (long)datatype);
+    if (!r)
+        rc = handle_error("MPI_File_write_shared");
+    else {
+        /* significant bytes actually written (a derived type's gaps
+         * never hit the file) */
+        set_status(status, 0, 0, (int)PyLong_AsLong(r));
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int MPI_File_read_shared(MPI_File fh, void *buf, int count,
+                         MPI_Datatype datatype, MPI_Status *status)
+{
+    size_t sig = dt_sig(datatype);
+    size_t esz = dt_extent(datatype);
+    if (!sig || !esz || count < 0)
+        return MPI_ERR_TYPE;
+    size_t extent_bytes = esz * (size_t)count;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "file_read_shared", "lllN", (long)fh,
+        (long)(sig * (size_t)count), (long)datatype,
+        mem_ro(buf, datatype >= DT_FIRST_DYN ? extent_bytes : 0));
+    if (!r)
+        rc = handle_error("MPI_File_read_shared");
+    else {
+        rc = copy_bytes(PyTuple_GetItem(r, 0), buf, extent_bytes);
+        set_status(status, 0, 0,
+                   (int)PyLong_AsLong(PyTuple_GetItem(r, 1)));
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int MPI_File_get_size(MPI_File fh, MPI_Offset *size)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "file_get_size", "l",
+                                      (long)fh);
+    if (!r)
+        rc = handle_error("MPI_File_get_size");
+    else {
+        *size = (MPI_Offset)PyLong_AsLongLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int MPI_File_set_size(MPI_File fh, MPI_Offset size)
+{
+    return file_simple("file_set_size", fh, (long)size);
+}
+
+int MPI_File_sync(MPI_File fh)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "file_sync", "l",
+                                      (long)fh);
+    if (!r)
+        rc = handle_error("MPI_File_sync");
     else
         Py_DECREF(r);
     GIL_END;

@@ -1081,6 +1081,147 @@ def win_accumulate(wh: int, view, dt: int, o: int, target: int,
                        op=op.name)
 
 
+# ---------------------------------------------------------------------
+# MPI-IO (MPI_File_* over io/perrank.RankFile): byte-addressed view,
+# each call brings its own datatype (offsets are byte offsets against
+# the default view, the MPI "native" etype=byte default)
+# ---------------------------------------------------------------------
+_files: Dict[int, Any] = {}
+_next_file = itertools.count(1)
+
+# MPI_MODE_* (mpi.h values) -> POSIX flags (io/file MODE_* are POSIX)
+_MPI_MODE_RDONLY = 2
+_MPI_MODE_RDWR = 8
+_MPI_MODE_WRONLY = 4
+_MPI_MODE_CREATE = 1
+_MPI_MODE_EXCL = 64
+_MPI_MODE_APPEND = 128
+
+
+def _file(fh: int):
+    with _lock:
+        f = _files.get(fh)
+    if f is None:
+        raise MPIError(ERR_ARG, f"invalid file handle {fh}")
+    return f
+
+
+def file_open(h: int, path: str, amode: int) -> int:
+    import os as _os
+
+    from ompi_tpu.io.perrank import RankFile
+    flags = 0
+    if amode & _MPI_MODE_RDWR:
+        flags |= _os.O_RDWR
+    elif amode & _MPI_MODE_WRONLY:
+        flags |= _os.O_WRONLY
+    # O_RDONLY is 0
+    if amode & _MPI_MODE_CREATE:
+        flags |= _os.O_CREAT
+    if amode & _MPI_MODE_EXCL:
+        flags |= _os.O_EXCL
+    # MPI_MODE_APPEND means the INITIAL position is EOF — it must NOT
+    # become O_APPEND (Linux pwrite on an O_APPEND fd ignores the
+    # offset and appends, breaking every positioned write)
+    f = RankFile(_comm(h), path, amode=flags, etype=np.uint8)
+    if amode & _MPI_MODE_APPEND:
+        f.seek_shared(f.get_size())      # collective, like the open
+    with _lock:
+        fh = next(_next_file)
+        _files[fh] = f
+    return fh
+
+
+def file_close(fh: int) -> None:
+    with _lock:
+        f = _files.pop(fh, None)
+    if f is None:
+        raise MPIError(ERR_ARG, f"invalid file handle {fh}")
+    f.close()
+
+
+def file_delete(path: str) -> None:
+    import os as _os
+    try:
+        _os.unlink(path)
+    except OSError as e:
+        raise MPIError(ERR_ARG, f"MPI_File_delete: {e}") from None
+
+
+def _file_write(fh: int, view, dt: int, collective: bool,
+                offset: Optional[int]) -> int:
+    """Returns the SIGNIFICANT bytes written (status counting)."""
+    f = _file(fh)
+    a = _pack(view, dt, _count_of(view, dt))
+    data = a.view(np.uint8)
+    if offset is None:
+        f.write_shared(data)
+    elif collective:
+        f.write_at_all(int(offset), data)
+    else:
+        f.write_at(int(offset), data)
+    return int(a.nbytes)
+
+
+def _file_read(fh: int, nbytes: int, dt: int, curview,
+               collective: bool, offset: Optional[int]
+               ) -> Tuple[bytes, int]:
+    """(origin buffer image, delivered significant bytes) — a short
+    read at EOF reports what was actually read, never the request."""
+    f = _file(fh)
+    if offset is None:
+        raw = f.read_shared(int(nbytes))
+    elif collective:
+        raw = f.read_at_all(int(offset), int(nbytes))
+    else:
+        raw = f.read_at(int(offset), int(nbytes))
+    raw = np.ascontiguousarray(raw)
+    base, _, _ = _type_parts(dt)
+    usable = (raw.nbytes // base.itemsize) * base.itemsize
+    flat = raw.view(np.uint8)[:usable].view(base)
+    cnt = _count_of(curview, dt) if len(curview) else flat.size
+    return _unpack(flat, dt, cnt, bytes(curview))[0], int(flat.nbytes)
+
+
+def file_write_at(fh: int, offset: int, view, dt: int) -> int:
+    return _file_write(fh, view, dt, False, offset)
+
+
+def file_write_at_all(fh: int, offset: int, view, dt: int) -> int:
+    return _file_write(fh, view, dt, True, offset)
+
+
+def file_write_shared(fh: int, view, dt: int) -> int:
+    return _file_write(fh, view, dt, False, None)
+
+
+def file_read_at(fh: int, offset: int, nbytes: int, dt: int, curview
+                 ) -> Tuple[bytes, int]:
+    return _file_read(fh, nbytes, dt, curview, False, offset)
+
+
+def file_read_at_all(fh: int, offset: int, nbytes: int, dt: int,
+                     curview) -> Tuple[bytes, int]:
+    return _file_read(fh, nbytes, dt, curview, True, offset)
+
+
+def file_read_shared(fh: int, nbytes: int, dt: int, curview
+                     ) -> Tuple[bytes, int]:
+    return _file_read(fh, nbytes, dt, curview, False, None)
+
+
+def file_get_size(fh: int) -> int:
+    return int(_file(fh).get_size())
+
+
+def file_set_size(fh: int, nbytes: int) -> None:
+    _file(fh).set_size(int(nbytes))
+
+
+def file_sync(fh: int) -> None:
+    _file(fh).sync()
+
+
 def exc_code(exc: BaseException) -> int:
     """Map a glue exception to an MPI error code for the C shim."""
     if isinstance(exc, MPIError):

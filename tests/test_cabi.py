@@ -35,6 +35,7 @@ CASES = [
     ("c09_waitany.c", 3),
     ("c10_icoll_pack.c", 3),
     ("c11_rma.c", 3),
+    ("c12_mpiio.c", 3),
 ]
 
 
