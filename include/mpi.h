@@ -276,6 +276,15 @@ int MPI_Cart_shift(MPI_Comm comm, int direction, int disp,
 int MPI_Cart_get(MPI_Comm comm, int maxdims, int dims[], int periods[],
                  int coords[]);
 int MPI_Cartdim_get(MPI_Comm comm, int *ndims);
+int MPI_Neighbor_allgather(const void *sendbuf, int sendcount,
+                           MPI_Datatype sendtype, void *recvbuf,
+                           int recvcount, MPI_Datatype recvtype,
+                           MPI_Comm comm);
+int MPI_Neighbor_alltoall(const void *sendbuf, int sendcount,
+                          MPI_Datatype sendtype, void *recvbuf,
+                          int recvcount, MPI_Datatype recvtype,
+                          MPI_Comm comm);
+int MPI_Error_class(int errorcode, int *errorclass);
 
 /* ---- persistent point-to-point ---- */
 int MPI_Send_init(const void *buf, int count, MPI_Datatype datatype,

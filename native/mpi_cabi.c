@@ -2542,3 +2542,83 @@ int MPI_File_sync(MPI_File fh)
     GIL_END;
     return rc;
 }
+
+/* ------------------------------------------------------------------ */
+/* neighborhood collectives (topo framework) + error class             */
+/* ------------------------------------------------------------------ */
+static int neighbor_count_of(MPI_Comm comm, int *n)
+{
+    long v;
+    int rc = group_call1("neighbor_count", (long)comm, &v);
+    if (rc == MPI_SUCCESS)
+        *n = (int)v;
+    return rc;
+}
+
+int MPI_Neighbor_allgather(const void *sendbuf, int sendcount,
+                           MPI_Datatype sendtype, void *recvbuf,
+                           int recvcount, MPI_Datatype recvtype,
+                           MPI_Comm comm)
+{
+    /* derived SEND types work (the column-halo idiom: pack gathers
+     * the significant elements); the receive overlay is basic-typed */
+    size_t ssz = dt_extent(sendtype), rsz = dt_size(recvtype);
+    if (!ssz || !rsz || sendcount < 0 || recvcount < 0)
+        return MPI_ERR_TYPE;
+    int nslots;
+    int qrc = neighbor_count_of(comm, &nslots);
+    if (qrc != MPI_SUCCESS)
+        return qrc;
+    size_t cap = (size_t)nslots * (size_t)recvcount * rsz;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "neighbor_allgather", "lNllN", (long)comm,
+        mem_ro(sendbuf, (size_t)sendcount * ssz), (long)sendtype,
+        (long)recvtype, mem_ro(recvbuf, cap));
+    if (!r)
+        rc = handle_error("MPI_Neighbor_allgather");
+    else {
+        rc = copy_bytes(r, recvbuf, cap);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int MPI_Neighbor_alltoall(const void *sendbuf, int sendcount,
+                          MPI_Datatype sendtype, void *recvbuf,
+                          int recvcount, MPI_Datatype recvtype,
+                          MPI_Comm comm)
+{
+    size_t ssz = dt_extent(sendtype), rsz = dt_size(recvtype);
+    if (!ssz || !rsz || sendcount < 0 || recvcount < 0)
+        return MPI_ERR_TYPE;
+    int nslots;
+    int qrc = neighbor_count_of(comm, &nslots);
+    if (qrc != MPI_SUCCESS)
+        return qrc;
+    size_t cap = (size_t)nslots * (size_t)recvcount * rsz;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "neighbor_alltoall", "lNlilN", (long)comm,
+        mem_ro(sendbuf, (size_t)nslots * (size_t)sendcount * ssz),
+        (long)sendtype, sendcount, (long)recvtype,
+        mem_ro(recvbuf, cap));
+    if (!r)
+        rc = handle_error("MPI_Neighbor_alltoall");
+    else {
+        rc = copy_bytes(r, recvbuf, cap);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int MPI_Error_class(int errorcode, int *errorclass)
+{
+    /* codes ARE classes in this ABI (core/errhandler.py values) */
+    *errorclass = errorcode;
+    return MPI_SUCCESS;
+}

@@ -31,8 +31,8 @@ import numpy as np
 
 from ompi_tpu.core import op as op_mod
 from ompi_tpu.core.errhandler import (ERR_ARG, ERR_COMM, ERR_OP,
-                                      ERR_REQUEST, ERR_TYPE, MPIError,
-                                      error_string)
+                                      ERR_REQUEST, ERR_TOPOLOGY,
+                                      ERR_TYPE, MPIError, error_string)
 
 # ---------------------------------------------------------------------
 # handle tables (mpi.h constants must match these values)
@@ -547,6 +547,49 @@ def cart_get(h: int) -> Tuple[bytes, bytes, bytes]:
     periods = np.asarray([int(p) for p in cart.periods], dtype=np.intc)
     coords = np.asarray(c.cart_coords(c.rank()), dtype=np.intc)
     return dims.tobytes(), periods.tobytes(), coords.tobytes()
+
+
+def neighbor_count(h: int) -> int:
+    c = _comm(h)
+    if c.topo is None:
+        raise MPIError(ERR_TOPOLOGY, "no topology attached")
+    return len(list(c.topo.neighbors(c.rank())))
+
+
+def _overlay_rows(rows, rdt: int, curview) -> bytes:
+    """Uniform per-slot overlay in topology-neighbor order; None slots
+    (PROC_NULL neighbors on non-periodic edges) keep the caller's
+    bytes (MPI leaves them undefined/untouched)."""
+    cur = np.frombuffer(curview, _dtype(rdt)).copy()
+    per = len(cur) // max(len(rows), 1)
+    for i, row in enumerate(rows):
+        if row is None:
+            continue
+        seg = np.asarray(row).ravel()[:per]
+        if seg.dtype != cur.dtype:
+            seg = seg.astype(cur.dtype)
+        cur[i * per:i * per + seg.size] = seg
+    return cur.tobytes()
+
+
+def neighbor_allgather(h: int, view, sdt: int, rdt: int,
+                       curview) -> bytes:
+    c = _comm(h)
+    rows = c.neighbor_allgather(_pack(view, sdt,
+                                      _count_of(view, sdt)))
+    return _overlay_rows(rows, rdt, curview)
+
+
+def neighbor_alltoall(h: int, view, sdt: int, percount: int, rdt: int,
+                      curview) -> bytes:
+    c = _comm(h)
+    n = neighbor_count(h)
+    a = _pack(view, sdt, _count_of(view, sdt))
+    # one chunk per neighbor SLOT (zero-count collectives must still
+    # contribute an empty chunk per slot, not zero chunks)
+    chunks = [a[i * percount:(i + 1) * percount] for i in range(n)]
+    rows = c.neighbor_alltoall(chunks)
+    return _overlay_rows(rows, rdt, curview)
 
 
 def cartdim_get(h: int) -> int:

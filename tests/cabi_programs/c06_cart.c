@@ -68,6 +68,39 @@ int main(int argc, char **argv)
     MPI_Allreduce(&one, &sum, 1, MPI_INT, MPI_SUM, cart);
     CHECK(sum == size, 12);
 
+    /* neighborhood collective halo: one allgather exchanges my rank
+     * with all 2*ndims neighbors, slots in (dim, -/+ ) order */
+    int nslots = 4;                      /* 2 dims, periodic */
+    int halo[4] = {-1, -1, -1, -1};
+    MPI_Neighbor_allgather(&myrank, 1, MPI_INT, halo, 1, MPI_INT,
+                           cart);
+    for (int dim = 0; dim < 2; dim++) {
+        int src, dst;
+        MPI_Cart_shift(cart, dim, 1, &src, &dst);
+        CHECK(halo[2 * dim] == src, 13);
+        CHECK(halo[2 * dim + 1] == dst, 14);
+    }
+    /* neighbor alltoall: send each neighbor a tagged value */
+    int nsend[4], nrecv[4] = {-1, -1, -1, -1};
+    for (int i = 0; i < nslots; i++)
+        nsend[i] = myrank * 10 + i;
+    MPI_Neighbor_alltoall(nsend, 1, MPI_INT, nrecv, 1, MPI_INT, cart);
+    for (int dim = 0; dim < 2; dim++) {
+        int src, dst;
+        MPI_Cart_shift(cart, dim, 1, &src, &dst);
+        if (src == dst) {
+            /* size-2 periodic dim: both directional slots talk to the
+             * SAME peer; per-slot FIFO pairs slot j with the peer's
+             * slot j */
+            CHECK(nrecv[2 * dim] == src * 10 + 2 * dim, 15);
+            CHECK(nrecv[2 * dim + 1] == src * 10 + 2 * dim + 1, 16);
+        } else {
+            /* my -dir slot carries what src sent in ITS +dir slot */
+            CHECK(nrecv[2 * dim] == src * 10 + 2 * dim + 1, 17);
+            CHECK(nrecv[2 * dim + 1] == dst * 10 + 2 * dim, 18);
+        }
+    }
+
     MPI_Comm_free(&cart);
     MPI_Finalize();
     printf("OK c06_cart rank=%d/%d\n", rank, size);

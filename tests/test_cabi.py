@@ -30,6 +30,7 @@ CASES = [
     ("c04_nb_split.c", 4),
     ("c05_types_v.c", 3),
     ("c06_cart.c", 4),
+    ("c06_cart.c", 6),
     ("c07_groups_persist.c", 4),
     ("c08_userop.c", 3),
     ("c09_waitany.c", 3),
@@ -57,7 +58,8 @@ def binaries(tmp_path_factory):
 
 
 @pytest.mark.parametrize("src,n", CASES,
-                         ids=[c[0].removesuffix(".c") for c in CASES])
+                         ids=[f"{c[0].removesuffix(chr(46)+chr(99))}-n{c[1]}"
+                              for c in CASES])
 def test_cabi_program(binaries, src, n):
     env = {k: v for k, v in os.environ.items()
            if not k.startswith(("JAX_", "XLA_"))}
