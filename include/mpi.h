@@ -91,6 +91,11 @@ typedef void (MPI_User_function)(void *invec, void *inoutvec, int *len,
 #define MPI_UNDEFINED   (-32766)
 #define MPI_IN_PLACE    ((void *)1)
 
+#define MPI_KEYVAL_INVALID (-1)
+typedef int (MPI_Copy_function)(MPI_Comm, int, void *, void *, void *,
+                                int *);
+typedef int (MPI_Delete_function)(MPI_Comm, int, void *, void *);
+
 #define MPI_MAX_PROCESSOR_NAME  256
 #define MPI_MAX_LIBRARY_VERSION_STRING 256
 
@@ -180,6 +185,15 @@ int MPI_Comm_dup(MPI_Comm comm, MPI_Comm *newcomm);
 int MPI_Comm_split(MPI_Comm comm, int color, int key, MPI_Comm *newcomm);
 int MPI_Comm_free(MPI_Comm *comm);
 int MPI_Comm_set_errhandler(MPI_Comm comm, MPI_Errhandler errhandler);
+int MPI_Comm_create_keyval(MPI_Copy_function *copy_fn,
+                           MPI_Delete_function *delete_fn,
+                           int *comm_keyval, void *extra_state);
+int MPI_Comm_free_keyval(int *comm_keyval);
+int MPI_Comm_set_attr(MPI_Comm comm, int comm_keyval,
+                      void *attribute_val);
+int MPI_Comm_get_attr(MPI_Comm comm, int comm_keyval,
+                      void *attribute_val, int *flag);
+int MPI_Comm_delete_attr(MPI_Comm comm, int comm_keyval);
 
 /* ---- point-to-point ---- */
 int MPI_Send(const void *buf, int count, MPI_Datatype datatype, int dest,

@@ -2622,3 +2622,97 @@ int MPI_Error_class(int errorcode, int *errorclass)
     *errorclass = errorcode;
     return MPI_SUCCESS;
 }
+
+/* ------------------------------------------------------------------ */
+/* communicator attributes (library state caching)                     */
+/* ------------------------------------------------------------------ */
+int MPI_Comm_create_keyval(MPI_Copy_function *copy_fn,
+                           MPI_Delete_function *delete_fn,
+                           int *comm_keyval, void *extra_state)
+{
+    (void)copy_fn;
+    (void)delete_fn;
+    (void)extra_state;                   /* callbacks not invoked:
+                                          * attributes do not
+                                          * propagate through dup in
+                                          * this binding subset */
+    long v;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "comm_create_keyval",
+                                      NULL);
+    if (!r)
+        rc = handle_error("MPI_Comm_create_keyval");
+    else {
+        v = PyLong_AsLong(r);
+        *comm_keyval = (int)v;
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int MPI_Comm_free_keyval(int *comm_keyval)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "comm_free_keyval", "i",
+                                      *comm_keyval);
+    if (!r)
+        rc = handle_error("MPI_Comm_free_keyval");
+    else
+        Py_DECREF(r);
+    GIL_END;
+    *comm_keyval = MPI_KEYVAL_INVALID;
+    return rc;
+}
+
+int MPI_Comm_set_attr(MPI_Comm comm, int comm_keyval,
+                      void *attribute_val)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "comm_set_attr", "liL", (long)comm, comm_keyval,
+        (long long)(intptr_t)attribute_val);
+    if (!r)
+        rc = handle_error("MPI_Comm_set_attr");
+    else
+        Py_DECREF(r);
+    GIL_END;
+    return rc;
+}
+
+int MPI_Comm_get_attr(MPI_Comm comm, int comm_keyval,
+                      void *attribute_val, int *flag)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "comm_get_attr", "li",
+                                      (long)comm, comm_keyval);
+    if (!r)
+        rc = handle_error("MPI_Comm_get_attr");
+    else {
+        *flag = (int)PyLong_AsLong(PyTuple_GetItem(r, 0));
+        if (*flag)
+            *(void **)attribute_val = (void *)(intptr_t)
+                PyLong_AsLongLong(PyTuple_GetItem(r, 1));
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int MPI_Comm_delete_attr(MPI_Comm comm, int comm_keyval)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "comm_delete_attr", "li",
+                                      (long)comm, comm_keyval);
+    if (!r)
+        rc = handle_error("MPI_Comm_delete_attr");
+    else
+        Py_DECREF(r);
+    GIL_END;
+    return rc;
+}

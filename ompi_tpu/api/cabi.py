@@ -585,9 +585,14 @@ def neighbor_alltoall(h: int, view, sdt: int, percount: int, rdt: int,
     c = _comm(h)
     n = neighbor_count(h)
     a = _pack(view, sdt, _count_of(view, sdt))
+    # chunk size in SIGNIFICANT base elements: percount counts send
+    # units, and a derived unit packs idx.size elements (slicing by
+    # percount alone would mis-split derived payloads)
+    _, idx, _ = _type_parts(sdt)
+    per = percount * int(idx.size)
     # one chunk per neighbor SLOT (zero-count collectives must still
     # contribute an empty chunk per slot, not zero chunks)
-    chunks = [a[i * percount:(i + 1) * percount] for i in range(n)]
+    chunks = [a[i * per:(i + 1) * per] for i in range(n)]
     rows = c.neighbor_alltoall(chunks)
     return _overlay_rows(rows, rdt, curview)
 
@@ -603,6 +608,43 @@ def dims_create(nnodes: int, ndims: int, dims_view) -> bytes:
     from ompi_tpu.topo.cart import dims_create as _dc
     return np.asarray(_dc(nnodes, ndims, fixed),
                       dtype=np.intc).tobytes()
+
+
+# communicator attributes (MPI_Comm_create_keyval family): C callers
+# cache library state (a void* value) under process-unique keyvals.
+# Keyvals come from the CORE registry — a private counter would share
+# the per-communicator attribute dict with Python-API keyvals and
+# eventually collide with them.
+
+
+def comm_create_keyval() -> int:
+    """Copy/delete callbacks are not invoked by this binding (no
+    copy_fn == the attribute is not propagated by comm_dup, per
+    MPI)."""
+    from ompi_tpu.core.communicator import create_keyval
+    return create_keyval(None, None)
+
+
+def comm_set_attr(h: int, keyval: int, value: int) -> None:
+    _comm(h).attributes[int(keyval)] = int(value)
+
+
+def comm_get_attr(h: int, keyval: int) -> Tuple[int, int]:
+    """(flag, value) — value is the stored C pointer/int."""
+    attrs = _comm(h).attributes
+    if int(keyval) in attrs:
+        return 1, int(attrs[int(keyval)])
+    return 0, 0
+
+
+def comm_delete_attr(h: int, keyval: int) -> None:
+    if _comm(h).attributes.pop(int(keyval), None) is None:
+        raise MPIError(ERR_ARG, f"attribute {keyval} not set")
+
+
+def comm_free_keyval(keyval: int) -> None:
+    from ompi_tpu.core.communicator import free_keyval
+    free_keyval(int(keyval))
 
 
 def comm_set_errhandler(h: int, which: int) -> None:

@@ -93,6 +93,21 @@ int main(int argc, char **argv)
     MPI_Comm_free(&dup);
     MPI_Comm_free(&node);
 
+    /* attributes: the library state-caching idiom */
+    int kv;
+    MPI_Comm_create_keyval(NULL, NULL, &kv, NULL);
+    static double cached = 42.25;
+    MPI_Comm_set_attr(MPI_COMM_WORLD, kv, &cached);
+    void *gotp = NULL;
+    int aflag = 0;
+    MPI_Comm_get_attr(MPI_COMM_WORLD, kv, &gotp, &aflag);
+    CHECK(aflag == 1 && *(double *)gotp == 42.25, 20);
+    MPI_Comm_delete_attr(MPI_COMM_WORLD, kv);
+    MPI_Comm_get_attr(MPI_COMM_WORLD, kv, &gotp, &aflag);
+    CHECK(aflag == 0, 21);
+    MPI_Comm_free_keyval(&kv);
+    CHECK(kv == MPI_KEYVAL_INVALID, 22);
+
     int ver, sub;
     MPI_Get_version(&ver, &sub);
     CHECK(ver == 3 && sub == 1, 9);

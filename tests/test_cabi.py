@@ -58,7 +58,7 @@ def binaries(tmp_path_factory):
 
 
 @pytest.mark.parametrize("src,n", CASES,
-                         ids=[f"{c[0].removesuffix(chr(46)+chr(99))}-n{c[1]}"
+                         ids=[f"{c[0].removesuffix('.c')}-n{c[1]}"
                               for c in CASES])
 def test_cabi_program(binaries, src, n):
     env = {k: v for k, v in os.environ.items()
