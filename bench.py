@@ -483,7 +483,36 @@ def main() -> None:
         _ab_matrix_child()
         return
 
+    # The TPU is reached through a tunnel that can be down for hours
+    # (observed 7+ h): a dead tunnel makes jax.devices() hang forever
+    # inside C, so probe it in a KILLABLE subprocess first and fall
+    # back to the host platform — a CPU-fallback run of record beats
+    # no run of record.
+    tunnel_down = False
+    tunnel_probe = ""
+    if os.environ.get("JAX_PLATFORMS") != "cpu":   # no tunnel in play
+        try:                                       # when already cpu
+            subprocess.run([sys.executable, "-c",
+                            "import jax; jax.devices()"],
+                           capture_output=True, timeout=120,
+                           check=True)
+        except subprocess.TimeoutExpired:
+            tunnel_down = True
+            tunnel_probe = "probe hung 120s (tunnel down)"
+        except subprocess.CalledProcessError as e:
+            tunnel_down = True
+            tunnel_probe = ("probe exited "
+                            f"{e.returncode}: "
+                            f"{(e.stderr or b'')[-200:].decode(errors='replace')}")
+        if tunnel_down:
+            os.environ["JAX_PLATFORMS"] = "cpu"
+
     import jax
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # env alone loses to a sitecustomize platform pin — assert it
+        # through the config (covers both the fallback AND a caller's
+        # explicit cpu pin, which skips the probe entirely)
+        jax.config.update("jax_platforms", "cpu")
     import ompi_tpu as MPI
     from ompi_tpu.accelerator import to_device, to_host
 
@@ -651,6 +680,8 @@ def main() -> None:
         "allreduce_8B_blocking_single_shot_us": round(blocking_us, 2),
         "ranks": n,
         "platform": platform,
+        "tunnel_down_cpu_fallback": tunnel_down,
+        **({"tunnel_probe": tunnel_probe} if tunnel_down else {}),
         "tunnel_rtt_ms": round(rtt * 1e3, 2),
         "dispatch_only_8B_us": round(dispatch_us, 2),
         "dispatch_bound_8B_us": round(dispatch_bound_us, 2),
