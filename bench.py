@@ -490,21 +490,26 @@ def main() -> None:
     # no run of record.
     tunnel_down = False
     tunnel_probe = ""
+    _PROBE_TIMEOUT_S = 120
     if os.environ.get("JAX_PLATFORMS") != "cpu":   # no tunnel in play
         try:                                       # when already cpu
             subprocess.run([sys.executable, "-c",
                             "import jax; jax.devices()"],
-                           capture_output=True, timeout=120,
+                           capture_output=True,
+                           timeout=_PROBE_TIMEOUT_S,
                            check=True)
         except subprocess.TimeoutExpired:
             tunnel_down = True
-            tunnel_probe = "probe hung 120s (tunnel down)"
+            tunnel_probe = (f"probe hung {_PROBE_TIMEOUT_S}s "
+                            "(tunnel down)")
         except subprocess.CalledProcessError as e:
             tunnel_down = True
             tunnel_probe = ("probe exited "
                             f"{e.returncode}: "
                             f"{(e.stderr or b'')[-200:].decode(errors='replace')}")
         if tunnel_down:
+            sys.stderr.write(f"bench: {tunnel_probe}; falling back to "
+                             "the CPU platform for the run of record\n")
             os.environ["JAX_PLATFORMS"] = "cpu"
 
     import jax

@@ -88,13 +88,15 @@ def test_cross_job_connect_accept(tmp_path):
             os.unlink(port_file)
         jobs = []
         for role in ("accept", "connect"):
+            # generous bounds: under full-suite load on the 1-core
+            # host, four jax imports + the rendezvous can exceed 150 s
             cmd = [sys.executable, _MPIRUN, "--per-rank", "-n", "2",
-                   "--timeout", "150", prog, role, port_file]
+                   "--timeout", "240", prog, role, port_file]
             jobs.append(subprocess.Popen(cmd, env=env,
                                          stdout=subprocess.PIPE,
                                          stderr=subprocess.PIPE,
                                          text=True, cwd=_REPO))
-        outs = [j.communicate(timeout=220) for j in jobs]
+        outs = [j.communicate(timeout=300) for j in jobs]
         ok = all(j.returncode == 0 for j in jobs) and all(
             out.count(f"OK p18_connect {role}") == 2
             for (out, _), role in zip(outs, ("accept", "connect")))
