@@ -43,6 +43,7 @@ CASES = [
     ("p22_part_sync.py", 3),
     ("p23_sessions.py", 3),
     ("p25_thread_multiple.py", 2),
+    ("p26_churn.py", 3),
 ]
 
 
