@@ -673,7 +673,7 @@ def main() -> None:
     # ---- per-rank transport rows (2 real OS processes, btl A/B) -----
     perrank = _perrank_rows() if (n == 1 and not args.no_ab) else None
 
-    print(json.dumps({
+    result = {
         # throughput-derived: amortized pipelined dispatch minus the
         # observation RTT (the OSU loop), NOT a single-shot latency —
         # that's the *_blocking_single_shot row next to it (VERDICT r2
@@ -705,7 +705,32 @@ def main() -> None:
                    "by XLA (algbw is an upper bound); >1-rank rows and "
                    "algorithm A/B come from the 8-rank CPU-mesh child"
                    if n == 1 else ""),
-    }))
+    }
+    print(json.dumps(result))
+    # Compact headline as the FINAL stdout line (round-3 postmortem:
+    # the full line above outgrew the driver's tail window and the run
+    # of record lost its own headline — BENCH_r03.json parsed: null).
+    # Everything the archive must never lose, in <= 500 bytes.
+    headline = {
+        "metric": result["metric"],
+        "value": result["value"],
+        "unit": result["unit"],
+        "vs_baseline": result["vs_baseline"],
+        "blocking_8B_us": result["allreduce_8B_blocking_single_shot_us"],
+        "large_algbw_gbps": result["large_algbw_gbps"],
+        "large_busbw_gbps": result["large_busbw_gbps"],
+        "large_msg_mb": result["large_msg_mb"],
+        "ranks": result["ranks"],
+        "platform": result["platform"],
+        "tunnel_down_cpu_fallback": result["tunnel_down_cpu_fallback"],
+        "correct": result["correct"],
+    }
+    line = json.dumps(headline)
+    if len(line) > 500:                   # hard promise to the driver
+        line = json.dumps({k: headline[k] for k in
+                           ("metric", "value", "unit", "vs_baseline",
+                            "correct")})
+    print(line)
     MPI.Finalize()
 
 

@@ -30,6 +30,7 @@ basic_linear, here fully on-device and XLA-fused.
 """
 from __future__ import annotations
 
+from collections import OrderedDict
 from typing import Any, Callable, Dict, Tuple
 
 import jax
@@ -56,11 +57,38 @@ def _spec(ndim: int) -> P:
     return P(AXIS, *([None] * (ndim - 1)))
 
 
+class _LruCache(OrderedDict):
+    """Bounded compiled-executable cache. Keys are
+    (collective, shape, dtype, op, epoch, ...) tuples, so a long-running
+    workload with varying shapes would otherwise grow host + HBM memory
+    monotonically; eviction drops the least-recently-used executable and
+    lets XLA's own deallocation reclaim it. The cap is the
+    ``coll_xla_cache_max_entries`` MCA var, read at insertion time so a
+    running job can be re-bounded without restarting."""
+
+    def __getitem__(self, key):
+        val = super().__getitem__(key)
+        self.move_to_end(key)
+        return val
+
+    def get(self, key, default=None):
+        if key not in self:
+            return default
+        return self[key]
+
+    def __setitem__(self, key, value):
+        super().__setitem__(key, value)
+        self.move_to_end(key)
+        cap = max(1, int(var.var_get("coll_xla_cache_max_entries", 256)))
+        while len(self) > cap:
+            self.popitem(last=False)
+
+
 class XlaCollModule:
     def __init__(self, comm):
         self.comm = comm
-        self._cache: Dict[Tuple, Callable] = {}
-        self._fast: Dict[Tuple, Callable] = {}
+        self._cache: Dict[Tuple, Callable] = _LruCache()
+        self._fast: Dict[Tuple, Callable] = _LruCache()
         self._barrier_tokens: Dict[str, Tuple] = {}
 
     # -- executable cache ------------------------------------------------
@@ -1378,6 +1406,12 @@ class XlaCollComponent(Component):
         var.var_register("coll", "xla", "priority", vtype="int", default=40,
                          help="Selection priority of the XLA-native "
                               "collective component")
+        var.var_register(
+            "coll", "xla", "cache_max_entries", vtype="int", default=256,
+            help="Per-module cap on cached compiled executables "
+                 "(each of the two caches); least-recently-used "
+                 "entries evict beyond it, bounding HBM/host growth "
+                 "under shape-varying workloads")
         var.var_register(
             "coll", "xla", "allreduce_algorithm", vtype="str",
             default="auto",
