@@ -966,7 +966,12 @@ def scatter(h: int, view, sdt: int, sendcount: int, root: int,
 
 
 def allgather(h: int, view, sdt: int, rdt: int) -> bytes:
-    rows = _comm(h).allgather(_arr(view, sdt))
+    c = _comm(h)
+    a = _arr(view, sdt)
+    if getattr(c, "is_per_rank", False):   # C signature: uniform counts
+        rows = c.allgather(a, uniform=True)
+    else:
+        rows = c.allgather(a)
     return _out(np.concatenate([np.atleast_1d(r) for r in rows]), rdt)
 
 
@@ -974,7 +979,13 @@ def alltoall(h: int, view, sdt: int, percount: int, rdt: int) -> bytes:
     c = _comm(h)
     a = _arr(view, sdt)
     chunks = [a[i * percount:(i + 1) * percount] for i in range(c.size)]
-    out = c.alltoall(chunks)
+    # the C signature fixes one sendcount/sendtype on every rank, so
+    # chunk uniformity holds globally -> large chunks may take the
+    # staged device tier (a per-rank-communicator option)
+    if getattr(c, "is_per_rank", False):
+        out = c.alltoall(chunks, uniform=True)
+    else:
+        out = c.alltoall(chunks)
     return _out(np.concatenate([np.atleast_1d(r) for r in out]), rdt)
 
 

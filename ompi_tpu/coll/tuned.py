@@ -62,22 +62,30 @@ def _load_rules(path: str) -> Dict[str, Dict]:
     return rules
 
 
+def stage_min_for(func: str) -> int:
+    """The staging switch point for one collective: the dynamic-rules
+    per-collective override when present, else the flat MCA var. One
+    decision plane shared by the single-controller TunedCollModule and
+    the per-rank staged device tier."""
+    rules = _load_rules(var.var_get("coll_tuned_dynamic_rules", ""))
+    return int(rules.get(func, {}).get(
+        "stage_min_bytes",
+        var.var_get("coll_tuned_stage_min_bytes", 1 << 20)))
+
+
 class TunedCollModule:
     def __init__(self, comm, rules: Dict[str, Dict]):
         self.comm = comm
         self.device = XlaCollModule(comm)
         self.host = BasicCollModule(comm)
         self.rules = rules
-        self.stage_min = var.var_get("coll_tuned_stage_min_bytes", 1 << 20)
 
     def _decide(self, func: str, buf):
         """Return (module, stage_back: bool) for this call."""
         if check_addr(buf) == LOCUS_DEVICE:
             return self.device, False
         nbytes = getattr(buf, "nbytes", 0)
-        threshold = self.rules.get(func, {}).get(
-            "stage_min_bytes", self.stage_min)
-        if nbytes >= threshold:
+        if nbytes >= stage_min_for(func):
             return self.device, True      # stage host->HBM, ride ICI
         return self.host, False
 

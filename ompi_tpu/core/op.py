@@ -22,6 +22,7 @@ from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 _op_counter = itertools.count()
@@ -128,6 +129,52 @@ _JNP_REDUCERS = {
                                         jax.lax.bitwise_or, (ax,)),
     "bxor": lambda x, ax: jax.lax.reduce(x, jnp.array(0, x.dtype),
                                          jax.lax.bitwise_xor, (ax,)),
+}
+
+def _np_logical(npfn):
+    """MPI logical ops yield 0/1 IN THE OPERAND TYPE (a bool result
+    would change the element size under typed byte-window views)."""
+    def fn(a, b):
+        return npfn(a, b).astype(np.asarray(b).dtype)
+    return fn
+
+
+def _np_minloc(a, b):
+    a, b = np.asarray(a), np.asarray(b)
+    av, ai = a[..., 0], a[..., 1]
+    bv, bi = b[..., 0], b[..., 1]
+    take_a = (av < bv) | ((av == bv) & (ai <= bi))
+    return np.stack([np.where(take_a, av, bv),
+                     np.where(take_a, ai, bi)], axis=-1)
+
+
+def _np_maxloc(a, b):
+    a, b = np.asarray(a), np.asarray(b)
+    av, ai = a[..., 0], a[..., 1]
+    bv, bi = b[..., 0], b[..., 1]
+    take_a = (av > bv) | ((av == bv) & (ai <= bi))
+    return np.stack([np.where(take_a, av, bv),
+                     np.where(take_a, ai, bi)], axis=-1)
+
+
+# Dtype-preserving numpy combiners for the predefined ops — the HOST
+# fold table (the op/base scalar-loop role). Host tiers must never use
+# the jnp combiners on numpy operands: without x64 enabled jax would
+# silently downcast 64-bit operands to 32-bit. Shared by the per-rank
+# host collectives and the RMA accumulate path.
+NP_COMBINERS = {
+    "sum": np.add,
+    "prod": np.multiply,
+    "max": np.maximum,
+    "min": np.minimum,
+    "band": np.bitwise_and,
+    "bor": np.bitwise_or,
+    "bxor": np.bitwise_xor,
+    "land": _np_logical(np.logical_and),
+    "lor": _np_logical(np.logical_or),
+    "lxor": _np_logical(np.logical_xor),
+    "minloc": _np_minloc,
+    "maxloc": _np_maxloc,
 }
 
 SUM = Op(jnp.add, name="sum", xla_prim="sum", predefined=True)

@@ -273,6 +273,47 @@ class RankCommunicator:
         data, _ = self._coll_pml.recv(src, tag)
         return data
 
+    # -- staged device tier (the coll/accelerator bracket, inverted) ---
+    # The reference stages device buffers OUT to run host algorithms
+    # (coll_accelerator_allreduce.c:55-80); here host/C buffers above
+    # coll_tuned_stage_min_bytes stage IN — one device shard per rank —
+    # so the collective rides the fabric as one compiled XLA program
+    # and the result copies back. This is the path that puts textbook
+    # C programs (numpy buffers via api/cabi.py) on the TPU.
+    def _stage_min(self, func: str) -> int:
+        # one decision plane with the single-controller tier: the flat
+        # MCA var plus the per-collective dynamic-rules override
+        from ompi_tpu.coll.tuned import stage_min_for
+        return stage_min_for(func)
+
+    def _stageable(self, data: Any, op: Optional[op_mod.Op] = None,
+                   nbytes: Optional[int] = None,
+                   func: str = "allreduce") -> bool:
+        """Local staging decision. Only called with arguments whose
+        relevant properties (shape, dtype, size) are identical on every
+        member by MPI semantics, so all ranks decide alike — the device
+        dispatch below is collective and a split decision would hang
+        the job. Asymmetric-argument collectives (bcast) must propagate
+        one rank's decision instead. ``nbytes`` overrides the payload
+        size for collectives whose full payload spans several chunks."""
+        if not isinstance(data, np.ndarray):
+            return False
+        if data.dtype.kind not in "fiub":
+            return False
+        if (data.nbytes if nbytes is None else nbytes) \
+                < self._stage_min(func):
+            return False
+        if data.dtype.itemsize == 8:
+            import jax
+            if not jax.config.jax_enable_x64:
+                return False             # silent downcast would corrupt
+        if op is not None:
+            if op.is_loc or op.fn is None:
+                return False             # pair ops stay on the host fold
+            if getattr(op, "_c_callback", None) is not None:
+                return False             # C fn pointers cannot trace
+        return self._mesh() is not None
+
     def barrier(self) -> None:
         """Dissemination barrier: ceil(log2 n) rounds
         (coll_base_barrier.c bruck/dissemination)."""
@@ -287,12 +328,45 @@ class RankCommunicator:
 
     def bcast(self, data: Any = None, root: int = 0) -> Any:
         """Binomial-tree bcast (coll_base_bcast.c binomial): non-root
-        callers pass nothing and receive the root's value."""
+        callers pass nothing and receive the root's value.
+
+        Staged device tier (the coll/accelerator bracket inverted,
+        ``coll_accelerator_allreduce.c:55-80``): bcast's args are
+        asymmetric — non-root callers may hold nothing — so the root's
+        staging decision travels first as a small host-tier metadata
+        bcast, then every rank joins the one compiled device bcast
+        with a right-shaped local buffer. Cost: log(n) tiny messages
+        before a >=stage_min_bytes payload rides the fabric once."""
         self._check()
         self._validate_root(root)
         spc.record("coll_bcast", 1)
         if isinstance(data, _dev_array_type()) and self._mesh() is not None:
             return self._device_bcast(data, root)
+        if self._mesh() is not None:
+            # ONE binomial round carries (staging decision, payload):
+            # staged -> (meta, None), the payload rides the device op;
+            # not staged -> (None, data), the payload already arrived.
+            if self._rank == root:
+                if self._stageable(data, func="bcast"):
+                    msg = ((tuple(data.shape), data.dtype.str), None)
+                else:
+                    msg = (None, data)
+            else:
+                msg = None
+            meta, payload = self._host_bcast(msg, root)
+            if meta is not None:
+                shape, dtstr = meta
+                local = (np.ascontiguousarray(data) if self._rank == root
+                         else np.empty(shape, np.dtype(dtstr)))
+                spc.record("coll_staged_device", 1)
+                res = self._device_bcast(local, root)
+                # the root already holds the payload: participate in
+                # the collective but skip the redundant D2H copy
+                return data if self._rank == root else np.asarray(res)
+            return data if self._rank == root else payload
+        return self._host_bcast(data, root)
+
+    def _host_bcast(self, data: Any, root: int) -> Any:
         n, t = self.size, self._tag()
         vr = (self._rank - root) % n
         mask = 1
@@ -328,6 +402,11 @@ class RankCommunicator:
             for x in rows[1:]:
                 acc = _apply(op, acc, x)
             return acc
+        if self._stageable(data, op, func="reduce"):
+            spc.record("coll_staged_device", 1)
+            y = self._device_allreduce(np.ascontiguousarray(data), op)
+            # only the root pays the D2H copy; others just participate
+            return np.asarray(y) if self._rank == root else None
         vr = (self._rank - root) % n
         acc = data
         k = 1
@@ -346,6 +425,10 @@ class RankCommunicator:
         spc.record("coll_allreduce", 1)
         if isinstance(data, _dev_array_type()) and self._mesh() is not None:
             return self._device_allreduce(data, op)
+        if self._stageable(data, op):
+            spc.record("coll_staged_device", 1)
+            return np.asarray(self._device_allreduce(
+                np.ascontiguousarray(data), op))
         r = self.reduce(data, op, 0)
         return self.bcast(r, 0)
 
@@ -383,13 +466,23 @@ class RankCommunicator:
             return chunks[root]
         return self._crecv(root, t)
 
-    def allgather(self, data: Any) -> List[Any]:
+    def allgather(self, data: Any, *, uniform: bool = False) -> List[Any]:
         """Ring allgather (coll_base_allgather ring): n-1 rounds, each
-        forwarding the chunk received last round."""
+        forwarding the chunk received last round.
+
+        ``uniform=True`` asserts every caller passes one (shape, dtype)
+        — the C `MPI_Allgather` signature guarantee — unlocking the
+        staged device tier for large host buffers (see ``alltoall``:
+        the staging decision must be rank-symmetric, and the generic
+        host path legally carries ragged objects)."""
         self._check()
         spc.record("coll_allgather", 1)
         if isinstance(data, _dev_array_type()) and self._mesh() is not None:
             return self._device_allgather(data)
+        if uniform and self._stageable(data, func="allgather"):
+            spc.record("coll_staged_device", 1)
+            return [np.asarray(g) for g in self._device_allgather(
+                np.ascontiguousarray(data))]
         n, r, t = self.size, self._rank, self._tag()
         out: List[Any] = [None] * n
         out[r] = data
@@ -403,8 +496,17 @@ class RankCommunicator:
             out[(r - 1 - s) % n] = cur
         return out
 
-    def alltoall(self, chunks: Sequence[Any]) -> List[Any]:
-        """Pairwise-exchange alltoall (coll_base_alltoall pairwise)."""
+    def alltoall(self, chunks: Sequence[Any], *,
+                 uniform: bool = False) -> List[Any]:
+        """Pairwise-exchange alltoall (coll_base_alltoall pairwise).
+
+        ``uniform=True`` asserts that every CALLER passes chunks of one
+        (shape, dtype) — the property the C `MPI_Alltoall` signature
+        (one sendcount/sendtype) guarantees globally. Only then may
+        large host chunks take the staged device tier: the staging
+        decision must be identical on every rank (the device dispatch
+        is collective), and chunk uniformity checked locally cannot
+        prove anything about other ranks' generic-object chunks."""
         self._check()
         spc.record("coll_alltoall", 1)
         n, r, t = self.size, self._rank, self._tag()
@@ -413,6 +515,14 @@ class RankCommunicator:
         if all(isinstance(c, _dev_array_type()) for c in chunks) \
                 and self._mesh() is not None and n > 1:
             return self._device_alltoall(chunks)
+        if (uniform and n > 1 and chunks
+                and all(isinstance(c, np.ndarray) for c in chunks)
+                and len({(c.shape, c.dtype.str) for c in chunks}) == 1
+                and self._stageable(chunks[0], nbytes=chunks[0].nbytes * n,
+                                    func="alltoall")):
+            spc.record("coll_staged_device", 1)
+            return [np.asarray(g) for g in self._device_alltoall(
+                [np.ascontiguousarray(c) for c in chunks])]
         out: List[Any] = [None] * n
         out[r] = chunks[r]
         for s in range(1, n):
@@ -910,9 +1020,21 @@ class RankCommunicator:
 
 
 def _apply(op: op_mod.Op, a: Any, b: Any) -> Any:
-    """Apply a reduction combiner on the host tier: numpy in, numpy out
-    (op combiners are jax-traceable and accept numpy operands)."""
+    """Apply a reduction combiner on the host tier: numpy in, numpy out.
+    Predefined ops take the C++ SIMD kernel table (the op/avx role) or
+    a dtype-preserving numpy ufunc — never the jnp combiner, which
+    would silently downcast 64-bit numpy operands to 32-bit whenever
+    jax runs without x64 (the per-rank default)."""
     if isinstance(a, np.ndarray) or isinstance(b, np.ndarray):
+        if op.predefined:
+            an, bn = np.asarray(a), np.asarray(b)
+            from ompi_tpu.native import native_reduce_local
+            out = native_reduce_local(op.name, an, bn)
+            if out is not None:
+                return np.asarray(out)
+            npfn = op_mod.NP_COMBINERS.get(op.name)
+            if npfn is not None:
+                return np.asarray(npfn(an, bn))
         return np.asarray(op.fn(a, b))
     try:
         import jax

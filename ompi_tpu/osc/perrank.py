@@ -34,27 +34,14 @@ from ompi_tpu.core.errhandler import ERR_ARG, ERR_RANK, MPIError
 LOCK_EXCLUSIVE = 1
 LOCK_SHARED = 2
 
-def _logical(npfn):
-    """MPI logical ops yield 0/1 IN THE OPERAND TYPE (a bool result
-    would change the element size under the typed byte-window view)."""
-    def fn(a, b):
-        return npfn(a, b).astype(np.asarray(b).dtype)
-    return fn
-
+# dtype-preserving numpy combiners (shared host fold table) plus the
+# two accumulate-only pseudo-ops
+from ompi_tpu.core.op import NP_COMBINERS as _NP_COMBINERS
 
 _ACC_OPS = {
-    "sum": np.add,
-    "prod": np.multiply,
-    "max": np.maximum,
-    "min": np.minimum,
+    **_NP_COMBINERS,
     "replace": None,                    # MPI_REPLACE
     "no_op": False,                     # MPI_NO_OP (fetch only)
-    "band": np.bitwise_and,
-    "bor": np.bitwise_or,
-    "bxor": np.bitwise_xor,
-    "land": _logical(np.logical_and),
-    "lor": _logical(np.logical_or),
-    "lxor": _logical(np.logical_xor),
 }
 
 

@@ -37,7 +37,13 @@ CASES = [
     ("c10_icoll_pack.c", 3),
     ("c11_rma.c", 3),
     ("c12_mpiio.c", 3),
+    ("c13_staged.c", 2),
 ]
+
+# per-program argv (c13 runs 4M floats = 16 MB in CI — above the 1 MB
+# staging threshold so the device tier is exercised, small enough for
+# the 1-core host; the 64 MB default is the manual/bench shape)
+PROG_ARGS = {"c13_staged.c": ["4194304"]}
 
 
 @pytest.fixture(scope="module")
@@ -67,7 +73,7 @@ def test_cabi_program(binaries, src, n):
     # re-asserts this over any sitecustomize platform pin
     res = subprocess.run(
         [sys.executable, _MPIRUN, "--per-rank", "-n", str(n),
-         "--timeout", "150", binaries[src]],
+         "--timeout", "150", binaries[src], *PROG_ARGS.get(src, [])],
         env=env, capture_output=True, text=True, timeout=200, cwd=_REPO)
     assert res.returncode == 0, \
         f"rc={res.returncode}\n--- out\n{res.stdout}\n--- err\n" \

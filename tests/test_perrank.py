@@ -44,6 +44,7 @@ CASES = [
     ("p23_sessions.py", 3),
     ("p25_thread_multiple.py", 2),
     ("p26_churn.py", 3),
+    ("p27_staged_coll.py", 3),
 ]
 
 
