@@ -418,6 +418,13 @@ int MPI_File_get_size(MPI_File fh, MPI_Offset *size);
 int MPI_File_set_size(MPI_File fh, MPI_Offset size);
 int MPI_File_sync(MPI_File fh);
 
+/* ---- PMPI profiling interface ----
+ * Every MPI_X above has a PMPI_X twin (generated from this header by
+ * native/gen_pmpi.py); the library defines PMPI_X strongly and MPI_X
+ * as a weak alias, so tools interpose MPI_X and call PMPI_X onward
+ * (the reference's profiling contract, docs/features/profiling.rst). */
+#include "mpi_pmpi.h"
+
 #ifdef __cplusplus
 }
 #endif

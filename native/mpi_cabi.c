@@ -234,7 +234,7 @@ static int copy_bytes(PyObject *bytes, void *buf, size_t cap)
 /* ------------------------------------------------------------------ */
 /* world lifecycle                                                     */
 /* ------------------------------------------------------------------ */
-int MPI_Init_thread(int *argc, char ***argv, int required, int *provided)
+int PMPI_Init_thread(int *argc, char ***argv, int required, int *provided)
 {
     (void)argc;
     (void)argv;
@@ -269,13 +269,13 @@ int MPI_Init_thread(int *argc, char ***argv, int required, int *provided)
     return rc;
 }
 
-int MPI_Init(int *argc, char ***argv)
+int PMPI_Init(int *argc, char ***argv)
 {
     int provided;
-    return MPI_Init_thread(argc, argv, MPI_THREAD_SINGLE, &provided);
+    return PMPI_Init_thread(argc, argv, MPI_THREAD_SINGLE, &provided);
 }
 
-int MPI_Finalize(void)
+int PMPI_Finalize(void)
 {
     GIL_BEGIN;
     int rc = MPI_SUCCESS;
@@ -307,17 +307,17 @@ static int flag_query(const char *fn, int *flag)
     return rc;
 }
 
-int MPI_Initialized(int *flag)
+int PMPI_Initialized(int *flag)
 {
     return flag_query("initialized", flag);
 }
 
-int MPI_Finalized(int *flag)
+int PMPI_Finalized(int *flag)
 {
     return flag_query("finalized", flag);
 }
 
-int MPI_Abort(MPI_Comm comm, int errorcode)
+int PMPI_Abort(MPI_Comm comm, int errorcode)
 {
     if (Py_IsInitialized() && g_mod) {
         GIL_BEGIN;
@@ -329,7 +329,7 @@ int MPI_Abort(MPI_Comm comm, int errorcode)
     _exit(errorcode > 0 && errorcode < 256 ? errorcode : 1);
 }
 
-int MPI_Get_processor_name(char *name, int *resultlen)
+int PMPI_Get_processor_name(char *name, int *resultlen)
 {
     GIL_BEGIN;
     int rc = MPI_SUCCESS;
@@ -346,7 +346,7 @@ int MPI_Get_processor_name(char *name, int *resultlen)
     return rc;
 }
 
-int MPI_Error_string(int errorcode, char *string, int *resultlen)
+int PMPI_Error_string(int errorcode, char *string, int *resultlen)
 {
     if (Py_IsInitialized() && g_mod) {
         GIL_BEGIN;
@@ -370,14 +370,14 @@ int MPI_Error_string(int errorcode, char *string, int *resultlen)
     return MPI_SUCCESS;
 }
 
-double MPI_Wtime(void)
+double PMPI_Wtime(void)
 {
     struct timespec ts;
     clock_gettime(CLOCK_MONOTONIC, &ts);
     return (double)ts.tv_sec + (double)ts.tv_nsec * 1e-9;
 }
 
-double MPI_Wtick(void)
+double PMPI_Wtick(void)
 {
     return 1e-9;
 }
@@ -400,17 +400,17 @@ static int int_query(const char *fn, MPI_Comm comm, int *out)
     return rc;
 }
 
-int MPI_Comm_rank(MPI_Comm comm, int *rank)
+int PMPI_Comm_rank(MPI_Comm comm, int *rank)
 {
     return int_query("comm_rank", comm, rank);
 }
 
-int MPI_Comm_size(MPI_Comm comm, int *size)
+int PMPI_Comm_size(MPI_Comm comm, int *size)
 {
     return int_query("comm_size", comm, size);
 }
 
-int MPI_Comm_dup(MPI_Comm comm, MPI_Comm *newcomm)
+int PMPI_Comm_dup(MPI_Comm comm, MPI_Comm *newcomm)
 {
     GIL_BEGIN;
     int rc = MPI_SUCCESS;
@@ -425,7 +425,7 @@ int MPI_Comm_dup(MPI_Comm comm, MPI_Comm *newcomm)
     return rc;
 }
 
-int MPI_Comm_split(MPI_Comm comm, int color, int key, MPI_Comm *newcomm)
+int PMPI_Comm_split(MPI_Comm comm, int color, int key, MPI_Comm *newcomm)
 {
     GIL_BEGIN;
     int rc = MPI_SUCCESS;
@@ -441,7 +441,7 @@ int MPI_Comm_split(MPI_Comm comm, int color, int key, MPI_Comm *newcomm)
     return rc;
 }
 
-int MPI_Comm_free(MPI_Comm *comm)
+int PMPI_Comm_free(MPI_Comm *comm)
 {
     GIL_BEGIN;
     int rc = MPI_SUCCESS;
@@ -457,7 +457,7 @@ int MPI_Comm_free(MPI_Comm *comm)
     return rc;
 }
 
-int MPI_Comm_set_errhandler(MPI_Comm comm, MPI_Errhandler errhandler)
+int PMPI_Comm_set_errhandler(MPI_Comm comm, MPI_Errhandler errhandler)
 {
     if (errhandler != MPI_ERRORS_ARE_FATAL
         && errhandler != MPI_ERRORS_RETURN)
@@ -502,21 +502,21 @@ static int send_common(const void *buf, int count, MPI_Datatype dt,
     return rc;
 }
 
-int MPI_Send(const void *buf, int count, MPI_Datatype datatype, int dest,
+int PMPI_Send(const void *buf, int count, MPI_Datatype datatype, int dest,
              int tag, MPI_Comm comm)
 {
     return send_common(buf, count, datatype, dest, tag, comm, 0,
                        "MPI_Send");
 }
 
-int MPI_Ssend(const void *buf, int count, MPI_Datatype datatype, int dest,
+int PMPI_Ssend(const void *buf, int count, MPI_Datatype datatype, int dest,
               int tag, MPI_Comm comm)
 {
     return send_common(buf, count, datatype, dest, tag, comm, 1,
                        "MPI_Ssend");
 }
 
-int MPI_Recv(void *buf, int count, MPI_Datatype datatype, int source,
+int PMPI_Recv(void *buf, int count, MPI_Datatype datatype, int source,
              int tag, MPI_Comm comm, MPI_Status *status)
 {
     size_t esz = dt_extent(datatype);
@@ -540,7 +540,7 @@ int MPI_Recv(void *buf, int count, MPI_Datatype datatype, int source,
     return rc;
 }
 
-int MPI_Sendrecv(const void *sendbuf, int sendcount,
+int PMPI_Sendrecv(const void *sendbuf, int sendcount,
                  MPI_Datatype sendtype, int dest, int sendtag,
                  void *recvbuf, int recvcount, MPI_Datatype recvtype,
                  int source, int recvtag, MPI_Comm comm,
@@ -568,7 +568,7 @@ int MPI_Sendrecv(const void *sendbuf, int sendcount,
     return rc;
 }
 
-int MPI_Isend(const void *buf, int count, MPI_Datatype datatype, int dest,
+int PMPI_Isend(const void *buf, int count, MPI_Datatype datatype, int dest,
               int tag, MPI_Comm comm, MPI_Request *request)
 {
     size_t esz = dt_extent(datatype);
@@ -591,7 +591,7 @@ int MPI_Isend(const void *buf, int count, MPI_Datatype datatype, int dest,
     return rc;
 }
 
-int MPI_Irecv(void *buf, int count, MPI_Datatype datatype, int source,
+int PMPI_Irecv(void *buf, int count, MPI_Datatype datatype, int source,
               int tag, MPI_Comm comm, MPI_Request *request)
 {
     size_t esz = dt_extent(datatype);
@@ -617,7 +617,7 @@ int MPI_Irecv(void *buf, int count, MPI_Datatype datatype, int source,
     return rc;
 }
 
-int MPI_Wait(MPI_Request *request, MPI_Status *status)
+int PMPI_Wait(MPI_Request *request, MPI_Status *status)
 {
     if (!request || *request == MPI_REQUEST_NULL) {
         set_status(status, MPI_ANY_SOURCE, MPI_ANY_TAG, 0);
@@ -647,12 +647,12 @@ int MPI_Wait(MPI_Request *request, MPI_Status *status)
     return rc;
 }
 
-int MPI_Waitall(int count, MPI_Request array_of_requests[],
+int PMPI_Waitall(int count, MPI_Request array_of_requests[],
                 MPI_Status array_of_statuses[])
 {
     int rc = MPI_SUCCESS;
     for (int i = 0; i < count; i++) {
-        int r = MPI_Wait(&array_of_requests[i],
+        int r = PMPI_Wait(&array_of_requests[i],
                          array_of_statuses ? &array_of_statuses[i]
                                            : MPI_STATUS_IGNORE);
         if (r != MPI_SUCCESS)
@@ -661,7 +661,7 @@ int MPI_Waitall(int count, MPI_Request array_of_requests[],
     return rc;
 }
 
-int MPI_Test(MPI_Request *request, int *flag, MPI_Status *status)
+int PMPI_Test(MPI_Request *request, int *flag, MPI_Status *status)
 {
     if (!request || *request == MPI_REQUEST_NULL) {
         *flag = 1;
@@ -714,7 +714,7 @@ int MPI_Test(MPI_Request *request, int *flag, MPI_Status *status)
     return rc;
 }
 
-int MPI_Probe(int source, int tag, MPI_Comm comm, MPI_Status *status)
+int PMPI_Probe(int source, int tag, MPI_Comm comm, MPI_Status *status)
 {
     GIL_BEGIN;
     int rc = MPI_SUCCESS;
@@ -733,7 +733,7 @@ int MPI_Probe(int source, int tag, MPI_Comm comm, MPI_Status *status)
     return rc;
 }
 
-int MPI_Iprobe(int source, int tag, MPI_Comm comm, int *flag,
+int PMPI_Iprobe(int source, int tag, MPI_Comm comm, int *flag,
                MPI_Status *status)
 {
     *flag = 0;
@@ -756,7 +756,7 @@ int MPI_Iprobe(int source, int tag, MPI_Comm comm, int *flag,
     return rc;
 }
 
-int MPI_Get_count(const MPI_Status *status, MPI_Datatype datatype,
+int PMPI_Get_count(const MPI_Status *status, MPI_Datatype datatype,
                   int *count)
 {
     if (!status)
@@ -777,7 +777,7 @@ int MPI_Get_count(const MPI_Status *status, MPI_Datatype datatype,
 /* ------------------------------------------------------------------ */
 /* collectives                                                         */
 /* ------------------------------------------------------------------ */
-int MPI_Barrier(MPI_Comm comm)
+int PMPI_Barrier(MPI_Comm comm)
 {
     GIL_BEGIN;
     int rc = MPI_SUCCESS;
@@ -790,7 +790,7 @@ int MPI_Barrier(MPI_Comm comm)
     return rc;
 }
 
-int MPI_Bcast(void *buffer, int count, MPI_Datatype datatype, int root,
+int PMPI_Bcast(void *buffer, int count, MPI_Datatype datatype, int root,
               MPI_Comm comm)
 {
     size_t esz = dt_extent(datatype);
@@ -819,7 +819,7 @@ static const void *pick_in(const void *sendbuf, const void *recvbuf)
     return sendbuf == MPI_IN_PLACE ? recvbuf : sendbuf;
 }
 
-int MPI_Allreduce(const void *sendbuf, void *recvbuf, int count,
+int PMPI_Allreduce(const void *sendbuf, void *recvbuf, int count,
                   MPI_Datatype datatype, MPI_Op op, MPI_Comm comm)
 {
     size_t esz = dt_size(datatype);
@@ -842,7 +842,7 @@ int MPI_Allreduce(const void *sendbuf, void *recvbuf, int count,
     return rc;
 }
 
-int MPI_Reduce(const void *sendbuf, void *recvbuf, int count,
+int PMPI_Reduce(const void *sendbuf, void *recvbuf, int count,
                MPI_Datatype datatype, MPI_Op op, int root, MPI_Comm comm)
 {
     size_t esz = dt_size(datatype);
@@ -866,14 +866,14 @@ int MPI_Reduce(const void *sendbuf, void *recvbuf, int count,
     return rc;
 }
 
-int MPI_Gather(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
+int PMPI_Gather(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
                void *recvbuf, int recvcount, MPI_Datatype recvtype,
                int root, MPI_Comm comm)
 {
     int size, rank;
-    int qrc = MPI_Comm_size(comm, &size);
+    int qrc = PMPI_Comm_size(comm, &size);
     if (qrc == MPI_SUCCESS)
-        qrc = MPI_Comm_rank(comm, &rank);
+        qrc = PMPI_Comm_rank(comm, &rank);
     if (qrc != MPI_SUCCESS)
         return qrc;
     /* recvtype/recvcount are significant at the root only (MPI-3.1);
@@ -914,14 +914,14 @@ int MPI_Gather(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
     return rc;
 }
 
-int MPI_Scatter(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
+int PMPI_Scatter(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
                 void *recvbuf, int recvcount, MPI_Datatype recvtype,
                 int root, MPI_Comm comm)
 {
     int size, rank;
-    int qrc = MPI_Comm_size(comm, &size);
+    int qrc = PMPI_Comm_size(comm, &size);
     if (qrc == MPI_SUCCESS)
-        qrc = MPI_Comm_rank(comm, &rank);
+        qrc = PMPI_Comm_rank(comm, &rank);
     if (qrc != MPI_SUCCESS)
         return qrc;
     /* sendtype/sendcount significant at the root only; MPI_IN_PLACE
@@ -960,7 +960,7 @@ int MPI_Scatter(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
     return rc;
 }
 
-int MPI_Allgather(const void *sendbuf, int sendcount,
+int PMPI_Allgather(const void *sendbuf, int sendcount,
                   MPI_Datatype sendtype, void *recvbuf, int recvcount,
                   MPI_Datatype recvtype, MPI_Comm comm)
 {
@@ -968,9 +968,9 @@ int MPI_Allgather(const void *sendbuf, int sendcount,
     if (!rsz || recvcount < 0)
         return MPI_ERR_TYPE;
     int size, rank;
-    int qrc = MPI_Comm_size(comm, &size);
+    int qrc = PMPI_Comm_size(comm, &size);
     if (qrc == MPI_SUCCESS)
-        qrc = MPI_Comm_rank(comm, &rank);
+        qrc = PMPI_Comm_rank(comm, &rank);
     if (qrc != MPI_SUCCESS)
         return qrc;
     if (sendbuf == MPI_IN_PLACE) {
@@ -1000,7 +1000,7 @@ int MPI_Allgather(const void *sendbuf, int sendcount,
     return rc;
 }
 
-int MPI_Alltoall(const void *sendbuf, int sendcount,
+int PMPI_Alltoall(const void *sendbuf, int sendcount,
                  MPI_Datatype sendtype, void *recvbuf, int recvcount,
                  MPI_Datatype recvtype, MPI_Comm comm)
 {
@@ -1008,7 +1008,7 @@ int MPI_Alltoall(const void *sendbuf, int sendcount,
     if (!rsz || recvcount < 0)
         return MPI_ERR_TYPE;
     int size;
-    int qrc = MPI_Comm_size(comm, &size);
+    int qrc = PMPI_Comm_size(comm, &size);
     if (qrc != MPI_SUCCESS)
         return qrc;
     if (sendbuf == MPI_IN_PLACE) {
@@ -1061,21 +1061,21 @@ static int scan_common(const void *sendbuf, void *recvbuf, int count,
     return rc;
 }
 
-int MPI_Scan(const void *sendbuf, void *recvbuf, int count,
+int PMPI_Scan(const void *sendbuf, void *recvbuf, int count,
              MPI_Datatype datatype, MPI_Op op, MPI_Comm comm)
 {
     return scan_common(sendbuf, recvbuf, count, datatype, op, comm,
                        "MPI_Scan", "scan");
 }
 
-int MPI_Exscan(const void *sendbuf, void *recvbuf, int count,
+int PMPI_Exscan(const void *sendbuf, void *recvbuf, int count,
                MPI_Datatype datatype, MPI_Op op, MPI_Comm comm)
 {
     return scan_common(sendbuf, recvbuf, count, datatype, op, comm,
                        "MPI_Exscan", "exscan");
 }
 
-int MPI_Reduce_scatter_block(const void *sendbuf, void *recvbuf,
+int PMPI_Reduce_scatter_block(const void *sendbuf, void *recvbuf,
                              int recvcount, MPI_Datatype datatype,
                              MPI_Op op, MPI_Comm comm)
 {
@@ -1083,7 +1083,7 @@ int MPI_Reduce_scatter_block(const void *sendbuf, void *recvbuf,
     if (!esz || recvcount < 0)
         return MPI_ERR_TYPE;
     int size;
-    int qrc = MPI_Comm_size(comm, &size);
+    int qrc = PMPI_Comm_size(comm, &size);
     if (qrc != MPI_SUCCESS)
         return qrc;
     GIL_BEGIN;
@@ -1122,21 +1122,21 @@ static int type_ctor(const char *fn, const char *fmt, MPI_Datatype *out,
     return rc;
 }
 
-int MPI_Type_contiguous(int count, MPI_Datatype oldtype,
+int PMPI_Type_contiguous(int count, MPI_Datatype oldtype,
                         MPI_Datatype *newtype)
 {
     return type_ctor("type_contiguous", "ll", newtype, (long)count,
                      (long)oldtype, 0, 0);
 }
 
-int MPI_Type_vector(int count, int blocklength, int stride,
+int PMPI_Type_vector(int count, int blocklength, int stride,
                     MPI_Datatype oldtype, MPI_Datatype *newtype)
 {
     return type_ctor("type_vector", "llll", newtype, (long)count,
                      (long)blocklength, (long)stride, (long)oldtype);
 }
 
-int MPI_Type_commit(MPI_Datatype *datatype)
+int PMPI_Type_commit(MPI_Datatype *datatype)
 {
     GIL_BEGIN;
     int rc = MPI_SUCCESS;
@@ -1150,7 +1150,7 @@ int MPI_Type_commit(MPI_Datatype *datatype)
     return rc;
 }
 
-int MPI_Type_free(MPI_Datatype *datatype)
+int PMPI_Type_free(MPI_Datatype *datatype)
 {
     GIL_BEGIN;
     int rc = MPI_SUCCESS;
@@ -1184,7 +1184,7 @@ static int type_query(const char *fn, MPI_Datatype dt, long *out)
     return rc;
 }
 
-int MPI_Type_size(MPI_Datatype datatype, int *size)
+int PMPI_Type_size(MPI_Datatype datatype, int *size)
 {
     long s;
     int rc = type_query("type_size_bytes", datatype, &s);
@@ -1193,7 +1193,7 @@ int MPI_Type_size(MPI_Datatype datatype, int *size)
     return rc;
 }
 
-int MPI_Type_get_extent(MPI_Datatype datatype, MPI_Aint *lb,
+int PMPI_Type_get_extent(MPI_Datatype datatype, MPI_Aint *lb,
                         MPI_Aint *extent)
 {
     long e;
@@ -1220,7 +1220,7 @@ static size_t v_extent(const int *counts, const int *displs, int size)
     return top;
 }
 
-int MPI_Allgatherv(const void *sendbuf, int sendcount,
+int PMPI_Allgatherv(const void *sendbuf, int sendcount,
                    MPI_Datatype sendtype, void *recvbuf,
                    const int recvcounts[], const int displs[],
                    MPI_Datatype recvtype, MPI_Comm comm)
@@ -1229,7 +1229,7 @@ int MPI_Allgatherv(const void *sendbuf, int sendcount,
     if (!ssz || !rsz || sendcount < 0)
         return MPI_ERR_TYPE;
     int size;
-    int qrc = MPI_Comm_size(comm, &size);
+    int qrc = PMPI_Comm_size(comm, &size);
     if (qrc != MPI_SUCCESS)
         return qrc;
     size_t cap = v_extent(recvcounts, displs, size) * rsz;
@@ -1251,7 +1251,7 @@ int MPI_Allgatherv(const void *sendbuf, int sendcount,
     return rc;
 }
 
-int MPI_Gatherv(const void *sendbuf, int sendcount,
+int PMPI_Gatherv(const void *sendbuf, int sendcount,
                 MPI_Datatype sendtype, void *recvbuf,
                 const int recvcounts[], const int displs[],
                 MPI_Datatype recvtype, int root, MPI_Comm comm)
@@ -1260,9 +1260,9 @@ int MPI_Gatherv(const void *sendbuf, int sendcount,
     if (!ssz || sendcount < 0)
         return MPI_ERR_TYPE;
     int size, rank;
-    int qrc = MPI_Comm_size(comm, &size);
+    int qrc = PMPI_Comm_size(comm, &size);
     if (qrc == MPI_SUCCESS)
-        qrc = MPI_Comm_rank(comm, &rank);
+        qrc = PMPI_Comm_rank(comm, &rank);
     if (qrc != MPI_SUCCESS)
         return qrc;
     size_t rsz = 0, cap = 0;
@@ -1293,7 +1293,7 @@ int MPI_Gatherv(const void *sendbuf, int sendcount,
     return rc;
 }
 
-int MPI_Scatterv(const void *sendbuf, const int sendcounts[],
+int PMPI_Scatterv(const void *sendbuf, const int sendcounts[],
                  const int displs[], MPI_Datatype sendtype,
                  void *recvbuf, int recvcount, MPI_Datatype recvtype,
                  int root, MPI_Comm comm)
@@ -1302,9 +1302,9 @@ int MPI_Scatterv(const void *sendbuf, const int sendcounts[],
     if (!rsz || recvcount < 0)
         return MPI_ERR_TYPE;
     int size, rank;
-    int qrc = MPI_Comm_size(comm, &size);
+    int qrc = PMPI_Comm_size(comm, &size);
     if (qrc == MPI_SUCCESS)
-        qrc = MPI_Comm_rank(comm, &rank);
+        qrc = PMPI_Comm_rank(comm, &rank);
     if (qrc != MPI_SUCCESS)
         return qrc;
     size_t ssz = 0, in_bytes = 0;
@@ -1334,7 +1334,7 @@ int MPI_Scatterv(const void *sendbuf, const int sendcounts[],
     return rc;
 }
 
-int MPI_Alltoallv(const void *sendbuf, const int sendcounts[],
+int PMPI_Alltoallv(const void *sendbuf, const int sendcounts[],
                   const int sdispls[], MPI_Datatype sendtype,
                   void *recvbuf, const int recvcounts[],
                   const int rdispls[], MPI_Datatype recvtype,
@@ -1344,7 +1344,7 @@ int MPI_Alltoallv(const void *sendbuf, const int sendcounts[],
     if (!ssz || !rsz)
         return MPI_ERR_TYPE;
     int size;
-    int qrc = MPI_Comm_size(comm, &size);
+    int qrc = PMPI_Comm_size(comm, &size);
     if (qrc != MPI_SUCCESS)
         return qrc;
     size_t in_bytes = v_extent(sendcounts, sdispls, size) * ssz;
@@ -1372,7 +1372,7 @@ int MPI_Alltoallv(const void *sendbuf, const int sendcounts[],
 /* ------------------------------------------------------------------ */
 /* cartesian topologies (topo framework)                               */
 /* ------------------------------------------------------------------ */
-int MPI_Dims_create(int nnodes, int ndims, int dims[])
+int PMPI_Dims_create(int nnodes, int ndims, int dims[])
 {
     GIL_BEGIN;
     int rc = MPI_SUCCESS;
@@ -1389,7 +1389,7 @@ int MPI_Dims_create(int nnodes, int ndims, int dims[])
     return rc;
 }
 
-int MPI_Cart_create(MPI_Comm comm, int ndims, const int dims[],
+int PMPI_Cart_create(MPI_Comm comm, int ndims, const int dims[],
                     const int periods[], int reorder,
                     MPI_Comm *comm_cart)
 {
@@ -1409,7 +1409,7 @@ int MPI_Cart_create(MPI_Comm comm, int ndims, const int dims[],
     return rc;
 }
 
-int MPI_Cart_coords(MPI_Comm comm, int rank, int maxdims, int coords[])
+int PMPI_Cart_coords(MPI_Comm comm, int rank, int maxdims, int coords[])
 {
     GIL_BEGIN;
     int rc = MPI_SUCCESS;
@@ -1425,10 +1425,10 @@ int MPI_Cart_coords(MPI_Comm comm, int rank, int maxdims, int coords[])
     return rc;
 }
 
-int MPI_Cart_rank(MPI_Comm comm, const int coords[], int *rank)
+int PMPI_Cart_rank(MPI_Comm comm, const int coords[], int *rank)
 {
     int nd;
-    int qrc = MPI_Cartdim_get(comm, &nd);
+    int qrc = PMPI_Cartdim_get(comm, &nd);
     if (qrc != MPI_SUCCESS)
         return qrc;
     GIL_BEGIN;
@@ -1446,7 +1446,7 @@ int MPI_Cart_rank(MPI_Comm comm, const int coords[], int *rank)
     return rc;
 }
 
-int MPI_Cart_shift(MPI_Comm comm, int direction, int disp,
+int PMPI_Cart_shift(MPI_Comm comm, int direction, int disp,
                    int *rank_source, int *rank_dest)
 {
     GIL_BEGIN;
@@ -1464,7 +1464,7 @@ int MPI_Cart_shift(MPI_Comm comm, int direction, int disp,
     return rc;
 }
 
-int MPI_Cart_get(MPI_Comm comm, int maxdims, int dims[], int periods[],
+int PMPI_Cart_get(MPI_Comm comm, int maxdims, int dims[], int periods[],
                  int coords[])
 {
     GIL_BEGIN;
@@ -1486,7 +1486,7 @@ int MPI_Cart_get(MPI_Comm comm, int maxdims, int dims[], int periods[],
     return rc;
 }
 
-int MPI_Cartdim_get(MPI_Comm comm, int *ndims)
+int PMPI_Cartdim_get(MPI_Comm comm, int *ndims)
 {
     GIL_BEGIN;
     int rc = MPI_SUCCESS;
@@ -1505,7 +1505,7 @@ int MPI_Cartdim_get(MPI_Comm comm, int *ndims)
 /* ------------------------------------------------------------------ */
 /* persistent point-to-point (MPI_Send_init / MPI_Recv_init / Start)   */
 /* ------------------------------------------------------------------ */
-int MPI_Send_init(const void *buf, int count, MPI_Datatype datatype,
+int PMPI_Send_init(const void *buf, int count, MPI_Datatype datatype,
                   int dest, int tag, MPI_Comm comm,
                   MPI_Request *request)
 {
@@ -1523,7 +1523,7 @@ int MPI_Send_init(const void *buf, int count, MPI_Datatype datatype,
     return MPI_SUCCESS;
 }
 
-int MPI_Recv_init(void *buf, int count, MPI_Datatype datatype,
+int PMPI_Recv_init(void *buf, int count, MPI_Datatype datatype,
                   int source, int tag, MPI_Comm comm,
                   MPI_Request *request)
 {
@@ -1544,7 +1544,7 @@ int MPI_Recv_init(void *buf, int count, MPI_Datatype datatype,
     return MPI_SUCCESS;
 }
 
-int MPI_Start(MPI_Request *request)
+int PMPI_Start(MPI_Request *request)
 {
     if (!request || *request == MPI_REQUEST_NULL)
         return MPI_ERR_REQUEST;
@@ -1579,24 +1579,24 @@ int MPI_Start(MPI_Request *request)
     return rc;
 }
 
-int MPI_Startall(int count, MPI_Request array_of_requests[])
+int PMPI_Startall(int count, MPI_Request array_of_requests[])
 {
     for (int i = 0; i < count; i++) {
-        int rc = MPI_Start(&array_of_requests[i]);
+        int rc = PMPI_Start(&array_of_requests[i]);
         if (rc != MPI_SUCCESS)
             return rc;
     }
     return MPI_SUCCESS;
 }
 
-int MPI_Request_free(MPI_Request *request)
+int PMPI_Request_free(MPI_Request *request)
 {
     if (!request || *request == MPI_REQUEST_NULL)
         return MPI_ERR_REQUEST;
     req_entry *e = (req_entry *)(intptr_t)*request;
     int rc = MPI_SUCCESS;
     if (e->pyh != 0) {                   /* active: complete first */
-        rc = MPI_Wait(request, MPI_STATUS_IGNORE);
+        rc = PMPI_Wait(request, MPI_STATUS_IGNORE);
         if (*request == MPI_REQUEST_NULL)
             return rc;                   /* non-persistent: freed */
         e = (req_entry *)(intptr_t)*request;
@@ -1642,7 +1642,7 @@ static int group_call2(const char *fn, long a, long b, long *out)
     return rc;
 }
 
-int MPI_Comm_group(MPI_Comm comm, MPI_Group *group)
+int PMPI_Comm_group(MPI_Comm comm, MPI_Group *group)
 {
     long g;
     int rc = group_call1("comm_group", (long)comm, &g);
@@ -1651,7 +1651,7 @@ int MPI_Comm_group(MPI_Comm comm, MPI_Group *group)
     return rc;
 }
 
-int MPI_Group_size(MPI_Group group, int *size)
+int PMPI_Group_size(MPI_Group group, int *size)
 {
     long v;
     int rc = group_call1("group_size", (long)group, &v);
@@ -1660,7 +1660,7 @@ int MPI_Group_size(MPI_Group group, int *size)
     return rc;
 }
 
-int MPI_Group_rank(MPI_Group group, int *rank)
+int PMPI_Group_rank(MPI_Group group, int *rank)
 {
     long v;
     int rc = group_call1("group_rank", (long)group, &v);
@@ -1687,19 +1687,19 @@ static int group_subset(const char *fn, MPI_Group group, int n,
     return rc;
 }
 
-int MPI_Group_incl(MPI_Group group, int n, const int ranks[],
+int PMPI_Group_incl(MPI_Group group, int n, const int ranks[],
                    MPI_Group *newgroup)
 {
     return group_subset("group_incl", group, n, ranks, newgroup);
 }
 
-int MPI_Group_excl(MPI_Group group, int n, const int ranks[],
+int PMPI_Group_excl(MPI_Group group, int n, const int ranks[],
                    MPI_Group *newgroup)
 {
     return group_subset("group_excl", group, n, ranks, newgroup);
 }
 
-int MPI_Group_union(MPI_Group group1, MPI_Group group2,
+int PMPI_Group_union(MPI_Group group1, MPI_Group group2,
                     MPI_Group *newgroup)
 {
     long g;
@@ -1709,7 +1709,7 @@ int MPI_Group_union(MPI_Group group1, MPI_Group group2,
     return rc;
 }
 
-int MPI_Group_intersection(MPI_Group group1, MPI_Group group2,
+int PMPI_Group_intersection(MPI_Group group1, MPI_Group group2,
                            MPI_Group *newgroup)
 {
     long g;
@@ -1720,7 +1720,7 @@ int MPI_Group_intersection(MPI_Group group1, MPI_Group group2,
     return rc;
 }
 
-int MPI_Group_difference(MPI_Group group1, MPI_Group group2,
+int PMPI_Group_difference(MPI_Group group1, MPI_Group group2,
                          MPI_Group *newgroup)
 {
     long g;
@@ -1731,7 +1731,7 @@ int MPI_Group_difference(MPI_Group group1, MPI_Group group2,
     return rc;
 }
 
-int MPI_Group_free(MPI_Group *group)
+int PMPI_Group_free(MPI_Group *group)
 {
     long v;
     int rc = group_call1("group_free", (long)*group, &v);
@@ -1741,7 +1741,7 @@ int MPI_Group_free(MPI_Group *group)
     return rc;
 }
 
-int MPI_Comm_create(MPI_Comm comm, MPI_Group group, MPI_Comm *newcomm)
+int PMPI_Comm_create(MPI_Comm comm, MPI_Group group, MPI_Comm *newcomm)
 {
     long c;
     int rc = group_call2("comm_create", (long)comm, (long)group, &c);
@@ -1753,7 +1753,7 @@ int MPI_Comm_create(MPI_Comm comm, MPI_Group group, MPI_Comm *newcomm)
 /* ------------------------------------------------------------------ */
 /* user-defined reduction operations (MPI_Op_create / MPI_Op_free)     */
 /* ------------------------------------------------------------------ */
-int MPI_Op_create(MPI_User_function *user_fn, int commute, MPI_Op *op)
+int PMPI_Op_create(MPI_User_function *user_fn, int commute, MPI_Op *op)
 {
     GIL_BEGIN;
     int rc = MPI_SUCCESS;
@@ -1770,7 +1770,7 @@ int MPI_Op_create(MPI_User_function *user_fn, int commute, MPI_Op *op)
     return rc;
 }
 
-int MPI_Op_free(MPI_Op *op)
+int PMPI_Op_free(MPI_Op *op)
 {
     GIL_BEGIN;
     int rc = MPI_SUCCESS;
@@ -1810,7 +1810,7 @@ static int req_peek_done(MPI_Request req)
     return done;
 }
 
-int MPI_Testall(int count, MPI_Request array_of_requests[], int *flag,
+int PMPI_Testall(int count, MPI_Request array_of_requests[], int *flag,
                 MPI_Status array_of_statuses[])
 {
     /* The standard's contract: flag=false modifies NOTHING. A
@@ -1827,7 +1827,7 @@ int MPI_Testall(int count, MPI_Request array_of_requests[], int *flag,
     int rc = MPI_SUCCESS;
     for (int i = 0; i < count; i++) {
         int f = 0;
-        int r = MPI_Test(&array_of_requests[i], &f,
+        int r = PMPI_Test(&array_of_requests[i], &f,
                          array_of_statuses ? &array_of_statuses[i]
                                            : MPI_STATUS_IGNORE);
         if (r != MPI_SUCCESS && rc == MPI_SUCCESS)
@@ -1842,7 +1842,7 @@ int MPI_Testall(int count, MPI_Request array_of_requests[], int *flag,
     return rc;
 }
 
-int MPI_Testany(int count, MPI_Request array_of_requests[], int *indx,
+int PMPI_Testany(int count, MPI_Request array_of_requests[], int *indx,
                 int *flag, MPI_Status *status)
 {
     *flag = 0;
@@ -1853,7 +1853,7 @@ int MPI_Testany(int count, MPI_Request array_of_requests[], int *indx,
             continue;
         all_null = 0;
         int f = 0;
-        int rc = MPI_Test(&array_of_requests[i], &f, status);
+        int rc = PMPI_Test(&array_of_requests[i], &f, status);
         if (rc != MPI_SUCCESS) {
             *indx = i;                   /* the caller must know WHICH
                                           * request completed in error
@@ -1875,12 +1875,12 @@ int MPI_Testany(int count, MPI_Request array_of_requests[], int *indx,
     return MPI_SUCCESS;
 }
 
-int MPI_Waitany(int count, MPI_Request array_of_requests[], int *indx,
+int PMPI_Waitany(int count, MPI_Request array_of_requests[], int *indx,
                 MPI_Status *status)
 {
     for (;;) {
         int flag = 0;
-        int rc = MPI_Testany(count, array_of_requests, indx, &flag,
+        int rc = PMPI_Testany(count, array_of_requests, indx, &flag,
                              status);
         if (rc != MPI_SUCCESS)
             return rc;
@@ -1893,7 +1893,7 @@ int MPI_Waitany(int count, MPI_Request array_of_requests[], int *indx,
     }
 }
 
-int MPI_Waitsome(int incount, MPI_Request array_of_requests[],
+int PMPI_Waitsome(int incount, MPI_Request array_of_requests[],
                  int *outcount, int array_of_indices[],
                  MPI_Status array_of_statuses[])
 {
@@ -1911,7 +1911,7 @@ int MPI_Waitsome(int incount, MPI_Request array_of_requests[],
             if (array_of_requests[i] == MPI_REQUEST_NULL)
                 continue;
             int f = 0;
-            int rc = MPI_Test(&array_of_requests[i], &f,
+            int rc = PMPI_Test(&array_of_requests[i], &f,
                               array_of_statuses
                                   ? &array_of_statuses[*outcount]
                                   : MPI_STATUS_IGNORE);
@@ -1934,19 +1934,19 @@ int MPI_Waitsome(int incount, MPI_Request array_of_requests[],
  * both reduce to standard send (the reference's bsend also degenerates
  * to eager below the buffer threshold; rsend's "receive must be
  * posted" precondition is the caller's promise, not checked) */
-int MPI_Bsend(const void *buf, int count, MPI_Datatype datatype,
+int PMPI_Bsend(const void *buf, int count, MPI_Datatype datatype,
               int dest, int tag, MPI_Comm comm)
 {
-    return MPI_Send(buf, count, datatype, dest, tag, comm);
+    return PMPI_Send(buf, count, datatype, dest, tag, comm);
 }
 
-int MPI_Rsend(const void *buf, int count, MPI_Datatype datatype,
+int PMPI_Rsend(const void *buf, int count, MPI_Datatype datatype,
               int dest, int tag, MPI_Comm comm)
 {
-    return MPI_Send(buf, count, datatype, dest, tag, comm);
+    return PMPI_Send(buf, count, datatype, dest, tag, comm);
 }
 
-int MPI_Comm_split_type(MPI_Comm comm, int split_type, int key,
+int PMPI_Comm_split_type(MPI_Comm comm, int split_type, int key,
                         MPI_Info info, MPI_Comm *newcomm)
 {
     (void)info;
@@ -1966,7 +1966,7 @@ int MPI_Comm_split_type(MPI_Comm comm, int split_type, int key,
     return rc;
 }
 
-int MPI_Comm_compare(MPI_Comm comm1, MPI_Comm comm2, int *result)
+int PMPI_Comm_compare(MPI_Comm comm1, MPI_Comm comm2, int *result)
 {
     long v;
     int rc = group_call2("comm_compare", (long)comm1, (long)comm2, &v);
@@ -1975,14 +1975,14 @@ int MPI_Comm_compare(MPI_Comm comm1, MPI_Comm comm2, int *result)
     return rc;
 }
 
-int MPI_Get_version(int *version, int *subversion)
+int PMPI_Get_version(int *version, int *subversion)
 {
     *version = 3;
     *subversion = 1;
     return MPI_SUCCESS;
 }
 
-int MPI_Get_library_version(char *version, int *resultlen)
+int PMPI_Get_library_version(char *version, int *resultlen)
 {
     snprintf(version, MPI_MAX_LIBRARY_VERSION_STRING,
              "ompi_tpu (TPU-native MPI over XLA/ICI), MPI 3.1 subset");
@@ -2007,7 +2007,7 @@ static int icoll_request(PyObject *r, void *buf, size_t cap,
     return MPI_SUCCESS;
 }
 
-int MPI_Ibarrier(MPI_Comm comm, MPI_Request *request)
+int PMPI_Ibarrier(MPI_Comm comm, MPI_Request *request)
 {
     GIL_BEGIN;
     PyObject *r = PyObject_CallMethod(g_mod, "ibarrier", "l",
@@ -2017,7 +2017,7 @@ int MPI_Ibarrier(MPI_Comm comm, MPI_Request *request)
     return rc;
 }
 
-int MPI_Ibcast(void *buffer, int count, MPI_Datatype datatype, int root,
+int PMPI_Ibcast(void *buffer, int count, MPI_Datatype datatype, int root,
                MPI_Comm comm, MPI_Request *request)
 {
     size_t esz = dt_extent(datatype);
@@ -2034,7 +2034,7 @@ int MPI_Ibcast(void *buffer, int count, MPI_Datatype datatype, int root,
     return rc;
 }
 
-int MPI_Iallreduce(const void *sendbuf, void *recvbuf, int count,
+int PMPI_Iallreduce(const void *sendbuf, void *recvbuf, int count,
                    MPI_Datatype datatype, MPI_Op op, MPI_Comm comm,
                    MPI_Request *request)
 {
@@ -2053,7 +2053,7 @@ int MPI_Iallreduce(const void *sendbuf, void *recvbuf, int count,
     return rc;
 }
 
-int MPI_Pack(const void *inbuf, int incount, MPI_Datatype datatype,
+int PMPI_Pack(const void *inbuf, int incount, MPI_Datatype datatype,
              void *outbuf, int outsize, int *position, MPI_Comm comm)
 {
     (void)comm;
@@ -2084,7 +2084,7 @@ int MPI_Pack(const void *inbuf, int incount, MPI_Datatype datatype,
     return rc;
 }
 
-int MPI_Unpack(const void *inbuf, int insize, int *position,
+int PMPI_Unpack(const void *inbuf, int insize, int *position,
                void *outbuf, int outcount, MPI_Datatype datatype,
                MPI_Comm comm)
 {
@@ -2118,7 +2118,7 @@ int MPI_Unpack(const void *inbuf, int insize, int *position,
     return rc;
 }
 
-int MPI_Pack_size(int incount, MPI_Datatype datatype, MPI_Comm comm,
+int PMPI_Pack_size(int incount, MPI_Datatype datatype, MPI_Comm comm,
                   int *size)
 {
     (void)comm;
@@ -2136,7 +2136,7 @@ int MPI_Pack_size(int incount, MPI_Datatype datatype, MPI_Comm comm,
     return rc;
 }
 
-int MPI_Sendrecv_replace(void *buf, int count, MPI_Datatype datatype,
+int PMPI_Sendrecv_replace(void *buf, int count, MPI_Datatype datatype,
                          int dest, int sendtag, int source, int recvtag,
                          MPI_Comm comm, MPI_Status *status)
 {
@@ -2150,7 +2150,7 @@ int MPI_Sendrecv_replace(void *buf, int count, MPI_Datatype datatype,
     if (!tmp)
         return MPI_ERR_INTERN;
     memcpy(tmp, buf, nbytes);
-    int rc = MPI_Sendrecv(tmp, count, datatype, dest, sendtag, buf,
+    int rc = PMPI_Sendrecv(tmp, count, datatype, dest, sendtag, buf,
                           count, datatype, source, recvtag, comm,
                           status);
     free(tmp);
@@ -2163,7 +2163,7 @@ int MPI_Sendrecv_replace(void *buf, int count, MPI_Datatype datatype,
 /* MPI_Win IS the glue window handle (a long): the disp-unit table
  * lives with the window object in the binding layer, scaled by the
  * TARGET's declared unit. */
-int MPI_Win_allocate(MPI_Aint size, int disp_unit, MPI_Info info,
+int PMPI_Win_allocate(MPI_Aint size, int disp_unit, MPI_Info info,
                      MPI_Comm comm, void *baseptr, MPI_Win *win)
 {
     (void)info;
@@ -2204,13 +2204,13 @@ static int win_simple(const char *fn, MPI_Win win, const char *fmt,
     return rc;
 }
 
-int MPI_Win_fence(int assert_, MPI_Win win)
+int PMPI_Win_fence(int assert_, MPI_Win win)
 {
     (void)assert_;
     return win_simple("win_fence", win, "l", 0, 0);
 }
 
-int MPI_Win_lock(int lock_type, int rank, int assert_, MPI_Win win)
+int PMPI_Win_lock(int lock_type, int rank, int assert_, MPI_Win win)
 {
     (void)assert_;
     /* "lll": varargs must be pushed as the type va_arg reads — an
@@ -2219,19 +2219,19 @@ int MPI_Win_lock(int lock_type, int rank, int assert_, MPI_Win win)
                       (long)rank);
 }
 
-int MPI_Win_unlock(int rank, MPI_Win win)
+int PMPI_Win_unlock(int rank, MPI_Win win)
 {
     return win_simple("win_unlock", win, "ll", (long)rank, 0);
 }
 
-int MPI_Win_free(MPI_Win *win)
+int PMPI_Win_free(MPI_Win *win)
 {
     int rc = win_simple("win_free", *win, "l", 0, 0);
     *win = MPI_WIN_NULL;
     return rc;
 }
 
-int MPI_Put(const void *origin_addr, int origin_count,
+int PMPI_Put(const void *origin_addr, int origin_count,
             MPI_Datatype origin_datatype, int target_rank,
             MPI_Aint target_disp, int target_count,
             MPI_Datatype target_datatype, MPI_Win win)
@@ -2255,7 +2255,7 @@ int MPI_Put(const void *origin_addr, int origin_count,
     return rc;
 }
 
-int MPI_Get(void *origin_addr, int origin_count,
+int PMPI_Get(void *origin_addr, int origin_count,
             MPI_Datatype origin_datatype, int target_rank,
             MPI_Aint target_disp, int target_count,
             MPI_Datatype target_datatype, MPI_Win win)
@@ -2286,7 +2286,7 @@ int MPI_Get(void *origin_addr, int origin_count,
     return rc;
 }
 
-int MPI_Accumulate(const void *origin_addr, int origin_count,
+int PMPI_Accumulate(const void *origin_addr, int origin_count,
                    MPI_Datatype origin_datatype, int target_rank,
                    MPI_Aint target_disp, int target_count,
                    MPI_Datatype target_datatype, MPI_Op op, MPI_Win win)
@@ -2314,7 +2314,7 @@ int MPI_Accumulate(const void *origin_addr, int origin_count,
 /* ------------------------------------------------------------------ */
 /* MPI-IO (MPI_File_* over the per-rank two-phase IO component)        */
 /* ------------------------------------------------------------------ */
-int MPI_File_open(MPI_Comm comm, const char *filename, int amode,
+int PMPI_File_open(MPI_Comm comm, const char *filename, int amode,
                   MPI_Info info, MPI_File *fh)
 {
     (void)info;
@@ -2346,7 +2346,7 @@ static int file_simple(const char *fn, MPI_File fh, long a)
     return rc;
 }
 
-int MPI_File_close(MPI_File *fh)
+int PMPI_File_close(MPI_File *fh)
 {
     GIL_BEGIN;
     int rc = MPI_SUCCESS;
@@ -2361,7 +2361,7 @@ int MPI_File_close(MPI_File *fh)
     return rc;
 }
 
-int MPI_File_delete(const char *filename, MPI_Info info)
+int PMPI_File_delete(const char *filename, MPI_Info info)
 {
     (void)info;
     GIL_BEGIN;
@@ -2399,7 +2399,7 @@ static int file_write_common(const char *fn, MPI_File fh,
     return rc;
 }
 
-int MPI_File_write_at(MPI_File fh, MPI_Offset offset, const void *buf,
+int PMPI_File_write_at(MPI_File fh, MPI_Offset offset, const void *buf,
                       int count, MPI_Datatype datatype,
                       MPI_Status *status)
 {
@@ -2407,7 +2407,7 @@ int MPI_File_write_at(MPI_File fh, MPI_Offset offset, const void *buf,
                              datatype, status);
 }
 
-int MPI_File_write_at_all(MPI_File fh, MPI_Offset offset,
+int PMPI_File_write_at_all(MPI_File fh, MPI_Offset offset,
                           const void *buf, int count,
                           MPI_Datatype datatype, MPI_Status *status)
 {
@@ -2443,7 +2443,7 @@ static int file_read_common(const char *fn, MPI_File fh,
     return rc;
 }
 
-int MPI_File_read_at(MPI_File fh, MPI_Offset offset, void *buf,
+int PMPI_File_read_at(MPI_File fh, MPI_Offset offset, void *buf,
                      int count, MPI_Datatype datatype,
                      MPI_Status *status)
 {
@@ -2451,7 +2451,7 @@ int MPI_File_read_at(MPI_File fh, MPI_Offset offset, void *buf,
                             datatype, status);
 }
 
-int MPI_File_read_at_all(MPI_File fh, MPI_Offset offset, void *buf,
+int PMPI_File_read_at_all(MPI_File fh, MPI_Offset offset, void *buf,
                          int count, MPI_Datatype datatype,
                          MPI_Status *status)
 {
@@ -2459,7 +2459,7 @@ int MPI_File_read_at_all(MPI_File fh, MPI_Offset offset, void *buf,
                             datatype, status);
 }
 
-int MPI_File_write_shared(MPI_File fh, const void *buf, int count,
+int PMPI_File_write_shared(MPI_File fh, const void *buf, int count,
                           MPI_Datatype datatype, MPI_Status *status)
 {
     size_t esz = dt_extent(datatype);
@@ -2482,7 +2482,7 @@ int MPI_File_write_shared(MPI_File fh, const void *buf, int count,
     return rc;
 }
 
-int MPI_File_read_shared(MPI_File fh, void *buf, int count,
+int PMPI_File_read_shared(MPI_File fh, void *buf, int count,
                          MPI_Datatype datatype, MPI_Status *status)
 {
     size_t sig = dt_sig(datatype);
@@ -2508,7 +2508,7 @@ int MPI_File_read_shared(MPI_File fh, void *buf, int count,
     return rc;
 }
 
-int MPI_File_get_size(MPI_File fh, MPI_Offset *size)
+int PMPI_File_get_size(MPI_File fh, MPI_Offset *size)
 {
     GIL_BEGIN;
     int rc = MPI_SUCCESS;
@@ -2524,12 +2524,12 @@ int MPI_File_get_size(MPI_File fh, MPI_Offset *size)
     return rc;
 }
 
-int MPI_File_set_size(MPI_File fh, MPI_Offset size)
+int PMPI_File_set_size(MPI_File fh, MPI_Offset size)
 {
     return file_simple("file_set_size", fh, (long)size);
 }
 
-int MPI_File_sync(MPI_File fh)
+int PMPI_File_sync(MPI_File fh)
 {
     GIL_BEGIN;
     int rc = MPI_SUCCESS;
@@ -2555,7 +2555,7 @@ static int neighbor_count_of(MPI_Comm comm, int *n)
     return rc;
 }
 
-int MPI_Neighbor_allgather(const void *sendbuf, int sendcount,
+int PMPI_Neighbor_allgather(const void *sendbuf, int sendcount,
                            MPI_Datatype sendtype, void *recvbuf,
                            int recvcount, MPI_Datatype recvtype,
                            MPI_Comm comm)
@@ -2586,7 +2586,7 @@ int MPI_Neighbor_allgather(const void *sendbuf, int sendcount,
     return rc;
 }
 
-int MPI_Neighbor_alltoall(const void *sendbuf, int sendcount,
+int PMPI_Neighbor_alltoall(const void *sendbuf, int sendcount,
                           MPI_Datatype sendtype, void *recvbuf,
                           int recvcount, MPI_Datatype recvtype,
                           MPI_Comm comm)
@@ -2616,7 +2616,7 @@ int MPI_Neighbor_alltoall(const void *sendbuf, int sendcount,
     return rc;
 }
 
-int MPI_Error_class(int errorcode, int *errorclass)
+int PMPI_Error_class(int errorcode, int *errorclass)
 {
     /* codes ARE classes in this ABI (core/errhandler.py values) */
     *errorclass = errorcode;
@@ -2626,7 +2626,7 @@ int MPI_Error_class(int errorcode, int *errorclass)
 /* ------------------------------------------------------------------ */
 /* communicator attributes (library state caching)                     */
 /* ------------------------------------------------------------------ */
-int MPI_Comm_create_keyval(MPI_Copy_function *copy_fn,
+int PMPI_Comm_create_keyval(MPI_Copy_function *copy_fn,
                            MPI_Delete_function *delete_fn,
                            int *comm_keyval, void *extra_state)
 {
@@ -2652,7 +2652,7 @@ int MPI_Comm_create_keyval(MPI_Copy_function *copy_fn,
     return rc;
 }
 
-int MPI_Comm_free_keyval(int *comm_keyval)
+int PMPI_Comm_free_keyval(int *comm_keyval)
 {
     GIL_BEGIN;
     int rc = MPI_SUCCESS;
@@ -2667,7 +2667,7 @@ int MPI_Comm_free_keyval(int *comm_keyval)
     return rc;
 }
 
-int MPI_Comm_set_attr(MPI_Comm comm, int comm_keyval,
+int PMPI_Comm_set_attr(MPI_Comm comm, int comm_keyval,
                       void *attribute_val)
 {
     GIL_BEGIN;
@@ -2683,7 +2683,7 @@ int MPI_Comm_set_attr(MPI_Comm comm, int comm_keyval,
     return rc;
 }
 
-int MPI_Comm_get_attr(MPI_Comm comm, int comm_keyval,
+int PMPI_Comm_get_attr(MPI_Comm comm, int comm_keyval,
                       void *attribute_val, int *flag)
 {
     GIL_BEGIN;
@@ -2703,7 +2703,7 @@ int MPI_Comm_get_attr(MPI_Comm comm, int comm_keyval,
     return rc;
 }
 
-int MPI_Comm_delete_attr(MPI_Comm comm, int comm_keyval)
+int PMPI_Comm_delete_attr(MPI_Comm comm, int comm_keyval)
 {
     GIL_BEGIN;
     int rc = MPI_SUCCESS;
@@ -2716,3 +2716,12 @@ int MPI_Comm_delete_attr(MPI_Comm comm, int comm_keyval)
     GIL_END;
     return rc;
 }
+
+/* ------------------------------------------------------------------ */
+/* PMPI profiling surface: every implementation above is the strong
+ * PMPI_X symbol; the public MPI_X names are weak aliases generated
+ * from mpi.h so profiling tools interpose by defining MPI_X and
+ * calling PMPI_X onward (the reference's double-symbol surface,
+ * ompi/mpi/c/Makefile.am:522-533).                                    */
+/* ------------------------------------------------------------------ */
+#include "pmpi_aliases.h"

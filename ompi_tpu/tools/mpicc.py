@@ -44,8 +44,11 @@ def build_lib(cc: str = "gcc", force: bool = False) -> Optional[str]:
     """Build native/libtpumpi.so from mpi_cabi.c (mtime-cached)."""
     if not os.path.exists(_SRC):
         return None
-    hdr = os.path.join(_INCLUDE_DIR, "mpi.h")
-    deps = [_SRC] + ([hdr] if os.path.exists(hdr) else [])
+    deps = [_SRC] + [p for p in
+                     (os.path.join(_INCLUDE_DIR, "mpi.h"),
+                      os.path.join(_INCLUDE_DIR, "mpi_pmpi.h"),
+                      os.path.join(_NATIVE_DIR, "pmpi_aliases.h"))
+                     if os.path.exists(p)]
     if (not force and os.path.exists(_SO)
             and os.path.getmtime(_SO) >= max(os.path.getmtime(d)
                                              for d in deps)):

@@ -1,0 +1,41 @@
+/* A textbook PMPI profiling tool: redefine MPI_X, count, call PMPI_X
+ * onward. Built as a shared library and LD_PRELOADed under an
+ * UNMODIFIED MPI program — the interposition contract the reference
+ * documents in docs/features/profiling.rst:5-21 (tools override the
+ * weak MPI_X aliases; the strong PMPI_X implementation remains
+ * callable). Prints one summary line per rank at MPI_Finalize. */
+#include <mpi.h>
+#include <stdio.h>
+
+static long n_allreduce, n_bcast, n_send;
+
+int MPI_Allreduce(const void *sendbuf, void *recvbuf, int count,
+                  MPI_Datatype datatype, MPI_Op op, MPI_Comm comm)
+{
+    n_allreduce++;
+    return PMPI_Allreduce(sendbuf, recvbuf, count, datatype, op, comm);
+}
+
+int MPI_Bcast(void *buffer, int count, MPI_Datatype datatype, int root,
+              MPI_Comm comm)
+{
+    n_bcast++;
+    return PMPI_Bcast(buffer, count, datatype, root, comm);
+}
+
+int MPI_Send(const void *buf, int count, MPI_Datatype datatype,
+             int dest, int tag, MPI_Comm comm)
+{
+    n_send++;
+    return PMPI_Send(buf, count, datatype, dest, tag, comm);
+}
+
+int MPI_Finalize(void)
+{
+    int rank = -1;
+    PMPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    printf("PMPI_TOOL rank=%d allreduce=%ld bcast=%ld send=%ld\n",
+           rank, n_allreduce, n_bcast, n_send);
+    fflush(stdout);
+    return PMPI_Finalize();
+}

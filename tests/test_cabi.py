@@ -82,6 +82,62 @@ def test_cabi_program(binaries, src, n):
     assert res.stdout.count(marker) == n, res.stdout
 
 
+def test_pmpi_interposer_ld_preload(binaries, tmp_path):
+    """The PMPI contract end-to-end: a profiling tool that redefines
+    MPI_Allreduce/MPI_Bcast (weak aliases) and calls PMPI_* onward is
+    LD_PRELOADed under an UNMODIFIED program binary; every rank's
+    counters must fire (docs/features/profiling.rst:5-21 behavior)."""
+    tool = str(tmp_path / "pmpi_tool.so")
+    res = subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.mpicc", "-shared",
+         "-fPIC", os.path.join(_PROGS, "pmpi_tool.c"), "-o", tool],
+        capture_output=True, text=True, timeout=300, cwd=_REPO)
+    assert res.returncode == 0, res.stderr
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("JAX_", "XLA_"))}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["LD_PRELOAD"] = tool
+    res = subprocess.run(
+        [sys.executable, _MPIRUN, "--per-rank", "-n", "2",
+         "--timeout", "150", binaries["c03_coll.c"]],
+        env=env, capture_output=True, text=True, timeout=200, cwd=_REPO)
+    assert res.returncode == 0, res.stdout + res.stderr
+    lines = [ln for ln in res.stdout.splitlines()
+             if ln.startswith("PMPI_TOOL")]
+    assert len(lines) == 2, res.stdout
+    for ln in lines:
+        assert "allreduce=1" in ln and "bcast=1" in ln, ln
+    assert res.stdout.count("OK c03_coll") == 2
+
+
+def test_pmpi_generated_files_in_sync():
+    """include/mpi_pmpi.h and native/pmpi_aliases.h are generated from
+    mpi.h; a drifted checkout breaks the double-symbol surface."""
+    res = subprocess.run(
+        [sys.executable, os.path.join("native", "gen_pmpi.py"),
+         "--check"], capture_output=True, text=True, timeout=60,
+        cwd=_REPO)
+    assert res.returncode == 0, \
+        "PMPI files out of sync: run python native/gen_pmpi.py"
+
+
+def test_every_exported_symbol_has_pmpi_twin():
+    """Every weak MPI_X exported by libtpumpi.so is backed by a strong
+    PMPI_X (the reference ships every binding twice)."""
+    from ompi_tpu.tools.mpicc import build_lib
+    so = build_lib()
+    assert so
+    out = subprocess.run(["nm", "-D", so], capture_output=True,
+                         text=True, timeout=60).stdout
+    weak = {ln.split()[-1] for ln in out.splitlines()
+            if " W MPI_" in ln}
+    strong = {ln.split()[-1] for ln in out.splitlines()
+              if " T PMPI_" in ln}
+    assert weak, "no weak MPI_ symbols exported"
+    missing = {w for w in weak if "P" + w not in strong}
+    assert not missing, f"MPI_ symbols without PMPI_ twin: {missing}"
+
+
 def test_mpicc_showme():
     res = subprocess.run(
         [sys.executable, "-m", "ompi_tpu.tools.mpicc", "--showme"],
