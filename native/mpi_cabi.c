@@ -2717,6 +2717,485 @@ int PMPI_Comm_delete_attr(MPI_Comm comm, int comm_keyval)
     return rc;
 }
 
+
+/* ------------------------------------------------------------------ */
+/* wave 2: the full nonblocking collective family + MPI_Reduce_scatter.
+ * Each i-variant lowers to the glue's generic worker-thread schedule
+ * (the libnbc role): the blocking marshaller runs off-thread and
+ * completion copies pre-marshalled bytes into the user buffer
+ * (reference wrappers: ompi/mpi/c/iallgather.c.in, ialltoall.c.in,
+ * ireduce.c.in, reduce_scatter.c.in, ...).                            */
+/* ------------------------------------------------------------------ */
+int PMPI_Reduce_scatter(const void *sendbuf, void *recvbuf,
+                        const int recvcounts[], MPI_Datatype datatype,
+                        MPI_Op op, MPI_Comm comm)
+{
+    size_t esz = dt_size(datatype);
+    if (!esz)
+        return MPI_ERR_TYPE;
+    int size, rank;
+    int qrc = PMPI_Comm_size(comm, &size);
+    if (qrc == MPI_SUCCESS)
+        qrc = PMPI_Comm_rank(comm, &rank);
+    if (qrc != MPI_SUCCESS)
+        return qrc;
+    size_t total = 0;
+    for (int i = 0; i < size; i++) {
+        if (recvcounts[i] < 0)
+            return MPI_ERR_COUNT;
+        total += (size_t)recvcounts[i];
+    }
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "reduce_scatter", "lNllN", (long)comm,
+        mem_ro(pick_in(sendbuf, recvbuf), total * esz),
+        (long)datatype, (long)op,
+        mem_ro(recvcounts, (size_t)size * sizeof(int)));
+    if (!r)
+        rc = handle_error("MPI_Reduce_scatter");
+    else {
+        rc = copy_bytes(r, recvbuf, (size_t)recvcounts[rank] * esz);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Ireduce(const void *sendbuf, void *recvbuf, int count,
+                 MPI_Datatype datatype, MPI_Op op, int root,
+                 MPI_Comm comm, MPI_Request *request)
+{
+    size_t esz = dt_extent(datatype);
+    if (!esz || count < 0)
+        return MPI_ERR_TYPE;
+    int rank;
+    int qrc = PMPI_Comm_rank(comm, &rank);
+    if (qrc != MPI_SUCCESS)
+        return qrc;
+    size_t nbytes = (size_t)count * esz;
+    GIL_BEGIN;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "ireduce", "lNlli", (long)comm,
+        mem_ro(pick_in(sendbuf, recvbuf), nbytes), (long)datatype,
+        (long)op, root);
+    int rc = icoll_request(r, rank == root ? recvbuf : NULL,
+                           rank == root ? nbytes : 0, request,
+                           "MPI_Ireduce");
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Iscan(const void *sendbuf, void *recvbuf, int count,
+               MPI_Datatype datatype, MPI_Op op, MPI_Comm comm,
+               MPI_Request *request)
+{
+    size_t esz = dt_size(datatype);
+    if (!esz || count < 0)
+        return MPI_ERR_TYPE;
+    size_t nbytes = (size_t)count * esz;
+    GIL_BEGIN;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "iscan", "lNll", (long)comm,
+        mem_ro(pick_in(sendbuf, recvbuf), nbytes), (long)datatype,
+        (long)op);
+    int rc = icoll_request(r, recvbuf, nbytes, request, "MPI_Iscan");
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Iexscan(const void *sendbuf, void *recvbuf, int count,
+                 MPI_Datatype datatype, MPI_Op op, MPI_Comm comm,
+                 MPI_Request *request)
+{
+    size_t esz = dt_size(datatype);
+    if (!esz || count < 0)
+        return MPI_ERR_TYPE;
+    size_t nbytes = (size_t)count * esz;
+    GIL_BEGIN;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "iexscan", "lNll", (long)comm,
+        mem_ro(pick_in(sendbuf, recvbuf), nbytes), (long)datatype,
+        (long)op);
+    int rc = icoll_request(r, recvbuf, nbytes, request, "MPI_Iexscan");
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Igather(const void *sendbuf, int sendcount,
+                 MPI_Datatype sendtype, void *recvbuf, int recvcount,
+                 MPI_Datatype recvtype, int root, MPI_Comm comm,
+                 MPI_Request *request)
+{
+    int size, rank;
+    int qrc = PMPI_Comm_size(comm, &size);
+    if (qrc == MPI_SUCCESS)
+        qrc = PMPI_Comm_rank(comm, &rank);
+    if (qrc != MPI_SUCCESS)
+        return qrc;
+    size_t rsz = 0;
+    if (rank == root) {
+        rsz = dt_size(recvtype);
+        if (!rsz || recvcount < 0)
+            return MPI_ERR_TYPE;
+        if (sendbuf == MPI_IN_PLACE) {
+            sendbuf = (const char *)recvbuf
+                + (size_t)rank * (size_t)recvcount * rsz;
+            sendcount = recvcount;
+            sendtype = recvtype;
+        }
+    } else if (sendbuf == MPI_IN_PLACE) {
+        return MPI_ERR_BUFFER;
+    }
+    size_t ssz = dt_size(sendtype);
+    if (!ssz || sendcount < 0)
+        return MPI_ERR_TYPE;
+    GIL_BEGIN;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "igather", "lNlil", (long)comm,
+        mem_ro(sendbuf, (size_t)sendcount * ssz), (long)sendtype, root,
+        (long)(rank == root ? recvtype : 0));
+    int rc = icoll_request(
+        r, rank == root ? recvbuf : NULL,
+        rank == root ? (size_t)size * (size_t)recvcount * rsz : 0,
+        request, "MPI_Igather");
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Iscatter(const void *sendbuf, int sendcount,
+                  MPI_Datatype sendtype, void *recvbuf, int recvcount,
+                  MPI_Datatype recvtype, int root, MPI_Comm comm,
+                  MPI_Request *request)
+{
+    int size, rank;
+    int qrc = PMPI_Comm_size(comm, &size);
+    if (qrc == MPI_SUCCESS)
+        qrc = PMPI_Comm_rank(comm, &rank);
+    if (qrc != MPI_SUCCESS)
+        return qrc;
+    size_t ssz = 0;
+    if (rank == root) {
+        ssz = dt_size(sendtype);
+        if (!ssz || sendcount < 0)
+            return MPI_ERR_TYPE;
+    }
+    int in_place = (recvbuf == MPI_IN_PLACE);
+    size_t rsz = 0;
+    if (!in_place) {
+        rsz = dt_size(recvtype);
+        if (!rsz || recvcount < 0)
+            return MPI_ERR_TYPE;
+    }
+    GIL_BEGIN;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "iscatter", "lNliil", (long)comm,
+        mem_ro(sendbuf, rank == root
+               ? (size_t)size * (size_t)sendcount * ssz : 0),
+        (long)(rank == root ? sendtype : 0), sendcount, root,
+        (long)(in_place ? 0 : recvtype));
+    int rc = icoll_request(r, in_place ? NULL : recvbuf,
+                           in_place ? 0 : (size_t)recvcount * rsz,
+                           request, "MPI_Iscatter");
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Iallgather(const void *sendbuf, int sendcount,
+                    MPI_Datatype sendtype, void *recvbuf, int recvcount,
+                    MPI_Datatype recvtype, MPI_Comm comm,
+                    MPI_Request *request)
+{
+    size_t rsz = dt_size(recvtype);
+    if (!rsz || recvcount < 0)
+        return MPI_ERR_TYPE;
+    int size, rank;
+    int qrc = PMPI_Comm_size(comm, &size);
+    if (qrc == MPI_SUCCESS)
+        qrc = PMPI_Comm_rank(comm, &rank);
+    if (qrc != MPI_SUCCESS)
+        return qrc;
+    if (sendbuf == MPI_IN_PLACE) {
+        sendbuf = (const char *)recvbuf
+            + (size_t)rank * (size_t)recvcount * rsz;
+        sendcount = recvcount;
+        sendtype = recvtype;
+    }
+    size_t ssz = dt_size(sendtype);
+    if (!ssz || sendcount < 0)
+        return MPI_ERR_TYPE;
+    GIL_BEGIN;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "iallgather", "lNll", (long)comm,
+        mem_ro(sendbuf, (size_t)sendcount * ssz), (long)sendtype,
+        (long)recvtype);
+    int rc = icoll_request(r, recvbuf,
+                           (size_t)size * (size_t)recvcount * rsz,
+                           request, "MPI_Iallgather");
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Ialltoall(const void *sendbuf, int sendcount,
+                   MPI_Datatype sendtype, void *recvbuf, int recvcount,
+                   MPI_Datatype recvtype, MPI_Comm comm,
+                   MPI_Request *request)
+{
+    size_t ssz = dt_size(sendtype), rsz = dt_size(recvtype);
+    if (!ssz || !rsz || sendcount < 0 || recvcount < 0)
+        return MPI_ERR_TYPE;
+    int size;
+    int qrc = PMPI_Comm_size(comm, &size);
+    if (qrc != MPI_SUCCESS)
+        return qrc;
+    GIL_BEGIN;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "ialltoall", "lNlil", (long)comm,
+        mem_ro(sendbuf, (size_t)size * (size_t)sendcount * ssz),
+        (long)sendtype, sendcount, (long)recvtype);
+    int rc = icoll_request(r, recvbuf,
+                           (size_t)size * (size_t)recvcount * rsz,
+                           request, "MPI_Ialltoall");
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Igatherv(const void *sendbuf, int sendcount,
+                  MPI_Datatype sendtype, void *recvbuf,
+                  const int recvcounts[], const int displs[],
+                  MPI_Datatype recvtype, int root, MPI_Comm comm,
+                  MPI_Request *request)
+{
+    size_t ssz = dt_size(sendtype);
+    if (!ssz || sendcount < 0)
+        return MPI_ERR_TYPE;
+    int size, rank;
+    int qrc = PMPI_Comm_size(comm, &size);
+    if (qrc == MPI_SUCCESS)
+        qrc = PMPI_Comm_rank(comm, &rank);
+    if (qrc != MPI_SUCCESS)
+        return qrc;
+    size_t cap = 0, rsz = 0;
+    if (rank == root) {
+        rsz = dt_size(recvtype);
+        if (!rsz)
+            return MPI_ERR_TYPE;
+        cap = v_extent(recvcounts, displs, size) * rsz;
+    }
+    GIL_BEGIN;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "igatherv", "lNlilNNN", (long)comm,
+        mem_ro(sendbuf, (size_t)sendcount * ssz), (long)sendtype, root,
+        (long)(rank == root ? recvtype : 0),
+        mem_ro(recvcounts, rank == root
+               ? (size_t)size * sizeof(int) : 0),
+        mem_ro(displs, rank == root ? (size_t)size * sizeof(int) : 0),
+        mem_ro(recvbuf, cap));
+    int rc = icoll_request(r, rank == root ? recvbuf : NULL, cap,
+                           request, "MPI_Igatherv");
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Iscatterv(const void *sendbuf, const int sendcounts[],
+                   const int displs[], MPI_Datatype sendtype,
+                   void *recvbuf, int recvcount, MPI_Datatype recvtype,
+                   int root, MPI_Comm comm, MPI_Request *request)
+{
+    size_t rsz = dt_size(recvtype);
+    if (!rsz || recvcount < 0)
+        return MPI_ERR_TYPE;
+    int size, rank;
+    int qrc = PMPI_Comm_size(comm, &size);
+    if (qrc == MPI_SUCCESS)
+        qrc = PMPI_Comm_rank(comm, &rank);
+    if (qrc != MPI_SUCCESS)
+        return qrc;
+    size_t ssz = 0, in_bytes = 0;
+    if (rank == root) {
+        ssz = dt_size(sendtype);
+        if (!ssz)
+            return MPI_ERR_TYPE;
+        in_bytes = v_extent(sendcounts, displs, size) * ssz;
+    }
+    GIL_BEGIN;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "iscatterv", "lNlNNil", (long)comm,
+        mem_ro(sendbuf, in_bytes),
+        (long)(rank == root ? sendtype : 0),
+        mem_ro(sendcounts, rank == root
+               ? (size_t)size * sizeof(int) : 0),
+        mem_ro(displs, rank == root ? (size_t)size * sizeof(int) : 0),
+        root, (long)recvtype);
+    int rc = icoll_request(r, recvbuf, (size_t)recvcount * rsz,
+                           request, "MPI_Iscatterv");
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Iallgatherv(const void *sendbuf, int sendcount,
+                     MPI_Datatype sendtype, void *recvbuf,
+                     const int recvcounts[], const int displs[],
+                     MPI_Datatype recvtype, MPI_Comm comm,
+                     MPI_Request *request)
+{
+    size_t ssz = dt_size(sendtype), rsz = dt_size(recvtype);
+    if (!ssz || !rsz || sendcount < 0)
+        return MPI_ERR_TYPE;
+    int size;
+    int qrc = PMPI_Comm_size(comm, &size);
+    if (qrc != MPI_SUCCESS)
+        return qrc;
+    size_t cap = v_extent(recvcounts, displs, size) * rsz;
+    GIL_BEGIN;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "iallgatherv", "lNllNNN", (long)comm,
+        mem_ro(sendbuf, (size_t)sendcount * ssz), (long)sendtype,
+        (long)recvtype, mem_ro(recvcounts, (size_t)size * sizeof(int)),
+        mem_ro(displs, (size_t)size * sizeof(int)),
+        mem_ro(recvbuf, cap));
+    int rc = icoll_request(r, recvbuf, cap, request,
+                           "MPI_Iallgatherv");
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Ialltoallv(const void *sendbuf, const int sendcounts[],
+                    const int sdispls[], MPI_Datatype sendtype,
+                    void *recvbuf, const int recvcounts[],
+                    const int rdispls[], MPI_Datatype recvtype,
+                    MPI_Comm comm, MPI_Request *request)
+{
+    size_t ssz = dt_size(sendtype), rsz = dt_size(recvtype);
+    if (!ssz || !rsz)
+        return MPI_ERR_TYPE;
+    int size;
+    int qrc = PMPI_Comm_size(comm, &size);
+    if (qrc != MPI_SUCCESS)
+        return qrc;
+    size_t in_bytes = v_extent(sendcounts, sdispls, size) * ssz;
+    size_t cap = v_extent(recvcounts, rdispls, size) * rsz;
+    GIL_BEGIN;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "ialltoallv", "lNlNNlNNN", (long)comm,
+        mem_ro(sendbuf, in_bytes), (long)sendtype,
+        mem_ro(sendcounts, (size_t)size * sizeof(int)),
+        mem_ro(sdispls, (size_t)size * sizeof(int)), (long)recvtype,
+        mem_ro(recvcounts, (size_t)size * sizeof(int)),
+        mem_ro(rdispls, (size_t)size * sizeof(int)),
+        mem_ro(recvbuf, cap));
+    int rc = icoll_request(r, recvbuf, cap, request,
+                           "MPI_Ialltoallv");
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Ireduce_scatter(const void *sendbuf, void *recvbuf,
+                         const int recvcounts[], MPI_Datatype datatype,
+                         MPI_Op op, MPI_Comm comm,
+                         MPI_Request *request)
+{
+    size_t esz = dt_size(datatype);
+    if (!esz)
+        return MPI_ERR_TYPE;
+    int size, rank;
+    int qrc = PMPI_Comm_size(comm, &size);
+    if (qrc == MPI_SUCCESS)
+        qrc = PMPI_Comm_rank(comm, &rank);
+    if (qrc != MPI_SUCCESS)
+        return qrc;
+    size_t total = 0;
+    for (int i = 0; i < size; i++) {
+        if (recvcounts[i] < 0)
+            return MPI_ERR_COUNT;
+        total += (size_t)recvcounts[i];
+    }
+    GIL_BEGIN;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "ireduce_scatter", "lNllN", (long)comm,
+        mem_ro(pick_in(sendbuf, recvbuf), total * esz),
+        (long)datatype, (long)op,
+        mem_ro(recvcounts, (size_t)size * sizeof(int)));
+    int rc = icoll_request(r, recvbuf,
+                           (size_t)recvcounts[rank] * esz, request,
+                           "MPI_Ireduce_scatter");
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Ireduce_scatter_block(const void *sendbuf, void *recvbuf,
+                               int recvcount, MPI_Datatype datatype,
+                               MPI_Op op, MPI_Comm comm,
+                               MPI_Request *request)
+{
+    size_t esz = dt_size(datatype);
+    if (!esz || recvcount < 0)
+        return MPI_ERR_TYPE;
+    int size;
+    int qrc = PMPI_Comm_size(comm, &size);
+    if (qrc != MPI_SUCCESS)
+        return qrc;
+    GIL_BEGIN;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "ireduce_scatter_block", "lNlli", (long)comm,
+        mem_ro(pick_in(sendbuf, recvbuf),
+               (size_t)size * (size_t)recvcount * esz),
+        (long)datatype, (long)op, recvcount);
+    int rc = icoll_request(r, recvbuf, (size_t)recvcount * esz,
+                           request, "MPI_Ireduce_scatter_block");
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Ineighbor_allgather(const void *sendbuf, int sendcount,
+                             MPI_Datatype sendtype, void *recvbuf,
+                             int recvcount, MPI_Datatype recvtype,
+                             MPI_Comm comm, MPI_Request *request)
+{
+    size_t ssz = dt_extent(sendtype), rsz = dt_size(recvtype);
+    if (!ssz || !rsz || sendcount < 0 || recvcount < 0)
+        return MPI_ERR_TYPE;
+    int nslots;
+    int qrc = neighbor_count_of(comm, &nslots);
+    if (qrc != MPI_SUCCESS)
+        return qrc;
+    size_t cap = (size_t)nslots * (size_t)recvcount * rsz;
+    GIL_BEGIN;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "ineighbor_allgather", "lNllN", (long)comm,
+        mem_ro(sendbuf, (size_t)sendcount * ssz), (long)sendtype,
+        (long)recvtype, mem_ro(recvbuf, cap));
+    int rc = icoll_request(r, recvbuf, cap, request,
+                           "MPI_Ineighbor_allgather");
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Ineighbor_alltoall(const void *sendbuf, int sendcount,
+                            MPI_Datatype sendtype, void *recvbuf,
+                            int recvcount, MPI_Datatype recvtype,
+                            MPI_Comm comm, MPI_Request *request)
+{
+    size_t ssz = dt_extent(sendtype), rsz = dt_size(recvtype);
+    if (!ssz || !rsz || sendcount < 0 || recvcount < 0)
+        return MPI_ERR_TYPE;
+    int nslots;
+    int qrc = neighbor_count_of(comm, &nslots);
+    if (qrc != MPI_SUCCESS)
+        return qrc;
+    size_t cap = (size_t)nslots * (size_t)recvcount * rsz;
+    GIL_BEGIN;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "ineighbor_alltoall", "lNlilN", (long)comm,
+        mem_ro(sendbuf, (size_t)nslots * (size_t)sendcount * ssz),
+        (long)sendtype, sendcount, (long)recvtype,
+        mem_ro(recvbuf, cap));
+    int rc = icoll_request(r, recvbuf, cap, request,
+                           "MPI_Ineighbor_alltoall");
+    GIL_END;
+    return rc;
+}
+
 /* ------------------------------------------------------------------ */
 /* PMPI profiling surface: every implementation above is the strong
  * PMPI_X symbol; the public MPI_X names are weak aliases generated

@@ -797,6 +797,10 @@ def wait(rh: int) -> Tuple[bytes, int, int, int, int]:
         _requests.pop(rh, None)
     if data is None:
         return b"", *_status(st), 0
+    if dt == 0:                          # _icoll_bytes: pre-marshalled
+        out = bytes(data)
+        src, t, _ = _status(st, out)
+        return out, src, t, len(out), 0
     out, trunc = _unpack(data, dt, _count_of(snap, dt), snap)
     src, t, cnt = _status(st, out)
     return out, src, t, cnt, trunc
@@ -817,6 +821,10 @@ def test(rh: int) -> Tuple[int, bytes, int, int, int, int]:
         _requests.pop(rh, None)
     if data is None:
         return 1, b"", *_status(st), 0
+    if dt == 0:                          # _icoll_bytes: pre-marshalled
+        out = bytes(data)
+        src, t, _ = _status(st, out)
+        return 1, out, src, t, len(out), 0
     out, trunc = _unpack(data, dt, _count_of(snap, dt), snap)
     src, t, cnt = _status(st, out)
     return 1, out, src, t, cnt, trunc
@@ -862,6 +870,126 @@ def ibcast(h: int, view, dt: int, root: int) -> int:
     # the buffer snapshot makes derived-type completion unpack into a
     # real extent image (same contract as the blocking bcast)
     return _icoll_handle(c.ibcast(data, root), dt, bytes(view))
+
+
+class _DoneReq:
+    """Immediately-complete request: on single-controller communicators
+    (no per-rank worker machinery) the 'nonblocking' collective runs
+    synchronously at the i-call — legal MPI behavior (completion at
+    MPI_Wait is a lower bound, not a mandate)."""
+
+    _complete = True
+
+    def __init__(self, data):
+        self._data = data
+
+    def wait(self, timeout=None):
+        return None
+
+    def test(self):
+        return True, None
+
+    def get(self):
+        return self._data
+
+
+def _icoll_bytes(h: int, job) -> int:
+    """Generic nonblocking collective: run ``job`` — a closure over the
+    blocking glue marshaller, returning the final C-buffer bytes — on
+    the communicator's nonblocking worker (the libnbc progress role).
+    The request entry's dt==0 marks the payload as pre-marshalled
+    bytes: wait/test deliver it verbatim, no unpack."""
+    c = _comm(h)
+    req = c._nb(job) if hasattr(c, "_nb") else _DoneReq(job())
+    return _icoll_handle(req, 0)
+
+
+def igather(h: int, view, sdt: int, root: int, rdt: int) -> int:
+    return _icoll_bytes(h, lambda: gather(h, view, sdt, root, rdt))
+
+
+def igatherv(h: int, view, sdt: int, root: int, rdt: int, counts_view,
+             displs_view, curview) -> int:
+    counts, displs = bytes(counts_view), bytes(displs_view)
+    snap = bytes(curview)
+    return _icoll_bytes(h, lambda: gatherv(
+        h, view, sdt, root, rdt, counts, displs, snap))
+
+
+def iscatter(h: int, view, sdt: int, sendcount: int, root: int,
+             rdt: int) -> int:
+    return _icoll_bytes(h, lambda: scatter(
+        h, view, sdt, sendcount, root, rdt))
+
+
+def iscatterv(h: int, view, sdt: int, counts_view, displs_view,
+              root: int, rdt: int) -> int:
+    counts, displs = bytes(counts_view), bytes(displs_view)
+    return _icoll_bytes(h, lambda: scatterv(
+        h, view, sdt, counts, displs, root, rdt))
+
+
+def iallgather(h: int, view, sdt: int, rdt: int) -> int:
+    return _icoll_bytes(h, lambda: allgather(h, view, sdt, rdt))
+
+
+def iallgatherv(h: int, view, sdt: int, rdt: int, counts_view,
+                displs_view, curview) -> int:
+    counts, displs = bytes(counts_view), bytes(displs_view)
+    snap = bytes(curview)
+    return _icoll_bytes(h, lambda: allgatherv(
+        h, view, sdt, rdt, counts, displs, snap))
+
+
+def ialltoall(h: int, view, sdt: int, percount: int, rdt: int) -> int:
+    return _icoll_bytes(h, lambda: alltoall(h, view, sdt, percount, rdt))
+
+
+def ialltoallv(h: int, view, sdt: int, scounts_view, sdispls_view,
+               rdt: int, rcounts_view, rdispls_view, curview) -> int:
+    sc, sd = bytes(scounts_view), bytes(sdispls_view)
+    rc_, rd = bytes(rcounts_view), bytes(rdispls_view)
+    snap = bytes(curview)
+    return _icoll_bytes(h, lambda: alltoallv(
+        h, view, sdt, sc, sd, rdt, rc_, rd, snap))
+
+
+def ireduce(h: int, view, dt: int, o: int, root: int) -> int:
+    return _icoll_bytes(h, lambda: reduce(h, view, dt, o, root))
+
+
+def iscan(h: int, view, dt: int, o: int) -> int:
+    return _icoll_bytes(h, lambda: scan(h, view, dt, o))
+
+
+def iexscan(h: int, view, dt: int, o: int) -> int:
+    return _icoll_bytes(h, lambda: exscan(h, view, dt, o))
+
+
+def ireduce_scatter_block(h: int, view, dt: int, o: int,
+                          recvcount: int) -> int:
+    return _icoll_bytes(h, lambda: reduce_scatter_block(
+        h, view, dt, o, recvcount))
+
+
+def ireduce_scatter(h: int, view, dt: int, o: int, counts_view) -> int:
+    counts = bytes(counts_view)      # the C array may not outlive us
+    return _icoll_bytes(h, lambda: reduce_scatter(
+        h, view, dt, o, counts))
+
+
+def ineighbor_allgather(h: int, view, sdt: int, rdt: int,
+                        curview) -> int:
+    snap = bytes(curview)
+    return _icoll_bytes(h, lambda: neighbor_allgather(
+        h, view, sdt, rdt, snap))
+
+
+def ineighbor_alltoall(h: int, view, sdt: int, percount: int, rdt: int,
+                       curview) -> int:
+    snap = bytes(curview)
+    return _icoll_bytes(h, lambda: neighbor_alltoall(
+        h, view, sdt, percount, rdt, snap))
 
 
 def iallreduce(h: int, view, dt: int, o: int) -> int:
@@ -1067,6 +1195,25 @@ def alltoallv(h: int, view, sdt: int, scounts_view, sdispls_view,
     chunks = [a[sd[i]:sd[i] + sc[i]] for i in range(c.size)]
     out = c.alltoall(chunks)
     return _overlay(out, rdt, rc, rd, curview)
+
+
+def reduce_scatter(h: int, view, dt: int, o: int, counts_view) -> bytes:
+    """MPI_Reduce_scatter: elementwise reduction of the full vector;
+    rank r receives its counts[r] segment. The base 'nonoverlapping'
+    composition (reduce + scatterv,
+    coll_base_reduce_scatter.c:nonoverlapping): here one allreduce —
+    which on large host buffers rides the staged device tier — then a
+    local slice."""
+    c = _comm(h)
+    counts = _ints(counts_view)
+    _op_ctx.dt = dt
+    try:
+        full = np.asarray(c.allreduce(_arr(view, dt), _op(o)))
+    finally:
+        _op_ctx.dt = 0
+    r = c.rank()
+    start = int(counts[:r].sum())
+    return _out(full[start:start + int(counts[r])], dt)
 
 
 def reduce_scatter_block(h: int, view, dt: int, o: int,

@@ -1,0 +1,163 @@
+/* The full nonblocking collective family (MPI-3.1 ch. 5.12) plus
+ * MPI_Reduce_scatter: every i-variant posted, overlapped, completed
+ * with Wait/Waitall, verified numerically on every rank. Reference
+ * wrappers: ompi/mpi/c/iallgather.c.in, ireduce.c.in,
+ * reduce_scatter.c.in. */
+#include <mpi.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+#define CHECK(cond, code)                                            \
+    do {                                                             \
+        if (!(cond)) {                                               \
+            fprintf(stderr, "rank %d: check failed at line %d\n",    \
+                    rank, __LINE__);                                 \
+            MPI_Abort(MPI_COMM_WORLD, code);                         \
+        }                                                            \
+    } while (0)
+
+int main(int argc, char **argv)
+{
+    MPI_Init(&argc, &argv);
+    int rank, size;
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &size);
+    MPI_Request req, reqs[3];
+
+    /* Ireduce at root 1 */
+    int v = rank + 1, tot = -1;
+    MPI_Ireduce(&v, &tot, 1, MPI_INT, MPI_SUM, 1, MPI_COMM_WORLD,
+                &req);
+    MPI_Wait(&req, MPI_STATUS_IGNORE);
+    if (rank == 1)
+        CHECK(tot == size * (size + 1) / 2, 2);
+
+    /* Iscan / Iexscan overlapped and completed with Waitall */
+    double s = (double)(rank + 1), pre = -1.0, epre = -7.0;
+    MPI_Iscan(&s, &pre, 1, MPI_DOUBLE, MPI_SUM, MPI_COMM_WORLD,
+              &reqs[0]);
+    MPI_Iexscan(&s, &epre, 1, MPI_DOUBLE, MPI_SUM, MPI_COMM_WORLD,
+                &reqs[1]);
+    MPI_Ibarrier(MPI_COMM_WORLD, &reqs[2]);
+    MPI_Waitall(3, reqs, MPI_STATUSES_IGNORE);
+    CHECK(pre == (double)(rank + 1) * (rank + 2) / 2, 3);
+    if (rank > 0)
+        CHECK(epre == (double)rank * (rank + 1) / 2, 4);
+
+    /* Igather / Iscatter at root 0 */
+    int *all = malloc(sizeof(int) * size);
+    MPI_Igather(&v, 1, MPI_INT, all, 1, MPI_INT, 0, MPI_COMM_WORLD,
+                &req);
+    MPI_Wait(&req, MPI_STATUS_IGNORE);
+    if (rank == 0)
+        for (int i = 0; i < size; i++)
+            CHECK(all[i] == i + 1, 5);
+    int mine = -1;
+    if (rank == 0)
+        for (int i = 0; i < size; i++)
+            all[i] = 100 + i;
+    MPI_Iscatter(all, 1, MPI_INT, &mine, 1, MPI_INT, 0,
+                 MPI_COMM_WORLD, &req);
+    MPI_Wait(&req, MPI_STATUS_IGNORE);
+    CHECK(mine == 100 + rank, 6);
+
+    /* Iallgather / Ialltoall */
+    float fv[2] = {(float)rank, (float)(rank * 2)};
+    float *ag = malloc(sizeof(float) * 2 * size);
+    MPI_Iallgather(fv, 2, MPI_FLOAT, ag, 2, MPI_FLOAT, MPI_COMM_WORLD,
+                   &req);
+    MPI_Wait(&req, MPI_STATUS_IGNORE);
+    for (int i = 0; i < size; i++)
+        CHECK(ag[2 * i] == (float)i && ag[2 * i + 1] == (float)(2 * i),
+              7);
+    int *sbuf = malloc(sizeof(int) * size);
+    int *rbuf = malloc(sizeof(int) * size);
+    for (int i = 0; i < size; i++)
+        sbuf[i] = rank * size + i;
+    MPI_Ialltoall(sbuf, 1, MPI_INT, rbuf, 1, MPI_INT, MPI_COMM_WORLD,
+                  &req);
+    MPI_Wait(&req, MPI_STATUS_IGNORE);
+    for (int i = 0; i < size; i++)
+        CHECK(rbuf[i] == i * size + rank, 8);
+
+    /* Igatherv / Iscatterv: rank i contributes i+1 elements */
+    int *counts = malloc(sizeof(int) * size);
+    int *displs = malloc(sizeof(int) * size);
+    int off = 0;
+    for (int i = 0; i < size; i++) {
+        counts[i] = i + 1;
+        displs[i] = off;
+        off += i + 1;
+    }
+    int *vbuf = malloc(sizeof(int) * (rank + 1));
+    for (int i = 0; i <= rank; i++)
+        vbuf[i] = rank * 10 + i;
+    int *gv = malloc(sizeof(int) * off);
+    MPI_Igatherv(vbuf, rank + 1, MPI_INT, gv, counts, displs, MPI_INT,
+                 0, MPI_COMM_WORLD, &req);
+    MPI_Wait(&req, MPI_STATUS_IGNORE);
+    if (rank == 0)
+        for (int i = 0; i < size; i++)
+            for (int j = 0; j <= i; j++)
+                CHECK(gv[displs[i] + j] == i * 10 + j, 9);
+    if (rank == 0)
+        for (int i = 0; i < off; i++)
+            gv[i] = 1000 + i;
+    int *sv = malloc(sizeof(int) * (rank + 1));
+    MPI_Iscatterv(gv, counts, displs, MPI_INT, sv, rank + 1, MPI_INT,
+                  0, MPI_COMM_WORLD, &req);
+    MPI_Wait(&req, MPI_STATUS_IGNORE);
+    for (int j = 0; j <= rank; j++)
+        CHECK(sv[j] == 1000 + displs[rank] + j, 10);
+
+    /* Iallgatherv / Ialltoallv */
+    int *agv = malloc(sizeof(int) * off);
+    MPI_Iallgatherv(vbuf, rank + 1, MPI_INT, agv, counts, displs,
+                    MPI_INT, MPI_COMM_WORLD, &req);
+    MPI_Wait(&req, MPI_STATUS_IGNORE);
+    for (int i = 0; i < size; i++)
+        for (int j = 0; j <= i; j++)
+            CHECK(agv[displs[i] + j] == i * 10 + j, 11);
+    int *sc = malloc(sizeof(int) * size), *sd = malloc(sizeof(int) * size);
+    int *acc = malloc(sizeof(int) * size);
+    for (int i = 0; i < size; i++) {
+        sc[i] = 1;
+        sd[i] = i;
+        sbuf[i] = rank * size + i;
+    }
+    MPI_Ialltoallv(sbuf, sc, sd, MPI_INT, rbuf, sc, sd, MPI_INT,
+                   MPI_COMM_WORLD, &req);
+    MPI_Wait(&req, MPI_STATUS_IGNORE);
+    for (int i = 0; i < size; i++)
+        CHECK(rbuf[i] == i * size + rank, 12);
+    (void)acc;
+
+    /* blocking Reduce_scatter + Ireduce_scatter(+_block) */
+    int *contrib = malloc(sizeof(int) * off);
+    for (int i = 0; i < off; i++)
+        contrib[i] = i;                  /* same on every rank */
+    int *seg = malloc(sizeof(int) * (rank + 1));
+    MPI_Reduce_scatter(contrib, seg, counts, MPI_INT, MPI_SUM,
+                       MPI_COMM_WORLD);
+    for (int j = 0; j <= rank; j++)
+        CHECK(seg[j] == size * (displs[rank] + j), 13);
+    MPI_Ireduce_scatter(contrib, seg, counts, MPI_INT, MPI_SUM,
+                        MPI_COMM_WORLD, &req);
+    MPI_Wait(&req, MPI_STATUS_IGNORE);
+    for (int j = 0; j <= rank; j++)
+        CHECK(seg[j] == size * (displs[rank] + j), 14);
+    int rs = -1;
+    MPI_Ireduce_scatter_block(sbuf, &rs, 1, MPI_INT, MPI_SUM,
+                              MPI_COMM_WORLD, &req);
+    MPI_Wait(&req, MPI_STATUS_IGNORE);
+    {   /* sum over ranks r of sbuf[rank] = r*size + rank */
+        int want = 0;
+        for (int r = 0; r < size; r++)
+            want += r * size + rank;
+        CHECK(rs == want, 15);
+    }
+
+    printf("OK c14_icoll_full rank=%d/%d\n", rank, size);
+    MPI_Finalize();
+    return 0;
+}

@@ -38,6 +38,7 @@ CASES = [
     ("c11_rma.c", 3),
     ("c12_mpiio.c", 3),
     ("c13_staged.c", 2),
+    ("c14_icoll_full.c", 3),
 ]
 
 # per-program argv (c13 runs 4M floats = 16 MB in CI — above the 1 MB
