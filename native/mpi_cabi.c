@@ -172,6 +172,14 @@ static PyObject *mem_ro(const void *buf, size_t n)
         PyBUF_READ);
 }
 
+static PyObject *mem_rw(void *buf, size_t n)
+{
+    static char dummy_rw;
+    return PyMemoryView_FromMemory(
+        (char *)(n ? buf : (void *)&dummy_rw), (Py_ssize_t)n,
+        PyBUF_WRITE);
+}
+
 static void set_status(MPI_Status *st, int src, int tag, int count)
 {
     if (!st)
@@ -2941,13 +2949,22 @@ int PMPI_Ialltoall(const void *sendbuf, int sendcount,
                    MPI_Datatype recvtype, MPI_Comm comm,
                    MPI_Request *request)
 {
-    size_t ssz = dt_size(sendtype), rsz = dt_size(recvtype);
-    if (!ssz || !rsz || sendcount < 0 || recvcount < 0)
+    size_t rsz = dt_size(recvtype);
+    if (!rsz || recvcount < 0)
         return MPI_ERR_TYPE;
     int size;
     int qrc = PMPI_Comm_size(comm, &size);
     if (qrc != MPI_SUCCESS)
         return qrc;
+    if (sendbuf == MPI_IN_PLACE) {
+        /* in-place alltoall: the input matrix IS recvbuf */
+        sendbuf = recvbuf;
+        sendcount = recvcount;
+        sendtype = recvtype;
+    }
+    size_t ssz = dt_size(sendtype);
+    if (!ssz || sendcount < 0)
+        return MPI_ERR_TYPE;
     GIL_BEGIN;
     PyObject *r = PyObject_CallMethod(
         g_mod, "ialltoall", "lNlil", (long)comm,
@@ -3192,6 +3209,268 @@ int PMPI_Ineighbor_alltoall(const void *sendbuf, int sendcount,
         mem_ro(recvbuf, cap));
     int rc = icoll_request(r, recvbuf, cap, request,
                            "MPI_Ineighbor_alltoall");
+    GIL_END;
+    return rc;
+}
+
+
+/* ------------------------------------------------------------------ */
+/* wave 2 RMA: user-memory windows, request-based ops, atomics, flush
+ * (reference: win_create.c.in:79, osc.h:269-279 rput/rget,
+ * fetch_and_op.c.in, compare_and_swap.c.in).                          */
+/* ------------------------------------------------------------------ */
+int PMPI_Win_create(void *base, MPI_Aint size, int disp_unit,
+                    MPI_Info info, MPI_Comm comm, MPI_Win *win)
+{
+    (void)info;
+    if (size < 0 || disp_unit <= 0)
+        return MPI_ERR_ARG;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    *win = MPI_WIN_NULL;
+    PyObject *r = PyObject_CallMethod(g_mod, "win_create", "lNi",
+                                      (long)comm,
+                                      mem_rw(base, (size_t)size),
+                                      disp_unit);
+    if (!r)
+        rc = handle_error("MPI_Win_create");
+    else {
+        *win = (MPI_Win)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Win_flush(int rank, MPI_Win win)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "win_flush", "li",
+                                      (long)win, rank);
+    if (!r)
+        rc = handle_error("MPI_Win_flush");
+    else
+        Py_DECREF(r);
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Win_flush_local(int rank, MPI_Win win)
+{
+    return PMPI_Win_flush(rank, win);
+}
+
+int PMPI_Win_flush_all(MPI_Win win)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "win_flush_all", "l",
+                                      (long)win);
+    if (!r)
+        rc = handle_error("MPI_Win_flush_all");
+    else
+        Py_DECREF(r);
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Win_flush_local_all(MPI_Win win)
+{
+    return PMPI_Win_flush_all(win);
+}
+
+int PMPI_Win_sync(MPI_Win win)
+{
+    (void)win;          /* public == private copy in this model */
+    return MPI_SUCCESS;
+}
+
+int PMPI_Win_lock_all(int assert_, MPI_Win win)
+{
+    (void)assert_;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "win_lock_all", "l",
+                                      (long)win);
+    if (!r)
+        rc = handle_error("MPI_Win_lock_all");
+    else
+        Py_DECREF(r);
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Win_unlock_all(MPI_Win win)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "win_unlock_all", "l",
+                                      (long)win);
+    if (!r)
+        rc = handle_error("MPI_Win_unlock_all");
+    else
+        Py_DECREF(r);
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Win_get_group(MPI_Win win, MPI_Group *group)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "win_get_group", "l",
+                                      (long)win);
+    if (!r)
+        rc = handle_error("MPI_Win_get_group");
+    else {
+        *group = (MPI_Group)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Fetch_and_op(const void *origin_addr, void *result_addr,
+                      MPI_Datatype datatype, int target_rank,
+                      MPI_Aint target_disp, MPI_Op op, MPI_Win win)
+{
+    size_t esz = dt_size(datatype);
+    if (!esz)
+        return MPI_ERR_TYPE;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "win_fetch_and_op", "lNllil", (long)win,
+        mem_ro(origin_addr ? origin_addr : result_addr, esz),
+        (long)datatype, (long)op, target_rank, (long)target_disp);
+    if (!r)
+        rc = handle_error("MPI_Fetch_and_op");
+    else {
+        rc = copy_bytes(r, result_addr, esz);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Compare_and_swap(const void *origin_addr,
+                          const void *compare_addr, void *result_addr,
+                          MPI_Datatype datatype, int target_rank,
+                          MPI_Aint target_disp, MPI_Win win)
+{
+    size_t esz = dt_size(datatype);
+    if (!esz)
+        return MPI_ERR_TYPE;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "win_compare_and_swap", "lNNlil", (long)win,
+        mem_ro(origin_addr, esz), mem_ro(compare_addr, esz),
+        (long)datatype, target_rank, (long)target_disp);
+    if (!r)
+        rc = handle_error("MPI_Compare_and_swap");
+    else {
+        rc = copy_bytes(r, result_addr, esz);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Get_accumulate(const void *origin_addr, int origin_count,
+                        MPI_Datatype origin_datatype,
+                        void *result_addr, int result_count,
+                        MPI_Datatype result_datatype, int target_rank,
+                        MPI_Aint target_disp, int target_count,
+                        MPI_Datatype target_datatype, MPI_Op op,
+                        MPI_Win win)
+{
+    (void)target_count;
+    (void)target_datatype;               /* same-typemap subset */
+    size_t osz = dt_size(origin_datatype);
+    size_t rsz = dt_size(result_datatype);
+    if (!rsz || result_count < 0 || origin_count < 0)
+        return MPI_ERR_TYPE;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "win_get_accumulate", "lNllilil", (long)win,
+        mem_ro(origin_addr ? origin_addr : result_addr,
+               osz ? (size_t)origin_count * osz : 0),
+        (long)origin_datatype, (long)op, target_rank,
+        (long)target_disp, result_count, (long)result_datatype);
+    if (!r)
+        rc = handle_error("MPI_Get_accumulate");
+    else {
+        rc = copy_bytes(r, result_addr, (size_t)result_count * rsz);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Rput(const void *origin_addr, int origin_count,
+              MPI_Datatype origin_datatype, int target_rank,
+              MPI_Aint target_disp, int target_count,
+              MPI_Datatype target_datatype, MPI_Win win,
+              MPI_Request *request)
+{
+    (void)target_count;
+    (void)target_datatype;
+    size_t esz = dt_extent(origin_datatype);
+    if (!esz || origin_count < 0)
+        return MPI_ERR_TYPE;
+    GIL_BEGIN;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "win_rput", "lNlil", (long)win,
+        mem_ro(origin_addr, (size_t)origin_count * esz),
+        (long)origin_datatype, target_rank, (long)target_disp);
+    int rc = icoll_request(r, NULL, 0, request, "MPI_Rput");
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Rget(void *origin_addr, int origin_count,
+              MPI_Datatype origin_datatype, int target_rank,
+              MPI_Aint target_disp, int target_count,
+              MPI_Datatype target_datatype, MPI_Win win,
+              MPI_Request *request)
+{
+    (void)target_count;
+    (void)target_datatype;
+    size_t esz = dt_extent(origin_datatype);
+    if (!esz || origin_count < 0)
+        return MPI_ERR_TYPE;
+    size_t cap = (size_t)origin_count * esz;
+    GIL_BEGIN;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "win_rget", "lilliN", (long)win, target_rank,
+        (long)target_disp, (long)origin_datatype, origin_count,
+        mem_ro(origin_addr, cap));
+    int rc = icoll_request(r, origin_addr, cap, request, "MPI_Rget");
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Raccumulate(const void *origin_addr, int origin_count,
+                     MPI_Datatype origin_datatype, int target_rank,
+                     MPI_Aint target_disp, int target_count,
+                     MPI_Datatype target_datatype, MPI_Op op,
+                     MPI_Win win, MPI_Request *request)
+{
+    (void)target_count;
+    (void)target_datatype;
+    size_t esz = dt_extent(origin_datatype);
+    if (!esz || origin_count < 0)
+        return MPI_ERR_TYPE;
+    GIL_BEGIN;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "win_raccumulate", "lNllil", (long)win,
+        mem_ro(origin_addr, (size_t)origin_count * esz),
+        (long)origin_datatype, (long)op, target_rank,
+        (long)target_disp);
+    int rc = icoll_request(r, NULL, 0, request, "MPI_Raccumulate");
     GIL_END;
     return rc;
 }

@@ -76,12 +76,16 @@ _DT = {
     23: np.dtype(np.uint64),   # MPI_UINT64_T
 }
 
-# mpi.h MPI_Op constants -> predefined ops (op.c:73-80 table)
+# mpi.h MPI_Op constants -> predefined ops (op.c:73-80 table).
+# MPI_REPLACE/MPI_NO_OP (11/12) are accumulate-ONLY pseudo-ops: they
+# resolve through _rma_op so collective reductions keep rejecting them
+# with MPI_ERR_OP (passing MPI_NO_OP to MPI_Allreduce is erroneous).
 _OPS = {
     1: op_mod.SUM, 2: op_mod.PROD, 3: op_mod.MAX, 4: op_mod.MIN,
     5: op_mod.LAND, 6: op_mod.LOR, 7: op_mod.LXOR,
     8: op_mod.BAND, 9: op_mod.BOR, 10: op_mod.BXOR,
 }
+_RMA_OPS = {11: op_mod.REPLACE, 12: op_mod.NO_OP}
 # user-defined ops (MPI_Op_create): handles >= 32, combiner = a real C
 # function pointer invoked through ctypes on the HOST reduction tier
 _FIRST_DYN_OP = 32
@@ -315,6 +319,16 @@ def _dtype(dt: int) -> np.dtype:
 
 def _op(o: int) -> op_mod.Op:
     p = _OPS.get(o)
+    if p is None:
+        raise MPIError(ERR_OP, f"invalid op handle {o}")
+    return p
+
+
+def _rma_op(o: int) -> op_mod.Op:
+    """Accumulate-path op lookup: the regular table PLUS the RMA-only
+    pseudo-ops (MPI_REPLACE/MPI_NO_OP, accumulate semantics in
+    ompi/op/op.c) which collective reductions must keep rejecting."""
+    p = _OPS.get(o) or _RMA_OPS.get(o)
     if p is None:
         raise MPIError(ERR_OP, f"invalid op handle {o}")
     return p
@@ -1266,6 +1280,143 @@ def win_allocate(nbytes: int, disp_unit: int, h: int
     return wh, int(win.local.ctypes.data)
 
 
+def win_create(h: int, base_view, disp_unit: int) -> int:
+    """MPI_Win_create (win_create.c.in:79): the CALLER's memory is the
+    exposure region — remote puts applied by the reader thread land
+    directly in the C program's buffer, so its plain loads observe
+    them after the synchronization call (the osc/sm model)."""
+    from ompi_tpu.osc.perrank import RankWindow
+    c = _comm(h)
+    storage = np.frombuffer(base_view, dtype=np.uint8)
+    win = RankWindow(c, storage.size, dtype=np.uint8,
+                     name=f"cabi_wincreate{storage.size}",
+                     storage=storage)
+    win._disp_units = [int(u) for u in
+                       c.allgather(np.int64(max(int(disp_unit), 1)))]
+    with _lock:
+        wh = next(_next_win)
+        _wins[wh] = win
+    return wh
+
+
+def win_flush(wh: int, target: int) -> None:
+    """Every RMA op here is target-acked before returning, so flush
+    variants are ordering no-ops (documented semantics, not a stub:
+    completion already happened)."""
+    _win(wh).flush(target)
+
+
+def win_flush_all(wh: int) -> None:
+    _win(wh).flush()
+
+
+def win_lock_all(wh: int) -> None:
+    from ompi_tpu.osc.perrank import LOCK_SHARED
+    w = _win(wh)
+    for t in range(w.comm.size):
+        w.lock(t, LOCK_SHARED)
+
+
+def win_unlock_all(wh: int) -> None:
+    w = _win(wh)
+    for t in range(w.comm.size):
+        w.unlock(t)
+
+
+def win_get_group(wh: int) -> int:
+    return _register_group(_win(wh).comm.group)
+
+
+def win_fetch_and_op(wh: int, view, dt: int, o: int, target: int,
+                     disp: int) -> bytes:
+    """Returns the target's PRIOR value (the MPI result buffer)."""
+    w = _win(wh)
+    op = _rma_op(o)
+    if not op.predefined:
+        raise MPIError(ERR_OP, "MPI_Fetch_and_op needs a predefined op")
+    a = _arr(view, dt)[:1]
+    old = w.get_accumulate_typed(a, target,
+                                 _byte_disp(w, target, disp),
+                                 op=op.name)
+    return _out(np.asarray(old), dt)
+
+
+def win_compare_and_swap(wh: int, origin_view, compare_view, dt: int,
+                         target: int, disp: int) -> bytes:
+    w = _win(wh)
+    origin = _arr(origin_view, dt)[:1]
+    compare = _arr(compare_view, dt)[:1]
+    old = w.compare_and_swap_typed(compare, origin, target,
+                                   _byte_disp(w, target, disp))
+    return _out(np.asarray(old).ravel(), dt)
+
+
+def win_get_accumulate(wh: int, view, dt: int, o: int, target: int,
+                       disp: int, result_count: int,
+                       rdt: int) -> bytes:
+    """Fetch-then-accumulate; for MPI_NO_OP the origin buffer is
+    ignored and the fetch length comes from result_count (MPI-3.1
+    11.3.4 significance rules)."""
+    w = _win(wh)
+    op = _rma_op(o)
+    if not op.predefined:
+        raise MPIError(ERR_OP,
+                       "MPI_Get_accumulate needs a predefined op")
+    if op.name == "no_op":
+        # origin buffer/count/datatype are IGNORED for MPI_NO_OP
+        # (MPI-3.1 11.3.4): the fetch is sized and typed by the
+        # RESULT arguments
+        data = np.zeros(result_count, _dtype(rdt))
+        out_dt = rdt
+    else:
+        data = _arr(view, dt)
+        out_dt = rdt if rdt else dt
+    old = w.get_accumulate_typed(data, target,
+                                 _byte_disp(w, target, disp),
+                                 op=op.name)
+    return _out(np.asarray(old), out_dt)
+
+
+def win_rput(wh: int, view, dt: int, target: int, disp: int) -> int:
+    """MPI_Rput -> request handle; completion == remote completion."""
+    w = _win(wh)
+    a = _pack(view, dt, _count_of(view, dt))
+    req = w.rput(a.view(np.uint8), target,
+                 _byte_disp(w, target, disp))
+    return _icoll_handle(req, 0)
+
+
+def win_rget(wh: int, target: int, disp: int, dt: int, count: int,
+             curview) -> int:
+    """MPI_Rget -> request handle; completion payload is the origin
+    buffer image (same overlay contract as win_get)."""
+    from ompi_tpu.pml.perrank import thread_request
+    w = _win(wh)
+    snap = bytes(curview)
+    bd = _byte_disp(w, target, disp)
+
+    def job():
+        nbytes = type_size_bytes(dt) * count
+        raw = w.get(target, bd, nbytes).tobytes()
+        base, _, _ = _type_parts(dt)
+        return _unpack(np.frombuffer(raw, base), dt, count, snap)[0]
+    return _icoll_handle(thread_request(job), 0)
+
+
+def win_raccumulate(wh: int, view, dt: int, o: int, target: int,
+                    disp: int) -> int:
+    from ompi_tpu.pml.perrank import thread_request
+    w = _win(wh)
+    op = _rma_op(o)
+    if not op.predefined:
+        raise MPIError(ERR_OP,
+                       "MPI_Raccumulate needs a predefined op")
+    a = _pack(view, dt, _count_of(view, dt))
+    bd = _byte_disp(w, target, disp)
+    return _icoll_handle(thread_request(
+        lambda: w.accumulate_typed(a, target, bd, op=op.name)), 0)
+
+
 def win_free(wh: int) -> None:
     with _lock:
         w = _wins.pop(wh, None)
@@ -1315,7 +1466,7 @@ def win_get(wh: int, target: int, disp: int, dt: int,
 def win_accumulate(wh: int, view, dt: int, o: int, target: int,
                    disp: int) -> None:
     w = _win(wh)
-    op = _op(o)
+    op = _rma_op(o)
     if not op.predefined:
         raise MPIError(ERR_OP,
                        "MPI_Accumulate requires a predefined op")

@@ -49,10 +49,20 @@ class RankWindow:
     """An RMA window whose caller is one rank (collective creation)."""
 
     def __init__(self, comm, size: int, dtype=np.float32,
-                 name: str = ""):
+                 name: str = "", storage: Optional[np.ndarray] = None):
+        """``storage``: use the CALLER's memory as the exposure region
+        (MPI_Win_create over user-allocated memory,
+        win_create.c.in:79): remote puts applied by the reader thread
+        land directly in it, so the owner's plain loads observe them —
+        the osc/sm shared-window model."""
         self.comm = comm
         self.size = int(size)
         self.dtype = np.dtype(dtype)
+        if storage is not None:
+            if (storage.dtype != self.dtype or storage.ndim != 1
+                    or storage.size != self.size
+                    or not storage.flags.writeable):
+                raise MPIError(ERR_ARG, "bad window storage array")
         # window id must agree across ranks: creation is collective ON
         # THIS communicator, so the sequence lives on the comm — a
         # process-global counter would diverge when ranks have created
@@ -62,7 +72,8 @@ class RankWindow:
         seq = next(comm._win_seq)
         self.wid = ("win", comm.cid, seq)
         self.name = name or f"win#{seq}"
-        self.local = np.zeros(self.size, self.dtype)
+        self.local = (storage if storage is not None
+                      else np.zeros(self.size, self.dtype))
         self._lock = threading.Lock()
         # passive-target lock state (target side)
         self._holders: List[Tuple[int, int]] = []   # (origin, type)
@@ -158,6 +169,52 @@ class RankWindow:
                                   target, disp, op)
         return out[0]
 
+    # -- typed origin entry points for byte-addressed (C ABI) windows --
+    def get_accumulate_typed(self, data, target: int, byte_disp: int,
+                             op: str = "sum"):
+        """Fetch-and-accumulate with the VALUE's dtype against a uint8
+        window (MPI_Get_accumulate from C: raw window memory, each
+        call brings its own datatype). Returns the prior typed
+        contents."""
+        if self.dtype != np.dtype(np.uint8):
+            raise MPIError(ERR_ARG, "typed RMA requires a byte window")
+        if op not in _ACC_OPS:
+            raise MPIError(ERR_ARG, f"bad accumulate op {op!r}")
+        arr = np.ascontiguousarray(np.asarray(data)).ravel()
+        self._bounds(byte_disp, arr.nbytes, target)
+        return self._rpc(target, {"op": "getacc",
+                                  "disp": int(byte_disp), "acc": op},
+                         arr)
+
+    def compare_and_swap_typed(self, compare, origin, target: int,
+                               byte_disp: int):
+        if self.dtype != np.dtype(np.uint8):
+            raise MPIError(ERR_ARG, "typed RMA requires a byte window")
+        pair = np.ascontiguousarray(
+            np.stack([np.asarray(origin).ravel()[0],
+                      np.asarray(compare).ravel()[0]]))
+        self._bounds(byte_disp, pair.dtype.itemsize, target)
+        return self._rpc(target, {"op": "cas", "disp": int(byte_disp)},
+                         pair)[0]
+
+    # -- request-based operations (osc.h:269-279 rput/rget) ------------
+    def rput(self, data, target: int, disp: int = 0):
+        """MPI_Rput: returns a request; completion == remote completion
+        (every op here is target-acked)."""
+        from ompi_tpu.pml.perrank import thread_request
+        return thread_request(lambda: self.put(data, target, disp))
+
+    def rget(self, target: int, disp: int = 0, count: int = 1):
+        """MPI_Rget: the request's payload is the fetched array."""
+        from ompi_tpu.pml.perrank import thread_request
+        return thread_request(lambda: self.get(target, disp, count))
+
+    def raccumulate(self, data, target: int, disp: int = 0,
+                    op: str = "sum"):
+        from ompi_tpu.pml.perrank import thread_request
+        return thread_request(
+            lambda: self.accumulate(data, target, disp, op))
+
     def compare_and_swap(self, compare, origin, target: int,
                          disp: int = 0):
         self._bounds(disp, 1, target)
@@ -249,17 +306,37 @@ class RankWindow:
                         data if fn is None else fn(seg, data))
             elif op == "getacc":
                 d = header["disp"]
-                seg = self.local[d:d + data.size]
-                reply = seg.copy()
                 fn = _ACC_OPS.get(header["acc"])
-                if fn is not False:      # MPI_NO_OP fetches only
-                    self.local[d:d + data.size] = (
-                        data if fn is None else fn(seg, data))
+                if self.dtype == np.uint8 and data.dtype != np.uint8:
+                    # typed fetch-accumulate into a byte-addressed
+                    # window (C ABI Get_accumulate/Fetch_and_op)
+                    nb = data.nbytes
+                    seg = self.local[d:d + nb].view(data.dtype)
+                    reply = seg.copy()
+                    if fn is not False:  # MPI_NO_OP fetches only
+                        out = data if fn is None else fn(seg, data)
+                        self.local[d:d + nb] = \
+                            np.ascontiguousarray(out).view(np.uint8)
+                else:
+                    seg = self.local[d:d + data.size]
+                    reply = seg.copy()
+                    if fn is not False:  # MPI_NO_OP fetches only
+                        self.local[d:d + data.size] = (
+                            data if fn is None else fn(seg, data))
             elif op == "cas":
                 d = header["disp"]
-                reply = np.array([self.local[d]], self.dtype)
-                if self.local[d] == data[1]:     # typed compare
-                    self.local[d] = data[0]
+                if self.dtype == np.uint8 and data.dtype != np.uint8:
+                    # typed CAS against a byte-addressed window
+                    esz = data.dtype.itemsize
+                    seg = self.local[d:d + esz].view(data.dtype)
+                    reply = seg.copy()
+                    if seg[0] == data[1]:
+                        self.local[d:d + esz] = np.ascontiguousarray(
+                            data[0:1]).view(np.uint8)
+                else:
+                    reply = np.array([self.local[d]], self.dtype)
+                    if self.local[d] == data[1]:  # typed compare
+                        self.local[d] = data[0]
             elif op == "unlock":
                 self._unlock(origin_world, aid)
                 return

@@ -239,6 +239,22 @@ class RankRequest(Request):
         return self.status
 
 
+def thread_request(job) -> RankRequest:
+    """Run ``job`` on a daemon worker thread; the returned request
+    completes with the job's result, or in error through the same
+    ``_fail`` path ULFM uses. The generic request-based-operation
+    primitive (request-based RMA rput/rget, ``osc.h:269-279``)."""
+    req = RankRequest(ANY_SOURCE, ANY_TAG)
+
+    def run():
+        try:
+            req._deliver(_Msg(ANY_SOURCE, 0, job()))
+        except BaseException as e:      # noqa: BLE001 — surfaced at wait
+            req._fail(e)
+    threading.Thread(target=run, daemon=True).start()
+    return req
+
+
 class PerRankEngine:
     """Matching state for ONE rank of one communicator.
 

@@ -79,6 +79,14 @@ int main(int argc, char **argv)
     MPI_Wait(&req, MPI_STATUS_IGNORE);
     for (int i = 0; i < size; i++)
         CHECK(rbuf[i] == i * size + rank, 8);
+    /* IN_PLACE variant: input matrix IS the recv buffer */
+    for (int i = 0; i < size; i++)
+        rbuf[i] = rank * size + i;
+    MPI_Ialltoall(MPI_IN_PLACE, 1, MPI_INT, rbuf, 1, MPI_INT,
+                  MPI_COMM_WORLD, &req);
+    MPI_Wait(&req, MPI_STATUS_IGNORE);
+    for (int i = 0; i < size; i++)
+        CHECK(rbuf[i] == i * size + rank, 16);
 
     /* Igatherv / Iscatterv: rank i contributes i+1 elements */
     int *counts = malloc(sizeof(int) * size);

@@ -1,0 +1,124 @@
+/* RMA wave 2: MPI_Win_create over USER memory (the program's own
+ * array is the exposure region — remote puts must appear in it),
+ * request-based Rput/Rget/Raccumulate, Fetch_and_op,
+ * Compare_and_swap, Get_accumulate with MPI_NO_OP fetch, lock_all
+ * epochs, flush. References: win_create.c.in:79, osc.h:269-279,
+ * fetch_and_op.c.in. */
+#include <mpi.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+#define CHECK(cond, code)                                            \
+    do {                                                             \
+        if (!(cond)) {                                               \
+            fprintf(stderr, "rank %d: check failed at line %d\n",    \
+                    rank, __LINE__);                                 \
+            MPI_Abort(MPI_COMM_WORLD, code);                         \
+        }                                                            \
+    } while (0)
+
+int main(int argc, char **argv)
+{
+    MPI_Init(&argc, &argv);
+    int rank, size;
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &size);
+    int right = (rank + 1) % size;
+
+    /* ---- Win_create over the program's own array ---- */
+    double mem[8];
+    for (int i = 0; i < 8; i++)
+        mem[i] = rank * 100.0 + i;
+    MPI_Win win;
+    MPI_Win_create(mem, 8 * sizeof(double), sizeof(double),
+                   MPI_INFO_NULL, MPI_COMM_WORLD, &win);
+
+    MPI_Win_fence(0, win);
+    double val = 1000.0 + rank;
+    MPI_Put(&val, 1, MPI_DOUBLE, right, 3, 1, MPI_DOUBLE, win);
+    MPI_Win_fence(0, win);
+    /* my slot 3 was written by my LEFT neighbor — directly visible in
+     * my own array, the whole point of Win_create */
+    int left = (rank - 1 + size) % size;
+    CHECK(mem[3] == 1000.0 + left, 2);
+    CHECK(mem[2] == rank * 100.0 + 2, 3);   /* untouched slots live */
+
+    /* request-based ops inside a lock_all epoch */
+    MPI_Win_lock_all(0, win);
+    MPI_Request reqs[2];
+    double pv = 77.0 + rank, gv = -1.0;
+    MPI_Rput(&pv, 1, MPI_DOUBLE, right, 5, 1, MPI_DOUBLE, win,
+             &reqs[0]);
+    MPI_Wait(&reqs[0], MPI_STATUS_IGNORE);
+    MPI_Rget(&gv, 1, MPI_DOUBLE, right, 5, 1, MPI_DOUBLE, win,
+             &reqs[1]);
+    MPI_Wait(&reqs[1], MPI_STATUS_IGNORE);
+    CHECK(gv == 77.0 + rank, 4);
+    double acc = 0.5;
+    MPI_Raccumulate(&acc, 1, MPI_DOUBLE, right, 5, 1, MPI_DOUBLE,
+                    MPI_SUM, win, &reqs[0]);
+    MPI_Wait(&reqs[0], MPI_STATUS_IGNORE);
+    MPI_Win_flush(right, win);
+    MPI_Rget(&gv, 1, MPI_DOUBLE, right, 5, 1, MPI_DOUBLE, win,
+             &reqs[1]);
+    MPI_Wait(&reqs[1], MPI_STATUS_IGNORE);
+    CHECK(gv == 77.5 + rank, 5);
+    MPI_Win_unlock_all(win);
+    MPI_Win_fence(0, win);
+
+    /* group accessor */
+    MPI_Group wg;
+    MPI_Win_get_group(win, &wg);
+    int gsize;
+    MPI_Group_size(wg, &gsize);
+    CHECK(gsize == size, 6);
+    MPI_Group_free(&wg);
+    MPI_Win_free(&win);
+
+    /* ---- atomics on an allocated counter window ---- */
+    long *cbase;
+    MPI_Win cwin;
+    MPI_Win_allocate(sizeof(long), sizeof(long), MPI_INFO_NULL,
+                     MPI_COMM_WORLD, &cbase, &cwin);
+    *cbase = 0;
+    MPI_Win_fence(0, cwin);
+    /* every rank fetch-adds 1 at rank 0: old values are a permutation
+     * of 0..size-1 and the final count is size */
+    long one = 1, old = -1;
+    MPI_Fetch_and_op(&one, &old, MPI_LONG, 0, 0, MPI_SUM, cwin);
+    CHECK(old >= 0 && old < size, 7);
+    MPI_Win_fence(0, cwin);
+    if (rank == 0)
+        CHECK(*cbase == size, 8);
+
+    /* CAS: only ONE rank succeeds in swapping 0->its id on a fresh
+     * slot (use MPI_NO_OP Get_accumulate to read it back) */
+    MPI_Win_fence(0, cwin);
+    if (rank == 0)
+        *cbase = -1;
+    MPI_Win_fence(0, cwin);
+    long want = -1, mine = (long)rank + 1, prev = -99;
+    MPI_Compare_and_swap(&mine, &want, &prev, MPI_LONG, 0, 0, cwin);
+    MPI_Win_fence(0, cwin);
+    long seen = -77, dummy = 0;
+    MPI_Get_accumulate(&dummy, 0, MPI_LONG, &seen, 1, MPI_LONG, 0, 0,
+                       1, MPI_LONG, MPI_NO_OP, cwin);
+    CHECK(seen >= 1 && seen <= (long)size, 9);
+    if (prev == -1)      /* I won the race: my id must be there OR a
+                          * later winner is impossible (one winner) */
+        CHECK(seen == mine, 10);
+    MPI_Win_fence(0, cwin);
+    MPI_Win_free(&cwin);
+
+    /* RMA-only pseudo-ops must stay rejected by collectives */
+    MPI_Comm_set_errhandler(MPI_COMM_WORLD, MPI_ERRORS_RETURN);
+    int a = 1, b = 0;
+    int erc = MPI_Allreduce(&a, &b, 1, MPI_INT, MPI_NO_OP,
+                            MPI_COMM_WORLD);
+    CHECK(erc != MPI_SUCCESS, 11);
+    MPI_Comm_set_errhandler(MPI_COMM_WORLD, MPI_ERRORS_ARE_FATAL);
+
+    printf("OK c15_rma2 rank=%d/%d\n", rank, size);
+    MPI_Finalize();
+    return 0;
+}

@@ -95,5 +95,29 @@ w3.fence()
 w3.free()
 print(f"OK p13b_asym rank={r}/{n}", flush=True)
 
+# request-based RMA (osc.h:269-279 rput/rget/raccumulate): the request
+# completes at remote completion; rget's payload is the fetched array
+w4 = RankWindow(world, 4, np.float64)
+w4.local[:] = 0.0
+w4.fence()
+right = (r + 1) % n
+req = w4.rput(np.array([10.0 + r, 20.0 + r]), right, disp=1)
+req.wait()
+g = w4.rget(right, disp=1, count=2)
+g.wait()
+got = g.get()
+assert got[0] == 10.0 + r and got[1] == 20.0 + r, got
+ra = w4.raccumulate(np.array([0.25, 0.25]), right, disp=1, op="sum")
+ra.wait()
+g2 = w4.rget(right, disp=1, count=2)
+g2.wait()
+assert g2.get()[0] == 10.25 + r, g2.get()
+w4.fence()
+# my own slots were written by my LEFT neighbor
+left = (r - 1) % n
+assert w4.local[1] == 10.25 + left, w4.local
+w4.free()
+print(f"OK p13c_request_rma rank={r}/{n}", flush=True)
+
 MPI.Finalize()
 print(f"OK p13_rma rank={r}/{n}", flush=True)
