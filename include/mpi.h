@@ -97,6 +97,12 @@ typedef void (MPI_User_function)(void *invec, void *inoutvec, int *len,
 typedef int (MPI_Copy_function)(MPI_Comm, int, void *, void *, void *,
                                 int *);
 typedef int (MPI_Delete_function)(MPI_Comm, int, void *, void *);
+/* predefined copy/delete sentinels (resolved in the binding layer) */
+#define MPI_COMM_NULL_COPY_FN   ((MPI_Copy_function *)0)
+#define MPI_COMM_DUP_FN         ((MPI_Copy_function *)1)
+#define MPI_COMM_NULL_DELETE_FN ((MPI_Delete_function *)0)
+#define MPI_MAX_INFO_KEY 256
+#define MPI_MAX_INFO_VAL 1024
 
 #define MPI_MAX_PROCESSOR_NAME  256
 #define MPI_MAX_LIBRARY_VERSION_STRING 256
@@ -196,6 +202,25 @@ int MPI_Comm_set_attr(MPI_Comm comm, int comm_keyval,
 int MPI_Comm_get_attr(MPI_Comm comm, int comm_keyval,
                       void *attribute_val, int *flag);
 int MPI_Comm_delete_attr(MPI_Comm comm, int comm_keyval);
+int MPI_Comm_get_errhandler(MPI_Comm comm, MPI_Errhandler *errhandler);
+int MPI_Errhandler_free(MPI_Errhandler *errhandler);
+int MPI_Comm_call_errhandler(MPI_Comm comm, int errorcode);
+
+/* ---- MPI_Info objects ---- */
+int MPI_Info_create(MPI_Info *info);
+int MPI_Info_set(MPI_Info info, const char *key, const char *value);
+int MPI_Info_get(MPI_Info info, const char *key, int valuelen,
+                 char *value, int *flag);
+int MPI_Info_get_valuelen(MPI_Info info, const char *key, int *valuelen,
+                          int *flag);
+int MPI_Info_delete(MPI_Info info, const char *key);
+int MPI_Info_get_nkeys(MPI_Info info, int *nkeys);
+int MPI_Info_get_nthkey(MPI_Info info, int n, char *key);
+int MPI_Info_dup(MPI_Info info, MPI_Info *newinfo);
+int MPI_Info_free(MPI_Info *info);
+int MPI_Get_address(const void *location, MPI_Aint *address);
+MPI_Aint MPI_Aint_add(MPI_Aint base, MPI_Aint disp);
+MPI_Aint MPI_Aint_diff(MPI_Aint addr1, MPI_Aint addr2);
 
 /* ---- point-to-point ---- */
 int MPI_Send(const void *buf, int count, MPI_Datatype datatype, int dest,

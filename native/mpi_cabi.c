@@ -129,9 +129,47 @@ static int ensure_module(void)
     return g_mod ? 0 : -1;
 }
 
+/* Per-comm errhandler table (errhandler.h semantics): entries override
+ * the process default g_errh; the glue keeps the matching Python-side
+ * per-comm state. */
+#define ERRH_TAB_MAX 256
+static struct { MPI_Comm comm; MPI_Errhandler errh; } g_errh_tab[ERRH_TAB_MAX];
+static int g_errh_n;
+
+static MPI_Errhandler errh_for(MPI_Comm c)
+{
+    for (int i = 0; i < g_errh_n; i++)
+        if (g_errh_tab[i].comm == c)
+            return g_errh_tab[i].errh;
+    return g_errh;
+}
+
+static void errh_drop(MPI_Comm c)
+{
+    for (int i = 0; i < g_errh_n; i++)
+        if (g_errh_tab[i].comm == c) {
+            g_errh_tab[i] = g_errh_tab[--g_errh_n];
+            return;
+        }
+}
+
+static void errh_set(MPI_Comm c, MPI_Errhandler eh)
+{
+    for (int i = 0; i < g_errh_n; i++)
+        if (g_errh_tab[i].comm == c) {
+            g_errh_tab[i].errh = eh;
+            return;
+        }
+    if (g_errh_n < ERRH_TAB_MAX) {
+        g_errh_tab[g_errh_n].comm = c;
+        g_errh_tab[g_errh_n].errh = eh;
+        g_errh_n++;
+    }
+}
+
 /* Called with the GIL held and a Python exception set.  Returns the
  * error code to hand back (ERRORS_RETURN) or exits (ERRORS_ARE_FATAL). */
-static int handle_error(const char *func)
+static int handle_error_eh(const char *func, MPI_Errhandler eh)
 {
     PyObject *type, *value, *tb;
     PyErr_Fetch(&type, &value, &tb);
@@ -145,7 +183,7 @@ static int handle_error(const char *func)
             PyErr_Clear();
         }
     }
-    if (g_errh == MPI_ERRORS_RETURN) {
+    if (eh == MPI_ERRORS_RETURN) {
         Py_XDECREF(type);
         Py_XDECREF(value);
         Py_XDECREF(tb);
@@ -156,6 +194,16 @@ static int handle_error(const char *func)
     PyErr_Restore(type, value, tb);
     PyErr_Print();
     exit(code > 0 && code < 126 ? code : 1);
+}
+
+static int handle_error(const char *func)
+{
+    return handle_error_eh(func, g_errh);
+}
+
+static int handle_error_comm(MPI_Comm comm, const char *func)
+{
+    return handle_error_eh(func, errh_for(comm));
 }
 
 #define GIL_BEGIN PyGILState_STATE _gst = PyGILState_Ensure()
@@ -424,9 +472,11 @@ int PMPI_Comm_dup(MPI_Comm comm, MPI_Comm *newcomm)
     int rc = MPI_SUCCESS;
     PyObject *r = PyObject_CallMethod(g_mod, "comm_dup", "l", (long)comm);
     if (!r)
-        rc = handle_error("MPI_Comm_dup");
+        rc = handle_error_comm(comm, "MPI_Comm_dup");
     else {
         *newcomm = (MPI_Comm)PyLong_AsLong(r);
+        /* dup inherits the parent's errhandler (comm.c:318 path) */
+        errh_set(*newcomm, errh_for(comm));
         Py_DECREF(r);
     }
     GIL_END;
@@ -440,9 +490,12 @@ int PMPI_Comm_split(MPI_Comm comm, int color, int key, MPI_Comm *newcomm)
     PyObject *r = PyObject_CallMethod(g_mod, "comm_split", "lii",
                                       (long)comm, color, key);
     if (!r)
-        rc = handle_error("MPI_Comm_split");
+        rc = handle_error_comm(comm, "MPI_Comm_split");
     else {
         *newcomm = (MPI_Comm)PyLong_AsLong(r);
+        /* derived comms inherit the parent errhandler */
+        if (*newcomm != MPI_COMM_NULL)
+            errh_set(*newcomm, errh_for(comm));
         Py_DECREF(r);
     }
     GIL_END;
@@ -458,6 +511,7 @@ int PMPI_Comm_free(MPI_Comm *comm)
     if (!r)
         rc = handle_error("MPI_Comm_free");
     else {
+        errh_drop(*comm);       /* bounded table under comm churn */
         *comm = MPI_COMM_NULL;
         Py_DECREF(r);
     }
@@ -478,12 +532,12 @@ int PMPI_Comm_set_errhandler(MPI_Comm comm, MPI_Errhandler errhandler)
     PyObject *r = PyObject_CallMethod(g_mod, "comm_set_errhandler", "li",
                                       (long)comm, (int)errhandler);
     if (!r)
-        rc = handle_error("MPI_Comm_set_errhandler");
+        rc = handle_error_comm(comm, "MPI_Comm_set_errhandler");
     else
         Py_DECREF(r);
     GIL_END;
     if (rc == MPI_SUCCESS)
-        g_errh = errhandler;    /* shim side: process-scoped */
+        errh_set(comm, errhandler);      /* shim side: per-comm */
     return rc;
 }
 
@@ -503,7 +557,7 @@ static int send_common(const void *buf, int count, MPI_Datatype dt,
         g_mod, "send", "lNliii", (long)comm,
         mem_ro(buf, (size_t)count * esz), (long)dt, dest, tag, sync);
     if (!r)
-        rc = handle_error(fn);
+        rc = handle_error_comm(comm, fn);
     else
         Py_DECREF(r);
     GIL_END;
@@ -539,7 +593,7 @@ int PMPI_Recv(void *buf, int count, MPI_Datatype datatype, int source,
                                       source, tag, (long)datatype,
                                       mem_ro(buf, snap));
     if (!r)
-        rc = handle_error("MPI_Recv");
+        rc = handle_error_comm(comm, "MPI_Recv");
     else {
         rc = copy_msg(r, buf, (size_t)count * esz, status);
         Py_DECREF(r);
@@ -567,7 +621,7 @@ int PMPI_Sendrecv(const void *sendbuf, int sendcount,
         sendtag, source, recvtag, (long)recvtype,
         mem_ro(recvbuf, snap));
     if (!r)
-        rc = handle_error("MPI_Sendrecv");
+        rc = handle_error_comm(comm, "MPI_Sendrecv");
     else {
         rc = copy_msg(r, recvbuf, (size_t)recvcount * rsz, status);
         Py_DECREF(r);
@@ -588,7 +642,7 @@ int PMPI_Isend(const void *buf, int count, MPI_Datatype datatype, int dest,
         g_mod, "isend", "lNlii", (long)comm,
         mem_ro(buf, (size_t)count * esz), (long)datatype, dest, tag);
     if (!r) {
-        rc = handle_error("MPI_Isend");
+        rc = handle_error_comm(comm, "MPI_Isend");
     } else {
         req_entry *e = req_new();
         e->pyh = PyLong_AsLong(r);
@@ -612,7 +666,7 @@ int PMPI_Irecv(void *buf, int count, MPI_Datatype datatype, int source,
                                       source, tag, (long)datatype,
                                       mem_ro(buf, snap));
     if (!r) {
-        rc = handle_error("MPI_Irecv");
+        rc = handle_error_comm(comm, "MPI_Irecv");
     } else {
         req_entry *e = req_new();
         e->pyh = PyLong_AsLong(r);
@@ -729,7 +783,7 @@ int PMPI_Probe(int source, int tag, MPI_Comm comm, MPI_Status *status)
     PyObject *r = PyObject_CallMethod(g_mod, "probe", "lii", (long)comm,
                                       source, tag);
     if (!r)
-        rc = handle_error("MPI_Probe");
+        rc = handle_error_comm(comm, "MPI_Probe");
     else {
         set_status(status,
                    (int)PyLong_AsLong(PyTuple_GetItem(r, 0)),
@@ -750,7 +804,7 @@ int PMPI_Iprobe(int source, int tag, MPI_Comm comm, int *flag,
     PyObject *r = PyObject_CallMethod(g_mod, "iprobe", "lii", (long)comm,
                                       source, tag);
     if (!r)
-        rc = handle_error("MPI_Iprobe");
+        rc = handle_error_comm(comm, "MPI_Iprobe");
     else {
         *flag = (int)PyLong_AsLong(PyTuple_GetItem(r, 0));
         if (*flag)
@@ -791,7 +845,7 @@ int PMPI_Barrier(MPI_Comm comm)
     int rc = MPI_SUCCESS;
     PyObject *r = PyObject_CallMethod(g_mod, "barrier", "l", (long)comm);
     if (!r)
-        rc = handle_error("MPI_Barrier");
+        rc = handle_error_comm(comm, "MPI_Barrier");
     else
         Py_DECREF(r);
     GIL_END;
@@ -811,7 +865,7 @@ int PMPI_Bcast(void *buffer, int count, MPI_Datatype datatype, int root,
                                       mem_ro(buffer, nbytes),
                                       (long)datatype, root);
     if (!r)
-        rc = handle_error("MPI_Bcast");
+        rc = handle_error_comm(comm, "MPI_Bcast");
     else {
         rc = copy_bytes(r, buffer, nbytes);
         Py_DECREF(r);
@@ -841,7 +895,7 @@ int PMPI_Allreduce(const void *sendbuf, void *recvbuf, int count,
         mem_ro(pick_in(sendbuf, recvbuf), nbytes), (long)datatype,
         (long)op);
     if (!r)
-        rc = handle_error("MPI_Allreduce");
+        rc = handle_error_comm(comm, "MPI_Allreduce");
     else {
         rc = copy_bytes(r, recvbuf, nbytes);
         Py_DECREF(r);
@@ -864,7 +918,7 @@ int PMPI_Reduce(const void *sendbuf, void *recvbuf, int count,
         mem_ro(pick_in(sendbuf, recvbuf), nbytes), (long)datatype,
         (long)op, root);
     if (!r)
-        rc = handle_error("MPI_Reduce");
+        rc = handle_error_comm(comm, "MPI_Reduce");
     else {
         if (PyBytes_Size(r) > 0)        /* root only */
             rc = copy_bytes(r, recvbuf, nbytes);
@@ -911,7 +965,7 @@ int PMPI_Gather(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
         mem_ro(sendbuf, (size_t)sendcount * ssz), (long)sendtype, root,
         (long)(rank == root ? recvtype : 0));
     if (!r)
-        rc = handle_error("MPI_Gather");
+        rc = handle_error_comm(comm, "MPI_Gather");
     else {
         if (PyBytes_Size(r) > 0)        /* root only */
             rc = copy_bytes(r, recvbuf,
@@ -958,7 +1012,7 @@ int PMPI_Scatter(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
         (long)(rank == root ? sendtype : 0), sendcount, root,
         (long)(in_place ? 0 : recvtype));
     if (!r)
-        rc = handle_error("MPI_Scatter");
+        rc = handle_error_comm(comm, "MPI_Scatter");
     else {
         if (!in_place)
             rc = copy_bytes(r, recvbuf, (size_t)recvcount * rsz);
@@ -998,7 +1052,7 @@ int PMPI_Allgather(const void *sendbuf, int sendcount,
         mem_ro(sendbuf, (size_t)sendcount * ssz), (long)sendtype,
         (long)recvtype);
     if (!r)
-        rc = handle_error("MPI_Allgather");
+        rc = handle_error_comm(comm, "MPI_Allgather");
     else {
         rc = copy_bytes(r, recvbuf,
                         (size_t)size * (size_t)recvcount * rsz);
@@ -1035,7 +1089,7 @@ int PMPI_Alltoall(const void *sendbuf, int sendcount,
         mem_ro(sendbuf, (size_t)size * (size_t)sendcount * ssz),
         (long)sendtype, sendcount, (long)recvtype);
     if (!r)
-        rc = handle_error("MPI_Alltoall");
+        rc = handle_error_comm(comm, "MPI_Alltoall");
     else {
         rc = copy_bytes(r, recvbuf,
                         (size_t)size * (size_t)recvcount * rsz);
@@ -1060,7 +1114,7 @@ static int scan_common(const void *sendbuf, void *recvbuf, int count,
         mem_ro(pick_in(sendbuf, recvbuf), nbytes), (long)datatype,
         (long)op);
     if (!r)
-        rc = handle_error(fn);
+        rc = handle_error_comm(comm, fn);
     else {
         rc = copy_bytes(r, recvbuf, nbytes);
         Py_DECREF(r);
@@ -1102,7 +1156,7 @@ int PMPI_Reduce_scatter_block(const void *sendbuf, void *recvbuf,
                (size_t)size * (size_t)recvcount * esz),
         (long)datatype, (long)op, recvcount);
     if (!r)
-        rc = handle_error("MPI_Reduce_scatter_block");
+        rc = handle_error_comm(comm, "MPI_Reduce_scatter_block");
     else {
         rc = copy_bytes(r, recvbuf, (size_t)recvcount * esz);
         Py_DECREF(r);
@@ -1250,7 +1304,7 @@ int PMPI_Allgatherv(const void *sendbuf, int sendcount,
         mem_ro(displs, (size_t)size * sizeof(int)),
         mem_ro(recvbuf, cap));
     if (!r)
-        rc = handle_error("MPI_Allgatherv");
+        rc = handle_error_comm(comm, "MPI_Allgatherv");
     else {
         rc = copy_bytes(r, recvbuf, cap);
         Py_DECREF(r);
@@ -1291,7 +1345,7 @@ int PMPI_Gatherv(const void *sendbuf, int sendcount,
         mem_ro(displs, rank == root ? (size_t)size * sizeof(int) : 0),
         mem_ro(recvbuf, cap));
     if (!r)
-        rc = handle_error("MPI_Gatherv");
+        rc = handle_error_comm(comm, "MPI_Gatherv");
     else {
         if (PyBytes_Size(r) > 0)         /* root only */
             rc = copy_bytes(r, recvbuf, cap);
@@ -1333,7 +1387,7 @@ int PMPI_Scatterv(const void *sendbuf, const int sendcounts[],
         mem_ro(displs, rank == root ? (size_t)size * sizeof(int) : 0),
         root, (long)recvtype);
     if (!r)
-        rc = handle_error("MPI_Scatterv");
+        rc = handle_error_comm(comm, "MPI_Scatterv");
     else {
         rc = copy_bytes(r, recvbuf, (size_t)recvcount * rsz);
         Py_DECREF(r);
@@ -1368,7 +1422,7 @@ int PMPI_Alltoallv(const void *sendbuf, const int sendcounts[],
         mem_ro(rdispls, (size_t)size * sizeof(int)),
         mem_ro(recvbuf, cap));
     if (!r)
-        rc = handle_error("MPI_Alltoallv");
+        rc = handle_error_comm(comm, "MPI_Alltoallv");
     else {
         rc = copy_bytes(r, recvbuf, cap);
         Py_DECREF(r);
@@ -1408,9 +1462,12 @@ int PMPI_Cart_create(MPI_Comm comm, int ndims, const int dims[],
         mem_ro(dims, (size_t)ndims * sizeof(int)),
         mem_ro(periods, (size_t)ndims * sizeof(int)), reorder);
     if (!r)
-        rc = handle_error("MPI_Cart_create");
+        rc = handle_error_comm(comm, "MPI_Cart_create");
     else {
         *comm_cart = (MPI_Comm)PyLong_AsLong(r);
+        /* derived comms inherit the parent errhandler */
+        if (*comm_cart != MPI_COMM_NULL)
+            errh_set(*comm_cart, errh_for(comm));
         Py_DECREF(r);
     }
     GIL_END;
@@ -1424,7 +1481,7 @@ int PMPI_Cart_coords(MPI_Comm comm, int rank, int maxdims, int coords[])
     PyObject *r = PyObject_CallMethod(g_mod, "cart_coords", "li",
                                       (long)comm, rank);
     if (!r)
-        rc = handle_error("MPI_Cart_coords");
+        rc = handle_error_comm(comm, "MPI_Cart_coords");
     else {
         rc = copy_bytes(r, coords, (size_t)maxdims * sizeof(int));
         Py_DECREF(r);
@@ -1445,7 +1502,7 @@ int PMPI_Cart_rank(MPI_Comm comm, const int coords[], int *rank)
         g_mod, "cart_rank", "lN", (long)comm,
         mem_ro(coords, (size_t)nd * sizeof(int)));
     if (!r)
-        rc = handle_error("MPI_Cart_rank");
+        rc = handle_error_comm(comm, "MPI_Cart_rank");
     else {
         *rank = (int)PyLong_AsLong(r);
         Py_DECREF(r);
@@ -1462,7 +1519,7 @@ int PMPI_Cart_shift(MPI_Comm comm, int direction, int disp,
     PyObject *r = PyObject_CallMethod(g_mod, "cart_shift", "lii",
                                       (long)comm, direction, disp);
     if (!r)
-        rc = handle_error("MPI_Cart_shift");
+        rc = handle_error_comm(comm, "MPI_Cart_shift");
     else {
         *rank_source = (int)PyLong_AsLong(PyTuple_GetItem(r, 0));
         *rank_dest = (int)PyLong_AsLong(PyTuple_GetItem(r, 1));
@@ -1480,7 +1537,7 @@ int PMPI_Cart_get(MPI_Comm comm, int maxdims, int dims[], int periods[],
     PyObject *r = PyObject_CallMethod(g_mod, "cart_get", "l",
                                       (long)comm);
     if (!r)
-        rc = handle_error("MPI_Cart_get");
+        rc = handle_error_comm(comm, "MPI_Cart_get");
     else {
         size_t cap = (size_t)maxdims * sizeof(int);
         rc = copy_bytes(PyTuple_GetItem(r, 0), dims, cap);
@@ -1501,7 +1558,7 @@ int PMPI_Cartdim_get(MPI_Comm comm, int *ndims)
     PyObject *r = PyObject_CallMethod(g_mod, "cartdim_get", "l",
                                       (long)comm);
     if (!r)
-        rc = handle_error("MPI_Cartdim_get");
+        rc = handle_error_comm(comm, "MPI_Cartdim_get");
     else {
         *ndims = (int)PyLong_AsLong(r);
         Py_DECREF(r);
@@ -1755,6 +1812,9 @@ int PMPI_Comm_create(MPI_Comm comm, MPI_Group group, MPI_Comm *newcomm)
     int rc = group_call2("comm_create", (long)comm, (long)group, &c);
     if (rc == MPI_SUCCESS)
         *newcomm = (MPI_Comm)c;
+        /* derived comms inherit the parent errhandler */
+        if (*newcomm != MPI_COMM_NULL)
+            errh_set(*newcomm, errh_for(comm));
     return rc;
 }
 
@@ -1964,10 +2024,13 @@ int PMPI_Comm_split_type(MPI_Comm comm, int split_type, int key,
     PyObject *r = PyObject_CallMethod(g_mod, "comm_split_type", "lii",
                                       (long)comm, split_type, key);
     if (!r)
-        rc = handle_error("MPI_Comm_split_type");
+        rc = handle_error_comm(comm, "MPI_Comm_split_type");
     else {
         c = PyLong_AsLong(r);
         *newcomm = (MPI_Comm)c;
+        /* derived comms inherit the parent errhandler */
+        if (*newcomm != MPI_COMM_NULL)
+            errh_set(*newcomm, errh_for(comm));
         Py_DECREF(r);
     }
     GIL_END;
@@ -2074,7 +2137,7 @@ int PMPI_Pack(const void *inbuf, int incount, MPI_Datatype datatype,
         g_mod, "pack", "Nli", mem_ro(inbuf, (size_t)incount * esz),
         (long)datatype, incount);
     if (!r)
-        rc = handle_error("MPI_Pack");
+        rc = handle_error_comm(comm, "MPI_Pack");
     else {
         char *p;
         Py_ssize_t n;
@@ -2115,7 +2178,7 @@ int PMPI_Unpack(const void *inbuf, int insize, int *position,
         outcount,
         mem_ro(outbuf, datatype >= DT_FIRST_DYN ? extent_bytes : 0));
     if (!r)
-        rc = handle_error("MPI_Unpack");
+        rc = handle_error_comm(comm, "MPI_Unpack");
     else {
         rc = copy_bytes(r, outbuf, extent_bytes);
         if (rc == MPI_SUCCESS)
@@ -2135,7 +2198,7 @@ int PMPI_Pack_size(int incount, MPI_Datatype datatype, MPI_Comm comm,
     PyObject *r = PyObject_CallMethod(g_mod, "pack_size", "li",
                                       (long)datatype, incount);
     if (!r)
-        rc = handle_error("MPI_Pack_size");
+        rc = handle_error_comm(comm, "MPI_Pack_size");
     else {
         *size = (int)PyLong_AsLong(r);
         Py_DECREF(r);
@@ -2184,7 +2247,7 @@ int PMPI_Win_allocate(MPI_Aint size, int disp_unit, MPI_Info info,
                                       (long)size, disp_unit,
                                       (long)comm);
     if (!r) {
-        rc = handle_error("MPI_Win_allocate");
+        rc = handle_error_comm(comm, "MPI_Win_allocate");
     } else {
         *win = (MPI_Win)PyLong_AsLong(PyTuple_GetItem(r, 0));
         /* the window's byte storage lives in the embedded
@@ -2332,7 +2395,7 @@ int PMPI_File_open(MPI_Comm comm, const char *filename, int amode,
     PyObject *r = PyObject_CallMethod(g_mod, "file_open", "lsi",
                                       (long)comm, filename, amode);
     if (!r)
-        rc = handle_error("MPI_File_open");
+        rc = handle_error_comm(comm, "MPI_File_open");
     else {
         *fh = (MPI_File)PyLong_AsLong(r);
         Py_DECREF(r);
@@ -2585,7 +2648,7 @@ int PMPI_Neighbor_allgather(const void *sendbuf, int sendcount,
         mem_ro(sendbuf, (size_t)sendcount * ssz), (long)sendtype,
         (long)recvtype, mem_ro(recvbuf, cap));
     if (!r)
-        rc = handle_error("MPI_Neighbor_allgather");
+        rc = handle_error_comm(comm, "MPI_Neighbor_allgather");
     else {
         rc = copy_bytes(r, recvbuf, cap);
         Py_DECREF(r);
@@ -2615,7 +2678,7 @@ int PMPI_Neighbor_alltoall(const void *sendbuf, int sendcount,
         (long)sendtype, sendcount, (long)recvtype,
         mem_ro(recvbuf, cap));
     if (!r)
-        rc = handle_error("MPI_Neighbor_alltoall");
+        rc = handle_error_comm(comm, "MPI_Neighbor_alltoall");
     else {
         rc = copy_bytes(r, recvbuf, cap);
         Py_DECREF(r);
@@ -2638,17 +2701,17 @@ int PMPI_Comm_create_keyval(MPI_Copy_function *copy_fn,
                            MPI_Delete_function *delete_fn,
                            int *comm_keyval, void *extra_state)
 {
-    (void)copy_fn;
-    (void)delete_fn;
-    (void)extra_state;                   /* callbacks not invoked:
-                                          * attributes do not
-                                          * propagate through dup in
-                                          * this binding subset */
     long v;
     GIL_BEGIN;
     int rc = MPI_SUCCESS;
-    PyObject *r = PyObject_CallMethod(g_mod, "comm_create_keyval",
-                                      NULL);
+    /* real callback registration: the glue wraps the C pointers via
+     * ctypes and fires them on dup/delete/free (attribute.c:349-384);
+     * sentinels 0/1 are NULL_COPY_FN / DUP_FN */
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "comm_create_keyval_c", "LLL",
+        (long long)(intptr_t)copy_fn,
+        (long long)(intptr_t)delete_fn,
+        (long long)(intptr_t)extra_state);
     if (!r)
         rc = handle_error("MPI_Comm_create_keyval");
     else {
@@ -2684,7 +2747,7 @@ int PMPI_Comm_set_attr(MPI_Comm comm, int comm_keyval,
         g_mod, "comm_set_attr", "liL", (long)comm, comm_keyval,
         (long long)(intptr_t)attribute_val);
     if (!r)
-        rc = handle_error("MPI_Comm_set_attr");
+        rc = handle_error_comm(comm, "MPI_Comm_set_attr");
     else
         Py_DECREF(r);
     GIL_END;
@@ -2699,7 +2762,7 @@ int PMPI_Comm_get_attr(MPI_Comm comm, int comm_keyval,
     PyObject *r = PyObject_CallMethod(g_mod, "comm_get_attr", "li",
                                       (long)comm, comm_keyval);
     if (!r)
-        rc = handle_error("MPI_Comm_get_attr");
+        rc = handle_error_comm(comm, "MPI_Comm_get_attr");
     else {
         *flag = (int)PyLong_AsLong(PyTuple_GetItem(r, 0));
         if (*flag)
@@ -2718,7 +2781,7 @@ int PMPI_Comm_delete_attr(MPI_Comm comm, int comm_keyval)
     PyObject *r = PyObject_CallMethod(g_mod, "comm_delete_attr", "li",
                                       (long)comm, comm_keyval);
     if (!r)
-        rc = handle_error("MPI_Comm_delete_attr");
+        rc = handle_error_comm(comm, "MPI_Comm_delete_attr");
     else
         Py_DECREF(r);
     GIL_END;
@@ -2761,7 +2824,7 @@ int PMPI_Reduce_scatter(const void *sendbuf, void *recvbuf,
         (long)datatype, (long)op,
         mem_ro(recvcounts, (size_t)size * sizeof(int)));
     if (!r)
-        rc = handle_error("MPI_Reduce_scatter");
+        rc = handle_error_comm(comm, "MPI_Reduce_scatter");
     else {
         rc = copy_bytes(r, recvbuf, (size_t)recvcounts[rank] * esz);
         Py_DECREF(r);
@@ -3233,7 +3296,7 @@ int PMPI_Win_create(void *base, MPI_Aint size, int disp_unit,
                                       mem_rw(base, (size_t)size),
                                       disp_unit);
     if (!r)
-        rc = handle_error("MPI_Win_create");
+        rc = handle_error_comm(comm, "MPI_Win_create");
     else {
         *win = (MPI_Win)PyLong_AsLong(r);
         Py_DECREF(r);
@@ -3473,6 +3536,215 @@ int PMPI_Raccumulate(const void *origin_addr, int origin_count,
     int rc = icoll_request(r, NULL, 0, request, "MPI_Raccumulate");
     GIL_END;
     return rc;
+}
+
+
+/* ------------------------------------------------------------------ */
+/* wave 2: errhandler accessors + MPI_Info objects                     */
+/* ------------------------------------------------------------------ */
+int PMPI_Comm_get_errhandler(MPI_Comm comm, MPI_Errhandler *errhandler)
+{
+    *errhandler = errh_for(comm);
+    return MPI_SUCCESS;
+}
+
+int PMPI_Errhandler_free(MPI_Errhandler *errhandler)
+{
+    if (!errhandler)
+        return MPI_ERR_ARG;
+    *errhandler = 0;                     /* predefined handles only */
+    return MPI_SUCCESS;
+}
+
+int PMPI_Comm_call_errhandler(MPI_Comm comm, int errorcode)
+{
+    if (errh_for(comm) == MPI_ERRORS_RETURN)
+        return MPI_SUCCESS;      /* the handler "ran" and returned:
+                                  * the call itself succeeded */
+    fprintf(stderr, "*** MPI_Comm_call_errhandler: error %d on comm "
+                    "%ld — aborting (MPI_ERRORS_ARE_FATAL)\n",
+            errorcode, (long)comm);
+    exit(errorcode > 0 && errorcode < 126 ? errorcode : 1);
+}
+
+int PMPI_Info_create(MPI_Info *info)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "info_create", NULL);
+    if (!r)
+        rc = handle_error("MPI_Info_create");
+    else {
+        *info = (MPI_Info)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Info_set(MPI_Info info, const char *key, const char *value)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "info_set", "lss",
+                                      (long)info, key, value);
+    if (!r)
+        rc = handle_error("MPI_Info_set");
+    else
+        Py_DECREF(r);
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Info_get(MPI_Info info, const char *key, int valuelen,
+                  char *value, int *flag)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "info_get", "ls",
+                                      (long)info, key);
+    if (!r)
+        rc = handle_error("MPI_Info_get");
+    else {
+        *flag = (int)PyLong_AsLong(PyTuple_GetItem(r, 0));
+        if (*flag && value && valuelen >= 0) {
+            /* MPI contract: the caller provides valuelen+1 bytes —
+             * copy up to valuelen chars and terminate after them */
+            const char *s = PyUnicode_AsUTF8(
+                PyTuple_GetItem(r, 1));
+            size_t n = s ? strlen(s) : 0;
+            if (n > (size_t)valuelen)
+                n = (size_t)valuelen;
+            memcpy(value, s ? s : "", n);
+            value[n] = '\0';
+        }
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Info_get_valuelen(MPI_Info info, const char *key, int *valuelen,
+                           int *flag)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "info_get", "ls",
+                                      (long)info, key);
+    if (!r)
+        rc = handle_error("MPI_Info_get_valuelen");
+    else {
+        *flag = (int)PyLong_AsLong(PyTuple_GetItem(r, 0));
+        if (*flag) {
+            Py_ssize_t n = 0;
+            PyUnicode_AsUTF8AndSize(PyTuple_GetItem(r, 1), &n);
+            *valuelen = (int)n;
+        }
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Info_delete(MPI_Info info, const char *key)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "info_delete", "ls",
+                                      (long)info, key);
+    if (!r)
+        rc = handle_error("MPI_Info_delete");
+    else
+        Py_DECREF(r);
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Info_get_nkeys(MPI_Info info, int *nkeys)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "info_get_nkeys", "l",
+                                      (long)info);
+    if (!r)
+        rc = handle_error("MPI_Info_get_nkeys");
+    else {
+        *nkeys = (int)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Info_get_nthkey(MPI_Info info, int n, char *key)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "info_get_nthkey", "li",
+                                      (long)info, n);
+    if (!r)
+        rc = handle_error("MPI_Info_get_nthkey");
+    else {
+        const char *s = PyUnicode_AsUTF8(r);
+        if (key && s) {
+            size_t n = strlen(s);
+            if (n > MPI_MAX_INFO_KEY)
+                n = MPI_MAX_INFO_KEY;   /* caller: KEY+1 bytes */
+            memcpy(key, s, n);
+            key[n] = '\0';
+        }
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Info_dup(MPI_Info info, MPI_Info *newinfo)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "info_dup", "l",
+                                      (long)info);
+    if (!r)
+        rc = handle_error("MPI_Info_dup");
+    else {
+        *newinfo = (MPI_Info)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Info_free(MPI_Info *info)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "info_free", "l",
+                                      (long)*info);
+    if (!r)
+        rc = handle_error("MPI_Info_free");
+    else {
+        *info = MPI_INFO_NULL;
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Get_address(const void *location, MPI_Aint *address)
+{
+    *address = (MPI_Aint)(intptr_t)location;
+    return MPI_SUCCESS;
+}
+
+MPI_Aint PMPI_Aint_add(MPI_Aint base, MPI_Aint disp)
+{
+    return base + disp;
+}
+
+MPI_Aint PMPI_Aint_diff(MPI_Aint addr1, MPI_Aint addr2)
+{
+    return addr1 - addr2;
 }
 
 /* ------------------------------------------------------------------ */

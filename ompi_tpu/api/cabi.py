@@ -631,16 +631,95 @@ def dims_create(nnodes: int, ndims: int, dims_view) -> bytes:
 # eventually collide with them.
 
 
-def comm_create_keyval() -> int:
-    """Copy/delete callbacks are not invoked by this binding (no
-    copy_fn == the attribute is not propagated by comm_dup, per
-    MPI)."""
+def _handle_of(c) -> int:
+    """Reverse map: communicator object -> its C handle (for the comm
+    argument of user attribute callbacks)."""
+    from ompi_tpu.runtime import init as rt
+    if c is rt.comm_world():
+        return COMM_WORLD
+    try:
+        if c is rt.comm_self():
+            return COMM_SELF
+    except Exception:                    # noqa: BLE001 — no self yet
+        pass
+    with _lock:
+        for h, obj in _comms.items():
+            if obj is c:
+                return h
+    return COMM_NULL
+
+
+# CFUNCTYPE objects per keyval: must outlive the keyval (a collected
+# trampoline is a dangling C function pointer)
+_keyval_refs: Dict[int, Any] = {}
+
+
+def comm_create_keyval_c(copy_ptr: int, delete_ptr: int,
+                         extra: int) -> int:
+    """MPI_Comm_create_keyval with REAL callback invocation
+    (attribute.c:349-384): copy_fn runs at every MPI_Comm_dup and may
+    veto/transform the value; delete_fn runs at delete/overwrite/free.
+    copy_ptr 0 = MPI_COMM_NULL_COPY_FN (never propagated), 1 =
+    MPI_COMM_DUP_FN (propagate verbatim); likewise delete_ptr 0 =
+    MPI_COMM_NULL_DELETE_FN."""
+    import ctypes
     from ompi_tpu.core.communicator import create_keyval
-    return create_keyval(None, None)
+    CopyFn = ctypes.CFUNCTYPE(
+        ctypes.c_int, ctypes.c_long, ctypes.c_int, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_int))
+    DelFn = ctypes.CFUNCTYPE(
+        ctypes.c_int, ctypes.c_long, ctypes.c_int, ctypes.c_void_p,
+        ctypes.c_void_p)
+    keep = []
+    copy_py = None
+    if copy_ptr == 1:                    # MPI_COMM_DUP_FN
+
+        def copy_py(comm, kv, val):
+            return True, val
+    elif copy_ptr:
+        cfn = CopyFn(copy_ptr)
+        keep.append(cfn)
+
+        def copy_py(comm, kv, val):
+            out = ctypes.c_void_p(0)
+            flag = ctypes.c_int(0)
+            rc = cfn(_handle_of(comm), int(kv), extra, int(val),
+                     ctypes.byref(out), ctypes.byref(flag))
+            if rc != 0:
+                raise MPIError(rc, "user attribute copy_fn failed")
+            return bool(flag.value), int(out.value or 0)
+    delete_py = None
+    if delete_ptr:
+        dfn = DelFn(delete_ptr)
+        keep.append(dfn)
+
+        def delete_py(comm, kv, val):
+            rc = dfn(_handle_of(comm), int(kv), int(val), extra)
+            if rc != 0:
+                raise MPIError(rc, "user attribute delete_fn failed")
+    kv = create_keyval(copy_py, delete_py)
+    if keep:
+        _keyval_refs[kv] = keep
+    return kv
+
+
+def comm_create_keyval() -> int:
+    """Callback-free keyval (kept for older callers)."""
+    return comm_create_keyval_c(0, 0, 0)
 
 
 def comm_set_attr(h: int, keyval: int, value: int) -> None:
-    _comm(h).attributes[int(keyval)] = int(value)
+    c = _comm(h)
+    kv = int(keyval)
+    if kv in c.attributes:
+        # MPI_Comm_set_attr over an existing attribute fires the
+        # delete callback on the OLD value first (MPI-3.1 6.7.2)
+        from ompi_tpu.core.communicator import _keyvals
+        cb = _keyvals.get(kv)
+        if cb and cb[1]:
+            cb[1](c, kv, c.attributes[kv])
+    c.attributes[kv] = int(value)
 
 
 def comm_get_attr(h: int, keyval: int) -> Tuple[int, int]:
@@ -652,13 +731,16 @@ def comm_get_attr(h: int, keyval: int) -> Tuple[int, int]:
 
 
 def comm_delete_attr(h: int, keyval: int) -> None:
-    if _comm(h).attributes.pop(int(keyval), None) is None:
+    c = _comm(h)
+    if int(keyval) not in c.attributes:
         raise MPIError(ERR_ARG, f"attribute {keyval} not set")
+    c.delete_attr(int(keyval))           # fires the delete callback
 
 
 def comm_free_keyval(keyval: int) -> None:
     from ompi_tpu.core.communicator import free_keyval
     free_keyval(int(keyval))
+    _keyval_refs.pop(int(keyval), None)
 
 
 def comm_set_errhandler(h: int, which: int) -> None:
@@ -667,21 +749,75 @@ def comm_set_errhandler(h: int, which: int) -> None:
     would print its abort banner and raise SystemExit before the C
     shim's ERRORS_RETURN path ever saw the real error class.
 
-    The C shim's g_errh is PROCESS-scoped (a documented simplification
-    of MPI's per-comm handlers), so this applies process-wide too —
-    world, self, and every live dynamic comm — keeping the two layers
-    in agreement: a mixed state (RETURN in C, FATAL on some comm in
-    Python) would turn that comm's errors into SystemExit mapped to
-    ERR_OTHER instead of their real class."""
+    PER-COMM (MPI semantics, errhandler.h): only the named
+    communicator changes; the C shim keeps a matching per-comm table
+    and consults it with the comm of the failing call."""
     from ompi_tpu.core import errhandler as eh
     handler = eh.ERRORS_RETURN if which == 2 else eh.ERRORS_ARE_FATAL
-    _comm(h)                             # validate the handle
-    from ompi_tpu.runtime import init as rt
-    targets = [rt.comm_world(), rt.comm_self()]
+    _comm(h).errhandler = handler
+
+
+def comm_get_errhandler(h: int) -> int:
+    from ompi_tpu.core import errhandler as eh
+    return 2 if _comm(h).errhandler is eh.ERRORS_RETURN else 1
+
+
+# ---------------------------------------------------------------------
+# MPI_Info objects (info_create.c.in family) over core/info.Info
+# ---------------------------------------------------------------------
+_infos: Dict[int, Any] = {}
+_next_info = itertools.count(1)
+
+
+def _info(ih: int):
     with _lock:
-        targets.extend(_comms.values())
-    for c in targets:
-        c.errhandler = handler
+        i = _infos.get(ih)
+    if i is None:
+        raise MPIError(ERR_ARG, f"invalid info handle {ih}")
+    return i
+
+
+def info_create() -> int:
+    from ompi_tpu.core.info import Info
+    with _lock:
+        ih = next(_next_info)
+        _infos[ih] = Info()
+    return ih
+
+
+def info_set(ih: int, key: str, value: str) -> None:
+    _info(ih).set(key, value)
+
+
+def info_get(ih: int, key: str) -> Tuple[int, str]:
+    v = _info(ih).get(key)
+    return (0, "") if v is None else (1, v)
+
+
+def info_delete(ih: int, key: str) -> None:
+    _info(ih).delete(key)
+
+
+def info_get_nkeys(ih: int) -> int:
+    return _info(ih).get_nkeys()
+
+
+def info_get_nthkey(ih: int, n: int) -> str:
+    return _info(ih).get_nthkey(n)
+
+
+def info_dup(ih: int) -> int:
+    dup = _info(ih).dup()
+    with _lock:
+        nh = next(_next_info)
+        _infos[nh] = dup
+    return nh
+
+
+def info_free(ih: int) -> None:
+    with _lock:
+        if _infos.pop(ih, None) is None:
+            raise MPIError(ERR_ARG, f"invalid info handle {ih}")
 
 
 def comm_split_type(h: int, split_type: int, key: int) -> int:
@@ -710,14 +846,17 @@ def comm_free(h: int) -> None:
     if h in (COMM_WORLD, COMM_SELF):
         raise MPIError(ERR_COMM, "cannot free a predefined communicator")
     with _lock:
-        c = _comms.pop(h, None)
+        c = _comms.get(h)
     if c is None:
         raise MPIError(ERR_COMM, f"invalid communicator handle {h}")
+    # free FIRST, pop after: user delete callbacks fire inside free()
+    # and must still resolve this comm's handle (_handle_of); their
+    # errors propagate — MPI_Comm_free reports callback failure
+    # (MPI-3.1 6.7.2), it does not swallow it
     if hasattr(c, "free"):
-        try:
-            c.free()
-        except Exception:                # noqa: BLE001 — already freed
-            pass
+        c.free()
+    with _lock:
+        _comms.pop(h, None)
 
 
 # ---------------------------------------------------------------------

@@ -919,17 +919,11 @@ class Communicator:
                            name=f"{self.name}.dup", parent=self,
                          info=info or self.info,
                          errhandler=self.errhandler)
-        # MPI attribute-copy semantics: an attribute propagates to the dup
-        # only if its keyval registered a copy callback, which may veto or
-        # transform the value (MPI_Comm_dup + COMM_DUP_FN behavior).
-        for kv, val in self.attributes.items():
-            cb = _keyvals.get(kv)
-            copy_fn = cb[0] if cb else None
-            if copy_fn is None:
-                continue
-            keep, newval = copy_fn(self, kv, val)
-            if keep:
-                c.attributes[kv] = newval
+        try:
+            propagate_attrs(self, c)
+        except BaseException:
+            c.free()                     # no half-built comm leaks
+            raise
         return c
 
     def split(self, colors: Sequence[int], keys: Optional[Sequence[int]] = None
@@ -1009,11 +1003,7 @@ class Communicator:
         return SIMILAR if g == SIMILAR else UNEQUAL
 
     def free(self) -> None:
-        for kv, val in list(self.attributes.items()):
-            cb = _keyvals.get(kv)
-            if cb and cb[1]:
-                cb[1](self, kv, val)
-        self.attributes.clear()
+        fire_delete_attrs(self)
         self._freed = True
 
     # -- process topologies (topo framework) ---------------------------
@@ -1491,3 +1481,28 @@ def create_keyval(copy_fn: Optional[Callable] = None,
 
 def free_keyval(keyval: int) -> None:
     _keyvals.pop(keyval, None)
+
+
+def propagate_attrs(src, dst) -> None:
+    """MPI attribute-copy semantics at Comm_dup (attribute.c:349-384):
+    an attribute propagates only through its keyval's copy callback,
+    which may veto or transform the value. Shared by both communicator
+    classes — one copy of the semantics."""
+    for kv, val in src.attributes.items():
+        cb = _keyvals.get(kv)
+        copy_fn = cb[0] if cb else None
+        if copy_fn is None:
+            continue
+        keep, newval = copy_fn(src, kv, val)
+        if keep:
+            dst.attributes[kv] = newval
+
+
+def fire_delete_attrs(comm) -> None:
+    """Delete callbacks at communicator free (attribute.c free path).
+    A raising callback propagates (MPI_Comm_free must report it)."""
+    for kv, val in list(comm.attributes.items()):
+        cb = _keyvals.get(kv)
+        if cb and cb[1]:
+            cb[1](comm, kv, val)
+    comm.attributes.clear()

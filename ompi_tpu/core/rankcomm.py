@@ -783,11 +783,18 @@ class RankCommunicator:
         self._check()
         seq = next(self._create_seq)
         self.barrier()                      # dup is collective
-        return RankCommunicator(
+        c = RankCommunicator(
             Group(self.group.world_ranks), self._my_world, self.router,
             cid=("d", self.cid, seq), name=f"{self.name}.dup",
             parent=self, errhandler=self.errhandler,
             info=info or self.info)
+        from ompi_tpu.core.communicator import propagate_attrs
+        try:
+            propagate_attrs(self, c)
+        except BaseException:
+            c.free()                     # no half-built comm leaks
+            raise
+        return c
 
     # -- process topologies (textbook cart surface) --------------------
     def create_cart(self, dims: Sequence[int],
@@ -978,6 +985,9 @@ class RankCommunicator:
             errhandler=self.errhandler)
 
     def free(self) -> None:
+        # delete callbacks fire at free (attribute.c free path)
+        from ompi_tpu.core.communicator import fire_delete_attrs
+        fire_delete_attrs(self)
         self._pml.close()
         self._coll_pml.close()
         for eng in self._aux_pmls.values():   # hidden channels too —
@@ -993,6 +1003,13 @@ class RankCommunicator:
         if keyval in self.attributes:
             return True, self.attributes[keyval]
         return False, None
+
+    def delete_attr(self, keyval: int) -> None:
+        from ompi_tpu.core import communicator as core_comm
+        val = self.attributes.pop(keyval, None)
+        cb = core_comm._keyvals.get(keyval)
+        if cb and cb[1] and val is not None:
+            cb[1](self, keyval, val)
 
     def set_errhandler(self, errh: Errhandler) -> None:
         self.errhandler = errh

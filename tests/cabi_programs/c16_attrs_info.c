@@ -1,0 +1,182 @@
+/* Attribute-callback machinery + per-comm errhandlers + MPI_Info:
+ * the PETSc/mpi4py idiom — a library caches state under a keyval,
+ * recovers it across MPI_Comm_dup via its copy callback, and the
+ * delete callback fires on delete/overwrite/free
+ * (attribute.c:349-384, comm.c:318 dup path). */
+#include <mpi.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+/* rank is global so the CHECK macro works inside callbacks */
+static int rank = -1;
+
+#define CHECK(cond, code)                                            \
+    do {                                                             \
+        if (!(cond)) {                                               \
+            fprintf(stderr, "rank %d: check failed at line %d\n",    \
+                    rank, __LINE__);                                 \
+            MPI_Abort(MPI_COMM_WORLD, code);                         \
+        }                                                            \
+    } while (0)
+
+static int n_copies, n_deletes;
+
+/* library state cached on a communicator */
+struct state { int magic; int rank; };
+
+static int copy_cb(MPI_Comm oldcomm, int keyval, void *extra,
+                   void *attr_in, void *attr_out, int *flag)
+{
+    (void)oldcomm;
+    (void)keyval;
+    n_copies++;
+    CHECK((long)(intptr_t)extra == 0x5eed, 90);
+    /* deep-copy the cached state (the PETSc pattern) */
+    struct state *old = (struct state *)attr_in;
+    struct state *neu = malloc(sizeof(*neu));
+    *neu = *old;
+    neu->magic += 1;                     /* transform on copy */
+    *(void **)attr_out = neu;
+    *flag = 1;
+    return MPI_SUCCESS;
+}
+
+static int delete_cb(MPI_Comm comm, int keyval, void *attr_val,
+                     void *extra)
+{
+    (void)comm;
+    (void)keyval;
+    (void)extra;
+    n_deletes++;
+    free(attr_val);
+    return MPI_SUCCESS;
+}
+
+int main(int argc, char **argv)
+{
+    MPI_Init(&argc, &argv);
+    int size;
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &size);
+
+    /* ---- attribute caching across dup ---- */
+    int kv = MPI_KEYVAL_INVALID;
+    MPI_Comm_create_keyval(copy_cb, delete_cb, &kv,
+                           (void *)(intptr_t)0x5eed);
+    CHECK(kv != MPI_KEYVAL_INVALID, 2);
+    struct state *st = malloc(sizeof(*st));
+    st->magic = 42;
+    st->rank = rank;
+    MPI_Comm_set_attr(MPI_COMM_WORLD, kv, st);
+
+    MPI_Comm dup1;
+    MPI_Comm_dup(MPI_COMM_WORLD, &dup1);
+    void *got = NULL;
+    int flag = 0;
+    MPI_Comm_get_attr(dup1, kv, &got, &flag);
+    CHECK(flag == 1, 3);
+    struct state *recovered = (struct state *)got;
+    CHECK(recovered->magic == 43 && recovered->rank == rank, 4);
+    CHECK(n_copies == 1, 5);
+
+    /* delete fires on comm free (deep copy freed exactly once) */
+    MPI_Comm_free(&dup1);
+    CHECK(n_deletes == 1, 6);
+
+    /* overwrite fires delete on the OLD value */
+    struct state *st2 = malloc(sizeof(*st2));
+    st2->magic = 7;
+    st2->rank = rank;
+    MPI_Comm_set_attr(MPI_COMM_WORLD, kv, st2);
+    CHECK(n_deletes == 2, 7);
+    /* explicit delete */
+    MPI_Comm_delete_attr(MPI_COMM_WORLD, kv);
+    CHECK(n_deletes == 3, 8);
+    MPI_Comm_free_keyval(&kv);
+
+    /* DUP_FN propagates verbatim; NULL_COPY_FN does not propagate */
+    int kv2, kv3;
+    MPI_Comm_create_keyval(MPI_COMM_DUP_FN, MPI_COMM_NULL_DELETE_FN,
+                           &kv2, NULL);
+    MPI_Comm_create_keyval(MPI_COMM_NULL_COPY_FN,
+                           MPI_COMM_NULL_DELETE_FN, &kv3, NULL);
+    MPI_Comm_set_attr(MPI_COMM_WORLD, kv2, (void *)(intptr_t)777);
+    MPI_Comm_set_attr(MPI_COMM_WORLD, kv3, (void *)(intptr_t)888);
+    MPI_Comm dup2;
+    MPI_Comm_dup(MPI_COMM_WORLD, &dup2);
+    MPI_Comm_get_attr(dup2, kv2, &got, &flag);
+    CHECK(flag == 1 && (long)(intptr_t)got == 777, 9);
+    MPI_Comm_get_attr(dup2, kv3, &got, &flag);
+    CHECK(flag == 0, 10);
+
+    /* ---- per-comm errhandlers ---- */
+    MPI_Comm_set_errhandler(dup2, MPI_ERRORS_RETURN);
+    MPI_Errhandler eh = 0;
+    MPI_Comm_get_errhandler(dup2, &eh);
+    CHECK(eh == MPI_ERRORS_RETURN, 11);
+    MPI_Comm_get_errhandler(MPI_COMM_WORLD, &eh);
+    CHECK(eh == MPI_ERRORS_ARE_FATAL, 12);
+    /* an error on dup2 returns; WORLD would abort */
+    int rc = MPI_Bcast(NULL, 1, MPI_INT, size + 10, dup2);
+    CHECK(rc != MPI_SUCCESS, 13);
+    /* MPI_Comm_call_errhandler itself succeeds when the handler
+     * returns (the handler is ERRORS_RETURN) */
+    CHECK(MPI_Comm_call_errhandler(dup2, MPI_ERR_OTHER)
+          == MPI_SUCCESS, 14);
+    MPI_Comm_free(&dup2);
+
+    /* derived comms inherit the parent errhandler on BOTH layers */
+    MPI_Comm_set_errhandler(MPI_COMM_WORLD, MPI_ERRORS_RETURN);
+    MPI_Comm halfc;
+    MPI_Comm_split(MPI_COMM_WORLD, rank % 2, 0, &halfc);
+    MPI_Comm_get_errhandler(halfc, &eh);
+    CHECK(eh == MPI_ERRORS_RETURN, 25);
+    rc = MPI_Bcast(NULL, 1, MPI_INT, 99, halfc);
+    CHECK(rc != MPI_SUCCESS, 26);
+    MPI_Comm_free(&halfc);
+    MPI_Comm_set_errhandler(MPI_COMM_WORLD, MPI_ERRORS_ARE_FATAL);
+
+    /* ---- MPI_Info ---- */
+    MPI_Info info;
+    MPI_Info_create(&info);
+    MPI_Info_set(info, "path", "/tmp/data");
+    MPI_Info_set(info, "mode", "striped");
+    int nkeys = -1;
+    MPI_Info_get_nkeys(info, &nkeys);
+    CHECK(nkeys == 2, 15);
+    char val[MPI_MAX_INFO_VAL];
+    MPI_Info_get(info, "path", MPI_MAX_INFO_VAL, val, &flag);
+    CHECK(flag == 1 && strcmp(val, "/tmp/data") == 0, 16);
+    int vlen = -1;
+    MPI_Info_get_valuelen(info, "mode", &vlen, &flag);
+    CHECK(flag == 1 && vlen == 7, 17);
+    MPI_Info newinfo;
+    MPI_Info_dup(info, &newinfo);
+    MPI_Info_delete(info, "mode");
+    MPI_Info_get_nkeys(info, &nkeys);
+    CHECK(nkeys == 1, 18);
+    MPI_Info_get(newinfo, "mode", MPI_MAX_INFO_VAL, val, &flag);
+    CHECK(flag == 1 && strcmp(val, "striped") == 0, 19);
+    char key[MPI_MAX_INFO_KEY];
+    MPI_Info_get_nthkey(newinfo, 0, key);
+    CHECK(key[0] != '\0', 20);
+    MPI_Info_get(newinfo, "missing", MPI_MAX_INFO_VAL, val, &flag);
+    CHECK(flag == 0, 21);
+    MPI_Info_free(&info);
+    MPI_Info_free(&newinfo);
+    CHECK(info == MPI_INFO_NULL, 22);
+
+    /* MPI_Get_address / Aint arithmetic */
+    double x[4];
+    MPI_Aint a0, a2;
+    MPI_Get_address(&x[0], &a0);
+    MPI_Get_address(&x[2], &a2);
+    CHECK(MPI_Aint_diff(a2, a0) == 2 * (MPI_Aint)sizeof(double), 23);
+    CHECK(MPI_Aint_add(a0, 2 * sizeof(double)) == a2, 24);
+
+    printf("OK c16_attrs_info rank=%d/%d\n", rank, size);
+    MPI_Finalize();
+    return 0;
+}
