@@ -113,6 +113,13 @@ typedef int (MPI_Delete_function)(MPI_Comm, int, void *, void *);
 #define MPI_CONGRUENT 1
 #define MPI_SIMILAR   2
 #define MPI_UNEQUAL   3
+/* MPI_Topo_test statuses */
+#define MPI_GRAPH      1
+#define MPI_CART       2
+#define MPI_DIST_GRAPH 3
+#define MPI_UNWEIGHTED    ((int *)2)
+#define MPI_WEIGHTS_EMPTY ((int *)3)
+#define MPI_MAX_OBJECT_NAME 64
 typedef long MPI_Info;
 #define MPI_INFO_NULL ((MPI_Info)0)
 typedef long MPI_Win;
@@ -326,6 +333,41 @@ int MPI_Neighbor_alltoall(const void *sendbuf, int sendcount,
                           int recvcount, MPI_Datatype recvtype,
                           MPI_Comm comm);
 int MPI_Error_class(int errorcode, int *errorclass);
+
+/* ---- graph / distributed-graph topologies ---- */
+int MPI_Graph_create(MPI_Comm comm, int nnodes, const int index[],
+                     const int edges[], int reorder,
+                     MPI_Comm *comm_graph);
+int MPI_Graphdims_get(MPI_Comm comm, int *nnodes, int *nedges);
+int MPI_Graph_get(MPI_Comm comm, int maxindex, int maxedges,
+                  int index[], int edges[]);
+int MPI_Graph_neighbors_count(MPI_Comm comm, int rank,
+                              int *nneighbors);
+int MPI_Graph_neighbors(MPI_Comm comm, int rank, int maxneighbors,
+                        int neighbors[]);
+int MPI_Topo_test(MPI_Comm comm, int *status);
+int MPI_Dist_graph_create_adjacent(
+    MPI_Comm comm, int indegree, const int sources[],
+    const int sourceweights[], int outdegree, const int destinations[],
+    const int destweights[], MPI_Info info, int reorder,
+    MPI_Comm *comm_dist_graph);
+int MPI_Dist_graph_neighbors_count(MPI_Comm comm, int *indegree,
+                                   int *outdegree, int *weighted);
+int MPI_Dist_graph_neighbors(MPI_Comm comm, int maxindegree,
+                             int sources[], int sourceweights[],
+                             int maxoutdegree, int destinations[],
+                             int destweights[]);
+int MPI_Comm_get_name(MPI_Comm comm, char *comm_name, int *resultlen);
+int MPI_Comm_set_name(MPI_Comm comm, const char *comm_name);
+int MPI_Comm_test_inter(MPI_Comm comm, int *flag);
+int MPI_Group_translate_ranks(MPI_Group group1, int n,
+                              const int ranks1[], MPI_Group group2,
+                              int ranks2[]);
+int MPI_Group_compare(MPI_Group group1, MPI_Group group2, int *result);
+int MPI_Group_range_incl(MPI_Group group, int n, int ranges[][3],
+                         MPI_Group *newgroup);
+int MPI_Group_range_excl(MPI_Group group, int n, int ranges[][3],
+                         MPI_Group *newgroup);
 
 /* ---- persistent point-to-point ---- */
 int MPI_Send_init(const void *buf, int count, MPI_Datatype datatype,

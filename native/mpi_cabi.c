@@ -3747,6 +3747,332 @@ MPI_Aint PMPI_Aint_diff(MPI_Aint addr1, MPI_Aint addr2)
     return addr1 - addr2;
 }
 
+
+/* ------------------------------------------------------------------ */
+/* wave 2: graph / dist_graph topologies + comm naming + group extras  */
+/* ------------------------------------------------------------------ */
+int PMPI_Graph_create(MPI_Comm comm, int nnodes, const int index[],
+                      const int edges[], int reorder,
+                      MPI_Comm *comm_graph)
+{
+    if (nnodes < 0)
+        return MPI_ERR_ARG;
+    int nedges = nnodes ? index[nnodes - 1] : 0;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "graph_create", "lNNi", (long)comm,
+        mem_ro(index, (size_t)nnodes * sizeof(int)),
+        mem_ro(edges, (size_t)nedges * sizeof(int)), reorder);
+    if (!r)
+        rc = handle_error_comm(comm, "MPI_Graph_create");
+    else {
+        *comm_graph = (MPI_Comm)PyLong_AsLong(r);
+        if (*comm_graph != MPI_COMM_NULL)
+            errh_set(*comm_graph, errh_for(comm));
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Graphdims_get(MPI_Comm comm, int *nnodes, int *nedges)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "graphdims_get", "l",
+                                      (long)comm);
+    if (!r)
+        rc = handle_error_comm(comm, "MPI_Graphdims_get");
+    else {
+        *nnodes = (int)PyLong_AsLong(PyTuple_GetItem(r, 0));
+        *nedges = (int)PyLong_AsLong(PyTuple_GetItem(r, 1));
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Graph_get(MPI_Comm comm, int maxindex, int maxedges,
+                   int index[], int edges[])
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "graph_get", "l",
+                                      (long)comm);
+    if (!r)
+        rc = handle_error_comm(comm, "MPI_Graph_get");
+    else {
+        rc = copy_bytes(PyTuple_GetItem(r, 0), index,
+                        (size_t)maxindex * sizeof(int));
+        if (rc == MPI_SUCCESS)
+            rc = copy_bytes(PyTuple_GetItem(r, 1), edges,
+                            (size_t)maxedges * sizeof(int));
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Graph_neighbors_count(MPI_Comm comm, int rank,
+                               int *nneighbors)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "graph_neighbors_count",
+                                      "li", (long)comm, rank);
+    if (!r)
+        rc = handle_error_comm(comm, "MPI_Graph_neighbors_count");
+    else {
+        *nneighbors = (int)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Graph_neighbors(MPI_Comm comm, int rank, int maxneighbors,
+                         int neighbors[])
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "graph_neighbors", "li",
+                                      (long)comm, rank);
+    if (!r)
+        rc = handle_error_comm(comm, "MPI_Graph_neighbors");
+    else {
+        rc = copy_bytes(r, neighbors,
+                        (size_t)maxneighbors * sizeof(int));
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Topo_test(MPI_Comm comm, int *status)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "topo_test", "l",
+                                      (long)comm);
+    if (!r)
+        rc = handle_error_comm(comm, "MPI_Topo_test");
+    else {
+        *status = (int)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Dist_graph_create_adjacent(
+    MPI_Comm comm, int indegree, const int sources[],
+    const int sourceweights[], int outdegree, const int destinations[],
+    const int destweights[], MPI_Info info, int reorder,
+    MPI_Comm *comm_dist_graph)
+{
+    (void)sourceweights;
+    (void)destweights;                   /* unweighted subset */
+    (void)info;
+    if (indegree < 0 || outdegree < 0)
+        return MPI_ERR_ARG;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "dist_graph_create_adjacent", "lNNi", (long)comm,
+        mem_ro(sources, (size_t)indegree * sizeof(int)),
+        mem_ro(destinations, (size_t)outdegree * sizeof(int)),
+        reorder);
+    if (!r)
+        rc = handle_error_comm(comm, "MPI_Dist_graph_create_adjacent");
+    else {
+        *comm_dist_graph = (MPI_Comm)PyLong_AsLong(r);
+        if (*comm_dist_graph != MPI_COMM_NULL)
+            errh_set(*comm_dist_graph, errh_for(comm));
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Dist_graph_neighbors_count(MPI_Comm comm, int *indegree,
+                                    int *outdegree, int *weighted)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "dist_graph_neighbors_count", "l", (long)comm);
+    if (!r)
+        rc = handle_error_comm(comm, "MPI_Dist_graph_neighbors_count");
+    else {
+        *indegree = (int)PyLong_AsLong(PyTuple_GetItem(r, 0));
+        *outdegree = (int)PyLong_AsLong(PyTuple_GetItem(r, 1));
+        *weighted = (int)PyLong_AsLong(PyTuple_GetItem(r, 2));
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Dist_graph_neighbors(MPI_Comm comm, int maxindegree,
+                              int sources[], int sourceweights[],
+                              int maxoutdegree, int destinations[],
+                              int destweights[])
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "dist_graph_neighbors",
+                                      "l", (long)comm);
+    if (!r)
+        rc = handle_error_comm(comm, "MPI_Dist_graph_neighbors");
+    else {
+        rc = copy_bytes(PyTuple_GetItem(r, 0), sources,
+                        (size_t)maxindegree * sizeof(int));
+        if (rc == MPI_SUCCESS)
+            rc = copy_bytes(PyTuple_GetItem(r, 1), destinations,
+                            (size_t)maxoutdegree * sizeof(int));
+        Py_DECREF(r);
+    }
+    GIL_END;
+    if (sourceweights && sourceweights != MPI_UNWEIGHTED)
+        for (int i = 0; i < maxindegree; i++)
+            sourceweights[i] = 1;
+    if (destweights && destweights != MPI_UNWEIGHTED)
+        for (int i = 0; i < maxoutdegree; i++)
+            destweights[i] = 1;
+    return rc;
+}
+
+int PMPI_Comm_get_name(MPI_Comm comm, char *comm_name, int *resultlen)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "comm_get_name", "l",
+                                      (long)comm);
+    if (!r)
+        rc = handle_error_comm(comm, "MPI_Comm_get_name");
+    else {
+        const char *s = PyUnicode_AsUTF8(r);
+        size_t n = s ? strlen(s) : 0;
+        if (n >= MPI_MAX_OBJECT_NAME)
+            n = MPI_MAX_OBJECT_NAME - 1;
+        if (comm_name) {
+            memcpy(comm_name, s ? s : "", n);
+            comm_name[n] = '\0';
+        }
+        if (resultlen)
+            *resultlen = (int)n;
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Comm_set_name(MPI_Comm comm, const char *comm_name)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "comm_set_name", "ls",
+                                      (long)comm, comm_name);
+    if (!r)
+        rc = handle_error_comm(comm, "MPI_Comm_set_name");
+    else
+        Py_DECREF(r);
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Comm_test_inter(MPI_Comm comm, int *flag)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "comm_test_inter", "l",
+                                      (long)comm);
+    if (!r)
+        rc = handle_error_comm(comm, "MPI_Comm_test_inter");
+    else {
+        *flag = (int)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Group_translate_ranks(MPI_Group group1, int n,
+                               const int ranks1[], MPI_Group group2,
+                               int ranks2[])
+{
+    if (n < 0)
+        return MPI_ERR_ARG;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "group_translate_ranks", "lNl", (long)group1,
+        mem_ro(ranks1, (size_t)n * sizeof(int)), (long)group2);
+    if (!r)
+        rc = handle_error("MPI_Group_translate_ranks");
+    else {
+        rc = copy_bytes(r, ranks2, (size_t)n * sizeof(int));
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Group_compare(MPI_Group group1, MPI_Group group2, int *result)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "group_compare", "ll",
+                                      (long)group1, (long)group2);
+    if (!r)
+        rc = handle_error("MPI_Group_compare");
+    else {
+        *result = (int)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+static int group_range_common(MPI_Group group, int n,
+                              const int ranges[][3],
+                              MPI_Group *newgroup, const char *pyfn,
+                              const char *fn)
+{
+    if (n < 0)
+        return MPI_ERR_ARG;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, pyfn, "lN", (long)group,
+        mem_ro(ranges, (size_t)n * 3 * sizeof(int)));
+    if (!r)
+        rc = handle_error(fn);
+    else {
+        *newgroup = (MPI_Group)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Group_range_incl(MPI_Group group, int n, int ranges[][3],
+                          MPI_Group *newgroup)
+{
+    return group_range_common(group, n, (const int (*)[3])ranges,
+                              newgroup, "group_range_incl",
+                              "MPI_Group_range_incl");
+}
+
+int PMPI_Group_range_excl(MPI_Group group, int n, int ranges[][3],
+                          MPI_Group *newgroup)
+{
+    return group_range_common(group, n, (const int (*)[3])ranges,
+                              newgroup, "group_range_excl",
+                              "MPI_Group_range_excl");
+}
+
 /* ------------------------------------------------------------------ */
 /* PMPI profiling surface: every implementation above is the strong
  * PMPI_X symbol; the public MPI_X names are weak aliases generated

@@ -611,6 +611,144 @@ def neighbor_alltoall(h: int, view, sdt: int, percount: int, rdt: int,
     return _overlay_rows(rows, rdt, curview)
 
 
+def comm_get_name(h: int) -> str:
+    return _comm(h).get_name()
+
+
+def comm_set_name(h: int, name: str) -> None:
+    _comm(h).set_name(name)
+
+
+def comm_test_inter(h: int) -> int:
+    c = _comm(h)
+    return int(bool(getattr(c, "is_inter", False)
+                    or getattr(c, "remote_group", None) is not None))
+
+
+def group_translate_ranks(a: int, ranks_view, b: int) -> bytes:
+    """MPI_Group_translate_ranks: map each rank of group a to its rank
+    in group b (MPI_UNDEFINED where absent)."""
+    ga, gb = _group(a), _group(b)
+    pos = {w: i for i, w in enumerate(gb.world_ranks)}
+    out = []
+    for r in _ints(ranks_view):
+        if not 0 <= int(r) < ga.size:
+            raise MPIError(ERR_RANK, f"rank {int(r)} not in group")
+        out.append(pos.get(ga.world_ranks[int(r)], -32766))
+    return np.asarray(out, np.intc).tobytes()
+
+
+def group_compare(a: int, b: int) -> int:
+    """MPI_IDENT(0)/MPI_SIMILAR(2)/MPI_UNEQUAL(3)."""
+    ga, gb = _group(a), _group(b)
+    if list(ga.world_ranks) == list(gb.world_ranks):
+        return 0
+    if sorted(ga.world_ranks) == sorted(gb.world_ranks):
+        return 2
+    return 3
+
+
+def _range_ranks(ranges: np.ndarray) -> list:
+    out = []
+    for i in range(0, len(ranges), 3):
+        first, last, stride = (int(ranges[i]), int(ranges[i + 1]),
+                               int(ranges[i + 2]))
+        if stride == 0:
+            raise MPIError(ERR_ARG, "zero stride in range")
+        r = first
+        while (stride > 0 and r <= last) or (stride < 0 and r >= last):
+            out.append(r)
+            r += stride
+    return out
+
+
+def group_range_incl(gh: int, ranges_view) -> int:
+    return group_incl(gh, np.asarray(_range_ranks(_ints(ranges_view)),
+                                     np.intc).tobytes())
+
+
+def group_range_excl(gh: int, ranges_view) -> int:
+    return group_excl(gh, np.asarray(_range_ranks(_ints(ranges_view)),
+                                     np.intc).tobytes())
+
+
+# ---- graph / dist_graph topologies (dist_graph_create.c.in family) --
+def graph_create(h: int, index_view, edges_view, reorder: int) -> int:
+    c = _comm(h)
+    index = [int(x) for x in _ints(index_view)]
+    edges = [int(x) for x in _ints(edges_view)]
+    sub = c.create_graph(index, edges, bool(reorder))
+    return COMM_NULL if sub is None else _register_comm(sub)
+
+
+def _graph_topo(h: int, dist_ok: bool = False):
+    from ompi_tpu.topo import DistGraphTopology, GraphTopology
+    t = _comm(h).topo
+    kinds = ((GraphTopology, DistGraphTopology) if dist_ok
+             else GraphTopology)
+    if not isinstance(t, kinds):
+        raise MPIError(ERR_TOPOLOGY, "no graph topology attached")
+    return t
+
+
+def graphdims_get(h: int) -> Tuple[int, int]:
+    t = _graph_topo(h)
+    return t.size, len(t.edges)
+
+
+def graph_get(h: int) -> Tuple[bytes, bytes]:
+    t = _graph_topo(h)
+    return (np.asarray(t.index, np.intc).tobytes(),
+            np.asarray(t.edges, np.intc).tobytes())
+
+
+def graph_neighbors(h: int, rank: int) -> bytes:
+    return np.asarray(_graph_topo(h).neighbors(int(rank)),
+                      np.intc).tobytes()
+
+
+def graph_neighbors_count(h: int, rank: int) -> int:
+    return len(_graph_topo(h).neighbors(int(rank)))
+
+
+def topo_test(h: int) -> int:
+    """MPI_Topo_test: 1 graph, 2 cart, 3 dist graph, -32766 none."""
+    from ompi_tpu.topo import (CartTopology, DistGraphTopology,
+                               GraphTopology)
+    t = _comm(h).topo
+    if isinstance(t, CartTopology):
+        return 2
+    if isinstance(t, DistGraphTopology):
+        return 3
+    if isinstance(t, GraphTopology):
+        return 1
+    return -32766                        # MPI_UNDEFINED
+
+
+def dist_graph_create_adjacent(h: int, sources_view, dests_view,
+                               reorder: int) -> int:
+    c = _comm(h)
+    srcs = [int(x) for x in _ints(sources_view)]
+    dsts = [int(x) for x in _ints(dests_view)]
+    del reorder                          # identity placement
+    return _register_comm(c.create_dist_graph_adjacent(srcs, dsts))
+
+
+def dist_graph_neighbors(h: int) -> Tuple[bytes, bytes]:
+    c = _comm(h)
+    t = _graph_topo(h, dist_ok=True)
+    r = c.rank()
+    return (np.asarray(t.neighbors(r), np.intc).tobytes(),
+            np.asarray(t.out_neighbors(r), np.intc).tobytes())
+
+
+def dist_graph_neighbors_count(h: int) -> Tuple[int, int, int]:
+    c = _comm(h)
+    t = _graph_topo(h, dist_ok=True)
+    r = c.rank()
+    return len(t.neighbors(r)), len(t.out_neighbors(r)), 0
+
+
 def cartdim_get(h: int) -> int:
     return len(_comm(h)._cart().dims)
 

@@ -817,6 +817,41 @@ class RankCommunicator:
         sub.name = f"{self.name}.cart"
         return sub
 
+    def create_graph(self, index: Sequence[int], edges: Sequence[int],
+                     reorder: bool = False
+                     ) -> Optional["RankCommunicator"]:
+        """MPI_Graph_create, textbook signature: callers beyond the
+        graph size get None. ``reorder`` is accepted but placement is
+        identity in the per-rank world — process binding is fixed at
+        launch (the single-controller path runs the treematch
+        permutation instead)."""
+        from ompi_tpu.topo import GraphTopology
+        topo = GraphTopology(index, edges)
+        if topo.size > self.size:
+            self._err(ERR_ARG, "graph larger than communicator")
+        sub = self.split(0 if self._rank < topo.size else UNDEFINED)
+        if sub is None:
+            return None
+        sub.topo = topo
+        sub.name = f"{self.name}.graph"
+        return sub
+
+    def create_dist_graph_adjacent(self, sources: Sequence[int],
+                                   destinations: Sequence[int]
+                                   ) -> "RankCommunicator":
+        """MPI_Dist_graph_create_adjacent, textbook signature: THIS
+        rank's in/out neighbor lists; the full per-rank table is
+        assembled collectively (the modex the reference does through
+        its topo machinery)."""
+        from ompi_tpu.topo import DistGraphTopology
+        rows = self.allgather(([int(s) for s in sources],
+                               [int(d) for d in destinations]))
+        c = self.dup()
+        c.topo = DistGraphTopology([r[0] for r in rows],
+                                   [r[1] for r in rows])
+        c.name = f"{self.name}.dist_graph"
+        return c
+
     def _cart(self):
         from ompi_tpu.topo import CartTopology
         if not isinstance(self.topo, CartTopology):
@@ -850,11 +885,15 @@ class RankCommunicator:
         # per-slot wait deadlocks on periodic rings of size >= 3 (each
         # rank's slot-0 wait needs a frame its neighbor only sends
         # after ITS slot-0 wait: a cycle)
+        # directed topologies (dist_graph): receive from IN-neighbors,
+        # send to OUT-neighbors (MPI_Neighbor_* on a dist graph)
         nbrs = list(self.topo.neighbors(self._rank))
+        outs = (list(self.topo.out_neighbors(self._rank))
+                if hasattr(self.topo, "out_neighbors") else nbrs)
         t = self._tag()
         reqs = [self._coll_pml.irecv(nb, t)
                 if 0 <= nb < self.size else None for nb in nbrs]
-        for nb in nbrs:
+        for nb in outs:
             if 0 <= nb < self.size:
                 self._coll_pml.send(data, nb, t)
         out: List[Any] = []
@@ -875,14 +914,16 @@ class RankCommunicator:
             from ompi_tpu.core.errhandler import ERR_TOPOLOGY
             self._err(ERR_TOPOLOGY, "no topology attached")
         nbrs = list(self.topo.neighbors(self._rank))
-        if len(chunks) != len(nbrs):
+        outs = (list(self.topo.out_neighbors(self._rank))
+                if hasattr(self.topo, "out_neighbors") else nbrs)
+        if len(chunks) != len(outs):
             self._err(ERR_COUNT, "need one chunk per neighbor slot")
         t = self._tag()
         reqs: List[Optional[RankRequest]] = []
         for nb in nbrs:
             reqs.append(self._coll_pml.irecv(nb, t)
                         if 0 <= nb < self.size else None)
-        for nb, c in zip(nbrs, chunks):
+        for nb, c in zip(outs, chunks):
             if 0 <= nb < self.size:
                 self._coll_pml.send(c, nb, t)
         out: List[Any] = []

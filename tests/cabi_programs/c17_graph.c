@@ -1,0 +1,127 @@
+/* Graph and distributed-graph topologies + their neighbor
+ * collectives + comm naming + group range/translate/compare
+ * (dist_graph_create.c.in, graph_create.c.in behavioral specs). */
+#include <mpi.h>
+#include <stdio.h>
+#include <string.h>
+
+#define CHECK(cond, code)                                            \
+    do {                                                             \
+        if (!(cond)) {                                               \
+            fprintf(stderr, "rank %d: check failed at line %d\n",    \
+                    rank, __LINE__);                                 \
+            MPI_Abort(MPI_COMM_WORLD, code);                         \
+        }                                                            \
+    } while (0)
+
+int main(int argc, char **argv)
+{
+    MPI_Init(&argc, &argv);
+    int rank, size;
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &size);
+    CHECK(size >= 3, 1);                 /* ring graph needs >= 3 */
+
+    /* ---- MPI_Graph_create: a bidirectional ring over all ranks ----
+     * node i's neighbors: (i-1+n)%n and (i+1)%n; CSR index/edges */
+    int index[16], edges[32];
+    for (int i = 0; i < size; i++) {
+        index[i] = 2 * (i + 1);
+        edges[2 * i] = (i - 1 + size) % size;
+        edges[2 * i + 1] = (i + 1) % size;
+    }
+    MPI_Comm gcomm;
+    MPI_Graph_create(MPI_COMM_WORLD, size, index, edges, 0, &gcomm);
+    CHECK(gcomm != MPI_COMM_NULL, 2);
+
+    int status = -1;
+    MPI_Topo_test(gcomm, &status);
+    CHECK(status == MPI_GRAPH, 3);
+    MPI_Topo_test(MPI_COMM_WORLD, &status);
+    CHECK(status == MPI_UNDEFINED, 4);
+
+    int nn = -1, ne = -1;
+    MPI_Graphdims_get(gcomm, &nn, &ne);
+    CHECK(nn == size && ne == 2 * size, 5);
+    int gi[16], ge[32];
+    MPI_Graph_get(gcomm, size, 2 * size, gi, ge);
+    CHECK(gi[0] == 2 && ge[0] == size - 1, 6);
+    int cnt = -1;
+    MPI_Graph_neighbors_count(gcomm, rank, &cnt);
+    CHECK(cnt == 2, 7);
+    int nbrs[2];
+    MPI_Graph_neighbors(gcomm, rank, 2, nbrs);
+    CHECK(nbrs[0] == (rank - 1 + size) % size
+          && nbrs[1] == (rank + 1) % size, 8);
+
+    /* neighbor collectives over the graph topology */
+    int mine = 100 + rank, got[2] = {-1, -1};
+    MPI_Neighbor_allgather(&mine, 1, MPI_INT, got, 1, MPI_INT, gcomm);
+    CHECK(got[0] == 100 + (rank - 1 + size) % size, 9);
+    CHECK(got[1] == 100 + (rank + 1) % size, 10);
+    int sends[2] = {rank * 10, rank * 10 + 1}, recvd[2] = {-1, -1};
+    MPI_Neighbor_alltoall(sends, 1, MPI_INT, recvd, 1, MPI_INT, gcomm);
+    /* left neighbor sent me its slot-1 chunk; right its slot-0 */
+    CHECK(recvd[0] == ((rank - 1 + size) % size) * 10 + 1, 11);
+    CHECK(recvd[1] == ((rank + 1) % size) * 10, 12);
+
+    /* naming */
+    MPI_Comm_set_name(gcomm, "ring-graph");
+    char name[MPI_MAX_OBJECT_NAME];
+    int nlen = -1;
+    MPI_Comm_get_name(gcomm, name, &nlen);
+    CHECK(strcmp(name, "ring-graph") == 0 && nlen == 10, 13);
+    int inter = -1;
+    MPI_Comm_test_inter(gcomm, &inter);
+    CHECK(inter == 0, 14);
+    MPI_Comm_free(&gcomm);
+
+    /* ---- dist graph: directed ring (recv from left, send right) --- */
+    int src = (rank - 1 + size) % size, dst = (rank + 1) % size;
+    MPI_Comm dcomm;
+    MPI_Dist_graph_create_adjacent(MPI_COMM_WORLD, 1, &src,
+                                   MPI_UNWEIGHTED, 1, &dst,
+                                   MPI_UNWEIGHTED, MPI_INFO_NULL, 0,
+                                   &dcomm);
+    MPI_Topo_test(dcomm, &status);
+    CHECK(status == MPI_DIST_GRAPH, 15);
+    int indeg = -1, outdeg = -1, weighted = -1;
+    MPI_Dist_graph_neighbors_count(dcomm, &indeg, &outdeg, &weighted);
+    CHECK(indeg == 1 && outdeg == 1 && weighted == 0, 16);
+    int s2 = -1, d2 = -1, sw = 0, dw = 0;
+    MPI_Dist_graph_neighbors(dcomm, 1, &s2, &sw, 1, &d2, &dw);
+    CHECK(s2 == src && d2 == dst, 17);
+    /* directed neighbor allgather: one slot, filled from the LEFT */
+    int token = 1000 + rank, in = -1;
+    MPI_Neighbor_allgather(&token, 1, MPI_INT, &in, 1, MPI_INT, dcomm);
+    CHECK(in == 1000 + src, 18);
+    MPI_Comm_free(&dcomm);
+
+    /* ---- group extras ---- */
+    MPI_Group world_g, evens, resorted;
+    MPI_Comm_group(MPI_COMM_WORLD, &world_g);
+    int ranges[1][3] = {{0, size - 1, 2}};
+    MPI_Group_range_incl(world_g, 1, ranges, &evens);
+    int esz = -1;
+    MPI_Group_size(evens, &esz);
+    CHECK(esz == (size + 1) / 2, 19);
+    int r0[2] = {0, 1}, r1[2] = {-7, -7};
+    MPI_Group_translate_ranks(world_g, 2, r0, evens, r1);
+    CHECK(r1[0] == 0 && r1[1] == MPI_UNDEFINED, 20);
+    int cmp = -1;
+    MPI_Group_compare(world_g, world_g, &cmp);
+    CHECK(cmp == MPI_IDENT, 21);
+    MPI_Group_compare(world_g, evens, &cmp);
+    CHECK(cmp == MPI_UNEQUAL, 22);
+    MPI_Group_range_excl(world_g, 1, ranges, &resorted);
+    int osz = -1;
+    MPI_Group_size(resorted, &osz);
+    CHECK(osz == size / 2, 23);
+    MPI_Group_free(&world_g);
+    MPI_Group_free(&evens);
+    MPI_Group_free(&resorted);
+
+    printf("OK c17_graph rank=%d/%d\n", rank, size);
+    MPI_Finalize();
+    return 0;
+}

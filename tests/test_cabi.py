@@ -41,6 +41,8 @@ CASES = [
     ("c14_icoll_full.c", 3),
     ("c15_rma2.c", 3),
     ("c16_attrs_info.c", 3),
+    ("c17_graph.c", 3),
+    ("c17_graph.c", 4),
 ]
 
 # per-program argv (c13 runs 4M floats = 16 MB in CI — above the 1 MB
