@@ -122,6 +122,11 @@ typedef int (MPI_Delete_function)(MPI_Comm, int, void *, void *);
 #define MPI_MAX_OBJECT_NAME 64
 typedef long MPI_Info;
 #define MPI_INFO_NULL ((MPI_Info)0)
+typedef long MPI_Session;
+#define MPI_SESSION_NULL ((MPI_Session)0)
+#define MPI_MAX_PSET_NAME_LEN 256
+#define MPI_MAX_PORT_NAME 1024
+#define MPI_MAX_STRINGTAG_LEN 256
 typedef long MPI_Win;
 typedef long MPI_File;
 typedef long long MPI_Offset;
@@ -228,6 +233,51 @@ int MPI_Info_free(MPI_Info *info);
 int MPI_Get_address(const void *location, MPI_Aint *address);
 MPI_Aint MPI_Aint_add(MPI_Aint base, MPI_Aint disp);
 MPI_Aint MPI_Aint_diff(MPI_Aint addr1, MPI_Aint addr2);
+
+/* ---- MPI-4 Sessions ---- */
+int MPI_Session_init(MPI_Info info, MPI_Errhandler errhandler,
+                     MPI_Session *session);
+int MPI_Session_finalize(MPI_Session *session);
+int MPI_Session_get_num_psets(MPI_Session session, MPI_Info info,
+                              int *npset_names);
+int MPI_Session_get_nth_pset(MPI_Session session, MPI_Info info,
+                             int n, int *pset_len, char *pset_name);
+int MPI_Group_from_session_pset(MPI_Session session,
+                                const char *pset_name,
+                                MPI_Group *newgroup);
+int MPI_Comm_create_from_group(MPI_Group group, const char *stringtag,
+                               MPI_Info info,
+                               MPI_Errhandler errhandler,
+                               MPI_Comm *newcomm);
+
+/* ---- dynamic process management (ports + cross-job comms) ---- */
+int MPI_Open_port(MPI_Info info, char *port_name);
+int MPI_Close_port(const char *port_name);
+int MPI_Comm_accept(const char *port_name, MPI_Info info, int root,
+                    MPI_Comm comm, MPI_Comm *newcomm);
+int MPI_Comm_connect(const char *port_name, MPI_Info info, int root,
+                     MPI_Comm comm, MPI_Comm *newcomm);
+int MPI_Comm_disconnect(MPI_Comm *comm);
+int MPI_Comm_remote_size(MPI_Comm comm, int *size);
+
+/* ---- datatype stragglers + misc ---- */
+int MPI_Type_indexed(int count, const int blocklengths[],
+                     const int displs[], MPI_Datatype oldtype,
+                     MPI_Datatype *newtype);
+int MPI_Type_create_indexed_block(int count, int blocklength,
+                                  const int displs[],
+                                  MPI_Datatype oldtype,
+                                  MPI_Datatype *newtype);
+int MPI_Type_dup(MPI_Datatype oldtype, MPI_Datatype *newtype);
+int MPI_Type_create_resized(MPI_Datatype oldtype, MPI_Aint lb,
+                            MPI_Aint extent, MPI_Datatype *newtype);
+int MPI_Op_commutative(MPI_Op op, int *commute);
+int MPI_Buffer_attach(void *buffer, int size);
+int MPI_Buffer_detach(void *buffer_addr, int *size);
+int MPI_Request_get_status(MPI_Request request, int *flag,
+                           MPI_Status *status);
+int MPI_Get_elements(const MPI_Status *status, MPI_Datatype datatype,
+                     int *count);
 
 /* ---- point-to-point ---- */
 int MPI_Send(const void *buf, int count, MPI_Datatype datatype, int dest,

@@ -2626,6 +2626,15 @@ static int neighbor_count_of(MPI_Comm comm, int *n)
     return rc;
 }
 
+static int neighbor_out_count_of(MPI_Comm comm, int *n)
+{
+    long v;
+    int rc = group_call1("neighbor_out_count", (long)comm, &v);
+    if (rc == MPI_SUCCESS)
+        *n = (int)v;
+    return rc;
+}
+
 int PMPI_Neighbor_allgather(const void *sendbuf, int sendcount,
                            MPI_Datatype sendtype, void *recvbuf,
                            int recvcount, MPI_Datatype recvtype,
@@ -2665,8 +2674,10 @@ int PMPI_Neighbor_alltoall(const void *sendbuf, int sendcount,
     size_t ssz = dt_extent(sendtype), rsz = dt_size(recvtype);
     if (!ssz || !rsz || sendcount < 0 || recvcount < 0)
         return MPI_ERR_TYPE;
-    int nslots;
+    int nslots, nout;
     int qrc = neighbor_count_of(comm, &nslots);
+    if (qrc == MPI_SUCCESS)
+        qrc = neighbor_out_count_of(comm, &nout);
     if (qrc != MPI_SUCCESS)
         return qrc;
     size_t cap = (size_t)nslots * (size_t)recvcount * rsz;
@@ -2674,7 +2685,7 @@ int PMPI_Neighbor_alltoall(const void *sendbuf, int sendcount,
     int rc = MPI_SUCCESS;
     PyObject *r = PyObject_CallMethod(
         g_mod, "neighbor_alltoall", "lNlilN", (long)comm,
-        mem_ro(sendbuf, (size_t)nslots * (size_t)sendcount * ssz),
+        mem_ro(sendbuf, (size_t)nout * (size_t)sendcount * ssz),
         (long)sendtype, sendcount, (long)recvtype,
         mem_ro(recvbuf, cap));
     if (!r)
@@ -3259,15 +3270,17 @@ int PMPI_Ineighbor_alltoall(const void *sendbuf, int sendcount,
     size_t ssz = dt_extent(sendtype), rsz = dt_size(recvtype);
     if (!ssz || !rsz || sendcount < 0 || recvcount < 0)
         return MPI_ERR_TYPE;
-    int nslots;
+    int nslots, nout;
     int qrc = neighbor_count_of(comm, &nslots);
+    if (qrc == MPI_SUCCESS)
+        qrc = neighbor_out_count_of(comm, &nout);
     if (qrc != MPI_SUCCESS)
         return qrc;
     size_t cap = (size_t)nslots * (size_t)recvcount * rsz;
     GIL_BEGIN;
     PyObject *r = PyObject_CallMethod(
         g_mod, "ineighbor_alltoall", "lNlilN", (long)comm,
-        mem_ro(sendbuf, (size_t)nslots * (size_t)sendcount * ssz),
+        mem_ro(sendbuf, (size_t)nout * (size_t)sendcount * ssz),
         (long)sendtype, sendcount, (long)recvtype,
         mem_ro(recvbuf, cap));
     int rc = icoll_request(r, recvbuf, cap, request,
@@ -4071,6 +4084,401 @@ int PMPI_Group_range_excl(MPI_Group group, int n, int ranges[][3],
     return group_range_common(group, n, (const int (*)[3])ranges,
                               newgroup, "group_range_excl",
                               "MPI_Group_range_excl");
+}
+
+
+/* ------------------------------------------------------------------ */
+/* wave 2: Sessions, dynamic process management, datatype stragglers   */
+/* ------------------------------------------------------------------ */
+int PMPI_Session_init(MPI_Info info, MPI_Errhandler errhandler,
+                      MPI_Session *session)
+{
+    (void)info;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "session_init", "i",
+                                      (int)errhandler);
+    if (!r)
+        rc = handle_error("MPI_Session_init");
+    else {
+        *session = (MPI_Session)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Session_finalize(MPI_Session *session)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "session_finalize", "l",
+                                      (long)*session);
+    if (!r)
+        rc = handle_error("MPI_Session_finalize");
+    else {
+        *session = MPI_SESSION_NULL;
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Session_get_num_psets(MPI_Session session, MPI_Info info,
+                               int *npset_names)
+{
+    (void)info;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "session_get_num_psets",
+                                      "l", (long)session);
+    if (!r)
+        rc = handle_error("MPI_Session_get_num_psets");
+    else {
+        *npset_names = (int)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Session_get_nth_pset(MPI_Session session, MPI_Info info,
+                              int n, int *pset_len, char *pset_name)
+{
+    (void)info;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "session_get_nth_pset",
+                                      "li", (long)session, n);
+    if (!r)
+        rc = handle_error("MPI_Session_get_nth_pset");
+    else {
+        const char *s = PyUnicode_AsUTF8(r);
+        size_t len = s ? strlen(s) : 0;
+        if (pset_name && *pset_len > 0) {
+            size_t m = len;
+            if (m > (size_t)*pset_len - 1)
+                m = (size_t)*pset_len - 1;
+            memcpy(pset_name, s ? s : "", m);
+            pset_name[m] = '\0';
+        }
+        *pset_len = (int)len + 1;        /* required buffer size */
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Group_from_session_pset(MPI_Session session,
+                                 const char *pset_name,
+                                 MPI_Group *newgroup)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "group_from_session_pset",
+                                      "ls", (long)session, pset_name);
+    if (!r)
+        rc = handle_error("MPI_Group_from_session_pset");
+    else {
+        *newgroup = (MPI_Group)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Comm_create_from_group(MPI_Group group, const char *stringtag,
+                                MPI_Info info,
+                                MPI_Errhandler errhandler,
+                                MPI_Comm *newcomm)
+{
+    (void)info;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "comm_create_from_group",
+                                      "ls", (long)group, stringtag);
+    if (!r)
+        rc = handle_error("MPI_Comm_create_from_group");
+    else {
+        *newcomm = (MPI_Comm)PyLong_AsLong(r);
+        if (*newcomm != MPI_COMM_NULL)
+            errh_set(*newcomm, errhandler ? errhandler : g_errh);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Open_port(MPI_Info info, char *port_name)
+{
+    (void)info;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "dpm_open_port", "l",
+                                      (long)MPI_COMM_WORLD);
+    if (!r)
+        rc = handle_error("MPI_Open_port");
+    else {
+        const char *s = PyUnicode_AsUTF8(r);
+        size_t n = s ? strlen(s) : 0;
+        if (n >= MPI_MAX_PORT_NAME)
+            n = MPI_MAX_PORT_NAME - 1;
+        memcpy(port_name, s ? s : "", n);
+        port_name[n] = '\0';
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Close_port(const char *port_name)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "dpm_close_port", "ls",
+                                      (long)MPI_COMM_WORLD, port_name);
+    if (!r)
+        rc = handle_error("MPI_Close_port");
+    else
+        Py_DECREF(r);
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Comm_accept(const char *port_name, MPI_Info info, int root,
+                     MPI_Comm comm, MPI_Comm *newcomm)
+{
+    (void)info;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "dpm_comm_accept", "sli",
+                                      port_name, (long)comm, root);
+    if (!r)
+        rc = handle_error_comm(comm, "MPI_Comm_accept");
+    else {
+        *newcomm = (MPI_Comm)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Comm_connect(const char *port_name, MPI_Info info, int root,
+                      MPI_Comm comm, MPI_Comm *newcomm)
+{
+    (void)info;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "dpm_comm_connect", "sli",
+                                      port_name, (long)comm, root);
+    if (!r)
+        rc = handle_error_comm(comm, "MPI_Comm_connect");
+    else {
+        *newcomm = (MPI_Comm)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Comm_disconnect(MPI_Comm *comm)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "comm_disconnect", "l",
+                                      (long)*comm);
+    if (!r)
+        rc = handle_error("MPI_Comm_disconnect");
+    else {
+        errh_drop(*comm);
+        *comm = MPI_COMM_NULL;
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Comm_remote_size(MPI_Comm comm, int *size)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "comm_remote_size", "l",
+                                      (long)comm);
+    if (!r)
+        rc = handle_error_comm(comm, "MPI_Comm_remote_size");
+    else {
+        *size = (int)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Type_indexed(int count, const int blocklengths[],
+                      const int displs[], MPI_Datatype oldtype,
+                      MPI_Datatype *newtype)
+{
+    if (count < 0)
+        return MPI_ERR_ARG;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "type_indexed", "NNl",
+        mem_ro(blocklengths, (size_t)count * sizeof(int)),
+        mem_ro(displs, (size_t)count * sizeof(int)), (long)oldtype);
+    if (!r)
+        rc = handle_error("MPI_Type_indexed");
+    else {
+        *newtype = (MPI_Datatype)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Type_create_indexed_block(int count, int blocklength,
+                                   const int displs[],
+                                   MPI_Datatype oldtype,
+                                   MPI_Datatype *newtype)
+{
+    if (count < 0 || blocklength < 0)
+        return MPI_ERR_ARG;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "type_create_indexed_block", "iNl", blocklength,
+        mem_ro(displs, (size_t)count * sizeof(int)), (long)oldtype);
+    if (!r)
+        rc = handle_error("MPI_Type_create_indexed_block");
+    else {
+        *newtype = (MPI_Datatype)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Type_dup(MPI_Datatype oldtype, MPI_Datatype *newtype)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "type_dup", "l",
+                                      (long)oldtype);
+    if (!r)
+        rc = handle_error("MPI_Type_dup");
+    else {
+        *newtype = (MPI_Datatype)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Type_create_resized(MPI_Datatype oldtype, MPI_Aint lb,
+                             MPI_Aint extent, MPI_Datatype *newtype)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "type_create_resized",
+                                      "lll", (long)oldtype, (long)lb,
+                                      (long)extent);
+    if (!r)
+        rc = handle_error("MPI_Type_create_resized");
+    else {
+        *newtype = (MPI_Datatype)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Op_commutative(MPI_Op op, int *commute)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "op_commutative", "l",
+                                      (long)op);
+    if (!r)
+        rc = handle_error("MPI_Op_commutative");
+    else {
+        *commute = (int)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+/* bsend buffer bookkeeping: every send here is buffered by the
+ * runtime, so attach/detach only track the user's pointer */
+static void *g_bsend_buf;
+static int g_bsend_size;
+
+int PMPI_Buffer_attach(void *buffer, int size)
+{
+    g_bsend_buf = buffer;
+    g_bsend_size = size;
+    return MPI_SUCCESS;
+}
+
+int PMPI_Buffer_detach(void *buffer_addr, int *size)
+{
+    *(void **)buffer_addr = g_bsend_buf;
+    *size = g_bsend_size;
+    g_bsend_buf = NULL;
+    g_bsend_size = 0;
+    return MPI_SUCCESS;
+}
+
+int PMPI_Request_get_status(MPI_Request request, int *flag,
+                            MPI_Status *status)
+{
+    if (request == MPI_REQUEST_NULL) {
+        *flag = 1;
+        set_status(status, MPI_ANY_SOURCE, MPI_ANY_TAG, 0);
+        return MPI_SUCCESS;
+    }
+    req_entry *e = (req_entry *)(intptr_t)request;
+    if (e->persistent && e->pyh == 0) {
+        *flag = 1;
+        set_status(status, MPI_ANY_SOURCE, MPI_ANY_TAG, 0);
+        return MPI_SUCCESS;
+    }
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "test_peek", "l", e->pyh);
+    if (!r)
+        rc = handle_error("MPI_Request_get_status");
+    else {
+        *flag = (int)PyLong_AsLong(r);
+        /* non-destructive: the request stays live; the status is not
+         * filled until the consuming Wait/Test (documented subset) */
+        set_status(status, MPI_ANY_SOURCE, MPI_ANY_TAG, 0);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Get_elements(const MPI_Status *status, MPI_Datatype datatype,
+                      int *count)
+{
+    if (!status)
+        return MPI_ERR_ARG;
+    size_t base = 0;
+    GIL_BEGIN;
+    PyObject *r = PyObject_CallMethod(g_mod, "type_base_bytes", "l",
+                                      (long)datatype);
+    if (r) {
+        base = (size_t)PyLong_AsLong(r);
+        Py_DECREF(r);
+    } else {
+        PyErr_Clear();
+    }
+    GIL_END;
+    if (!base)
+        return MPI_ERR_TYPE;
+    *count = (int)((size_t)status->_count / base);
+    return MPI_SUCCESS;
 }
 
 /* ------------------------------------------------------------------ */

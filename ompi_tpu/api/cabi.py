@@ -238,6 +238,77 @@ def type_vector(count: int, blocklength: int, stride: int,
     return h
 
 
+def type_indexed(counts_view, displs_view, oldtype: int) -> int:
+    """MPI_Type_indexed: block i has counts[i] oldtypes starting at
+    displacement displs[i] (in oldtype extents). Monotonic
+    non-overlapping displacements required (no lb/extent model)."""
+    counts, displs = _ints(counts_view), _ints(displs_view)
+    base, idx, ext = _type_parts(oldtype)
+    blocks = []
+    top = 0
+    prev_end = None
+    for c, d in zip(counts, displs):
+        c, d = int(c), int(d)
+        if c < 0 or d < 0:
+            raise MPIError(ERR_ARG, "negative count/displacement")
+        if prev_end is not None and d < prev_end:
+            raise MPIError(ERR_ARG, "overlapping/decreasing "
+                                    "indexed blocks unsupported")
+        for j in range(c):
+            blocks.append(idx + (d + j) * ext)
+        prev_end = d + c
+        top = max(top, d + c)
+    new_idx = (np.concatenate(blocks) if blocks
+               else np.array([], dtype=np.int64))
+    h = next(_next_dyn_type)
+    _dyn_types[h] = DerivedType(base, new_idx, top * ext)
+    return h
+
+
+def type_create_indexed_block(blocklength: int, displs_view,
+                              oldtype: int) -> int:
+    """MPI_Type_create_indexed_block: uniform blocklength."""
+    displs = _ints(displs_view)
+    counts = np.full(len(displs), int(blocklength), np.intc)
+    return type_indexed(counts.tobytes(), bytes(displs_view), oldtype)
+
+
+def type_dup(dt: int) -> int:
+    """MPI_Type_dup."""
+    base, idx, ext = _type_parts(dt)
+    h = next(_next_dyn_type)
+    _dyn_types[h] = DerivedType(base, np.array(idx), int(ext))
+    return h
+
+
+def type_create_resized(oldtype: int, lb: int, extent: int) -> int:
+    """MPI_Type_create_resized: change the extent (in BYTES). lb must
+    be 0 and the new extent a multiple of the base element size — the
+    flattened representation has no true lb model; out-of-range
+    arguments are rejected rather than mis-laid-out."""
+    base, idx, _ = _type_parts(oldtype)
+    if lb != 0:
+        raise MPIError(ERR_ARG, "nonzero lb unsupported")
+    if extent <= 0 or extent % base.itemsize:
+        raise MPIError(ERR_ARG,
+                       "extent must be a positive multiple of the "
+                       "base element size")
+    h = next(_next_dyn_type)
+    _dyn_types[h] = DerivedType(base, np.array(idx),
+                                extent // base.itemsize)
+    return h
+
+
+def type_base_bytes(dt: int) -> int:
+    """Base-element size (MPI_Get_elements units)."""
+    base, _, _ = _type_parts(dt)
+    return int(base.itemsize)
+
+
+def op_commutative(o: int) -> int:
+    return int(_rma_op(o).commute)
+
+
 def type_commit(dt: int) -> None:
     _type_parts(dt)                      # validates the handle
 
@@ -564,10 +635,24 @@ def cart_get(h: int) -> Tuple[bytes, bytes, bytes]:
 
 
 def neighbor_count(h: int) -> int:
+    """IN-neighbor slot count (receive side of neighbor colls)."""
     c = _comm(h)
     if c.topo is None:
         raise MPIError(ERR_TOPOLOGY, "no topology attached")
     return len(list(c.topo.neighbors(c.rank())))
+
+
+def neighbor_out_count(h: int) -> int:
+    """OUT-neighbor slot count (send side); equals neighbor_count on
+    undirected topologies."""
+    c = _comm(h)
+    t = c.topo
+    if t is None:
+        raise MPIError(ERR_TOPOLOGY, "no topology attached")
+    r = c.rank()
+    if hasattr(t, "out_neighbors"):
+        return len(list(t.out_neighbors(r)))
+    return len(list(t.neighbors(r)))
 
 
 def _overlay_rows(rows, rdt: int, curview) -> bytes:
@@ -597,7 +682,9 @@ def neighbor_allgather(h: int, view, sdt: int, rdt: int,
 def neighbor_alltoall(h: int, view, sdt: int, percount: int, rdt: int,
                       curview) -> bytes:
     c = _comm(h)
-    n = neighbor_count(h)
+    # directed topologies (dist graph): the SEND buffer holds one
+    # chunk per OUT-neighbor; receives fill one slot per IN-neighbor
+    n = neighbor_out_count(h)
     a = _pack(view, sdt, _count_of(view, sdt))
     # chunk size in SIGNIFICANT base elements: percount counts send
     # units, and a derived unit packs idx.size elements (slicing by
@@ -621,8 +708,125 @@ def comm_set_name(h: int, name: str) -> None:
 
 def comm_test_inter(h: int) -> int:
     c = _comm(h)
-    return int(bool(getattr(c, "is_inter", False)
-                    or getattr(c, "remote_group", None) is not None))
+    return int(getattr(c, "remote_group", None) is not None
+               or getattr(c, "remote_size", None) is not None)
+
+
+def comm_remote_size(h: int) -> int:
+    c = _comm(h)
+    rs = getattr(c, "remote_size", None)
+    if rs is None:
+        rg = getattr(c, "remote_group", None)
+        if rg is None:
+            raise MPIError(ERR_COMM, "not an intercommunicator")
+        rs = rg.size
+    return int(rs)
+
+
+# ---------------------------------------------------------------------
+# MPI-4 Sessions (session_init.c.in family; runtime/session.Session)
+# ---------------------------------------------------------------------
+_sessions: Dict[int, Any] = {}
+_next_session = itertools.count(1)
+_session_groups: Dict[int, int] = {}     # group handle -> session
+
+
+def _session(sh: int):
+    with _lock:
+        s = _sessions.get(sh)
+    if s is None:
+        raise MPIError(ERR_ARG, f"invalid session handle {sh}")
+    return s
+
+
+def session_init(errh: int) -> int:
+    from ompi_tpu.core import errhandler as eh
+    from ompi_tpu.runtime.session import Session
+    handler = eh.ERRORS_RETURN if errh == 2 else eh.ERRORS_ARE_FATAL
+    s = Session(errhandler=handler)
+    with _lock:
+        sh = next(_next_session)
+        _sessions[sh] = s
+    return sh
+
+
+def session_finalize(sh: int) -> None:
+    with _lock:
+        s = _sessions.pop(sh, None)
+    if s is None:
+        raise MPIError(ERR_ARG, f"invalid session handle {sh}")
+    s.finalize()
+
+
+def session_get_num_psets(sh: int) -> int:
+    return _session(sh).get_num_psets()
+
+
+def session_get_nth_pset(sh: int, n: int) -> str:
+    return _session(sh).get_nth_pset(int(n))
+
+
+def group_from_session_pset(sh: int, name: str) -> int:
+    gh = _register_group(_session(sh).group_from_pset(name))
+    _session_groups[gh] = sh
+    return gh
+
+
+def comm_create_from_group(gh: int, tag: str) -> int:
+    """MPI_Comm_create_from_group: the group must come from a session
+    pset (Group_from_session_pset) so the instance linkage exists —
+    the reference resolves the instance from the group the same way."""
+    sh = _session_groups.get(gh)
+    if sh is None:
+        raise MPIError(ERR_ARG,
+                       "group is not derived from a session pset")
+    c = _session(sh).comm_create_from_group(_group(gh), tag)
+    return COMM_NULL if c is None else _register_comm(c)
+
+
+# ---------------------------------------------------------------------
+# dynamic process management (dpm: ports + cross-job connect/accept)
+# ---------------------------------------------------------------------
+def _dpm_mod(h: int):
+    c = _comm(h)
+    if getattr(c, "is_per_rank", False):
+        from ompi_tpu.core import dpm_perrank as m
+        return m
+    from ompi_tpu.core import dpm as m
+    return m
+
+
+def dpm_open_port(h: int) -> str:
+    return _dpm_mod(h).open_port()
+
+
+def dpm_close_port(h: int, name: str) -> None:
+    _dpm_mod(h).close_port(name)
+
+
+def dpm_comm_accept(port: str, h: int, root: int) -> int:
+    c, m = _comm(h), _dpm_mod(h)
+    if hasattr(m, "comm_accept"):        # per-rank bridge (p18 model)
+        return _register_comm(m.comm_accept(port, c, root))
+    return _register_comm(m.accept(port, c))
+
+
+def dpm_comm_connect(port: str, h: int, root: int) -> int:
+    c, m = _comm(h), _dpm_mod(h)
+    if hasattr(m, "comm_connect"):
+        return _register_comm(m.comm_connect(port, c, root))
+    return _register_comm(m.connect(port, c))
+
+
+def comm_disconnect(h: int) -> None:
+    with _lock:
+        c = _comms.pop(h, None)
+    if c is None:
+        raise MPIError(ERR_COMM, f"invalid communicator handle {h}")
+    if hasattr(c, "disconnect"):
+        c.disconnect()
+    elif hasattr(c, "free"):
+        c.free()
 
 
 def group_translate_ranks(a: int, ranks_view, b: int) -> bytes:
@@ -632,9 +836,13 @@ def group_translate_ranks(a: int, ranks_view, b: int) -> bytes:
     pos = {w: i for i, w in enumerate(gb.world_ranks)}
     out = []
     for r in _ints(ranks_view):
-        if not 0 <= int(r) < ga.size:
-            raise MPIError(ERR_RANK, f"rank {int(r)} not in group")
-        out.append(pos.get(ga.world_ranks[int(r)], -32766))
+        r = int(r)
+        if r == -2:                      # MPI_PROC_NULL maps to itself
+            out.append(-2)
+            continue
+        if not 0 <= r < ga.size:
+            raise MPIError(ERR_RANK, f"rank {r} not in group")
+        out.append(pos.get(ga.world_ranks[r], -32766))
     return np.asarray(out, np.intc).tobytes()
 
 
@@ -702,13 +910,21 @@ def graph_get(h: int) -> Tuple[bytes, bytes]:
             np.asarray(t.edges, np.intc).tobytes())
 
 
+def _graph_rank(t, rank: int) -> int:
+    if not 0 <= int(rank) < t.size:
+        raise MPIError(ERR_RANK, f"rank {rank} not in graph")
+    return int(rank)
+
+
 def graph_neighbors(h: int, rank: int) -> bytes:
-    return np.asarray(_graph_topo(h).neighbors(int(rank)),
+    t = _graph_topo(h)
+    return np.asarray(t.neighbors(_graph_rank(t, rank)),
                       np.intc).tobytes()
 
 
 def graph_neighbors_count(h: int, rank: int) -> int:
-    return len(_graph_topo(h).neighbors(int(rank)))
+    t = _graph_topo(h)
+    return len(t.neighbors(_graph_rank(t, rank)))
 
 
 def topo_test(h: int) -> int:

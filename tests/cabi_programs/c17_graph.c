@@ -95,7 +95,37 @@ int main(int argc, char **argv)
     int token = 1000 + rank, in = -1;
     MPI_Neighbor_allgather(&token, 1, MPI_INT, &in, 1, MPI_INT, dcomm);
     CHECK(in == 1000 + src, 18);
+    /* directed neighbor alltoall on the same ring */
+    int dsend = 2000 + rank, drecv = -1;
+    MPI_Neighbor_alltoall(&dsend, 1, MPI_INT, &drecv, 1, MPI_INT,
+                          dcomm);
+    CHECK(drecv == 2000 + src, 24);
     MPI_Comm_free(&dcomm);
+
+    /* ASYMMETRIC degrees: rank 0 fans out to everyone (in=0, out=n-1);
+     * others only receive from 0 (in=1, out=0). The send buffer is
+     * sized by OUT-degree, receives by IN-degree. */
+    int nsrc = (rank == 0) ? 0 : 1;
+    int srcs0 = 0;
+    int ndst = (rank == 0) ? size - 1 : 0;
+    int dsts[16];
+    for (int i = 0; i < size - 1; i++)
+        dsts[i] = i + 1;
+    MPI_Comm fan;
+    MPI_Dist_graph_create_adjacent(MPI_COMM_WORLD, nsrc, &srcs0,
+                                   MPI_UNWEIGHTED, ndst, dsts,
+                                   MPI_UNWEIGHTED, MPI_INFO_NULL, 0,
+                                   &fan);
+    int indeg2 = -1, outdeg2 = -1, w2 = -1;
+    MPI_Dist_graph_neighbors_count(fan, &indeg2, &outdeg2, &w2);
+    CHECK(indeg2 == nsrc && outdeg2 == ndst, 25);
+    int fsend[16], frecv = -1;
+    for (int i = 0; i < ndst; i++)
+        fsend[i] = 3000 + dsts[i];       /* payload names its target */
+    MPI_Neighbor_alltoall(fsend, 1, MPI_INT, &frecv, 1, MPI_INT, fan);
+    if (rank != 0)
+        CHECK(frecv == 3000 + rank, 26);
+    MPI_Comm_free(&fan);
 
     /* ---- group extras ---- */
     MPI_Group world_g, evens, resorted;

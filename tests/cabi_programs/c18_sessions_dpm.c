@@ -1,0 +1,175 @@
+/* MPI-4 Sessions + dynamic process management + datatype stragglers
+ * from C (session_init.c.in, comm_create_from_group semantics,
+ * port/accept/connect over the cross-job bridge, type_indexed).
+ * Note: Session_init after MPI_Init is the supported per-rank order
+ * (Init-free session bootstrap is a documented limit). */
+#include <mpi.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <string.h>
+
+#define CHECK(cond, code)                                            \
+    do {                                                             \
+        if (!(cond)) {                                               \
+            fprintf(stderr, "rank %d: check failed at line %d\n",    \
+                    rank, __LINE__);                                 \
+            MPI_Abort(MPI_COMM_WORLD, code);                         \
+        }                                                            \
+    } while (0)
+
+int main(int argc, char **argv)
+{
+    MPI_Init(&argc, &argv);
+    int rank, size;
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &size);
+    CHECK(size >= 2, 1);
+
+    /* ---- Sessions: psets -> group -> communicator ---- */
+    MPI_Session ses;
+    MPI_Session_init(MPI_INFO_NULL, MPI_ERRORS_RETURN, &ses);
+    CHECK(ses != MPI_SESSION_NULL, 2);
+    int npsets = -1;
+    MPI_Session_get_num_psets(ses, MPI_INFO_NULL, &npsets);
+    CHECK(npsets >= 2, 3);
+    int found_world = 0;
+    for (int i = 0; i < npsets; i++) {
+        char name[MPI_MAX_PSET_NAME_LEN];
+        int len = MPI_MAX_PSET_NAME_LEN;
+        MPI_Session_get_nth_pset(ses, MPI_INFO_NULL, i, &len, name);
+        if (strcmp(name, "mpi://WORLD") == 0)
+            found_world = 1;
+    }
+    CHECK(found_world, 4);
+    MPI_Group wg;
+    MPI_Group_from_session_pset(ses, "mpi://WORLD", &wg);
+    int gsz = -1;
+    MPI_Group_size(wg, &gsz);
+    CHECK(gsz == size, 5);
+    MPI_Comm scomm;
+    MPI_Comm_create_from_group(wg, "c18/tag", MPI_INFO_NULL,
+                               MPI_ERRORS_RETURN, &scomm);
+    CHECK(scomm != MPI_COMM_NULL, 6);
+    int sum = -1, one = 1;
+    MPI_Allreduce(&one, &sum, 1, MPI_INT, MPI_SUM, scomm);
+    CHECK(sum == size, 7);
+    MPI_Comm_free(&scomm);
+    MPI_Group_free(&wg);
+    MPI_Session_finalize(&ses);
+    CHECK(ses == MPI_SESSION_NULL, 8);
+
+    /* ---- ports + accept/connect (ranks 0/1, COMM_SELF sides) ---- */
+    if (rank == 0) {
+        char port[MPI_MAX_PORT_NAME];
+        MPI_Open_port(MPI_INFO_NULL, port);
+        int plen = (int)strlen(port) + 1;
+        MPI_Send(&plen, 1, MPI_INT, 1, 7, MPI_COMM_WORLD);
+        MPI_Send(port, plen, MPI_CHAR, 1, 8, MPI_COMM_WORLD);
+        MPI_Comm inter;
+        MPI_Comm_accept(port, MPI_INFO_NULL, 0, MPI_COMM_SELF,
+                        &inter);
+        int flag = -1, rsz = -1;
+        MPI_Comm_test_inter(inter, &flag);
+        CHECK(flag == 1, 9);
+        MPI_Comm_remote_size(inter, &rsz);
+        CHECK(rsz == 1, 10);
+        double payload = 3.25;
+        MPI_Send(&payload, 1, MPI_DOUBLE, 0, 5, inter);
+        double back = 0;
+        MPI_Recv(&back, 1, MPI_DOUBLE, 0, 6, inter,
+                 MPI_STATUS_IGNORE);
+        CHECK(back == 6.5, 11);
+        MPI_Comm_disconnect(&inter);
+        CHECK(inter == MPI_COMM_NULL, 12);
+        MPI_Close_port(port);
+    } else if (rank == 1) {
+        int plen = 0;
+        MPI_Recv(&plen, 1, MPI_INT, 0, 7, MPI_COMM_WORLD,
+                 MPI_STATUS_IGNORE);
+        char port[MPI_MAX_PORT_NAME];
+        MPI_Recv(port, plen, MPI_CHAR, 0, 8, MPI_COMM_WORLD,
+                 MPI_STATUS_IGNORE);
+        MPI_Comm inter;
+        MPI_Comm_connect(port, MPI_INFO_NULL, 0, MPI_COMM_SELF,
+                         &inter);
+        double got = 0;
+        MPI_Recv(&got, 1, MPI_DOUBLE, 0, 5, inter, MPI_STATUS_IGNORE);
+        CHECK(got == 3.25, 13);
+        got *= 2;
+        MPI_Send(&got, 1, MPI_DOUBLE, 0, 6, inter);
+        MPI_Comm_disconnect(&inter);
+    }
+    MPI_Barrier(MPI_COMM_WORLD);
+
+    /* ---- type_indexed: gather scattered columns ---- */
+    int bl[3] = {1, 2, 1};
+    int dis[3] = {0, 3, 7};
+    MPI_Datatype idxt;
+    MPI_Type_indexed(3, bl, dis, MPI_INT, &idxt);
+    MPI_Type_commit(&idxt);
+    int tsz = -1;
+    MPI_Type_size(idxt, &tsz);
+    CHECK(tsz == 4 * (int)sizeof(int), 14);
+    MPI_Aint lb = -1, ext = -1;
+    MPI_Type_get_extent(idxt, &lb, &ext);
+    CHECK(ext == 8 * (MPI_Aint)sizeof(int), 15);
+    if (rank == 0) {
+        int src[8] = {10, 11, 12, 13, 14, 15, 16, 17};
+        MPI_Send(src, 1, idxt, 1, 9, MPI_COMM_WORLD);
+    } else if (rank == 1) {
+        int dst[8] = {0, 0, 0, 0, 0, 0, 0, 0};
+        MPI_Status st;
+        MPI_Recv(dst, 1, idxt, 0, 9, MPI_COMM_WORLD, &st);
+        /* significant slots landed; gaps stayed zero */
+        CHECK(dst[0] == 10 && dst[3] == 13 && dst[4] == 14
+              && dst[7] == 17, 16);
+        CHECK(dst[1] == 0 && dst[2] == 0 && dst[5] == 0
+              && dst[6] == 0, 17);
+        int elems = -1;
+        MPI_Get_elements(&st, MPI_INT, &elems);
+        CHECK(elems == 4, 18);
+    }
+    MPI_Type_free(&idxt);
+
+    /* indexed_block + dup + resized */
+    int d2[2] = {1, 4};
+    MPI_Datatype blk, blkdup, wide;
+    MPI_Type_create_indexed_block(2, 2, d2, MPI_INT, &blk);
+    MPI_Type_dup(blk, &blkdup);
+    int s1 = -1, s2 = -1;
+    MPI_Type_size(blk, &s1);
+    MPI_Type_size(blkdup, &s2);
+    CHECK(s1 == s2 && s1 == 4 * (int)sizeof(int), 19);
+    MPI_Type_create_resized(MPI_INT, 0, 3 * sizeof(int), &wide);
+    MPI_Type_get_extent(wide, &lb, &ext);
+    CHECK(lb == 0 && ext == 3 * (MPI_Aint)sizeof(int), 20);
+    MPI_Type_free(&blk);
+    MPI_Type_free(&blkdup);
+    MPI_Type_free(&wide);
+
+    /* misc: Op_commutative, Buffer_attach/detach, Request_get_status */
+    int comm_flag = -1;
+    MPI_Op_commutative(MPI_SUM, &comm_flag);
+    CHECK(comm_flag == 1, 21);
+    static char bsendbuf[4096];
+    MPI_Buffer_attach(bsendbuf, sizeof(bsendbuf));
+    void *detached;
+    int dsize = -1;
+    MPI_Buffer_detach(&detached, &dsize);
+    CHECK(detached == bsendbuf && dsize == sizeof(bsendbuf), 22);
+    MPI_Request req;
+    int right = (rank + 1) % size, left = (rank - 1 + size) % size;
+    int tok = rank, rtok = -1;
+    MPI_Irecv(&rtok, 1, MPI_INT, left, 30, MPI_COMM_WORLD, &req);
+    MPI_Send(&tok, 1, MPI_INT, right, 30, MPI_COMM_WORLD);
+    int done = 0;
+    for (int spin = 0; spin < 100000 && !done; spin++)
+        MPI_Request_get_status(req, &done, MPI_STATUS_IGNORE);
+    CHECK(done == 1, 23);
+    MPI_Wait(&req, MPI_STATUS_IGNORE);   /* request survived the peek */
+    CHECK(rtok == left, 24);
+
+    printf("OK c18_sessions_dpm rank=%d/%d\n", rank, size);
+    MPI_Finalize();
+    return 0;
+}

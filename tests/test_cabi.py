@@ -43,6 +43,7 @@ CASES = [
     ("c16_attrs_info.c", 3),
     ("c17_graph.c", 3),
     ("c17_graph.c", 4),
+    ("c18_sessions_dpm.c", 3),
 ]
 
 # per-program argv (c13 runs 4M floats = 16 MB in CI — above the 1 MB
