@@ -248,6 +248,31 @@ def _perrank_child() -> None:
     host_s = _timed(lambda: w.allreduce(big, MPI.SUM))
     _var.var_set("coll_tuned_stage_min_bytes", 1 << 20)
 
+    # device pt2pt A/B at 16 MB (VERDICT r3 next #4): the same
+    # jax.Array round-trip over the PJRT transfer plane (D2D
+    # rendezvous pull) vs forced onto the host byte path. 16 MB: large
+    # enough that transfer amortization dominates this 1-core box's
+    # scheduler noise (4 MB results flip run-to-run here).
+    import jax.numpy as jnp
+    xdev = jnp.full((16 << 20) // 4, float(r), jnp.float32)
+
+    def _pingpong_dev():
+        if r == 0:
+            w.send(xdev, 1, tag=21)
+            y, _ = w.recv(1, tag=22)
+        else:
+            y, _ = w.recv(0, tag=21)
+            w.send(xdev, 0, tag=22)
+        np.asarray(y[:1])                # observe completion
+
+    # host leg FIRST (so the transfer-plane connection warm-up can
+    # never leak into the host number), 5 reps each: this box is
+    # 1-core and scheduler noise at 3 reps flipped the comparison
+    _var.var_set("btl_devxfer_min_bytes", 1 << 62)
+    hostp_s = _timed(_pingpong_dev, reps=5)
+    _var.var_set("btl_devxfer_min_bytes", 1 << 20)
+    d2d_s = _timed(_pingpong_dev, reps=5)
+
     from ompi_tpu.runtime.init import _state
     stats = dict(_state["router"].endpoint.stats)
     w.barrier()
@@ -260,6 +285,8 @@ def _perrank_child() -> None:
             "allreduce_8MB_staged_ms": round(staged_s * 1e3, 2),
             "allreduce_8MB_host_ms": round(host_s * 1e3, 2),
             "staged_device_hits": int(staged_hits),
+            "pt2pt_16MB_rtt_d2d_ms": round(d2d_s * 1e3, 2),
+            "pt2pt_16MB_rtt_host_ms": round(hostp_s * 1e3, 2),
             "transports": stats,
         }), flush=True)
 

@@ -69,6 +69,17 @@ def register_params() -> None:
                           "bandwidth plane; smaller frames stay on the "
                           "tcp latency plane (socket wakeup beats any "
                           "poll cadence a GIL runtime can offer)")
+    var.var_register("btl", "devxfer", "enable", vtype="bool",
+                     default=True,
+                     help="Move large jax.Array pt2pt payloads over "
+                          "the PJRT cross-host transfer plane "
+                          "(device-to-device rendezvous pull) instead "
+                          "of the host byte path")
+    var.var_register("btl", "devxfer", "min_bytes", vtype="int",
+                     default=1 << 20,
+                     help="Device-array payloads at or above this ride "
+                          "the transfer plane (the rndv eager limit, "
+                          "pml_ob1_sendreq.h:389-460 role)")
 
 
 class BmlEndpoint:

@@ -1,0 +1,175 @@
+"""btl/devxfer — device-to-device payload plane for per-rank pt2pt.
+
+Behavioral spec: ob1's rendezvous/RDMA protocol switch
+(``pml_ob1_sendreq.h:389-460``) — above the eager limit, bulk payloads
+leave the copy-in/copy-out byte path and ride an RDMA get: the sender
+publishes the buffer, the receiver pulls it directly.
+
+TPU-native re-design: the PJRT cross-host transfer service
+(``jax.experimental.transfer``) is the RDMA-get engine. Each process
+starts one transfer server and publishes its address through the
+coordination-service KV (the PMIx modex, same as the btl/tcp
+addresses). A large ``jax.Array`` send registers the buffer under a
+fresh uuid (``await_pull``) and sends only a descriptor header over
+the host matching plane; the receiver resolves it with ``pull`` —
+device buffers move over the PJRT bulk transport (DCN sockets here,
+the same engine that rides ICI/DCN on real TPU slices) and NEVER
+round-trip through host pickle. Pulls are one-sided, so there is no
+collective-ordering deadlock under THREAD_MULTIPLE.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+_KV_PREFIX = "ompi_tpu/xfer/"
+
+_lock = threading.Lock()
+_state: Dict[str, Any] = {"server": None, "failed": False}
+_conns: Dict[int, Any] = {}
+_uuid = itertools.count(1)
+
+
+def _enabled() -> bool:
+    from ompi_tpu.mca import var
+    return bool(var.var_get("btl_devxfer_enable", True))
+
+
+def eager_limit() -> int:
+    """Payloads at or above this ride the device plane (the
+    btl_rndv_eager_limit role)."""
+    from ompi_tpu.mca import var
+    return int(var.var_get("btl_devxfer_min_bytes", 1 << 20))
+
+
+def _server(router) -> Optional[Any]:
+    """The process-wide transfer server, started lazily and modex'd.
+    Returns None (and remembers the failure) where the PJRT transfer
+    engine is unavailable — callers fall back to the host byte path."""
+    with _lock:
+        if _state["failed"]:
+            return None
+        srv = _state["server"]
+        if srv is None:
+            try:
+                import jax
+                import jax.experimental.transfer as xfer
+                client = jax.local_devices()[0].client
+                # explicit loopback transport: the default wildcard
+                # address is not dialable and the CPU backend CHECKs
+                # without a transport address list
+                srv = xfer.start_transfer_server(
+                    client, "127.0.0.1:0", ["127.0.0.1:0"])
+                addr = srv.address().replace("[::]", "127.0.0.1")
+                router.kv_set(_KV_PREFIX + str(router.rank), addr)
+                _state["server"] = srv
+            except Exception:            # noqa: BLE001 — engine absent
+                _state["failed"] = True
+                return None
+        return srv
+
+
+def try_register(router, data) -> Optional[dict]:
+    """Sender-side protocol switch: if ``data`` is a device array at or
+    above the eager limit and the transfer engine is up, register it
+    for pulling and return the descriptor to ship instead of bytes."""
+    if not _enabled():
+        return None
+    try:
+        import jax
+        if not isinstance(data, jax.Array):
+            return None
+    except Exception:                    # noqa: BLE001
+        return None
+    if data.nbytes < eager_limit() or data.ndim == 0:
+        return None
+    srv = _server(router)
+    if srv is None:
+        return None
+    uid = next(_uuid)
+    try:
+        srv.await_pull(uid, [data])
+    except Exception:                    # noqa: BLE001 — e.g. a
+        return None                      # sharded array the engine
+    #                                      rejects: host path instead
+    return {"kind": "devrndv", "uuid": uid, "src": router.rank,
+            "shape": tuple(data.shape), "dtype": str(data.dtype)}
+
+
+class DevPayload:
+    """Descriptor of a remote device buffer, resolved (pulled) lazily
+    on the CONSUMER thread — reader threads stay free to deliver other
+    frames. Carries the array metadata so probe/status byte counts are
+    right before resolution."""
+
+    def __init__(self, router, desc: dict):
+        self._router = router
+        self._desc = desc
+        self._result = None
+        self._done = False
+        self._rlock = threading.Lock()
+        self.shape = tuple(desc["shape"])
+        self.dtype = np.dtype(desc["dtype"])
+        self.size = int(np.prod(self.shape)) if self.shape else 1
+        self.nbytes = self.size * self.dtype.itemsize
+
+    def resolve(self):
+        from ompi_tpu.core.errhandler import (ERR_OTHER,
+                                              ERR_PROC_FAILED, MPIError)
+        with self._rlock:                # exactly-once, thread-safe
+            if self._done:
+                return self._result
+            import jax
+            src = int(self._desc["src"])
+            from ompi_tpu.runtime import ft
+            if ft.is_failed(src):        # ULFM fail-fast, not a hang
+                raise MPIError(ERR_PROC_FAILED,
+                               f"device payload source rank {src} "
+                               f"has failed before the pull")
+            with _lock:
+                conn = _conns.get(src)
+            if conn is None:
+                srv = _server(self._router)
+                if srv is None:
+                    raise MPIError(ERR_OTHER,
+                                   "PJRT transfer engine unavailable "
+                                   "on the receive side; peer sent a "
+                                   "device-rendezvous payload")
+                addr = self._router.kv_get(_KV_PREFIX + str(src))
+                conn = srv.connect(addr)
+                with _lock:
+                    _conns[src] = conn
+            sds = jax.ShapeDtypeStruct(
+                self.shape, self.dtype,
+                sharding=jax.sharding.SingleDeviceSharding(
+                    jax.local_devices()[0]))
+            try:
+                [out] = conn.pull(int(self._desc["uuid"]), [sds])
+            except Exception as e:       # noqa: BLE001 — a dying
+                # sender breaks the transport (TCP RST) and the pull
+                # raises; surface it as the process failure it is
+                raise MPIError(ERR_PROC_FAILED,
+                               f"device payload pull from rank {src} "
+                               f"failed: {type(e).__name__}: {e}")
+            self._result = out
+            self._done = True
+            return out
+
+
+def maybe_resolve(data):
+    """Consumer-side hook: pull a device payload through the transfer
+    plane; anything else passes through untouched."""
+    if isinstance(data, DevPayload):
+        return data.resolve()
+    return data
+
+
+def reset() -> None:
+    """Finalize: drop connections and the server (new jobs re-modex)."""
+    with _lock:
+        _conns.clear()
+        _state["server"] = None
+        _state["failed"] = False

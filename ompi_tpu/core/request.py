@@ -115,8 +115,13 @@ class Request:
 
     def get(self) -> Any:
         """Wait and return the operation's result value (framework
-        extension — the functional-API analogue of reading recvbuf)."""
+        extension — the functional-API analogue of reading recvbuf).
+        Device-rendezvous payloads resolve here, on the consumer
+        thread (covers persistent receives, whose completion copies
+        the inner request's raw result)."""
         self.wait()
+        from ompi_tpu.btl.devxfer import maybe_resolve
+        self._result = maybe_resolve(self._result)
         return self._result
 
     def cancel(self) -> None:

@@ -44,6 +44,8 @@ class Router:
     def __init__(self, rank: int, nprocs: int, kv_set, kv_get):
         self.rank = rank
         self.nprocs = nprocs
+        self.kv_set = kv_set             # the modex plane (devxfer
+        self.kv_get = kv_get             # publishes its address here)
         self._engines: Dict[Any, "PerRankEngine"] = {}
         self._pending: Dict[Any, List[Tuple[dict, bytes]]] = {}
         # ack id -> [Event, reply payload] (replies carry RMA get/fetch
@@ -238,6 +240,15 @@ class RankRequest(Request):
             raise self._error
         return self.status
 
+    def get(self):
+        """Wait (raising any stored ULFM error — the base contract)
+        and resolve a device-rendezvous payload on THIS (consumer)
+        thread — the pull must never run on a btl reader thread."""
+        self.wait()
+        from ompi_tpu.btl.devxfer import maybe_resolve
+        self._result = maybe_resolve(self._result)
+        return self._result
+
 
 def thread_request(job) -> RankRequest:
     """Run ``job`` on a daemon worker thread; the returned request
@@ -278,8 +289,15 @@ class PerRankEngine:
 
     # -- wire side -----------------------------------------------------
     def _incoming(self, header: dict, raw: bytes) -> None:
-        msg = _Msg(header["src"], header["tag"],
-                   decode_payload(header["desc"], raw),
+        d = header["desc"]
+        if d.get("kind") == "devrndv":
+            # descriptor-only frame: the device payload is pulled
+            # lazily on the consumer thread (btl/devxfer)
+            from ompi_tpu.btl.devxfer import DevPayload
+            payload = DevPayload(self.router, d)
+        else:
+            payload = decode_payload(d, raw)
+        msg = _Msg(header["src"], header["tag"], payload,
                    ack=(header["wsrc"], header["ack_id"])
                    if header.get("ack_id") else None)
         with self._lock:
@@ -340,11 +358,22 @@ class PerRankEngine:
             from ompi_tpu.core.errhandler import ERR_PROC_FAILED
             raise MPIError(ERR_PROC_FAILED,
                            f"send peer rank {dest} has failed")
-        desc, raw = encode_payload(data)
+        # protocol switch (pml_ob1_sendreq.h:389-460): large device
+        # arrays ride the PJRT transfer plane (register + descriptor-
+        # only header, receiver pulls D2D); everything else goes
+        # eager copy over the host byte path
+        from ompi_tpu.btl import devxfer
+        dev_desc = devxfer.try_register(self.router, data)
+        if dev_desc is not None:
+            desc, raw = dev_desc, b""
+            wire_bytes = int(data.nbytes)   # moved out-of-band (D2D)
+        else:
+            desc, raw = encode_payload(data)
+            wire_bytes = len(raw)
         me = self.comm.rank()
         t = self.traffic.setdefault((me, dest), [0, 0])
         t[0] += 1
-        t[1] += len(raw)
+        t[1] += wire_bytes
         header = {"cid": self.comm.cid, "src": me,
                   "tag": tag, "desc": desc}
         ent = aid = None
@@ -465,11 +494,11 @@ class PerRankEngine:
 
     @staticmethod
     def mrecv(msg: _Msg) -> Tuple[Any, Status]:
-        return msg.data, Status(source=msg.src, tag=msg.tag,
-                                count=int(getattr(msg.data, "size", 1)
-                                          or 1),
-                                nbytes=int(getattr(msg.data, "nbytes",
-                                                   -1)))
+        from ompi_tpu.btl.devxfer import maybe_resolve
+        data = maybe_resolve(msg.data)
+        return data, Status(source=msg.src, tag=msg.tag,
+                            count=int(getattr(data, "size", 1) or 1),
+                            nbytes=int(getattr(data, "nbytes", -1)))
 
     def close(self) -> None:
         self.router.unregister(self.comm.cid)

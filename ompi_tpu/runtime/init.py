@@ -190,6 +190,11 @@ def finalize() -> None:
             except Exception:
                 pass
         router.close()
+        # drop the device-transfer plane with the router: connections,
+        # the server, and any unpulled registrations (a stale server
+        # address must never leak into a later job's modex)
+        from ompi_tpu.btl import devxfer
+        devxfer.reset()
     _state["finalized"] = True
     _state["world"] = None
     _state["self"] = None

@@ -45,6 +45,7 @@ CASES = [
     ("p25_thread_multiple.py", 2),
     ("p26_churn.py", 3),
     ("p27_staged_coll.py", 3),
+    ("p28_devxfer.py", 3),
 ]
 
 
