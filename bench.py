@@ -275,6 +275,7 @@ def _perrank_child() -> None:
 
     from ompi_tpu.runtime.init import _state
     stats = dict(_state["router"].endpoint.stats)
+    probe = dict(getattr(_state["router"].endpoint, "probe_basis", {}))
     w.barrier()
     MPI.Finalize()
     if r == 0:
@@ -288,6 +289,7 @@ def _perrank_child() -> None:
             "pt2pt_16MB_rtt_d2d_ms": round(d2d_s * 1e3, 2),
             "pt2pt_16MB_rtt_host_ms": round(hostp_s * 1e3, 2),
             "transports": stats,
+            "btl_probe": probe,
         }), flush=True)
 
 

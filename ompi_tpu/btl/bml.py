@@ -82,6 +82,53 @@ def register_params() -> None:
                           "pml_ob1_sendreq.h:389-460 role)")
 
 
+def _probe_stream(chunk: int = 64 << 10, reps: int = 8
+                  ) -> "tuple[float, float]":
+    """~1 ms micro-probe of the two planes' stream mechanics on THIS
+    host: bytes/sec pushing+popping records through a loopback
+    /dev/shm ring (the sm bulk path's two memcpys and bookkeeping) vs
+    writing+reading a local socketpair (the tcp path's kernel
+    copies). Returns (sm_bps, tcp_bps)."""
+    import socket
+    import time
+
+    from ompi_tpu.btl.sm import Ring
+    payload = b"\x5a" * chunk
+
+    ring = Ring(None, capacity=max(2 * chunk + (1 << 12), 1 << 20),
+                create=True)
+    try:
+        ring.push(payload)               # warm the mapping
+        ring.pop()
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            ring.push(payload)
+            ring.pop()
+        sm_s = time.perf_counter() - t0
+    finally:
+        ring.close()
+    a, b = socket.socketpair()
+    try:
+        a.sendall(payload)               # warm the buffers
+        _drain_sock(b, chunk)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            a.sendall(payload)
+            _drain_sock(b, chunk)
+        tcp_s = time.perf_counter() - t0
+    finally:
+        a.close()
+        b.close()
+    total = float(reps * chunk)
+    return total / max(sm_s, 1e-9), total / max(tcp_s, 1e-9)
+
+
+def _drain_sock(sock, n: int) -> None:
+    got = 0
+    while got < n:
+        got += len(sock.recv(n - got))
+
+
 class BmlEndpoint:
     """Composite endpoint: TcpEndpoint surface, sm fast path.
 
@@ -132,6 +179,29 @@ class BmlEndpoint:
         # per-transport frame counts (the hook/comm_method selection
         # table's data source)
         self.stats = {"sm": 0, "tcp": 0, "self": 0}
+        # routing earns its defaults from DATA (round-3 postmortem:
+        # the sm "bandwidth plane" measurably lost to tcp on the CI
+        # host and the decision layer still routed bulk to it). A ~1ms
+        # local micro-probe measures both planes' stream mechanics; sm
+        # is demoted for bulk unless it actually wins. A user-set
+        # btl_sm_min_bytes (env/file/CLI) overrides the probe.
+        self.probe_basis: Dict[str, object] = {"ran": False}
+        if (self.sm is not None
+                and var.var_source("btl_sm_min_bytes")
+                in (None, var.SOURCE_DEFAULT)):
+            try:
+                sm_bps, tcp_bps = _probe_stream()
+                demote = sm_bps <= tcp_bps * 1.1
+                if demote:
+                    self._sm_min = 1 << 62   # bulk stays on tcp
+                self.probe_basis = {
+                    "ran": True,
+                    "sm_gbps": round(sm_bps / 1e9, 3),
+                    "tcp_gbps": round(tcp_bps / 1e9, 3),
+                    "sm_demoted": bool(demote),
+                }
+            except Exception:            # noqa: BLE001 — probe is
+                pass                     # advisory, never fatal
 
     # -- the TcpEndpoint surface the Router binds ----------------------
     @property

@@ -66,6 +66,12 @@ def table(comm) -> Dict:
     if ep is not None and hasattr(ep, "stats"):
         out["pt2pt_transports"] = dict(ep.stats)
         out["btl_sm"] = getattr(ep, "sm", None) is not None
+        # the MEASURED basis for the bulk-routing decision (the init
+        # micro-probe): operators see why sm carries bulk — or why it
+        # was demoted — instead of trusting a hard-coded default
+        basis = getattr(ep, "probe_basis", None)
+        if basis:
+            out["btl_probe"] = dict(basis)
     return out
 
 

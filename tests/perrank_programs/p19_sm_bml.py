@@ -5,6 +5,10 @@ and the mixed transports NEVER reorder a sender's stream (the ob1
 sequencing rule at the bml boundary)."""
 import os
 os.environ["JAX_PLATFORMS"] = "cpu"   # must beat any sitecustomize platform pin
+# Pin the routing threshold (env = user-set source): this program
+# tests the sm/bml MECHANICS, so the init micro-probe must not demote
+# sm on hosts where the ring measures slower than sockets.
+os.environ.setdefault("OMPI_TPU_MCA_btl_sm_min_bytes", str(32 << 10))
 import jax
 jax.config.update("jax_platforms", "cpu")
 import numpy as np               # noqa: E402
@@ -19,6 +23,8 @@ peer = 1 - r
 from ompi_tpu.runtime.init import _state        # noqa: E402
 ep = _state["router"].endpoint
 assert ep.sm is not None, "sm plane should be up on a same-host job"
+assert not ep.probe_basis.get("ran"), \
+    "user-set btl_sm_min_bytes must suppress the probe"
 
 # interleave small (tcp), medium (sm ring), and ring-busting (tcp
 # fallback) messages; the receiver must see them exactly in send order

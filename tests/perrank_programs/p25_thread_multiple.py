@@ -6,6 +6,10 @@ message must arrive intact, per-(thread-tag) in order, with none lost.
 """
 import os
 os.environ["JAX_PLATFORMS"] = "cpu"   # must beat any sitecustomize platform pin
+# pin the sm threshold (this program stresses the sm-ring producer
+# locks; the init micro-probe would otherwise demote sm on hosts
+# where the ring measures slower than sockets)
+os.environ.setdefault("OMPI_TPU_MCA_btl_sm_min_bytes", str(32 << 10))
 import jax
 jax.config.update("jax_platforms", "cpu")
 import threading                 # noqa: E402
