@@ -260,3 +260,126 @@ def test_ulysses_non_causal_and_head_guard(world, rng):
         ulysses_attention(np.zeros((1, 2, 3, 4), np.float32),
                           np.zeros((1, 2, 3, 4), np.float32),
                           np.zeros((1, 2, 3, 4), np.float32), c)
+
+
+def test_flash_attention_path_matches_dense(mpi, world):
+    """The flagship's flash local-attention path (ops/flash_attention
+    block kernel) is numerically the dense softmax attention."""
+    import jax
+    import jax.numpy as jnp
+    from ompi_tpu.models import transformer as T
+    cfg_d = T.Config(vocab=32, d_model=32, n_heads=4, n_layers=2,
+                     d_ff=64, seq=16, dtype=jnp.float32)
+    cfg_f = T.Config(vocab=32, d_model=32, n_heads=4, n_layers=2,
+                     d_ff=64, seq=16, dtype=jnp.float32,
+                     use_flash=True)
+    params = T.init_params(jax.random.PRNGKey(3), cfg_d)
+    toks = jax.random.randint(jax.random.PRNGKey(4), (2, 16), 0, 32)
+    a = T.forward(params, toks, cfg_d)
+    b = T.forward(params, toks, cfg_f)
+    assert jnp.allclose(a, b, atol=2e-4), float(jnp.abs(a - b).max())
+
+
+def test_pp_train_step_single_axis_matches_ref():
+    """pp_train_step with pp=1 on a 1-device mesh reduces to the plain
+    training step (same loss, same updated params)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+    from ompi_tpu.models import transformer as T
+    from ompi_tpu.parallel import InGraphComm
+
+    cfg = T.Config(vocab=32, d_model=32, n_heads=4, n_layers=2,
+                   d_ff=64, seq=8, dtype=jnp.float32)
+    key = jax.random.PRNGKey(0)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (4, 9), 0, 32)
+    batch = (toks[:, :-1], toks[:, 1:])
+
+    flat = T.init_params(key, cfg)
+    ref_p, ref_loss = jax.jit(
+        lambda p, b: T.sgd_train_step(p, b, cfg, 1e-2))(flat, batch)
+
+    pp_params = T.init_pp_params(key, cfg, pp=1)
+    mesh = Mesh(np.array(jax.devices()[:1]), ("pp",))
+    pp = InGraphComm("pp", 1)
+
+    def step(p, i, t):
+        return T.pp_train_step(p, (i, t), cfg, 1e-2, pp_comm=pp,
+                               n_micro=2)
+    try:
+        smap = jax.shard_map(step, mesh=mesh,
+                             in_specs=(P(), P(), P()),
+                             out_specs=(P(), P()), check_vma=False)
+    except TypeError:
+        smap = jax.shard_map(step, mesh=mesh,
+                             in_specs=(P(), P(), P()),
+                             out_specs=(P(), P()), check_rep=False)
+    new_p, loss = jax.jit(smap)(pp_params, *batch)
+    assert jnp.allclose(loss, ref_loss, atol=1e-5), (loss, ref_loss)
+    # spot-check one stage weight evolved identically to the flat ref
+    w_ref = ref_p["tp"]["layers"][1]["w1"]
+    w_pp = new_p["stage"][1]["w1"][0]
+    assert jnp.allclose(w_ref, w_pp, atol=1e-5), \
+        float(jnp.abs(w_ref - w_pp).max())
+
+
+def test_moe_grads_keep_replicated_params_replicated():
+    """The Megatron f operator on the MoE path: gradients of
+    tp-replicated params (norms, gate, rep) must be IDENTICAL across
+    tp ranks — a per-rank partial would silently diverge them."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from ompi_tpu.models import transformer as T
+    from ompi_tpu.parallel import InGraphComm
+    from __graft_entry__ import _stage_specs
+
+    cfg = T.Config(vocab=32, d_model=16, n_heads=4, n_layers=2,
+                   d_ff=32, seq=8, dtype=jnp.float32, moe=True,
+                   moe_experts=2)
+    params = T.init_pp_params(jax.random.PRNGKey(0), cfg, pp=1)
+    mesh = Mesh(np.array(jax.devices()[:2]).reshape(1, 2),
+                ("pp", "tp"))
+    specs = _stage_specs(params, cfg, P)
+    params = jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        params, specs)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (4, 9), 0, 32)
+    pp = InGraphComm("pp", 1)
+    tp = InGraphComm("tp", 2)
+
+    def divergence(p, i, t):
+        def loss(p):
+            # reuse the step's loss plumbing via grad of pp_train_step
+            # internals: one forward through the layer stack
+            x = p["rep"]["emb"][i].astype(cfg.dtype)
+            causal = jnp.tril(jnp.ones((i.shape[1],) * 2, jnp.bool_))
+            for lay in p["stage"]:
+                lr_ = {"ln1": lay["ln1"][0], "ln2": lay["ln2"][0]}
+                lt_ = {k: v[0] for k, v in lay.items()
+                       if k not in ("ln1", "ln2")}
+                x = T._layer(x, lr_, lt_, causal, cfg, tp, None, tp)
+            h = T._rmsnorm(x, p["rep"]["ln_f"])
+            logits = jnp.einsum("bsd,vd->bsv",
+                                h.astype(jnp.float32), p["rep"]["emb"])
+            lp = jax.nn.log_softmax(logits, axis=-1)
+            return jnp.mean(-jnp.take_along_axis(lp, t[..., None],
+                                                 axis=-1))
+        g = jax.grad(loss)(p)
+        reps = [g["rep"]["emb"], g["rep"]["ln_f"]] + \
+            [lay[k] for lay in g["stage"] for k in ("ln1", "ln2")]
+        div = sum(jnp.sum((x - tp.pmean(x)) ** 2) for x in reps)
+        return tp.pmean(div)
+
+    try:
+        smap = jax.shard_map(divergence, mesh=mesh,
+                             in_specs=(specs, P(), P()),
+                             out_specs=P(), check_vma=False)
+    except TypeError:
+        smap = jax.shard_map(divergence, mesh=mesh,
+                             in_specs=(specs, P(), P()),
+                             out_specs=P(), check_rep=False)
+    div = jax.jit(smap)(params, toks[:, :-1], toks[:, 1:])
+    assert float(div) < 1e-9, float(div)
