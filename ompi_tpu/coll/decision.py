@@ -84,8 +84,11 @@ FIXED_RULES: Dict[str, List[Sequence]] = {
 REORDERING = frozenset({
     "ring", "ring_segmented", "hier", "recursive_doubling",
     "rabenseifner", "rabenseifner_root", "knomial",
-    "recursive_halving",
+    "recursive_halving", "butterfly",
 })
+# reduce/in_order_binary is deliberately ABSENT from REORDERING: it is
+# the one tree whose combine order equals rank order — the registry's
+# non-commutative-correct choice (coll_base_functions.h:276).
 
 # (collective, algorithm) pairs exempt from the REORDERING demotion:
 # the name reorders in one collective but is order-preserving in

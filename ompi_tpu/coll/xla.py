@@ -525,6 +525,58 @@ class XlaCollModule:
             return jnp.take(buf, idx, axis=0)[None]
         return inner
 
+    def _in_order_binary_reduce_inner(self, op, n, root):
+        """In-order binary-tree reduce (coll_base_functions.h:276,
+        coll_base_reduce.c in_order_binary) — the ONE tree whose
+        combine order equals rank order, so it is correct for
+        NON-commutative (associative) operators: at distance d, rank r
+        with r % 2d == 0 folds rank r+d's accumulator on its RIGHT
+        (acc covers [r, r+d); the peer's covers [r+d, r+2d)). Any n;
+        result lands on rank 0 and rides one ppermute to root."""
+        def inner(b):                    # (1, *s) -> (1, *s) at root
+            x = b
+            r = jax.lax.axis_index(AXIS)
+            acc = x
+            d = 1
+            while d < n:
+                perm = [(i, (i - d) % n) for i in range(n)]
+                recvd = jax.lax.ppermute(acc, AXIS, perm=perm)
+                combine = (jnp.mod(r, 2 * d) == 0) & (r + d < n)
+                acc = jnp.where(combine, op.fn(acc, recvd), acc)
+                d *= 2
+            if root != 0:
+                moved = jax.lax.ppermute(acc, AXIS, perm=[(0, root)])
+                acc = jnp.where(r == root, moved, acc)
+            return acc
+        return inner
+
+    def _sparbit_allgather_inner(self, n):
+        """Sparbit allgather (coll_base_functions.h:191,
+        coll_base_allgather.c sparbit): distance-doubling exchange
+        that writes received blocks straight into their ABSOLUTE
+        slots, guided by a bitmap of held blocks — bruck's round count
+        (ceil(log2 n), any n) without bruck's final local rotation.
+        The sparse bitmap is the algorithm's namesake; here it is a
+        boolean lane mask the masks select on."""
+        def inner(b):                    # (1, *s) -> (1, n, *s)
+            x = b[0]
+            r = jax.lax.axis_index(AXIS)
+            buf = jnp.zeros((n,) + x.shape, x.dtype)
+            buf = jax.lax.dynamic_update_index_in_dim(buf, x, r, 0)
+            have = jnp.arange(n) == r            # the bitmap
+            dist = 1
+            extra = (1,) * x.ndim
+            while dist < n:
+                perm = [(i, (i - dist) % n) for i in range(n)]
+                recvd = jax.lax.ppermute(buf, AXIS, perm=perm)
+                rhave = jax.lax.ppermute(have, AXIS, perm=perm)
+                take = rhave & ~have
+                buf = jnp.where(take.reshape((n,) + extra), recvd, buf)
+                have = have | rhave
+                dist *= 2
+            return buf[None]
+        return inner
+
     def _binomial_bcast_inner(self, n, root):
         """Binomial-tree bcast (ompi_coll_base_bcast_intra_binomial):
         ceil(log2 n) rounds; in round k, virtual ranks < 2^k forward to
@@ -808,6 +860,61 @@ class XlaCollModule:
             return x                     # (1, *s): my reduced block
         return inner
 
+    def _butterfly_rsb_inner(self, op, n):
+        """Butterfly reduce_scatter (coll_base_functions.h:284,
+        coll_base_reduce_scatter.c butterfly): XOR-distance vector
+        halving for ANY member count — the capability the
+        recursive_halving row lacks. Excess ranks beyond the largest
+        power of two fold their vector into a proxy, the pow2 core
+        runs the halving butterfly over a zero-padded block vector
+        (per-index identity keeps padding inert for every op), and
+        proxies ship the excess ranks' finished blocks back."""
+        n2 = 1
+        while n2 * 2 <= n:
+            n2 *= 2
+        rem = n - n2
+        B2 = 2 * n2 if rem else n2
+
+        def inner(b):                    # (1, n, *s) -> (1, *s)
+            x = b[0]                     # (n, *s)
+            r = jax.lax.axis_index(AXIS)
+            if B2 > n:
+                pad = jnp.zeros((B2 - n,) + x.shape[1:], x.dtype)
+                x = jnp.concatenate([x, pad], axis=0)
+            if rem:
+                # excess ranks fold their whole vector into proxies
+                recvd = jax.lax.ppermute(
+                    x, AXIS, perm=[(i, i - n2) for i in range(n2, n)])
+                is_proxy = r < rem
+                x = jnp.where(is_proxy, op.fn(x, recvd), x)
+            d = n2 // 2
+            while d >= 1:
+                m = x.shape[0] // 2
+                lo, hi = x[:m], x[m:]
+                upper = (r & d) != 0
+                to_send = jnp.where(upper, lo, hi)
+                perm = [(i, i ^ d) for i in range(n2)]
+                recvd = jax.lax.ppermute(to_send, AXIS, perm=perm)
+                kept = jnp.where(upper, hi, lo)
+                x = jnp.where(upper, op.fn(recvd, kept),
+                              op.fn(kept, recvd))
+                d //= 2
+            if not rem:
+                return x                 # (1, *s): my reduced block
+            # padded (2*n2-block) space: the rounds consume rank bits
+            # against the TOP block bits, so core rank q ends holding
+            # the contiguous pair {2q, 2q+1} — ship each block to its
+            # owner (block j lives at rank j//2, slot j%2)
+            even = jax.lax.ppermute(
+                x[0:1], AXIS,
+                perm=[(q, 2 * q) for q in range(n2) if 2 * q < n])
+            odd = jax.lax.ppermute(
+                x[1:2], AXIS,
+                perm=[(q, 2 * q + 1) for q in range(n2)
+                      if 2 * q + 1 < n])
+            return jnp.where(jnp.mod(r, 2) == 0, even, odd)
+        return inner
+
     def _bruck_alltoall_inner(self, n):
         """Bruck alltoall (ompi_coll_base_alltoall_intra_bruck):
         ceil(log2 n) rounds instead of pairwise's n-1 — the
@@ -1085,6 +1192,13 @@ class XlaCollModule:
                 return self._smap(inner, x.ndim, x.ndim)
             fn = self._compiled(
                 self._key("reduce", x, op.uid, n, root, alg), build, x)
+        elif alg == "in_order_binary" and n > 1:
+            # the non-commutative-correct tree: no commute constraint
+            def build():
+                inner = self._in_order_binary_reduce_inner(op, n, root)
+                return self._smap(inner, x.ndim, x.ndim)
+            fn = self._compiled(
+                self._key("reduce", x, op.uid, n, root, alg), build, x)
         elif alg != "rabenseifner_root" or op.xla_prim != "sum" or n == 1:
             fn = lambda xx, _op=op: self.allreduce(xx, _op)  # noqa: E731
         else:
@@ -1168,6 +1282,8 @@ class XlaCollModule:
                 inner = self._ring_allgather_inner(n)
             elif alg == "bruck":
                 inner = self._bruck_allgather_inner(n)
+            elif alg == "sparbit" and n > 1:
+                inner = self._sparbit_allgather_inner(n)
             elif alg == "neighborexchange" and n % 2 == 0 and n > 1:
                 inner = self._neighborexchange_allgather_inner(n)
             elif alg == "two_procs" and n == 2:
@@ -1283,6 +1399,8 @@ class XlaCollModule:
                 inner = self._ring_reduce_scatter_inner(op, n)
             elif alg == "recursive_halving" and n > 1:
                 inner = self._rhalving_rsb_inner(op, n)
+            elif alg == "butterfly" and n > 1:
+                inner = self._butterfly_rsb_inner(op, n)
             elif op.xla_prim == "sum":
                 def inner(b):                   # (1, N, *s) -> (1, *s)
                     return jax.lax.psum_scatter(b[0], AXIS,
@@ -1434,10 +1552,12 @@ class XlaCollComponent(Component):
         var.var_register(
             "coll", "xla", "allgather_algorithm", vtype="str",
             default="auto",
-            enumerator=["auto", "direct", "ring", "bruck", "hier",
-                        "neighborexchange", "two_procs"],
+            enumerator=["auto", "direct", "ring", "bruck", "sparbit",
+                        "hier", "neighborexchange", "two_procs"],
             help="Allgather lowering: fused XLA all_gather, explicit "
-                 "neighbor-shift ring, or log-round Bruck doubling")
+                 "neighbor-shift ring, log-round Bruck doubling, or "
+                 "sparbit (bruck's rounds, absolute-slot writes, no "
+                 "final rotation)")
         var.var_register(
             "coll", "xla", "bcast_algorithm", vtype="str",
             default="auto",
@@ -1457,10 +1577,12 @@ class XlaCollComponent(Component):
             "coll", "xla", "reduce_algorithm", vtype="str",
             default="auto",
             enumerator=["auto", "alias", "rabenseifner_root",
-                        "knomial"],
-            help="Reduce lowering: allreduce alias (one fused psum) or "
+                        "knomial", "in_order_binary"],
+            help="Reduce lowering: allreduce alias (one fused psum), "
                  "root-targeted redscat+binomial-collect (half the "
-                 "alias's wire traffic; sum ops)")
+                 "alias's wire traffic; sum ops), or the in-order "
+                 "binary tree (rank-ordered combines — correct for "
+                 "non-commutative ops)")
         var.var_register(
             "coll", "xla", "gather_algorithm", vtype="str",
             default="auto", enumerator=["auto", "allgather", "binomial"],
@@ -1475,10 +1597,11 @@ class XlaCollComponent(Component):
             "coll", "xla", "reduce_scatter_block_algorithm", vtype="str",
             default="auto",
             enumerator=["auto", "direct", "ring", "recursive_halving",
-                        "hier"],
+                        "butterfly", "hier"],
             help="Reduce_scatter_block lowering: fused psum_scatter, "
-                 "explicit accumulating ring, or recursive halving "
-                 "(log rounds; power-of-two sizes)")
+                 "explicit accumulating ring, recursive halving (log "
+                 "rounds; power-of-two sizes), or butterfly (halving "
+                 "for ANY member count via proxy fold-in)")
         var.var_register(
             "coll", "xla", "scan_algorithm", vtype="str",
             default="auto",

@@ -16,8 +16,9 @@ strategy table made concrete in a single composed program:
   ``lax.scan``; backward is AD through the shifts).
 - **ep**: Switch-style MoE MLPs with one expert per rank of the
   expert axis (``moe_apply``: two alltoalls dispatch/combine).
-- local attention lowers through ``ops/flash_attention`` (pallas on
-  TPU, exact jnp fold elsewhere) when ``cfg.use_flash``.
+- local attention lowers through ``ops/flash_attention``'s
+  differentiable online-softmax fold when ``cfg.use_flash`` (the
+  pallas kernel serves forward-only uses until a custom VJP lands).
 
 Layout: bfloat16 activations, float32 params — MXU-friendly.
 """
@@ -160,6 +161,13 @@ def _mlp(x, lt: Dict, cfg: Config, tp_comm: Optional[InGraphComm],
     configured, Megatron column/row pair otherwise. ``x`` is the
     ln2-normalized input (already copy_in'd for tp)."""
     if cfg.moe and ep_comm is not None:
+        # the Megatron f operator over the EXPERT axis — identity
+        # forward, psum backward. Each expert rank consumes only its
+        # token shard (dynamic_slice below); without the backward psum
+        # every upstream cotangent (ln/wqkv/wo/emb) would be a
+        # per-rank partial and "replicated" params would silently
+        # diverge — regardless of whether ep rides the tp axis
+        x = ep_comm.copy_in(x)
         B, S, D = x.shape
         E = ep_comm._size
         assert cfg.moe_experts in (0, E), (
@@ -215,12 +223,10 @@ def _layer(x, lr: Dict, lt: Dict, causal, cfg: Config,
         o = tp_comm.reduce_out(o)                      # row-parallel sum
     x = x + o
     h = _rmsnorm(x, lr["ln2"])
-    if tp_comm is not None:
-        # the Megatron f operator — identity forward, psum backward —
-        # is REQUIRED on the MoE path too: each expert rank consumes
-        # only its token shard, so without the backward psum every
-        # upstream cotangent (ln/wqkv/wo/emb) would be a per-rank
-        # partial and "replicated" params would silently diverge
+    if tp_comm is not None and not (cfg.moe and ep_comm is not None):
+        # dense Megatron pair: f operator here, g (reduce_out) in _mlp.
+        # The MoE branch applies its own f over the EP axis instead —
+        # applying both on the same axis would double the backward psum
         h = tp_comm.copy_in(h)
     return x + _mlp(h, lt, cfg, tp_comm, ep_comm)
 

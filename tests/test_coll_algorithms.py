@@ -288,3 +288,64 @@ def test_scan_rd_on_odd_size_subcomm(mpi, world, alg):
     for r in range(1, 3):
         acc = acc + rows[r]
         assert np.allclose(y[r], acc), r
+
+
+def test_allgather_sparbit(mpi, world, alg):
+    rows, x = _rank_data(world, (3,), seed=21)
+    alg("allgather", "sparbit")
+    y = np.asarray(world.allgather(x))
+    want = np.stack(rows)
+    for r in range(world.size):
+        assert np.allclose(y[r], want, atol=1e-6), r
+
+
+def test_reduce_scatter_butterfly(mpi, world, alg):
+    rows, x = _rank_data(world, (world.size, 4), seed=22)
+    alg("reduce_scatter_block", "butterfly")
+    y = np.asarray(world.reduce_scatter_block(x, mpi.SUM))
+    want = np.sum(rows, axis=0)          # (n, 4): row r -> rank r
+    for r in range(world.size):
+        assert np.allclose(y[r], want[r], atol=1e-4), r
+    ymax = np.asarray(world.reduce_scatter_block(x, mpi.MAX))
+    wmax = np.max(rows, axis=0)
+    for r in range(world.size):
+        assert np.allclose(ymax[r], wmax[r]), r
+
+
+def test_reduce_scatter_butterfly_odd_subcomm(mpi, world, alg):
+    """The registry row butterfly exists for: halving on a NON-power-
+    of-two member count (recursive_halving demotes there)."""
+    n = world.size
+    if n < 3:
+        pytest.skip("needs >= 3 ranks")
+    subs = world.split([0] * 3 + [mpi.UNDEFINED] * (n - 3))
+    sub = subs[0]
+    assert sub is not None and sub.size == 3
+    rng = np.random.default_rng(23)
+    rows = [rng.standard_normal((3, 2)).astype(np.float32) + r
+            for r in range(3)]
+    x = sub.stack(rows)
+    alg("reduce_scatter_block", "butterfly")
+    y = np.asarray(sub.reduce_scatter_block(x, mpi.SUM))
+    want = np.sum(rows, axis=0)
+    for r in range(3):
+        assert np.allclose(y[r], want[r], atol=1e-4), r
+
+
+@pytest.mark.parametrize("root", [0, 3])
+def test_reduce_in_order_binary(mpi, world, alg, root):
+    rows, x = _rank_data(world, (4,), seed=24)
+    alg("reduce", "in_order_binary")
+    y = np.asarray(world.reduce(x, mpi.SUM, root))
+    assert np.allclose(y[root], np.sum(rows, axis=0), atol=1e-4)
+
+
+def test_reduce_in_order_binary_non_commutative(mpi, world, alg):
+    """THE point of the in-order tree: a non-commutative (associative)
+    op reduces in exact rank order — no demotion to direct needed."""
+    rows, x = _rank_data(world, (3,), seed=25)
+    f = mpi.op_create(lambda a, b: b, commute=False)  # right-take
+    alg("reduce", "in_order_binary")
+    y = np.asarray(world.reduce(x, f, 0))
+    # ordered fold of right-take == the LAST rank's row
+    assert np.allclose(y[0], rows[world.size - 1], atol=1e-6)
