@@ -175,6 +175,29 @@ typedef long long MPI_Offset;
 #define MPI_THREAD_SERIALIZED 2
 #define MPI_THREAD_MULTIPLE   3
 
+/* ---- MPI_T (tool information interface) ---- */
+typedef long MPI_T_cvar_handle;
+typedef long MPI_T_pvar_handle;
+typedef long MPI_T_pvar_session;
+typedef long MPI_T_enum;
+#define MPI_T_ENUM_NULL ((MPI_T_enum)0)
+#define MPI_T_CVAR_HANDLE_NULL ((MPI_T_cvar_handle)-1)
+#define MPI_T_PVAR_HANDLE_NULL ((MPI_T_pvar_handle)-1)
+#define MPI_T_PVAR_SESSION_NULL ((MPI_T_pvar_session)0)
+#define MPI_T_VERBOSITY_USER_BASIC 1
+#define MPI_T_VERBOSITY_USER_DETAIL 2
+#define MPI_T_VERBOSITY_USER_ALL 3
+#define MPI_T_BIND_NO_OBJECT 0
+#define MPI_T_SCOPE_CONSTANT 0
+#define MPI_T_SCOPE_READONLY 1
+#define MPI_T_SCOPE_LOCAL 2
+#define MPI_T_SCOPE_ALL_EQ 5
+#define MPI_T_PVAR_CLASS_COUNTER 4
+#define MPI_T_ERR_INVALID_NAME 73
+#define MPI_T_ERR_INVALID_INDEX 74
+#define MPI_T_ERR_INVALID 76
+#define MPI_T_ERR_NOT_INITIALIZED 77
+
 /* ---- status ---- */
 typedef struct MPI_Status {
     int MPI_SOURCE;
@@ -636,6 +659,41 @@ int MPI_File_read_shared(MPI_File fh, void *buf, int count,
 int MPI_File_get_size(MPI_File fh, MPI_Offset *size);
 int MPI_File_set_size(MPI_File fh, MPI_Offset size);
 int MPI_File_sync(MPI_File fh);
+
+/* ---- MPI_T: cvar/pvar enumeration, read, write ---- */
+int MPI_T_init_thread(int required, int *provided);
+int MPI_T_finalize(void);
+int MPI_T_cvar_get_num(int *num_cvar);
+int MPI_T_cvar_get_info(int cvar_index, char *name, int *name_len,
+                        int *verbosity, MPI_Datatype *datatype,
+                        MPI_T_enum *enumtype, char *desc,
+                        int *desc_len, int *bind, int *scope);
+int MPI_T_cvar_get_index(const char *name, int *cvar_index);
+int MPI_T_cvar_handle_alloc(int cvar_index, void *obj_handle,
+                            MPI_T_cvar_handle *handle, int *count);
+int MPI_T_cvar_handle_free(MPI_T_cvar_handle *handle);
+int MPI_T_cvar_read(MPI_T_cvar_handle handle, void *buf);
+int MPI_T_cvar_write(MPI_T_cvar_handle handle, const void *buf);
+int MPI_T_pvar_get_num(int *num_pvar);
+int MPI_T_pvar_get_info(int pvar_index, char *name, int *name_len,
+                        int *verbosity, int *var_class,
+                        MPI_Datatype *datatype, MPI_T_enum *enumtype,
+                        char *desc, int *desc_len, int *bind,
+                        int *readonly, int *continuous, int *atomic);
+int MPI_T_pvar_get_index(const char *name, int *pvar_index);
+int MPI_T_pvar_session_create(MPI_T_pvar_session *session);
+int MPI_T_pvar_session_free(MPI_T_pvar_session *session);
+int MPI_T_pvar_handle_alloc(MPI_T_pvar_session session, int pvar_index,
+                            void *obj_handle,
+                            MPI_T_pvar_handle *handle, int *count);
+int MPI_T_pvar_handle_free(MPI_T_pvar_session session,
+                           MPI_T_pvar_handle *handle);
+int MPI_T_pvar_start(MPI_T_pvar_session session,
+                     MPI_T_pvar_handle handle);
+int MPI_T_pvar_stop(MPI_T_pvar_session session,
+                    MPI_T_pvar_handle handle);
+int MPI_T_pvar_read(MPI_T_pvar_session session,
+                    MPI_T_pvar_handle handle, void *buf);
 
 /* ---- PMPI profiling interface ----
  * Every MPI_X above has a PMPI_X twin (generated from this header by

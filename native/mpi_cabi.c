@@ -21,6 +21,7 @@
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
 
+#include <stdarg.h>
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
@@ -4478,6 +4479,356 @@ int PMPI_Get_elements(const MPI_Status *status, MPI_Datatype datatype,
     if (!base)
         return MPI_ERR_TYPE;
     *count = (int)((size_t)status->_count / base);
+    return MPI_SUCCESS;
+}
+
+
+/* ------------------------------------------------------------------ */
+/* MPI_T: the tool information interface (ompi/mpi/tool/*) — cvar and
+ * pvar enumeration/read/write with stable indices; handles carry the
+ * index. MPI_T is usable BEFORE MPI_Init (T_init_thread brings the
+ * interpreter up itself) and its errors are RETURN-ONLY: failures
+ * come back as MPI_T_ERR_* codes, never through the MPI errhandler
+ * machinery (which may abort).                                        */
+/* ------------------------------------------------------------------ */
+static int g_t_inited;
+
+/* string cvar handles advertise this element count; reads are bounded
+ * to it (the MPI_T contract sizes the caller's buffer from count) */
+#define T_CVAR_STR_MAX 256
+
+static int t_ensure_python(void)
+{
+    if (!Py_IsInitialized()) {
+        Py_InitializeEx(0);
+        g_owns_interp = 1;
+    }
+    PyGILState_STATE gst = PyGILState_Ensure();
+    int ok = ensure_module() == 0;
+    PyGILState_Release(gst);
+    if (ok && g_owns_interp == 1) {
+        PyEval_SaveThread();
+        g_owns_interp = 2;
+    }
+    return ok ? MPI_SUCCESS : MPI_T_ERR_INVALID;
+}
+
+int PMPI_T_init_thread(int required, int *provided)
+{
+    (void)required;
+    int rc = t_ensure_python();
+    if (rc != MPI_SUCCESS)
+        return rc;
+    if (provided)
+        *provided = MPI_THREAD_MULTIPLE;
+    g_t_inited++;
+    return MPI_SUCCESS;
+}
+
+int PMPI_T_finalize(void)
+{
+    if (g_t_inited <= 0)
+        return MPI_T_ERR_NOT_INITIALIZED;
+    g_t_inited--;
+    return MPI_SUCCESS;
+}
+
+/* Call one glue function; Python exceptions become err_code, never
+ * the errhandler machinery. Returns NULL on failure with the GIL
+ * released. */
+static PyObject *t_call(const char *fn, const char *fmt, ...)
+{
+    if (!Py_IsInitialized() || !g_mod)
+        return NULL;
+    va_list ap;
+    va_start(ap, fmt);
+    PyGILState_STATE gst = PyGILState_Ensure();
+    PyObject *meth = PyObject_GetAttrString(g_mod, fn);
+    PyObject *r = NULL;
+    if (meth) {
+        PyObject *args = fmt && fmt[0]
+            ? Py_VaBuildValue(fmt, ap) : PyTuple_New(0);
+        if (args && !PyTuple_Check(args)) {
+            PyObject *t = PyTuple_Pack(1, args);
+            Py_DECREF(args);
+            args = t;
+        }
+        if (args) {
+            r = PyObject_CallObject(meth, args);
+            Py_DECREF(args);
+        }
+        Py_DECREF(meth);
+    }
+    if (!r)
+        PyErr_Clear();                   /* RETURN-only error model */
+    PyGILState_Release(gst);
+    va_end(ap);
+    return r;                            /* caller holds no GIL; only
+                                          * reads/decrefs the result
+                                          * under t_take */
+}
+
+/* result accessors re-acquire the GIL briefly */
+static long t_long(PyObject *r, int slot, long dflt)
+{
+    PyGILState_STATE gst = PyGILState_Ensure();
+    PyObject *item = slot < 0 ? r : PyTuple_GetItem(r, slot);
+    long v = item ? PyLong_AsLong(item) : dflt;
+    if (PyErr_Occurred()) {
+        PyErr_Clear();
+        v = dflt;
+    }
+    PyGILState_Release(gst);
+    return v;
+}
+
+static void t_str(PyObject *r, int slot, char *buf, int *len, int cap)
+{
+    PyGILState_STATE gst = PyGILState_Ensure();
+    PyObject *item = slot < 0 ? r : PyTuple_GetItem(r, slot);
+    const char *c = item ? PyUnicode_AsUTF8(item) : NULL;
+    if (PyErr_Occurred())
+        PyErr_Clear();
+    size_t n = c ? strlen(c) : 0;
+    int limit = cap;
+    if (len && *len > 0 && (limit <= 0 || *len < limit))
+        limit = *len;
+    if (buf && limit > 0) {
+        size_t m = n;
+        if (m > (size_t)limit - 1)
+            m = (size_t)limit - 1;
+        memcpy(buf, c ? c : "", m);
+        buf[m] = '\0';
+    }
+    if (len)
+        *len = (int)n + 1;
+    PyGILState_Release(gst);
+}
+
+static void t_drop(PyObject *r)
+{
+    PyGILState_STATE gst = PyGILState_Ensure();
+    Py_XDECREF(r);
+    PyGILState_Release(gst);
+}
+
+int PMPI_T_cvar_get_num(int *num_cvar)
+{
+    PyObject *r = t_call("t_cvar_get_num", NULL);
+    if (!r)
+        return MPI_T_ERR_NOT_INITIALIZED;
+    *num_cvar = (int)t_long(r, -1, 0);
+    t_drop(r);
+    return MPI_SUCCESS;
+}
+
+int PMPI_T_cvar_get_info(int cvar_index, char *name, int *name_len,
+                         int *verbosity, MPI_Datatype *datatype,
+                         MPI_T_enum *enumtype, char *desc,
+                         int *desc_len, int *bind, int *scope)
+{
+    PyObject *r = t_call("t_cvar_get_info", "(i)", cvar_index);
+    if (!r)
+        return MPI_T_ERR_INVALID_INDEX;
+    t_str(r, 0, name, name_len, 0);
+    char ty[16] = {0};
+    int tylen = sizeof(ty);
+    t_str(r, 1, ty, &tylen, sizeof(ty));
+    if (datatype)
+        *datatype = strcmp(ty, "str") == 0 ? MPI_CHAR : MPI_INT;
+    t_str(r, 2, desc, desc_len, 0);
+    if (verbosity)
+        *verbosity = MPI_T_VERBOSITY_USER_BASIC;
+    if (enumtype)
+        *enumtype = MPI_T_ENUM_NULL;
+    if (bind)
+        *bind = MPI_T_BIND_NO_OBJECT;
+    if (scope)
+        *scope = MPI_T_SCOPE_ALL_EQ;
+    t_drop(r);
+    return MPI_SUCCESS;
+}
+
+int PMPI_T_cvar_get_index(const char *name, int *cvar_index)
+{
+    PyObject *r = t_call("t_cvar_get_index", "(s)", name);
+    if (!r)
+        return MPI_T_ERR_INVALID_NAME;
+    *cvar_index = (int)t_long(r, -1, -1);
+    t_drop(r);
+    return MPI_SUCCESS;
+}
+
+static int t_cvar_kind_of(int idx)
+{
+    PyObject *r = t_call("t_cvar_kind", "(i)", idx);
+    if (!r)
+        return -1;
+    int k = (int)t_long(r, -1, -1);
+    t_drop(r);
+    return k;
+}
+
+int PMPI_T_cvar_handle_alloc(int cvar_index, void *obj_handle,
+                             MPI_T_cvar_handle *handle, int *count)
+{
+    (void)obj_handle;
+    int kind = t_cvar_kind_of(cvar_index);
+    if (kind < 0)
+        return MPI_T_ERR_INVALID_INDEX;
+    *handle = (MPI_T_cvar_handle)cvar_index;
+    if (count)                           /* the caller sizes its read
+                                          * buffer from this */
+        *count = kind ? T_CVAR_STR_MAX : 1;
+    return MPI_SUCCESS;
+}
+
+int PMPI_T_cvar_handle_free(MPI_T_cvar_handle *handle)
+{
+    *handle = MPI_T_CVAR_HANDLE_NULL;
+    return MPI_SUCCESS;
+}
+
+int PMPI_T_cvar_read(MPI_T_cvar_handle handle, void *buf)
+{
+    PyObject *r = t_call("t_cvar_read", "(i)", (int)handle);
+    if (!r)
+        return MPI_T_ERR_INVALID_INDEX;
+    if (t_long(r, 0, 0)) {
+        int len = T_CVAR_STR_MAX;
+        t_str(r, 2, (char *)buf, &len, T_CVAR_STR_MAX);
+    } else {
+        *(int *)buf = (int)t_long(r, 1, 0);
+    }
+    t_drop(r);
+    return MPI_SUCCESS;
+}
+
+int PMPI_T_cvar_write(MPI_T_cvar_handle handle, const void *buf)
+{
+    int kind = t_cvar_kind_of((int)handle);
+    if (kind < 0)
+        return MPI_T_ERR_INVALID_INDEX;
+    PyObject *r = kind
+        ? t_call("t_cvar_write_str", "(is)", (int)handle,
+                 (const char *)buf)
+        : t_call("t_cvar_write_int", "(ii)", (int)handle,
+                 *(const int *)buf);
+    if (!r)
+        return MPI_T_ERR_INVALID;
+    t_drop(r);
+    return MPI_SUCCESS;
+}
+
+int PMPI_T_pvar_get_num(int *num_pvar)
+{
+    PyObject *r = t_call("t_pvar_get_num", NULL);
+    if (!r)
+        return MPI_T_ERR_NOT_INITIALIZED;
+    *num_pvar = (int)t_long(r, -1, 0);
+    t_drop(r);
+    return MPI_SUCCESS;
+}
+
+int PMPI_T_pvar_get_info(int pvar_index, char *name, int *name_len,
+                         int *verbosity, int *var_class,
+                         MPI_Datatype *datatype, MPI_T_enum *enumtype,
+                         char *desc, int *desc_len, int *bind,
+                         int *readonly, int *continuous, int *atomic)
+{
+    PyObject *r = t_call("t_pvar_get_info", "(i)", pvar_index);
+    if (!r)
+        return MPI_T_ERR_INVALID_INDEX;
+    t_str(r, 0, name, name_len, 0);
+    t_str(r, 2, desc, desc_len, 0);
+    if (verbosity)
+        *verbosity = MPI_T_VERBOSITY_USER_BASIC;
+    if (var_class)
+        *var_class = MPI_T_PVAR_CLASS_COUNTER;
+    if (datatype)
+        *datatype = MPI_UNSIGNED_LONG_LONG;
+    if (enumtype)
+        *enumtype = MPI_T_ENUM_NULL;
+    if (bind)
+        *bind = MPI_T_BIND_NO_OBJECT;
+    if (readonly)
+        *readonly = 1;
+    if (continuous)
+        *continuous = 1;
+    if (atomic)
+        *atomic = 0;
+    t_drop(r);
+    return MPI_SUCCESS;
+}
+
+int PMPI_T_pvar_get_index(const char *name, int *pvar_index)
+{
+    PyObject *r = t_call("t_pvar_get_index", "(s)", name);
+    if (!r)
+        return MPI_T_ERR_INVALID_NAME;
+    *pvar_index = (int)t_long(r, -1, -1);
+    t_drop(r);
+    return MPI_SUCCESS;
+}
+
+int PMPI_T_pvar_session_create(MPI_T_pvar_session *session)
+{
+    *session = (MPI_T_pvar_session)1;
+    return MPI_SUCCESS;
+}
+
+int PMPI_T_pvar_session_free(MPI_T_pvar_session *session)
+{
+    *session = MPI_T_PVAR_SESSION_NULL;
+    return MPI_SUCCESS;
+}
+
+int PMPI_T_pvar_handle_alloc(MPI_T_pvar_session session,
+                             int pvar_index, void *obj_handle,
+                             MPI_T_pvar_handle *handle, int *count)
+{
+    (void)session;
+    (void)obj_handle;
+    *handle = (MPI_T_pvar_handle)pvar_index;
+    if (count)
+        *count = 1;
+    return MPI_SUCCESS;
+}
+
+int PMPI_T_pvar_handle_free(MPI_T_pvar_session session,
+                            MPI_T_pvar_handle *handle)
+{
+    (void)session;
+    *handle = MPI_T_PVAR_HANDLE_NULL;
+    return MPI_SUCCESS;
+}
+
+int PMPI_T_pvar_start(MPI_T_pvar_session session,
+                      MPI_T_pvar_handle handle)
+{
+    (void)session;
+    (void)handle;                        /* pvars here are continuous */
+    return MPI_SUCCESS;
+}
+
+int PMPI_T_pvar_stop(MPI_T_pvar_session session,
+                     MPI_T_pvar_handle handle)
+{
+    (void)session;
+    (void)handle;
+    return MPI_SUCCESS;
+}
+
+int PMPI_T_pvar_read(MPI_T_pvar_session session,
+                     MPI_T_pvar_handle handle, void *buf)
+{
+    (void)session;
+    PyObject *r = t_call("t_pvar_read", "(i)", (int)handle);
+    if (!r)
+        return MPI_T_ERR_INVALID_INDEX;
+    *(unsigned long long *)buf =
+        (unsigned long long)t_long(r, -1, 0);
+    t_drop(r);
     return MPI_SUCCESS;
 }
 

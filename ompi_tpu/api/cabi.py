@@ -2109,6 +2109,124 @@ def file_sync(fh: int) -> None:
     _file(fh).sync()
 
 
+# ---------------------------------------------------------------------
+# MPI_T — the tool information interface from C (ompi/mpi/tool/*): the
+# third leg of the profiling story next to PMPI and the monitoring
+# interposers. Handles are indices into the sorted var/pvar dumps,
+# stable within one MPI_T epoch (the C side allocs/frees handles but
+# they carry no state beyond the index).
+# ---------------------------------------------------------------------
+# MPI_T indices must be STABLE (the spec allows the count to grow but
+# an index, once returned, keeps naming the same variable): keep an
+# append-only NAME order across enumerations. Enumeration never reads
+# counter values (a tool loop over N pvars must not pay N reads per
+# call).
+_t_orders: Dict[str, list] = {"cvar": [], "pvar": []}
+
+
+def _t_stable(kind: str, names) -> list:
+    order = _t_orders[kind]
+    known = set(order)
+    for name in sorted(names):
+        if name not in known:
+            order.append(name)
+    cur = set(names)
+    return [n for n in order if n in cur]
+
+
+def _t_cvars() -> Dict[str, Dict[str, Any]]:
+    from ompi_tpu.mca import var as _v
+    return {d["name"]: d for d in _v.var_dump()}
+
+
+def t_cvar_get_num() -> int:
+    return len(_t_stable("cvar", _t_cvars().keys()))
+
+
+def _t_cvar(i: int) -> Dict[str, Any]:
+    cur = _t_cvars()
+    names = _t_stable("cvar", cur.keys())
+    if not 0 <= int(i) < len(names):
+        raise MPIError(ERR_ARG, f"bad cvar index {i}")
+    return cur[names[int(i)]]
+
+
+def t_cvar_get_info(i: int) -> Tuple[str, str, str]:
+    v = _t_cvar(i)
+    return v["name"], str(v["type"]), v.get("help") or ""
+
+
+def t_cvar_get_index(name: str) -> int:
+    for idx, n in enumerate(_t_stable("cvar", _t_cvars().keys())):
+        if n == name:
+            return idx
+    raise MPIError(ERR_ARG, f"no such cvar {name!r}")
+
+
+def t_cvar_kind(i: int) -> int:
+    """1 = string-typed, 0 = integer-typed (the C marshalling switch
+    and the handle's element count source)."""
+    v = _t_cvar(i)
+    return int(v["type"] == "str" or isinstance(v["value"], str))
+
+
+def t_cvar_read(i: int) -> Tuple[int, int, str]:
+    """(is_string, int_value, str_value) for the C marshaller."""
+    v = _t_cvar(i)
+    val = v["value"]
+    if v["type"] == "str" or isinstance(val, str):
+        return 1, 0, "" if val is None else str(val)
+    return 0, int(val or 0), ""
+
+
+def t_cvar_write_int(i: int, value: int) -> None:
+    from ompi_tpu.mca import var as _v
+    v = _t_cvar(i)
+    _v.var_set(v["name"], bool(value) if v["type"] == "bool"
+               else int(value))
+
+
+def t_cvar_write_str(i: int, value: str) -> None:
+    from ompi_tpu.mca import var as _v
+    _v.var_set(_t_cvar(i)["name"], value)
+
+
+def _t_pvar_names() -> list:
+    from ompi_tpu.mca import pvar as _p
+    _p.refresh()
+    return _t_stable("pvar", _p.pvar_names())
+
+
+def t_pvar_get_num() -> int:
+    return len(_t_pvar_names())
+
+
+def _t_pvar(i: int) -> Dict[str, Any]:
+    from ompi_tpu.mca import pvar as _p
+    names = _t_pvar_names()
+    if not 0 <= int(i) < len(names):
+        raise MPIError(ERR_ARG, f"bad pvar index {i}")
+    return _p.pvar_info(names[int(i)])
+
+
+def t_pvar_get_info(i: int) -> Tuple[str, str, str]:
+    v = _t_pvar(i)
+    return v["name"], str(v.get("class", "counter")), v.get("help") or ""
+
+
+def t_pvar_get_index(name: str) -> int:
+    for idx, n in enumerate(_t_pvar_names()):
+        if n == name:
+            return idx
+    raise MPIError(ERR_ARG, f"no such pvar {name!r}")
+
+
+def t_pvar_read(i: int) -> int:
+    from ompi_tpu.mca import pvar as _p
+    val = _p.pvar_read(_t_pvar(i)["name"])
+    return int(val or 0)
+
+
 def exc_code(exc: BaseException) -> int:
     """Map a glue exception to an MPI error code for the C shim."""
     if isinstance(exc, MPIError):

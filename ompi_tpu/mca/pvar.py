@@ -54,3 +54,20 @@ def _install_spc_pvars() -> None:
 
 def refresh() -> None:
     _install_spc_pvars()
+
+
+def pvar_names() -> List[str]:
+    """Names only — enumeration must not invoke every counter's read
+    closure (the MPI_T index paths call this on hot tool loops)."""
+    with _lock:
+        return sorted(_pvars)
+
+
+def pvar_info(name: str) -> Dict[str, Any]:
+    """One pvar's metadata WITHOUT reading its value."""
+    with _lock:
+        v = _pvars.get(name)
+    if v is None:
+        raise KeyError(f"no such pvar: {name}")
+    return {"name": name, "unit": v["unit"], "class": v["class"],
+            "help": v["help"]}

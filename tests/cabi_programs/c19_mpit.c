@@ -1,0 +1,126 @@
+/* MPI_T from C: enumerate control variables, read and WRITE one (the
+ * algorithm-selection knob — a tool retuning the library at runtime),
+ * and read performance counters that move with traffic
+ * (ompi/mpi/tool/* + the SPC pvar surface). */
+#include <mpi.h>
+#include <stdio.h>
+#include <string.h>
+
+#define CHECK(cond, code)                                            \
+    do {                                                             \
+        if (!(cond)) {                                               \
+            fprintf(stderr, "rank %d: check failed at line %d\n",    \
+                    rank, __LINE__);                                 \
+            MPI_Abort(MPI_COMM_WORLD, code);                         \
+        }                                                            \
+    } while (0)
+
+int main(int argc, char **argv)
+{
+    int rank, size, provided = -1;
+    MPI_T_init_thread(MPI_THREAD_SINGLE, &provided);
+    CHECK(provided == MPI_THREAD_MULTIPLE, 1);
+    /* MPI_T is usable BEFORE MPI_Init (tools enumerate early) */
+    int early = -1;
+    CHECK(MPI_T_cvar_get_num(&early) == MPI_SUCCESS && early >= 0, 30);
+    /* and out-of-range probes RETURN, never abort */
+    char nm[64];
+    int nl = sizeof(nm), verb, bind, scope;
+    MPI_Datatype edt;
+    MPI_T_enum een;
+    char eds[64];
+    int edl = sizeof(eds);
+    CHECK(MPI_T_cvar_get_info(1 << 28, nm, &nl, &verb, &edt, &een,
+                              eds, &edl, &bind, &scope)
+          == MPI_T_ERR_INVALID_INDEX, 31);
+    MPI_Init(&argc, &argv);
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &size);
+
+    /* ---- cvars: enumerate, find by name, read, write ---- */
+    int ncvar = -1;
+    MPI_T_cvar_get_num(&ncvar);
+    CHECK(ncvar > 10, 2);
+    char name[128], desc[256];
+    int name_len = sizeof(name), desc_len = sizeof(desc);
+    int verb, bind, scope;
+    MPI_Datatype dt;
+    MPI_T_enum en;
+    MPI_T_cvar_get_info(0, name, &name_len, &verb, &dt, &en, desc,
+                        &desc_len, &bind, &scope);
+    CHECK(name[0] != '\0', 3);
+
+    int idx = -1;
+    CHECK(MPI_T_cvar_get_index("coll_xla_allreduce_algorithm", &idx)
+          == MPI_SUCCESS && idx >= 0, 4);
+    /* indices are stable: the same name resolves to the same index */
+    int idx2 = -1;
+    MPI_T_cvar_get_index("coll_xla_allreduce_algorithm", &idx2);
+    CHECK(idx2 == idx, 5);
+
+    MPI_T_cvar_handle ch;
+    int count = -1;
+    MPI_T_cvar_handle_alloc(idx, NULL, &ch, &count);
+    /* string cvar: count advertises the read capacity the caller
+     * must provide (the MPI_T buffer-sizing contract) */
+    CHECK(count == 256, 6);
+    char val[256] = {0};
+    MPI_T_cvar_read(ch, val);
+    CHECK(strcmp(val, "auto") == 0, 7);
+    /* a tool retunes the library: write, reread, restore */
+    MPI_T_cvar_write(ch, "ring");
+    MPI_T_cvar_read(ch, val);
+    CHECK(strcmp(val, "ring") == 0, 8);
+    MPI_T_cvar_write(ch, "auto");
+    MPI_T_cvar_handle_free(&ch);
+
+    /* an integer-typed cvar round-trips through the int marshalling */
+    CHECK(MPI_T_cvar_get_index("coll_xla_cache_max_entries", &idx)
+          == MPI_SUCCESS, 9);
+    MPI_T_cvar_handle_alloc(idx, NULL, &ch, &count);
+    int cap = -1;
+    MPI_T_cvar_read(ch, &cap);
+    CHECK(cap == 256, 10);
+    int newcap = 128;
+    MPI_T_cvar_write(ch, &newcap);
+    MPI_T_cvar_read(ch, &cap);
+    CHECK(cap == 128, 11);
+    newcap = 256;
+    MPI_T_cvar_write(ch, &newcap);
+    MPI_T_cvar_handle_free(&ch);
+
+    /* unknown names fail with the MPI_T error class */
+    CHECK(MPI_T_cvar_get_index("no_such_var_xyz", &idx)
+          == MPI_T_ERR_INVALID_NAME, 12);
+
+    /* ---- pvars: counters move with traffic ---- */
+    /* counters surface lazily with their subsystem's first use */
+    int warm = 1, wsum = 0;
+    MPI_Allreduce(&warm, &wsum, 1, MPI_INT, MPI_SUM, MPI_COMM_WORLD);
+    int npvar = -1;
+    MPI_T_pvar_get_num(&npvar);
+    CHECK(npvar > 0, 13);
+    int pidx = -1;
+    CHECK(MPI_T_pvar_get_index("spc_coll_allreduce", &pidx)
+          == MPI_SUCCESS, 14);
+    MPI_T_pvar_session ses;
+    MPI_T_pvar_session_create(&ses);
+    MPI_T_pvar_handle ph;
+    MPI_T_pvar_handle_alloc(ses, pidx, NULL, &ph, &count);
+    MPI_T_pvar_start(ses, ph);
+    unsigned long long before = 0, after = 0;
+    MPI_T_pvar_read(ses, ph, &before);
+    int v = rank, s = -1;
+    MPI_Allreduce(&v, &s, 1, MPI_INT, MPI_SUM, MPI_COMM_WORLD);
+    MPI_Allreduce(&v, &s, 1, MPI_INT, MPI_SUM, MPI_COMM_WORLD);
+    MPI_T_pvar_read(ses, ph, &after);
+    CHECK(after >= before + 2, 15);
+    MPI_T_pvar_stop(ses, ph);
+    MPI_T_pvar_handle_free(ses, &ph);
+    MPI_T_pvar_session_free(&ses);
+
+    printf("OK c19_mpit rank=%d/%d\n", rank, size);
+    MPI_Finalize();
+    MPI_T_finalize();
+    return 0;
+}

@@ -44,6 +44,7 @@ CASES = [
     ("c17_graph.c", 3),
     ("c17_graph.c", 4),
     ("c18_sessions_dpm.c", 3),
+    ("c19_mpit.c", 2),
 ]
 
 # per-program argv (c13 runs 4M floats = 16 MB in CI — above the 1 MB
