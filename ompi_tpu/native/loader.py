@@ -4,6 +4,7 @@ components that fall back to base kernels when unavailable)."""
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import threading
@@ -22,6 +23,55 @@ _SRCS = [os.path.join(_NATIVE_DIR, f)
 _SO = os.path.join(_NATIVE_DIR, "libompi_tpu_native.so")
 
 
+def cached_native_build(deps, so_path: str, make_cmd,
+                        timeout: int = 180,
+                        on_error=None) -> Optional[str]:
+    """Content-hash-cached native build, shared by this loader and
+    tools/mpicc (one protocol, one place to fix it). ``deps`` are the
+    source files hashed into the sidecar ``<so>.hash``; mtime is never
+    consulted (git checkouts scramble it). ``make_cmd(tmp_path)``
+    returns the compiler argv building to the private temp path, which
+    is renamed into place only on success — concurrent builders never
+    observe a half-written library. Returns ``so_path`` or None."""
+    h = hashlib.sha256()
+    for d in deps:
+        with open(d, "rb") as f:
+            h.update(f.read())
+    digest = h.hexdigest()
+    hash_file = so_path + ".hash"
+    if os.path.exists(so_path) and os.path.exists(hash_file):
+        try:
+            with open(hash_file) as f:
+                if f.read().strip() == digest:
+                    return so_path
+        except OSError:
+            pass
+    tmp = f"{so_path}.tmp.{os.getpid()}"
+    try:
+        subprocess.run(make_cmd(tmp), check=True, capture_output=True,
+                       timeout=timeout)
+        os.replace(tmp, so_path)
+        try:
+            with open(hash_file, "w") as f:
+                f.write(digest)
+        except OSError:
+            pass          # the BUILD succeeded; a missing sidecar only
+            #               costs a rebuild next process
+        return so_path
+    except subprocess.CalledProcessError as e:
+        if on_error is not None:
+            on_error(e)
+        return None
+    except (OSError, subprocess.SubprocessError):
+        return None
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+
 def _build() -> Optional[str]:
     srcs = [s for s in _SRCS if os.path.exists(s)]
     if len(srcs) != len(_SRCS):
@@ -29,18 +79,11 @@ def _build() -> Optional[str]:
         # version) yet miss symbols, which would disable everything at
         # bind time — refuse up front instead.
         return None
-    if (os.path.exists(_SO)
-            and os.path.getmtime(_SO) >= max(os.path.getmtime(s)
-                                             for s in srcs)):
-        return _SO
-    try:
-        subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", *srcs,
-             "-o", _SO],
-            check=True, capture_output=True, timeout=120)
-        return _SO
-    except (OSError, subprocess.SubprocessError):
-        return None
+    return cached_native_build(
+        srcs, _SO,
+        lambda tmp: ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                     *srcs, "-o", tmp],
+        timeout=120)
 
 
 def get_lib() -> Optional[ctypes.CDLL]:

@@ -41,44 +41,38 @@ def _py_embed_flags() -> tuple:
 
 
 def build_lib(cc: str = "gcc", force: bool = False) -> Optional[str]:
-    """Build native/libtpumpi.so from mpi_cabi.c (mtime-cached)."""
+    """Build native/libtpumpi.so from mpi_cabi.c (content-hash-cached
+    via the shared protocol in ``ompi_tpu.native.loader``: a sidecar
+    ``.hash`` records the source digest, mtime is never consulted, so
+    a stale binary — committed, copied, or left by an older tree — is
+    always rebuilt)."""
     if not os.path.exists(_SRC):
         return None
+    from ompi_tpu.native.loader import cached_native_build
     deps = [_SRC] + [p for p in
                      (os.path.join(_INCLUDE_DIR, "mpi.h"),
                       os.path.join(_INCLUDE_DIR, "mpi_pmpi.h"),
                       os.path.join(_NATIVE_DIR, "pmpi_aliases.h"))
                      if os.path.exists(p)]
-    if (not force and os.path.exists(_SO)
-            and os.path.getmtime(_SO) >= max(os.path.getmtime(d)
-                                             for d in deps)):
-        return _SO
+    if force:
+        try:
+            os.remove(_SO + ".hash")
+        except OSError:
+            pass
     inc, libdir, pylib = _py_embed_flags()
-    # Build to a private temp path and rename into place: concurrent
-    # mpicc invocations (make -j) must never observe a half-written
-    # library on the shared path.
-    tmp = f"{_SO}.tmp.{os.getpid()}"
-    cmd = [cc, "-O2", "-shared", "-fPIC", "-std=c11", _SRC,
-           f"-I{inc}", f"-I{_INCLUDE_DIR}",
-           f"-DOMPI_TPU_ROOT=\"{_REPO_DIR}\"",
-           "-o", tmp,
-           f"-L{libdir}", f"-l{pylib}", "-ldl", "-lm",
-           f"-Wl,-rpath,{libdir}"]
-    try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=180)
-        os.replace(tmp, _SO)
-        return _SO
-    except subprocess.CalledProcessError as e:
-        sys.stderr.write(e.stderr.decode(errors="replace"))
-        return None
-    except OSError:
-        return None
-    finally:
-        if os.path.exists(tmp):
-            try:
-                os.remove(tmp)
-            except OSError:
-                pass
+
+    def make_cmd(tmp: str) -> List[str]:
+        return [cc, "-O2", "-shared", "-fPIC", "-std=c11", _SRC,
+                f"-I{inc}", f"-I{_INCLUDE_DIR}",
+                f"-DOMPI_TPU_ROOT=\"{_REPO_DIR}\"",
+                "-o", tmp,
+                f"-L{libdir}", f"-l{pylib}", "-ldl", "-lm",
+                f"-Wl,-rpath,{libdir}"]
+
+    return cached_native_build(
+        deps, _SO, make_cmd, timeout=180,
+        on_error=lambda e: sys.stderr.write(
+            e.stderr.decode(errors="replace")))
 
 
 def wrapper_flags() -> List[str]:

@@ -43,7 +43,6 @@ int main(int argc, char **argv)
     CHECK(ncvar > 10, 2);
     char name[128], desc[256];
     int name_len = sizeof(name), desc_len = sizeof(desc);
-    int verb, bind, scope;
     MPI_Datatype dt;
     MPI_T_enum en;
     MPI_T_cvar_get_info(0, name, &name_len, &verb, &dt, &en, desc,
