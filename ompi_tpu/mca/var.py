@@ -238,6 +238,17 @@ def var_source(full: str) -> Optional[str]:
         return v.source if v is not None else None
 
 
+def var_overridden(full: str) -> bool:
+    """True when a non-default value is in effect for ``full`` — an
+    active session VarScope override (which var_source cannot see) OR
+    a global env/file/set source. Probe-earned defaults (the staged
+    tier's switch point, the bml's sm threshold) must yield to both."""
+    for sc in reversed(_scope_stack.get()):
+        if full in sc.values:
+            return True
+    return var_source(full) not in (None, SOURCE_DEFAULT)
+
+
 def var_dump() -> List[Dict[str, Any]]:
     """Introspect all registered vars (``ompi_info -a`` equivalent)."""
     with _lock:

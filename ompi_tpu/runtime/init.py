@@ -156,6 +156,36 @@ def _init_per_rank(requested: int) -> int:
     client.wait_at_barrier("ompi_tpu_init", 120_000)
     router.wire_up()
 
+    # Staged-tier threshold modex (VERDICT r4 next #3): the staging
+    # switch point is probe-earned, but the probe is timing-based and
+    # the staging decision must be rank-symmetric — so rank 0 measures
+    # and publishes; every rank adopts the SAME value. A user-set
+    # coll_tuned_stage_min_bytes suppresses the probe (checked inside
+    # stage_min_for too; the skip here just avoids the measurement).
+    from ompi_tpu.coll import tuned as _tuned
+    if not var.var_overridden("coll_tuned_stage_min_bytes"):
+        import json as _json
+        key = "ompi_tpu/coll/stage_probe"
+        if rank == 0:
+            try:
+                pb = dict(getattr(router.endpoint, "probe_basis",
+                                  {}) or {})
+                bps = None
+                if pb.get("ran"):
+                    g = (pb.get("sm_gbps") if not pb.get("sm_demoted")
+                         else pb.get("tcp_gbps"))
+                    bps = g * 1e9 if g else None
+                value, basis = _tuned.staging_probe(
+                    transport_bps=bps, nranks=nprocs)
+            except Exception:            # noqa: BLE001 — advisory
+                value, basis = 1 << 20, {"ran": False, "error": True}
+            client.key_value_set(key, _json.dumps({"v": value, **basis}))
+        blob = client.blocking_key_value_get(key, 120_000)
+        if isinstance(blob, bytes):
+            blob = blob.decode()
+        d = _json.loads(blob)
+        _tuned.adopt_probed_stage_min(int(d.pop("v")), d)
+
     INFO_ENV.set("command", os.environ.get("_", ""))
     INFO_ENV.set("maxprocs", str(nprocs))
     INFO_ENV.set("host", socket.gethostname())

@@ -72,6 +72,12 @@ def table(comm) -> Dict:
         basis = getattr(ep, "probe_basis", None)
         if basis:
             out["btl_probe"] = dict(basis)
+    # the staged device tier's measured switch point (same discipline:
+    # the decision shows its data, VERDICT r4 next #3)
+    from ompi_tpu.coll.tuned import probed_stage_basis
+    sb = probed_stage_basis()
+    if sb.get("ran"):
+        out["stage_probe"] = sb
     return out
 
 

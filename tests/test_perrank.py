@@ -46,6 +46,7 @@ CASES = [
     ("p26_churn.py", 3),
     ("p27_staged_coll.py", 3),
     ("p28_devxfer.py", 3),
+    ("p29_stage_probe.py", 3),
 ]
 
 
