@@ -419,6 +419,43 @@ class RankCommunicator:
             k <<= 1
         return acc if self._rank == root else None
 
+    def _small_allreduce(self, data: Any, op: op_mod.Op) -> Any:
+        """Combined small-message allreduce (VERDICT r4 next #4): every
+        rank eagerly sends its contribution to every peer ONCE; btl
+        reader threads park arrivals straight into a combining slot
+        (``btl_sendi`` role — no matching, no per-message request); the
+        last arrival folds in deterministic rank order and wakes the
+        caller exactly once. One message latency + one wakeup replaces
+        the reduce-then-bcast chain's log(n) serialized round trips —
+        the path that held 8 B latency at ~2.2 ms for two rounds.
+        Rank-ordered folding keeps non-commutative ops and float
+        reproducibility exact (same canonical order on every rank)."""
+        n, r, t = self.size, self._rank, self._tag()
+        eng = self._coll_pml
+
+        def fold(vals):
+            acc = vals[0]
+            for v in vals[1:]:
+                acc = _apply(op, acc, v)
+            return acc
+
+        slot = eng.post_combine(t, n, n - 1, fold, own=(r, data))
+        try:
+            for off in range(1, n):
+                self._csend((r + off) % n, t, data)
+            return slot.wait()
+        finally:
+            eng.end_combine(t)
+
+    def _small_allreduce_ok(self, data: Any, op: op_mod.Op) -> bool:
+        from ompi_tpu.coll.tuned import small_allreduce_limits
+        max_bytes, max_ranks = small_allreduce_limits()
+        if not (1 < self.size <= max_ranks):
+            return False
+        if isinstance(data, np.ndarray):
+            return data.nbytes <= max_bytes
+        return isinstance(data, (int, float, complex, np.generic))
+
     def allreduce(self, data: Any, op: op_mod.Op = op_mod.SUM) -> Any:
         self._check()
         self._validate_op(op)
@@ -429,6 +466,9 @@ class RankCommunicator:
             spc.record("coll_staged_device", 1)
             return np.asarray(self._device_allreduce(
                 np.ascontiguousarray(data), op))
+        if self._small_allreduce_ok(data, op):
+            spc.record("coll_small_combine", 1)
+            return self._small_allreduce(data, op)
         r = self.reduce(data, op, 0)
         return self.bcast(r, 0)
 

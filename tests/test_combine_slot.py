@@ -1,0 +1,85 @@
+"""CombineSlot — the inline reader-thread combining primitive behind
+the small-message allreduce fast path (the btl_sendi role,
+``opal/mca/btl/btl.h`` inline-send, applied receive-side)."""
+import threading
+
+import numpy as np
+import pytest
+
+from ompi_tpu.pml.perrank import CombineSlot
+
+
+def _fold_sub(vals):
+    acc = vals[0]
+    for v in vals[1:]:
+        acc = acc - v
+    return acc
+
+
+def test_rank_ordered_fold_is_deterministic():
+    """Arrival order must not change the result: the fold runs in rank
+    order (MPI's same-result-everywhere promise; also what makes
+    non-commutative ops correct on this path)."""
+    for arrival in ([1, 2, 3], [3, 2, 1], [2, 3, 1]):
+        slot = CombineSlot(4, 3, _fold_sub)
+        slot.put_own(0, 100.0)
+        for src in arrival:
+            slot.feed(src, float(src))
+        assert slot.wait(5) == 100.0 - 1.0 - 2.0 - 3.0
+
+
+def test_last_arrival_completes_once():
+    slot = CombineSlot(2, 1, lambda vs: vs[0] + vs[1])
+    slot.put_own(0, np.float64(1.5))
+    assert not slot._event.is_set()
+    slot.feed(1, np.float64(2.5))
+    assert slot.wait(5) == 4.0
+    # duplicate feeds are ignored, result stands
+    slot.feed(1, np.float64(99.0))
+    assert slot.result == 4.0
+
+
+def test_fail_wakes_waiter():
+    slot = CombineSlot(2, 1, lambda vs: vs)
+    err = RuntimeError("peer died")
+
+    waiter_result = {}
+
+    def wait():
+        try:
+            slot.wait(5)
+        except RuntimeError as e:
+            waiter_result["err"] = e
+
+    t = threading.Thread(target=wait)
+    t.start()
+    slot.fail(err)
+    t.join(5)
+    assert waiter_result["err"] is err
+    # feeds after failure are ignored
+    slot.feed(1, 1.0)
+    assert slot.result is None
+
+
+def test_fold_exception_surfaces_at_wait():
+    slot = CombineSlot(2, 1, lambda vs: 1 / 0)
+    slot.put_own(0, 1.0)
+    slot.feed(1, 2.0)
+    with pytest.raises(ZeroDivisionError):
+        slot.wait(5)
+
+
+def test_concurrent_feeds_fold_exactly_once():
+    n = 8
+    results = []
+    slot = CombineSlot(n, n - 1,
+                       lambda vs: results.append(1) or sum(vs))
+    slot.put_own(0, 0)
+    threads = [threading.Thread(target=slot.feed, args=(i, i))
+               for i in range(1, n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(5)
+    assert slot.wait(5) == sum(range(n))
+    assert results == [1]
