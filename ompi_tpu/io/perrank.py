@@ -96,12 +96,16 @@ class RankFile:
         return os.fstat(self.fd).st_size
 
     def set_size(self, nbytes: int) -> None:
-        """Collective (MPI_File_set_size)."""
+        """Collective (MPI_File_set_size); entry barrier for the same
+        reason as seek_shared — a fast rank's truncate must not
+        overtake a slow rank's pre-collective reads."""
+        self.comm.barrier()
         if self.comm.rank() == 0:
             os.ftruncate(self.fd, nbytes)
         self.comm.barrier()
 
     def preallocate(self, nbytes: int) -> None:
+        self.comm.barrier()
         if self.comm.rank() == 0 and self.get_size() < nbytes:
             os.ftruncate(self.fd, nbytes)
         self.comm.barrier()
@@ -189,7 +193,13 @@ class RankFile:
         return self.read_at(start, count)
 
     def seek_shared(self, offset: int) -> None:
-        """Collective per MPI (all ranks same offset)."""
+        """Collective per MPI (all ranks same offset). The ENTRY
+        barrier matters: every rank's pre-seek shared-pointer reads
+        (get_position_shared is NOT collective) must land before the
+        write, or a fast rank's seek overwrites the pointer a slow
+        rank is still about to read — observed as a real race in
+        c24_io_rma's ordered section."""
+        self.comm.barrier()
         if self.comm.rank() == 0:
             self._sp.accumulate([offset], 0, 0, op="replace")
         self.comm.barrier()

@@ -23,6 +23,10 @@ observable semantics.
 """
 from __future__ import annotations
 
+# env-gated RMA handler tracing (operator debugging; reads once)
+import os as _os_mod
+_RMA_DEBUG = bool(_os_mod.environ.get("OMPI_TPU_RMA_DEBUG"))
+
 import itertools
 import threading
 from typing import Any, Dict, List, Optional, Tuple
@@ -269,6 +273,12 @@ class RankWindow:
         router = self.comm.router
         origin_world = header["origin"]          # world rank of origin
         op = header["op"]
+        if _RMA_DEBUG:
+            import sys as _sys
+            _sys.stderr.write(
+                f"RMADBG r{router.rank} handle {op} wid={self.wid} "
+                f"name={self.name} origin={origin_world}\n")
+            _sys.stderr.flush()
         aid = header["ack_id"]
         data = (decode_payload(header["desc"], raw)
                 if "desc" in header else None)
