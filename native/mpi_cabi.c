@@ -40,6 +40,7 @@ static MPI_Errhandler g_errh = MPI_ERRORS_ARE_FATAL;
 static const size_t DT_SIZE[] = {
     0, 1, 1, 1, 1, 2, 2, 4, 4, 8, 8, 8, 8, 4, 8, 1,
     1, 2, 4, 8, 1, 2, 4, 8,
+    8, 8, 8,                  /* MPI_AINT, MPI_COUNT, MPI_OFFSET */
 };
 #define DT_MAX ((long)(sizeof(DT_SIZE) / sizeof(DT_SIZE[0]) - 1))
 
@@ -84,6 +85,58 @@ static size_t dt_sig(MPI_Datatype dt)
                               : dt_size(dt);
 }
 
+/* signed glue query (window offsets are <= 0) */
+static long long dyn_query_ll(const char *fn, MPI_Datatype dt)
+{
+    if (!g_mod)
+        return 0;
+    PyGILState_STATE g = PyGILState_Ensure();
+    long long out = 0;
+    PyObject *r = PyObject_CallMethod(g_mod, fn, "l", (long)dt);
+    if (r) {
+        out = PyLong_AsLongLong(r);
+        Py_DECREF(r);
+    } else {
+        PyErr_Clear();
+    }
+    PyGILState_Release(g);
+    return out;
+}
+
+/* Marshalling-window geometry for count elements of dt (the granule
+ * model, api/cabi.py): the window starts at buf + *off (the type's
+ * true lb — negative for types that place data BEHIND the pointer,
+ * positive for types whose first significant byte sits past it, e.g.
+ * a subarray with nonzero starts) and spans EXACTLY the data:
+ * *len = (count-1)*extent + true_span. Never longer — a positive lb
+ * with a padded length would read/write past the user's buffer.
+ * For basic types this degenerates to the legacy count*size.
+ * Returns 0 on an invalid/empty type (legacy MPI_ERR_TYPE path). */
+static int dt_window(MPI_Datatype dt, long long count,
+                     long long *off, long long *len)
+{
+    *off = 0;
+    *len = 0;
+    if (count < 0)
+        return 0;
+    if (dt < DT_FIRST_DYN) {
+        size_t s = dt_size(dt);
+        if (!s)
+            return 0;
+        *len = count * (long long)s;
+        return 1;
+    }
+    long long ext = (long long)dt_extent(dt);
+    if (!ext)
+        return 0;
+    if (count == 0)
+        return 1;
+    long long span = dyn_query_ll("type_true_span_bytes", dt);
+    *off = dyn_query_ll("type_window_off_bytes", dt);
+    *len = (count - 1) * ext + span;
+    return 1;
+}
+
 typedef struct {
     long pyh;                           /* glue request handle (0 =
                                          * inactive persistent) */
@@ -99,6 +152,18 @@ typedef struct {
     int peer;
     int tag;
     MPI_Comm comm;
+    /* partitioned requests (MPI_Psend_init): persistent handles whose
+     * wait must NOT consume the glue entry (Start re-arms) */
+    int is_part;
+    /* generalized requests (MPI_Grequest_start): completion is driven
+     * by the APP via MPI_Grequest_complete; wait/test call query_fn
+     * to fill the status (grequest_start.c.in contract) */
+    int is_greq;
+    volatile int greq_done;
+    int (*greq_query)(void *, MPI_Status *);
+    int (*greq_free)(void *);
+    int (*greq_cancel)(void *, int);
+    void *greq_extra;
 } req_entry;
 
 static req_entry *req_new(void)
@@ -229,13 +294,15 @@ static PyObject *mem_rw(void *buf, size_t n)
         PyBUF_WRITE);
 }
 
-static void set_status(MPI_Status *st, int src, int tag, int count)
+static void set_status(MPI_Status *st, int src, int tag,
+                       long long count)
 {
     if (!st)
         return;
     st->MPI_SOURCE = src;
     st->MPI_TAG = tag;
     st->MPI_ERROR = MPI_SUCCESS;
+    st->_cancelled = 0;
     st->_count = count;
 }
 
@@ -249,7 +316,7 @@ static int copy_msg(PyObject *r, void *buf, size_t cap, MPI_Status *st)
     PyObject *payload = PyTuple_GetItem(r, 0);
     int src = (int)PyLong_AsLong(PyTuple_GetItem(r, 1));
     int tag = (int)PyLong_AsLong(PyTuple_GetItem(r, 2));
-    int cnt = (int)PyLong_AsLong(PyTuple_GetItem(r, 3));
+    long long cnt = PyLong_AsLongLong(PyTuple_GetItem(r, 3));
     char *p;
     Py_ssize_t n;
     if (PyBytes_AsStringAndSize(payload, &p, &n) < 0)
@@ -270,7 +337,11 @@ static int copy_msg(PyObject *r, void *buf, size_t cap, MPI_Status *st)
     /* cnt = SIGNIFICANT wire bytes (a derived type's delivered buffer
      * image includes gap bytes the count must not); truncation reports
      * what was actually delivered. */
-    set_status(st, src, tag, rc == MPI_SUCCESS ? cnt : (int)n);
+    set_status(st, src, tag, rc == MPI_SUCCESS ? cnt : (long long)n);
+    /* slot 6: the receive was cancelled (MPI_Cancel semantics) */
+    if (st && PyTuple_Size(r) >= 6
+        && PyLong_AsLong(PyTuple_GetItem(r, 5)))
+        st->_cancelled = 1;
     return rc;
 }
 
@@ -545,24 +616,32 @@ int PMPI_Comm_set_errhandler(MPI_Comm comm, MPI_Errhandler errhandler)
 /* ------------------------------------------------------------------ */
 /* point-to-point                                                      */
 /* ------------------------------------------------------------------ */
-static int send_common(const void *buf, int count, MPI_Datatype dt,
-                       int dest, int tag, MPI_Comm comm, int sync,
-                       const char *fn)
+static int send_common_c(const void *buf, long long count,
+                         MPI_Datatype dt, int dest, int tag,
+                         MPI_Comm comm, int sync, const char *fn)
 {
-    size_t esz = dt_extent(dt);
-    if (!esz || count < 0)
+    long long off, len;
+    if (!dt_window(dt, count, &off, &len))
         return MPI_ERR_TYPE;
     GIL_BEGIN;
     int rc = MPI_SUCCESS;
     PyObject *r = PyObject_CallMethod(
         g_mod, "send", "lNliii", (long)comm,
-        mem_ro(buf, (size_t)count * esz), (long)dt, dest, tag, sync);
+        mem_ro((const char *)buf + off, (size_t)len), (long)dt, dest,
+        tag, sync);
     if (!r)
         rc = handle_error_comm(comm, fn);
     else
         Py_DECREF(r);
     GIL_END;
     return rc;
+}
+
+static int send_common(const void *buf, int count, MPI_Datatype dt,
+                       int dest, int tag, MPI_Comm comm, int sync,
+                       const char *fn)
+{
+    return send_common_c(buf, count, dt, dest, tag, comm, sync, fn);
 }
 
 int PMPI_Send(const void *buf, int count, MPI_Datatype datatype, int dest,
@@ -579,28 +658,37 @@ int PMPI_Ssend(const void *buf, int count, MPI_Datatype datatype, int dest,
                        "MPI_Ssend");
 }
 
-int PMPI_Recv(void *buf, int count, MPI_Datatype datatype, int source,
-             int tag, MPI_Comm comm, MPI_Status *status)
+static int recv_common_c(void *buf, long long count,
+                         MPI_Datatype datatype, int source, int tag,
+                         MPI_Comm comm, MPI_Status *status)
 {
-    size_t esz = dt_extent(datatype);
-    if (!esz || count < 0)
+    long long off, len;
+    if (!dt_window(datatype, count, &off, &len))
         return MPI_ERR_TYPE;
+    char *win = (char *)buf + off;
     GIL_BEGIN;
     int rc = MPI_SUCCESS;
     /* current content travels along only for derived types, which
      * overlay into it; basic types never read it (skip the copy) */
-    size_t snap = datatype >= DT_FIRST_DYN ? (size_t)count * esz : 0;
+    size_t snap = datatype >= DT_FIRST_DYN ? (size_t)len : 0;
     PyObject *r = PyObject_CallMethod(g_mod, "recv", "liilN", (long)comm,
                                       source, tag, (long)datatype,
-                                      mem_ro(buf, snap));
+                                      mem_ro(win, snap));
     if (!r)
         rc = handle_error_comm(comm, "MPI_Recv");
     else {
-        rc = copy_msg(r, buf, (size_t)count * esz, status);
+        rc = copy_msg(r, win, (size_t)len, status);
         Py_DECREF(r);
     }
     GIL_END;
     return rc;
+}
+
+int PMPI_Recv(void *buf, int count, MPI_Datatype datatype, int source,
+             int tag, MPI_Comm comm, MPI_Status *status)
+{
+    return recv_common_c(buf, count, datatype, source, tag, comm,
+                         status);
 }
 
 int PMPI_Sendrecv(const void *sendbuf, int sendcount,
@@ -609,22 +697,49 @@ int PMPI_Sendrecv(const void *sendbuf, int sendcount,
                  int source, int recvtag, MPI_Comm comm,
                  MPI_Status *status)
 {
-    size_t ssz = dt_extent(sendtype), rsz = dt_extent(recvtype);
-    if (!ssz || !rsz || sendcount < 0 || recvcount < 0)
+    long long soff, slen, roff, rlen;
+    if (!dt_window(sendtype, sendcount, &soff, &slen)
+        || !dt_window(recvtype, recvcount, &roff, &rlen))
         return MPI_ERR_TYPE;
+    char *rwin = (char *)recvbuf + roff;
     GIL_BEGIN;
     int rc = MPI_SUCCESS;
-    size_t snap = recvtype >= DT_FIRST_DYN
-        ? (size_t)recvcount * rsz : 0;
+    size_t snap = recvtype >= DT_FIRST_DYN ? (size_t)rlen : 0;
     PyObject *r = PyObject_CallMethod(
         g_mod, "sendrecv", "lNliiiilN", (long)comm,
-        mem_ro(sendbuf, (size_t)sendcount * ssz), (long)sendtype, dest,
-        sendtag, source, recvtag, (long)recvtype,
-        mem_ro(recvbuf, snap));
+        mem_ro((const char *)sendbuf + soff, (size_t)slen),
+        (long)sendtype, dest, sendtag, source, recvtag, (long)recvtype,
+        mem_ro(rwin, snap));
     if (!r)
         rc = handle_error_comm(comm, "MPI_Sendrecv");
     else {
-        rc = copy_msg(r, recvbuf, (size_t)recvcount * rsz, status);
+        rc = copy_msg(r, rwin, (size_t)rlen, status);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+static int isend_common_c(const void *buf, long long count,
+                          MPI_Datatype datatype, int dest, int tag,
+                          MPI_Comm comm, MPI_Request *request,
+                          const char *fn)
+{
+    long long off, len;
+    if (!dt_window(datatype, count, &off, &len))
+        return MPI_ERR_TYPE;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "isend", "lNlii", (long)comm,
+        mem_ro((const char *)buf + off, (size_t)len), (long)datatype,
+        dest, tag);
+    if (!r) {
+        rc = handle_error_comm(comm, fn);
+    } else {
+        req_entry *e = req_new();
+        e->pyh = PyLong_AsLong(r);
+        *request = (MPI_Request)(intptr_t)e;
         Py_DECREF(r);
     }
     GIL_END;
@@ -634,19 +749,31 @@ int PMPI_Sendrecv(const void *sendbuf, int sendcount,
 int PMPI_Isend(const void *buf, int count, MPI_Datatype datatype, int dest,
               int tag, MPI_Comm comm, MPI_Request *request)
 {
-    size_t esz = dt_extent(datatype);
-    if (!esz || count < 0)
+    return isend_common_c(buf, count, datatype, dest, tag, comm,
+                          request, "MPI_Isend");
+}
+
+static int irecv_common_c(void *buf, long long count,
+                          MPI_Datatype datatype, int source, int tag,
+                          MPI_Comm comm, MPI_Request *request)
+{
+    long long off, len;
+    if (!dt_window(datatype, count, &off, &len))
         return MPI_ERR_TYPE;
+    char *win = (char *)buf + off;
     GIL_BEGIN;
     int rc = MPI_SUCCESS;
-    PyObject *r = PyObject_CallMethod(
-        g_mod, "isend", "lNlii", (long)comm,
-        mem_ro(buf, (size_t)count * esz), (long)datatype, dest, tag);
+    size_t snap = datatype >= DT_FIRST_DYN ? (size_t)len : 0;
+    PyObject *r = PyObject_CallMethod(g_mod, "irecv", "liilN", (long)comm,
+                                      source, tag, (long)datatype,
+                                      mem_ro(win, snap));
     if (!r) {
-        rc = handle_error_comm(comm, "MPI_Isend");
+        rc = handle_error_comm(comm, "MPI_Irecv");
     } else {
         req_entry *e = req_new();
         e->pyh = PyLong_AsLong(r);
+        e->buf = win;
+        e->cap = (size_t)len;
         *request = (MPI_Request)(intptr_t)e;
         Py_DECREF(r);
     }
@@ -657,27 +784,8 @@ int PMPI_Isend(const void *buf, int count, MPI_Datatype datatype, int dest,
 int PMPI_Irecv(void *buf, int count, MPI_Datatype datatype, int source,
               int tag, MPI_Comm comm, MPI_Request *request)
 {
-    size_t esz = dt_extent(datatype);
-    if (!esz || count < 0)
-        return MPI_ERR_TYPE;
-    GIL_BEGIN;
-    int rc = MPI_SUCCESS;
-    size_t snap = datatype >= DT_FIRST_DYN ? (size_t)count * esz : 0;
-    PyObject *r = PyObject_CallMethod(g_mod, "irecv", "liilN", (long)comm,
-                                      source, tag, (long)datatype,
-                                      mem_ro(buf, snap));
-    if (!r) {
-        rc = handle_error_comm(comm, "MPI_Irecv");
-    } else {
-        req_entry *e = req_new();
-        e->pyh = PyLong_AsLong(r);
-        e->buf = buf;
-        e->cap = (size_t)count * esz;
-        *request = (MPI_Request)(intptr_t)e;
-        Py_DECREF(r);
-    }
-    GIL_END;
-    return rc;
+    return irecv_common_c(buf, count, datatype, source, tag, comm,
+                          request);
 }
 
 int PMPI_Wait(MPI_Request *request, MPI_Status *status)
@@ -687,9 +795,45 @@ int PMPI_Wait(MPI_Request *request, MPI_Status *status)
         return MPI_SUCCESS;
     }
     req_entry *e = (req_entry *)(intptr_t)*request;
+    if (e->is_part) {
+        /* partitioned: completion does NOT consume the handle (the
+         * request is persistent; Start re-arms it) */
+        GIL_BEGIN;
+        int rc = MPI_SUCCESS;
+        PyObject *r = PyObject_CallMethod(g_mod, "part_wait", "l",
+                                          e->pyh);
+        if (!r)
+            rc = handle_error("MPI_Wait");
+        else {
+            rc = copy_msg(r, e->buf, e->cap, status);
+            Py_DECREF(r);
+        }
+        GIL_END;
+        return rc;
+    }
     if (e->persistent && e->pyh == 0) {  /* inactive: immediate */
         set_status(status, MPI_ANY_SOURCE, MPI_ANY_TAG, 0);
         return MPI_SUCCESS;
+    }
+    if (e->is_greq) {
+        /* completion comes from the APP (MPI_Grequest_complete),
+         * possibly on another thread: poll with a short sleep */
+        while (!e->greq_done) {
+            struct timespec ts = {0, 200000};    /* 0.2 ms */
+            nanosleep(&ts, NULL);
+        }
+        int rc = MPI_SUCCESS;
+        MPI_Status tmp;
+        set_status(&tmp, MPI_UNDEFINED, MPI_UNDEFINED, 0);
+        if (e->greq_query)
+            rc = e->greq_query(e->greq_extra, &tmp);
+        if (status)
+            *status = tmp;
+        if (e->greq_free)
+            e->greq_free(e->greq_extra);
+        free(e);
+        *request = MPI_REQUEST_NULL;
+        return rc;
     }
     GIL_BEGIN;
     int rc = MPI_SUCCESS;
@@ -733,10 +877,48 @@ int PMPI_Test(MPI_Request *request, int *flag, MPI_Status *status)
     }
     *flag = 0;
     req_entry *e = (req_entry *)(intptr_t)*request;
+    if (e->is_part) {
+        /* partitioned handles live in their own glue namespace and
+         * survive completion (persistent); never touch _requests */
+        GIL_BEGIN;
+        int rc = MPI_SUCCESS;
+        PyObject *r = PyObject_CallMethod(g_mod, "part_test", "l",
+                                          e->pyh);
+        if (!r) {
+            rc = handle_error("MPI_Test");
+        } else {
+            *flag = (int)PyLong_AsLong(PyTuple_GetItem(r, 0));
+            if (*flag) {
+                PyObject *msg = PyTuple_GetSlice(r, 1, 7);
+                rc = copy_msg(msg, e->buf, e->cap, status);
+                Py_DECREF(msg);
+            }
+            Py_DECREF(r);
+        }
+        GIL_END;
+        return rc;
+    }
     if (e->persistent && e->pyh == 0) {  /* inactive: immediate */
         *flag = 1;
         set_status(status, MPI_ANY_SOURCE, MPI_ANY_TAG, 0);
         return MPI_SUCCESS;
+    }
+    if (e->is_greq) {
+        if (!e->greq_done)
+            return MPI_SUCCESS;          /* flag stays 0 */
+        *flag = 1;
+        int rc = MPI_SUCCESS;
+        MPI_Status tmp;
+        set_status(&tmp, MPI_UNDEFINED, MPI_UNDEFINED, 0);
+        if (e->greq_query)
+            rc = e->greq_query(e->greq_extra, &tmp);
+        if (status)
+            *status = tmp;
+        if (e->greq_free)
+            e->greq_free(e->greq_extra);
+        free(e);
+        *request = MPI_REQUEST_NULL;
+        return rc;
     }
     GIL_BEGIN;
     int rc = MPI_SUCCESS;
@@ -761,7 +943,7 @@ int PMPI_Test(MPI_Request *request, int *flag, MPI_Status *status)
     } else {
         *flag = (int)PyLong_AsLong(PyTuple_GetItem(r, 0));
         if (*flag) {
-            PyObject *msg = PyTuple_GetSlice(r, 1, 6);
+            PyObject *msg = PyTuple_GetSlice(r, 1, 7);
             rc = copy_msg(msg, e->buf, e->cap, status);
             Py_DECREF(msg);
             if (e->persistent) {
@@ -828,12 +1010,15 @@ int PMPI_Get_count(const MPI_Status *status, MPI_Datatype datatype,
     if (!esz)
         return MPI_ERR_TYPE;
     /* _count carries bytes; convert into the caller datatype's units,
-     * MPI_UNDEFINED when the message is not an integral number. */
-    if ((size_t)status->_count % esz) {
+     * MPI_UNDEFINED when the message is not an integral number OR the
+     * element count does not fit the 32-bit result (bigcount callers
+     * use MPI_Get_count_c — never truncate silently). */
+    if (status->_count % (long long)esz) {
         *count = MPI_UNDEFINED;
         return MPI_SUCCESS;
     }
-    *count = (int)((size_t)status->_count / esz);
+    long long c = status->_count / (long long)esz;
+    *count = (c > 2147483647LL) ? MPI_UNDEFINED : (int)c;
     return MPI_SUCCESS;
 }
 
@@ -853,26 +1038,33 @@ int PMPI_Barrier(MPI_Comm comm)
     return rc;
 }
 
-int PMPI_Bcast(void *buffer, int count, MPI_Datatype datatype, int root,
-              MPI_Comm comm)
+static int bcast_common_c(void *buffer, long long count,
+                          MPI_Datatype datatype, int root,
+                          MPI_Comm comm)
 {
-    size_t esz = dt_extent(datatype);
-    if (!esz || count < 0)
+    long long off, len;
+    if (!dt_window(datatype, count, &off, &len))
         return MPI_ERR_TYPE;
-    size_t nbytes = (size_t)count * esz;
+    char *win = (char *)buffer + off;
     GIL_BEGIN;
     int rc = MPI_SUCCESS;
     PyObject *r = PyObject_CallMethod(g_mod, "bcast", "lNli", (long)comm,
-                                      mem_ro(buffer, nbytes),
+                                      mem_ro(win, (size_t)len),
                                       (long)datatype, root);
     if (!r)
         rc = handle_error_comm(comm, "MPI_Bcast");
     else {
-        rc = copy_bytes(r, buffer, nbytes);
+        rc = copy_bytes(r, win, (size_t)len);
         Py_DECREF(r);
     }
     GIL_END;
     return rc;
+}
+
+int PMPI_Bcast(void *buffer, int count, MPI_Datatype datatype, int root,
+              MPI_Comm comm)
+{
+    return bcast_common_c(buffer, count, datatype, root, comm);
 }
 
 /* sendbuf/recvbuf pair with MPI_IN_PLACE support: in place means the
@@ -1252,7 +1444,9 @@ int PMPI_Type_size(MPI_Datatype datatype, int *size)
     long s;
     int rc = type_query("type_size_bytes", datatype, &s);
     if (rc == MPI_SUCCESS)
-        *size = (int)s;
+        /* a size past INT_MAX is unrepresentable here: MPI_UNDEFINED,
+         * never silent truncation (bigcount callers use Type_size_c) */
+        *size = s > 2147483647L ? MPI_UNDEFINED : (int)s;
     return rc;
 }
 
@@ -1263,7 +1457,9 @@ int PMPI_Type_get_extent(MPI_Datatype datatype, MPI_Aint *lb,
     int rc = type_query("type_extent_bytes", datatype, &e);
     if (rc == MPI_SUCCESS) {
         if (lb)
-            *lb = 0;
+            *lb = datatype >= DT_FIRST_DYN
+                ? (MPI_Aint)dyn_query_ll("type_lb_bytes", datatype)
+                : 0;
         *extent = (MPI_Aint)e;
     }
     return rc;
@@ -1593,14 +1789,14 @@ int PMPI_Recv_init(void *buf, int count, MPI_Datatype datatype,
                   int source, int tag, MPI_Comm comm,
                   MPI_Request *request)
 {
-    size_t esz = dt_extent(datatype);
-    if (!esz || count < 0)
+    long long woff, wlen;
+    if (!dt_window(datatype, count, &woff, &wlen))
         return MPI_ERR_TYPE;
     req_entry *e = req_new();
     e->persistent = 1;
     e->is_recv = 1;
-    e->buf = buf;
-    e->cap = (size_t)count * esz;
+    e->buf = (char *)buf + woff;
+    e->cap = (size_t)wlen;
     e->count = count;
     e->dt = datatype;
     e->peer = source;
@@ -1615,15 +1811,29 @@ int PMPI_Start(MPI_Request *request)
     if (!request || *request == MPI_REQUEST_NULL)
         return MPI_ERR_REQUEST;
     req_entry *e = (req_entry *)(intptr_t)*request;
+    if (e->is_part) {                    /* partitioned: re-arm */
+        GIL_BEGIN;
+        int rc = MPI_SUCCESS;
+        PyObject *r = PyObject_CallMethod(g_mod, "part_start", "l",
+                                          e->pyh);
+        if (!r)
+            rc = handle_error("MPI_Start");
+        else
+            Py_DECREF(r);
+        GIL_END;
+        return rc;
+    }
     if (!e->persistent || e->pyh != 0)
         return MPI_ERR_REQUEST;          /* not persistent, or active */
-    size_t esz = dt_extent(e->dt);
+    long long woff, wlen;
+    if (!dt_window(e->dt, e->count, &woff, &wlen))
+        return MPI_ERR_TYPE;
     GIL_BEGIN;
     int rc = MPI_SUCCESS;
     PyObject *r;
     if (e->is_recv) {
-        size_t snap = e->dt >= DT_FIRST_DYN
-            ? (size_t)e->count * esz : 0;
+        /* e->buf was window-adjusted at init time */
+        size_t snap = e->dt >= DT_FIRST_DYN ? (size_t)wlen : 0;
         r = PyObject_CallMethod(g_mod, "irecv", "liilN", (long)e->comm,
                                 e->peer, e->tag, (long)e->dt,
                                 mem_ro(e->buf, snap));
@@ -1631,8 +1841,8 @@ int PMPI_Start(MPI_Request *request)
         /* the buffer is re-read at EVERY start (persistent semantics:
          * the app refills it between rounds) */
         r = PyObject_CallMethod(g_mod, "isend", "lNlii", (long)e->comm,
-                                mem_ro(e->sbuf,
-                                       (size_t)e->count * esz),
+                                mem_ro((const char *)e->sbuf + woff,
+                                       (size_t)wlen),
                                 (long)e->dt, e->peer, e->tag);
     }
     if (!r)
@@ -1661,6 +1871,19 @@ int PMPI_Request_free(MPI_Request *request)
         return MPI_ERR_REQUEST;
     req_entry *e = (req_entry *)(intptr_t)*request;
     int rc = MPI_SUCCESS;
+    if (e->is_part) {                    /* release the glue entry */
+        GIL_BEGIN;
+        PyObject *r = PyObject_CallMethod(g_mod, "part_free", "l",
+                                          e->pyh);
+        if (!r)
+            PyErr_Clear();
+        else
+            Py_DECREF(r);
+        GIL_END;
+        free(e);
+        *request = MPI_REQUEST_NULL;
+        return MPI_SUCCESS;
+    }
     if (e->pyh != 0) {                   /* active: complete first */
         rc = PMPI_Wait(request, MPI_STATUS_IGNORE);
         if (*request == MPI_REQUEST_NULL)
@@ -2129,13 +2352,14 @@ int PMPI_Pack(const void *inbuf, int incount, MPI_Datatype datatype,
              void *outbuf, int outsize, int *position, MPI_Comm comm)
 {
     (void)comm;
-    size_t esz = dt_extent(datatype);
-    if (!esz || incount < 0)
+    long long woff, wlen;
+    if (!dt_window(datatype, incount, &woff, &wlen))
         return MPI_ERR_TYPE;
     GIL_BEGIN;
     int rc = MPI_SUCCESS;
     PyObject *r = PyObject_CallMethod(
-        g_mod, "pack", "Nli", mem_ro(inbuf, (size_t)incount * esz),
+        g_mod, "pack", "Nli",
+        mem_ro((const char *)inbuf + woff, (size_t)wlen),
         (long)datatype, incount);
     if (!r)
         rc = handle_error_comm(comm, "MPI_Pack");
@@ -2161,27 +2385,27 @@ int PMPI_Unpack(const void *inbuf, int insize, int *position,
                MPI_Comm comm)
 {
     (void)comm;
-    size_t esz = dt_extent(datatype);
     size_t sig = dt_sig(datatype);
-    if (!esz || outcount < 0)
+    long long woff, wlen;
+    if (!dt_window(datatype, outcount, &woff, &wlen))
         return MPI_ERR_TYPE;
     size_t need = sig * (size_t)outcount;
     /* size_t arithmetic end to end: an int cast of a >2 GiB payload
      * would wrap negative and bypass the bounds check */
     if ((size_t)*position + need > (size_t)insize)
         return MPI_ERR_TRUNCATE;
-    size_t extent_bytes = (size_t)outcount * esz;
+    char *win = (char *)outbuf + woff;
     GIL_BEGIN;
     int rc = MPI_SUCCESS;
     PyObject *r = PyObject_CallMethod(
         g_mod, "unpack", "NliN",
         mem_ro((const char *)inbuf + *position, need), (long)datatype,
         outcount,
-        mem_ro(outbuf, datatype >= DT_FIRST_DYN ? extent_bytes : 0));
+        mem_ro(win, datatype >= DT_FIRST_DYN ? (size_t)wlen : 0));
     if (!r)
         rc = handle_error_comm(comm, "MPI_Unpack");
     else {
-        rc = copy_bytes(r, outbuf, extent_bytes);
+        rc = copy_bytes(r, win, (size_t)wlen);
         if (rc == MPI_SUCCESS)
             *position += (int)need;
         Py_DECREF(r);
@@ -2701,7 +2925,22 @@ int PMPI_Neighbor_alltoall(const void *sendbuf, int sendcount,
 
 int PMPI_Error_class(int errorcode, int *errorclass)
 {
-    /* codes ARE classes in this ABI (core/errhandler.py values) */
+    /* predefined codes ARE classes in this ABI (core/errhandler.py
+     * values); codes minted by MPI_Add_error_code resolve through the
+     * glue's dynamic table */
+    if (errorcode > MPI_ERR_LASTCODE && g_mod) {
+        GIL_BEGIN;
+        PyObject *r = PyObject_CallMethod(g_mod, "error_class_of", "i",
+                                          errorcode);
+        if (r) {
+            *errorclass = (int)PyLong_AsLong(r);
+            Py_DECREF(r);
+            GIL_END;
+            return MPI_SUCCESS;
+        }
+        PyErr_Clear();
+        GIL_END;
+    }
     *errorclass = errorcode;
     return MPI_SUCCESS;
 }
@@ -4829,6 +5068,1533 @@ int PMPI_T_pvar_read(MPI_T_pvar_session session,
     *(unsigned long long *)buf =
         (unsigned long long)t_long(r, -1, 0);
     t_drop(r);
+    return MPI_SUCCESS;
+}
+
+/* ------------------------------------------------------------------ */
+/* round-5 wave 3: send modes, matched probe, cancel, generalized
+ * requests, dynamic error space (the textbook-closure set; reference
+ * templates under ompi/mpi/c/: issend.c.in, mprobe.c.in, cancel.c.in,
+ * grequest_start.c.in, add_error_class.c.in).                         */
+/* ------------------------------------------------------------------ */
+
+int PMPI_Issend(const void *buf, int count, MPI_Datatype datatype,
+               int dest, int tag, MPI_Comm comm, MPI_Request *request)
+{
+    long long off, len;
+    if (!dt_window(datatype, count, &off, &len))
+        return MPI_ERR_TYPE;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "issend", "lNlii", (long)comm,
+        mem_ro((const char *)buf + off, (size_t)len), (long)datatype,
+        dest, tag);
+    if (!r) {
+        rc = handle_error_comm(comm, "MPI_Issend");
+    } else {
+        req_entry *e = req_new();
+        e->pyh = PyLong_AsLong(r);
+        *request = (MPI_Request)(intptr_t)e;
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Ibsend(const void *buf, int count, MPI_Datatype datatype,
+               int dest, int tag, MPI_Comm comm, MPI_Request *request)
+{
+    return isend_common_c(buf, count, datatype, dest, tag, comm,
+                          request, "MPI_Ibsend");
+}
+
+int PMPI_Irsend(const void *buf, int count, MPI_Datatype datatype,
+               int dest, int tag, MPI_Comm comm, MPI_Request *request)
+{
+    return isend_common_c(buf, count, datatype, dest, tag, comm,
+                          request, "MPI_Irsend");
+}
+
+static int sendmode_init(const void *buf, int count,
+                         MPI_Datatype datatype, int dest, int tag,
+                         MPI_Comm comm, MPI_Request *request)
+{
+    return PMPI_Send_init(buf, count, datatype, dest, tag, comm,
+                          request);
+}
+
+int PMPI_Bsend_init(const void *buf, int count, MPI_Datatype datatype,
+                   int dest, int tag, MPI_Comm comm,
+                   MPI_Request *request)
+{
+    return sendmode_init(buf, count, datatype, dest, tag, comm,
+                         request);
+}
+
+int PMPI_Ssend_init(const void *buf, int count, MPI_Datatype datatype,
+                   int dest, int tag, MPI_Comm comm,
+                   MPI_Request *request)
+{
+    return sendmode_init(buf, count, datatype, dest, tag, comm,
+                         request);
+}
+
+int PMPI_Rsend_init(const void *buf, int count, MPI_Datatype datatype,
+                   int dest, int tag, MPI_Comm comm,
+                   MPI_Request *request)
+{
+    return sendmode_init(buf, count, datatype, dest, tag, comm,
+                         request);
+}
+
+/* ---- matched probe (mprobe.c.in / imrecv.c.in) ------------------- */
+int PMPI_Mprobe(int source, int tag, MPI_Comm comm,
+               MPI_Message *message, MPI_Status *status)
+{
+    if (source == MPI_PROC_NULL) {
+        *message = MPI_MESSAGE_NO_PROC;
+        set_status(status, MPI_PROC_NULL, tag, 0);
+        return MPI_SUCCESS;
+    }
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "mprobe", "lii",
+                                      (long)comm, source, tag);
+    if (!r) {
+        rc = handle_error_comm(comm, "MPI_Mprobe");
+    } else {
+        *message = (MPI_Message)PyLong_AsLong(PyTuple_GetItem(r, 0));
+        set_status(status,
+                   (int)PyLong_AsLong(PyTuple_GetItem(r, 1)),
+                   (int)PyLong_AsLong(PyTuple_GetItem(r, 2)),
+                   PyLong_AsLongLong(PyTuple_GetItem(r, 3)));
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Improbe(int source, int tag, MPI_Comm comm, int *flag,
+                MPI_Message *message, MPI_Status *status)
+{
+    if (source == MPI_PROC_NULL) {
+        *flag = 1;
+        *message = MPI_MESSAGE_NO_PROC;
+        set_status(status, MPI_PROC_NULL, tag, 0);
+        return MPI_SUCCESS;
+    }
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "improbe", "lii",
+                                      (long)comm, source, tag);
+    if (!r) {
+        rc = handle_error_comm(comm, "MPI_Improbe");
+    } else {
+        *flag = (int)PyLong_AsLong(PyTuple_GetItem(r, 0));
+        if (*flag) {
+            *message =
+                (MPI_Message)PyLong_AsLong(PyTuple_GetItem(r, 1));
+            set_status(status,
+                       (int)PyLong_AsLong(PyTuple_GetItem(r, 2)),
+                       (int)PyLong_AsLong(PyTuple_GetItem(r, 3)),
+                       PyLong_AsLongLong(PyTuple_GetItem(r, 4)));
+        }
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Mrecv(void *buf, int count, MPI_Datatype datatype,
+              MPI_Message *message, MPI_Status *status)
+{
+    if (*message == MPI_MESSAGE_NO_PROC) {
+        *message = MPI_MESSAGE_NULL;
+        set_status(status, MPI_PROC_NULL, MPI_ANY_TAG, 0);
+        return MPI_SUCCESS;
+    }
+    long long off, len;
+    if (!dt_window(datatype, count, &off, &len))
+        return MPI_ERR_TYPE;
+    char *win = (char *)buf + off;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    size_t snap = datatype >= DT_FIRST_DYN ? (size_t)len : 0;
+    PyObject *r = PyObject_CallMethod(g_mod, "mrecv", "llN",
+                                      (long)*message, (long)datatype,
+                                      mem_ro(win, snap));
+    if (!r) {
+        rc = handle_error("MPI_Mrecv");
+    } else {
+        rc = copy_msg(r, win, (size_t)len, status);
+        Py_DECREF(r);
+        *message = MPI_MESSAGE_NULL;
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Imrecv(void *buf, int count, MPI_Datatype datatype,
+               MPI_Message *message, MPI_Request *request)
+{
+    if (*message == MPI_MESSAGE_NO_PROC) {
+        *message = MPI_MESSAGE_NULL;
+        *request = MPI_REQUEST_NULL;
+        return MPI_SUCCESS;
+    }
+    long long off, len;
+    if (!dt_window(datatype, count, &off, &len))
+        return MPI_ERR_TYPE;
+    char *win = (char *)buf + off;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    size_t snap = datatype >= DT_FIRST_DYN ? (size_t)len : 0;
+    PyObject *r = PyObject_CallMethod(g_mod, "imrecv", "llN",
+                                      (long)*message, (long)datatype,
+                                      mem_ro(win, snap));
+    if (!r) {
+        rc = handle_error("MPI_Imrecv");
+    } else {
+        req_entry *e = req_new();
+        e->pyh = PyLong_AsLong(r);
+        e->buf = win;
+        e->cap = (size_t)len;
+        *request = (MPI_Request)(intptr_t)e;
+        Py_DECREF(r);
+        *message = MPI_MESSAGE_NULL;
+    }
+    GIL_END;
+    return rc;
+}
+
+/* ---- cancel (cancel.c.in) ---------------------------------------- */
+int PMPI_Cancel(MPI_Request *request)
+{
+    if (!request || *request == MPI_REQUEST_NULL)
+        return MPI_ERR_REQUEST;
+    req_entry *e = (req_entry *)(intptr_t)*request;
+    if (e->is_part)
+        return MPI_SUCCESS;              /* partitioned transfers are
+                                          * past the cancellation
+                                          * point once started */
+    if (e->is_greq) {
+        if (e->greq_cancel)
+            return e->greq_cancel(e->greq_extra, e->greq_done);
+        return MPI_SUCCESS;
+    }
+    if (e->persistent && e->pyh == 0)
+        return MPI_SUCCESS;              /* inactive: nothing in flight */
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "request_cancel", "l",
+                                      e->pyh);
+    if (!r)
+        rc = handle_error("MPI_Cancel");
+    else
+        Py_DECREF(r);
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Test_cancelled(const MPI_Status *status, int *flag)
+{
+    if (!status || !flag)
+        return MPI_ERR_ARG;
+    *flag = status->_cancelled;
+    return MPI_SUCCESS;
+}
+
+int PMPI_Status_set_cancelled(MPI_Status *status, int flag)
+{
+    if (!status)
+        return MPI_ERR_ARG;
+    status->_cancelled = flag ? 1 : 0;
+    return MPI_SUCCESS;
+}
+
+int PMPI_Status_set_elements(MPI_Status *status, MPI_Datatype datatype,
+                            int count)
+{
+    if (!status || count < 0)
+        return MPI_ERR_ARG;
+    size_t esz = dt_sig(datatype);
+    if (!esz)
+        return MPI_ERR_TYPE;
+    status->_count = (long long)count * (long long)esz;
+    return MPI_SUCCESS;
+}
+
+int PMPI_Status_set_elements_x(MPI_Status *status,
+                              MPI_Datatype datatype, MPI_Count count)
+{
+    if (!status || count < 0)
+        return MPI_ERR_ARG;
+    size_t esz = dt_sig(datatype);
+    if (!esz)
+        return MPI_ERR_TYPE;
+    status->_count = count * (long long)esz;
+    return MPI_SUCCESS;
+}
+
+/* ---- generalized requests (grequest_start.c.in) ------------------ */
+int PMPI_Grequest_start(MPI_Grequest_query_function *query_fn,
+                       MPI_Grequest_free_function *free_fn,
+                       MPI_Grequest_cancel_function *cancel_fn,
+                       void *extra_state, MPI_Request *request)
+{
+    req_entry *e = req_new();
+    e->is_greq = 1;
+    e->greq_query = query_fn;
+    e->greq_free = free_fn;
+    e->greq_cancel = cancel_fn;
+    e->greq_extra = extra_state;
+    *request = (MPI_Request)(intptr_t)e;
+    return MPI_SUCCESS;
+}
+
+int PMPI_Grequest_complete(MPI_Request request)
+{
+    if (request == MPI_REQUEST_NULL)
+        return MPI_ERR_REQUEST;
+    req_entry *e = (req_entry *)(intptr_t)request;
+    if (!e->is_greq)
+        return MPI_ERR_REQUEST;
+    e->greq_done = 1;
+    return MPI_SUCCESS;
+}
+
+/* ---- dynamic error space (add_error_class.c.in) ------------------ */
+int PMPI_Add_error_class(int *errorclass)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "add_error_class", NULL);
+    if (!r)
+        rc = handle_error("MPI_Add_error_class");
+    else {
+        *errorclass = (int)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Add_error_code(int errorclass, int *errorcode)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "add_error_code", "i",
+                                      errorclass);
+    if (!r)
+        rc = handle_error("MPI_Add_error_code");
+    else {
+        *errorcode = (int)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Add_error_string(int errorcode, const char *string)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "add_error_string", "is",
+                                      errorcode, string);
+    if (!r)
+        rc = handle_error("MPI_Add_error_string");
+    else
+        Py_DECREF(r);
+    GIL_END;
+    return rc;
+}
+
+/* ---- local reduction (reduce_local.c.in) ------------------------- */
+int PMPI_Reduce_local(const void *inbuf, void *inoutbuf, int count,
+                     MPI_Datatype datatype, MPI_Op op)
+{
+    size_t esz = dt_size(datatype);
+    if (!esz || count < 0)
+        return MPI_ERR_TYPE;
+    size_t nbytes = (size_t)count * esz;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "reduce_local", "NNll", mem_ro(inbuf, nbytes),
+        mem_ro(inoutbuf, nbytes), (long)datatype, (long)op);
+    if (!r)
+        rc = handle_error("MPI_Reduce_local");
+    else {
+        rc = copy_bytes(r, inoutbuf, nbytes);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+/* ---- communicator construction closure --------------------------- */
+int PMPI_Cart_sub(MPI_Comm comm, const int remain_dims[],
+                 MPI_Comm *newcomm)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    int nd = 0;
+    {
+        PyObject *q = PyObject_CallMethod(g_mod, "cartdim_get", "l",
+                                          (long)comm);
+        if (q) {
+            nd = (int)PyLong_AsLong(q);
+            Py_DECREF(q);
+        } else {
+            rc = handle_error_comm(comm, "MPI_Cart_sub");
+        }
+    }
+    if (rc == MPI_SUCCESS) {
+        PyObject *r = PyObject_CallMethod(
+            g_mod, "cart_sub", "lN", (long)comm,
+            mem_ro(remain_dims, (size_t)nd * sizeof(int)));
+        if (!r)
+            rc = handle_error_comm(comm, "MPI_Cart_sub");
+        else {
+            *newcomm = (MPI_Comm)PyLong_AsLong(r);
+            Py_DECREF(r);
+        }
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Intercomm_create(MPI_Comm local_comm, int local_leader,
+                         MPI_Comm peer_comm, int remote_leader,
+                         int tag, MPI_Comm *newintercomm)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "intercomm_create", "lilii", (long)local_comm,
+        local_leader, (long)peer_comm, remote_leader, tag);
+    if (!r)
+        rc = handle_error_comm(local_comm, "MPI_Intercomm_create");
+    else {
+        *newintercomm = (MPI_Comm)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Intercomm_merge(MPI_Comm intercomm, int high,
+                        MPI_Comm *newintracomm)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "intercomm_merge", "li",
+                                      (long)intercomm, high);
+    if (!r)
+        rc = handle_error_comm(intercomm, "MPI_Intercomm_merge");
+    else {
+        *newintracomm = (MPI_Comm)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Comm_create_group(MPI_Comm comm, MPI_Group group, int tag,
+                          MPI_Comm *newcomm)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "comm_create_group",
+                                      "lli", (long)comm, (long)group,
+                                      tag);
+    if (!r)
+        rc = handle_error_comm(comm, "MPI_Comm_create_group");
+    else {
+        *newcomm = (MPI_Comm)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+/* ---- datatype constructor closure -------------------------------- */
+static int type_ctor_result(PyObject *r, MPI_Datatype *newtype,
+                            const char *fn)
+{
+    if (!r)
+        return handle_error(fn);
+    *newtype = (MPI_Datatype)PyLong_AsLong(r);
+    Py_DECREF(r);
+    return MPI_SUCCESS;
+}
+
+int PMPI_Type_create_hvector(int count, int blocklength, MPI_Aint stride,
+                            MPI_Datatype oldtype, MPI_Datatype *newtype)
+{
+    GIL_BEGIN;
+    int rc = type_ctor_result(
+        PyObject_CallMethod(g_mod, "type_create_hvector", "iiLl",
+                            count, blocklength, (long long)stride,
+                            (long)oldtype),
+        newtype, "MPI_Type_create_hvector");
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Type_create_hindexed(int count, const int blocklengths[],
+                             const MPI_Aint displacements[],
+                             MPI_Datatype oldtype,
+                             MPI_Datatype *newtype)
+{
+    /* marshal MPI_Aint displacements as int64 regardless of long
+     * width */
+    long long *d64 = malloc(sizeof(long long) * (size_t)count);
+    if (!d64 && count)
+        return MPI_ERR_INTERN;
+    for (int i = 0; i < count; i++)
+        d64[i] = (long long)displacements[i];
+    GIL_BEGIN;
+    int rc = type_ctor_result(
+        PyObject_CallMethod(
+            g_mod, "type_create_hindexed", "NNl",
+            mem_ro(blocklengths, sizeof(int) * (size_t)count),
+            mem_ro(d64, sizeof(long long) * (size_t)count),
+            (long)oldtype),
+        newtype, "MPI_Type_create_hindexed");
+    GIL_END;
+    free(d64);
+    return rc;
+}
+
+int PMPI_Type_create_hindexed_block(int count, int blocklength,
+                                   const MPI_Aint displacements[],
+                                   MPI_Datatype oldtype,
+                                   MPI_Datatype *newtype)
+{
+    long long *d64 = malloc(sizeof(long long) * (size_t)count);
+    if (!d64 && count)
+        return MPI_ERR_INTERN;
+    for (int i = 0; i < count; i++)
+        d64[i] = (long long)displacements[i];
+    GIL_BEGIN;
+    int rc = type_ctor_result(
+        PyObject_CallMethod(
+            g_mod, "type_create_hindexed_block", "iNl", blocklength,
+            mem_ro(d64, sizeof(long long) * (size_t)count),
+            (long)oldtype),
+        newtype, "MPI_Type_create_hindexed_block");
+    GIL_END;
+    free(d64);
+    return rc;
+}
+
+int PMPI_Type_create_struct(int count, const int blocklengths[],
+                           const MPI_Aint displacements[],
+                           const MPI_Datatype types[],
+                           MPI_Datatype *newtype)
+{
+    long long *d64 = malloc(sizeof(long long) * (size_t)count);
+    long long *t64 = malloc(sizeof(long long) * (size_t)count);
+    if ((!d64 || !t64) && count) {
+        free(d64);
+        free(t64);
+        return MPI_ERR_INTERN;
+    }
+    for (int i = 0; i < count; i++) {
+        d64[i] = (long long)displacements[i];
+        t64[i] = (long long)types[i];
+    }
+    GIL_BEGIN;
+    int rc = type_ctor_result(
+        PyObject_CallMethod(
+            g_mod, "type_create_struct", "NNN",
+            mem_ro(blocklengths, sizeof(int) * (size_t)count),
+            mem_ro(d64, sizeof(long long) * (size_t)count),
+            mem_ro(t64, sizeof(long long) * (size_t)count)),
+        newtype, "MPI_Type_create_struct");
+    GIL_END;
+    free(d64);
+    free(t64);
+    return rc;
+}
+
+int PMPI_Type_create_subarray(int ndims, const int sizes[],
+                             const int subsizes[], const int starts[],
+                             int order, MPI_Datatype oldtype,
+                             MPI_Datatype *newtype)
+{
+    GIL_BEGIN;
+    int rc = type_ctor_result(
+        PyObject_CallMethod(
+            g_mod, "type_create_subarray", "NNNil",
+            mem_ro(sizes, sizeof(int) * (size_t)ndims),
+            mem_ro(subsizes, sizeof(int) * (size_t)ndims),
+            mem_ro(starts, sizeof(int) * (size_t)ndims),
+            order, (long)oldtype),
+        newtype, "MPI_Type_create_subarray");
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Type_create_darray(int size, int rank, int ndims,
+                           const int gsizes[], const int distribs[],
+                           const int dargs[], const int psizes[],
+                           int order, MPI_Datatype oldtype,
+                           MPI_Datatype *newtype)
+{
+    GIL_BEGIN;
+    int rc = type_ctor_result(
+        PyObject_CallMethod(
+            g_mod, "type_create_darray", "iiNNNNil", size, rank,
+            mem_ro(gsizes, sizeof(int) * (size_t)ndims),
+            mem_ro(distribs, sizeof(int) * (size_t)ndims),
+            mem_ro(dargs, sizeof(int) * (size_t)ndims),
+            mem_ro(psizes, sizeof(int) * (size_t)ndims),
+            order, (long)oldtype),
+        newtype, "MPI_Type_create_darray");
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Type_get_true_extent(MPI_Datatype datatype, MPI_Aint *true_lb,
+                             MPI_Aint *true_extent)
+{
+    if (datatype < DT_FIRST_DYN) {
+        size_t s = dt_size(datatype);
+        if (!s)
+            return MPI_ERR_TYPE;
+        *true_lb = 0;
+        *true_extent = (MPI_Aint)s;
+        return MPI_SUCCESS;
+    }
+    *true_lb = (MPI_Aint)dyn_query_ll("type_true_lb_bytes", datatype);
+    *true_extent =
+        (MPI_Aint)dyn_query_ll("type_true_span_bytes", datatype);
+    return MPI_SUCCESS;
+}
+
+/* ---- Alltoallw (alltoallw.c.in): per-peer types and displs ------- */
+int PMPI_Alltoallw(const void *sendbuf, const int sendcounts[],
+                  const int sdispls[], const MPI_Datatype sendtypes[],
+                  void *recvbuf, const int recvcounts[],
+                  const int rdispls[], const MPI_Datatype recvtypes[],
+                  MPI_Comm comm)
+{
+    int n;
+    int rc = PMPI_Comm_size(comm, &n);
+    if (rc != MPI_SUCCESS)
+        return rc;
+    /* windows must span every peer lane on both sides */
+    long long send_hi = 0, recv_hi = 0;
+    long long *st64 = malloc(sizeof(long long) * (size_t)n);
+    long long *rt64 = malloc(sizeof(long long) * (size_t)n);
+    if ((!st64 || !rt64) && n) {
+        free(st64);
+        free(rt64);
+        return MPI_ERR_INTERN;
+    }
+    for (int j = 0; j < n; j++) {
+        long long off, len;
+        if (sdispls[j] < 0 || rdispls[j] < 0
+            || !dt_window(sendtypes[j], sendcounts[j], &off, &len)
+            || off != 0) {
+            free(st64);
+            free(rt64);
+            return MPI_ERR_TYPE;         /* nonzero-lb lanes: edge */
+        }
+        if (sdispls[j] + len > send_hi)
+            send_hi = sdispls[j] + len;
+        if (!dt_window(recvtypes[j], recvcounts[j], &off, &len)
+            || off != 0) {
+            free(st64);
+            free(rt64);
+            return MPI_ERR_TYPE;
+        }
+        if (rdispls[j] + len > recv_hi)
+            recv_hi = rdispls[j] + len;
+        st64[j] = (long long)sendtypes[j];
+        rt64[j] = (long long)recvtypes[j];
+    }
+    GIL_BEGIN;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "alltoallw", "lNNNNNNNN", (long)comm,
+        mem_ro(sendbuf, (size_t)send_hi),
+        mem_ro(sendcounts, sizeof(int) * (size_t)n),
+        mem_ro(sdispls, sizeof(int) * (size_t)n),
+        mem_ro(st64, sizeof(long long) * (size_t)n),
+        mem_ro(recvbuf, (size_t)recv_hi),
+        mem_ro(recvcounts, sizeof(int) * (size_t)n),
+        mem_ro(rdispls, sizeof(int) * (size_t)n),
+        mem_ro(rt64, sizeof(long long) * (size_t)n));
+    if (!r)
+        rc = handle_error_comm(comm, "MPI_Alltoallw");
+    else {
+        rc = copy_bytes(r, recvbuf, (size_t)recv_hi);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    free(st64);
+    free(rt64);
+    return rc;
+}
+
+/* ------------------------------------------------------------------ */
+/* round-5 wave 3 part B: file views + individual pointers + ordered
+ * access (file_set_view.c.in, file_iread.c.in, file_read_ordered
+ * .c.in), dynamic RMA windows (win_create_dynamic.c.in), spawn
+ * (comm_spawn.c.in), the MPI-4 bigcount surface
+ * (ompi/mpi/bindings/ompi_bindings/c.py:296), and MPI_T events.       */
+/* ------------------------------------------------------------------ */
+
+int PMPI_File_set_view(MPI_File fh, MPI_Offset disp, MPI_Datatype etype,
+                      MPI_Datatype filetype, const char *datarep,
+                      MPI_Info info)
+{
+    (void)info;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "file_set_view", "lLlls",
+                                      (long)fh, (long long)disp,
+                                      (long)etype, (long)filetype,
+                                      datarep ? datarep : "native");
+    if (!r)
+        rc = handle_error("MPI_File_set_view");
+    else
+        Py_DECREF(r);
+    GIL_END;
+    return rc;
+}
+
+int PMPI_File_get_view(MPI_File fh, MPI_Offset *disp,
+                      MPI_Datatype *etype, MPI_Datatype *filetype,
+                      char *datarep)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "file_get_view", "l",
+                                      (long)fh);
+    if (!r) {
+        rc = handle_error("MPI_File_get_view");
+    } else {
+        *disp = (MPI_Offset)PyLong_AsLongLong(PyTuple_GetItem(r, 0));
+        *etype = (MPI_Datatype)PyLong_AsLong(PyTuple_GetItem(r, 1));
+        *filetype =
+            (MPI_Datatype)PyLong_AsLong(PyTuple_GetItem(r, 2));
+        if (datarep) {
+            const char *s = PyUnicode_AsUTF8(PyTuple_GetItem(r, 3));
+            strcpy(datarep, s ? s : "native");
+        }
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_File_seek(MPI_File fh, MPI_Offset offset, int whence)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "file_seek", "lLi",
+                                      (long)fh, (long long)offset,
+                                      whence);
+    if (!r)
+        rc = handle_error("MPI_File_seek");
+    else
+        Py_DECREF(r);
+    GIL_END;
+    return rc;
+}
+
+int PMPI_File_get_position(MPI_File fh, MPI_Offset *offset)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "file_get_position", "l",
+                                      (long)fh);
+    if (!r) {
+        rc = handle_error("MPI_File_get_position");
+    } else {
+        *offset = (MPI_Offset)PyLong_AsLongLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+/* individual-pointer read/write: offset -1 tells the glue to use (and
+ * advance) the handle's individual file pointer */
+int PMPI_File_read(MPI_File fh, void *buf, int count,
+                  MPI_Datatype datatype, MPI_Status *status)
+{
+    return file_read_common("file_read_ind", fh, (MPI_Offset)-1, buf,
+                            count, datatype, status);
+}
+
+int PMPI_File_write(MPI_File fh, const void *buf, int count,
+                   MPI_Datatype datatype, MPI_Status *status)
+{
+    return file_write_common("file_write_ind", fh, (MPI_Offset)-1, buf,
+                             count, datatype, status);
+}
+
+int PMPI_File_read_ordered(MPI_File fh, void *buf, int count,
+                          MPI_Datatype datatype, MPI_Status *status)
+{
+    return file_read_common("file_read_ordered", fh, (MPI_Offset)-1,
+                            buf, count, datatype, status);
+}
+
+int PMPI_File_write_ordered(MPI_File fh, const void *buf, int count,
+                           MPI_Datatype datatype, MPI_Status *status)
+{
+    return file_write_common("file_write_ordered", fh, (MPI_Offset)-1,
+                             buf, count, datatype, status);
+}
+
+/* nonblocking file IO: the glue returns a request handle whose wait
+ * delivers (bytes, 0, 0, nbytes) for reads, (b"", ...) for writes */
+static int file_iread_common(const char *fn, MPI_File fh,
+                             MPI_Offset offset, void *buf, int count,
+                             MPI_Datatype datatype,
+                             MPI_Request *request)
+{
+    size_t esz = dt_extent(datatype);
+    size_t sig = dt_sig(datatype);
+    if (!esz || count < 0)
+        return MPI_ERR_TYPE;
+    size_t extent_bytes = esz * (size_t)count;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, fn, "lLlLN", (long)fh, (long long)offset,
+        (long)(sig * (size_t)count), (long long)datatype,
+        mem_ro(buf, datatype >= DT_FIRST_DYN ? extent_bytes : 0));
+    if (!r) {
+        rc = handle_error(fn);
+    } else {
+        req_entry *e = req_new();
+        e->pyh = PyLong_AsLong(r);
+        e->buf = buf;
+        e->cap = extent_bytes;
+        *request = (MPI_Request)(intptr_t)e;
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+static int file_iwrite_common(const char *fn, MPI_File fh,
+                              MPI_Offset offset, const void *buf,
+                              int count, MPI_Datatype datatype,
+                              MPI_Request *request)
+{
+    size_t esz = dt_extent(datatype);
+    if (!esz || count < 0)
+        return MPI_ERR_TYPE;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, fn, "lLNl", (long)fh, (long long)offset,
+        mem_ro(buf, (size_t)count * esz), (long)datatype);
+    if (!r) {
+        rc = handle_error(fn);
+    } else {
+        req_entry *e = req_new();
+        e->pyh = PyLong_AsLong(r);
+        *request = (MPI_Request)(intptr_t)e;
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_File_iread(MPI_File fh, void *buf, int count,
+                   MPI_Datatype datatype, MPI_Request *request)
+{
+    return file_iread_common("file_iread", fh, (MPI_Offset)-1, buf,
+                             count, datatype, request);
+}
+
+int PMPI_File_iwrite(MPI_File fh, const void *buf, int count,
+                    MPI_Datatype datatype, MPI_Request *request)
+{
+    return file_iwrite_common("file_iwrite", fh, (MPI_Offset)-1, buf,
+                              count, datatype, request);
+}
+
+int PMPI_File_iread_at(MPI_File fh, MPI_Offset offset, void *buf,
+                      int count, MPI_Datatype datatype,
+                      MPI_Request *request)
+{
+    return file_iread_common("file_iread", fh, offset, buf, count,
+                             datatype, request);
+}
+
+int PMPI_File_iwrite_at(MPI_File fh, MPI_Offset offset, const void *buf,
+                       int count, MPI_Datatype datatype,
+                       MPI_Request *request)
+{
+    return file_iwrite_common("file_iwrite", fh, offset, buf, count,
+                              datatype, request);
+}
+
+int PMPI_File_seek_shared(MPI_File fh, MPI_Offset offset, int whence)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "file_seek_shared", "lLi",
+                                      (long)fh, (long long)offset,
+                                      whence);
+    if (!r)
+        rc = handle_error("MPI_File_seek_shared");
+    else
+        Py_DECREF(r);
+    GIL_END;
+    return rc;
+}
+
+int PMPI_File_get_position_shared(MPI_File fh, MPI_Offset *offset)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod,
+                                      "file_get_position_shared", "l",
+                                      (long)fh);
+    if (!r) {
+        rc = handle_error("MPI_File_get_position_shared");
+    } else {
+        *offset = (MPI_Offset)PyLong_AsLongLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Status_set_source(MPI_Status *status, int source)
+{
+    if (!status)
+        return MPI_ERR_ARG;
+    status->MPI_SOURCE = source;
+    return MPI_SUCCESS;
+}
+
+int PMPI_Status_set_tag(MPI_Status *status, int tag)
+{
+    if (!status)
+        return MPI_ERR_ARG;
+    status->MPI_TAG = tag;
+    return MPI_SUCCESS;
+}
+
+int PMPI_Status_set_error(MPI_Status *status, int err)
+{
+    if (!status)
+        return MPI_ERR_ARG;
+    status->MPI_ERROR = err;
+    return MPI_SUCCESS;
+}
+
+int PMPI_File_get_amode(MPI_File fh, int *amode)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "file_get_amode", "l",
+                                      (long)fh);
+    if (!r) {
+        rc = handle_error("MPI_File_get_amode");
+    } else {
+        *amode = (int)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_File_preallocate(MPI_File fh, MPI_Offset size)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "file_preallocate", "lL",
+                                      (long)fh, (long long)size);
+    if (!r)
+        rc = handle_error("MPI_File_preallocate");
+    else
+        Py_DECREF(r);
+    GIL_END;
+    return rc;
+}
+
+int PMPI_File_get_type_extent(MPI_File fh, MPI_Datatype datatype,
+                             MPI_Aint *extent)
+{
+    (void)fh;                            /* native representation:
+                                          * memory extent == file
+                                          * extent */
+    size_t e = dt_extent(datatype);
+    if (!e)
+        return MPI_ERR_TYPE;
+    *extent = (MPI_Aint)e;
+    return MPI_SUCCESS;
+}
+
+int PMPI_Ialltoallw(const void *sendbuf, const int sendcounts[],
+                   const int sdispls[], const MPI_Datatype sendtypes[],
+                   void *recvbuf, const int recvcounts[],
+                   const int rdispls[], const MPI_Datatype recvtypes[],
+                   MPI_Comm comm, MPI_Request *request)
+{
+    /* single-phase schedule: the w-variant's per-peer marshalling
+     * dominates; completion at wait via the blocking engine on a
+     * worker would race the recv buffer, so complete-at-call like the
+     * other single-controller i-collectives' documented edge — the
+     * per-rank tier still overlaps the underlying alltoall rounds */
+    int rc = PMPI_Alltoallw(sendbuf, sendcounts, sdispls, sendtypes,
+                           recvbuf, recvcounts, rdispls, recvtypes,
+                           comm);
+    if (rc == MPI_SUCCESS)
+        *request = MPI_REQUEST_NULL;     /* born complete */
+    return rc;
+}
+
+/* ---- dynamic windows (win_create_dynamic.c.in, win_attach.c.in) -- */
+int PMPI_Win_create_dynamic(MPI_Info info, MPI_Comm comm, MPI_Win *win)
+{
+    (void)info;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "win_create_dynamic", "l",
+                                      (long)comm);
+    if (!r) {
+        rc = handle_error_comm(comm, "MPI_Win_create_dynamic");
+    } else {
+        *win = (MPI_Win)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Win_attach(MPI_Win win, void *base, MPI_Aint size)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "win_attach", "lLL",
+                                      (long)win,
+                                      (long long)(intptr_t)base,
+                                      (long long)size);
+    if (!r)
+        rc = handle_error("MPI_Win_attach");
+    else
+        Py_DECREF(r);
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Win_detach(MPI_Win win, const void *base)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "win_detach", "lL",
+                                      (long)win,
+                                      (long long)(intptr_t)base);
+    if (!r)
+        rc = handle_error("MPI_Win_detach");
+    else
+        Py_DECREF(r);
+    GIL_END;
+    return rc;
+}
+
+/* ---- spawn (comm_spawn.c.in / comm_get_parent.c.in) -------------- */
+int PMPI_Comm_spawn(const char *command, char *argv[], int maxprocs,
+                   MPI_Info info, int root, MPI_Comm comm,
+                   MPI_Comm *intercomm, int array_of_errcodes[])
+{
+    (void)info;
+    /* argv -> one \x1f-joined string (the glue splits; \x1f cannot
+     * appear in shell-safe argv) */
+    size_t total = 1;
+    for (char **a = argv; a && *a; a++)
+        total += strlen(*a) + 1;
+    char *joined = malloc(total);
+    if (!joined)
+        return MPI_ERR_INTERN;
+    joined[0] = '\0';
+    for (char **a = argv; a && *a; a++) {
+        strcat(joined, *a);
+        if (a[1])
+            strcat(joined, "\x1f");
+    }
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "comm_spawn", "lssii",
+                                      (long)comm, command, joined,
+                                      maxprocs, root);
+    if (!r) {
+        rc = handle_error_comm(comm, "MPI_Comm_spawn");
+    } else {
+        *intercomm = (MPI_Comm)PyLong_AsLong(r);
+        Py_DECREF(r);
+        if (array_of_errcodes)
+            for (int i = 0; i < maxprocs; i++)
+                array_of_errcodes[i] = MPI_SUCCESS;
+    }
+    GIL_END;
+    free(joined);
+    return rc;
+}
+
+int PMPI_Comm_get_parent(MPI_Comm *parent)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "comm_get_parent", NULL);
+    if (!r) {
+        rc = handle_error("MPI_Comm_get_parent");
+    } else {
+        *parent = (MPI_Comm)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+/* ---- MPI-4 bigcount (_c): 64-bit counts end to end --------------- */
+int PMPI_Send_c(const void *buf, MPI_Count count, MPI_Datatype datatype,
+               int dest, int tag, MPI_Comm comm)
+{
+    return send_common_c(buf, count, datatype, dest, tag, comm, 0,
+                         "MPI_Send_c");
+}
+
+int PMPI_Recv_c(void *buf, MPI_Count count, MPI_Datatype datatype,
+               int source, int tag, MPI_Comm comm, MPI_Status *status)
+{
+    return recv_common_c(buf, count, datatype, source, tag, comm,
+                         status);
+}
+
+int PMPI_Isend_c(const void *buf, MPI_Count count, MPI_Datatype datatype,
+                int dest, int tag, MPI_Comm comm, MPI_Request *request)
+{
+    return isend_common_c(buf, count, datatype, dest, tag, comm,
+                          request, "MPI_Isend_c");
+}
+
+int PMPI_Irecv_c(void *buf, MPI_Count count, MPI_Datatype datatype,
+                int source, int tag, MPI_Comm comm,
+                MPI_Request *request)
+{
+    return irecv_common_c(buf, count, datatype, source, tag, comm,
+                          request);
+}
+
+int PMPI_Bcast_c(void *buffer, MPI_Count count, MPI_Datatype datatype,
+                int root, MPI_Comm comm)
+{
+    return bcast_common_c(buffer, count, datatype, root, comm);
+}
+
+static int allreduce_common_c(const void *sendbuf, void *recvbuf,
+                              long long count, MPI_Datatype datatype,
+                              MPI_Op op, MPI_Comm comm,
+                              const char *fn)
+{
+    size_t esz = dt_size(datatype);
+    if (!esz || count < 0)
+        return MPI_ERR_TYPE;
+    size_t nbytes = (size_t)count * esz;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "allreduce", "lNll", (long)comm,
+        mem_ro(sendbuf == MPI_IN_PLACE ? recvbuf : sendbuf, nbytes),
+        (long)datatype, (long)op);
+    if (!r)
+        rc = handle_error_comm(comm, fn);
+    else {
+        rc = copy_bytes(r, recvbuf, nbytes);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Allreduce_c(const void *sendbuf, void *recvbuf, MPI_Count count,
+                    MPI_Datatype datatype, MPI_Op op, MPI_Comm comm)
+{
+    return allreduce_common_c(sendbuf, recvbuf, count, datatype, op,
+                              comm, "MPI_Allreduce_c");
+}
+
+int PMPI_Reduce_c(const void *sendbuf, void *recvbuf, MPI_Count count,
+                 MPI_Datatype datatype, MPI_Op op, int root,
+                 MPI_Comm comm)
+{
+    size_t esz = dt_size(datatype);
+    if (!esz || count < 0)
+        return MPI_ERR_TYPE;
+    size_t nbytes = (size_t)count * esz;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "reduce", "lNlli", (long)comm,
+        mem_ro(sendbuf == MPI_IN_PLACE ? recvbuf : sendbuf, nbytes),
+        (long)datatype, (long)op, root);
+    if (!r)
+        rc = handle_error_comm(comm, "MPI_Reduce_c");
+    else {
+        if (PyBytes_Size(r) > 0)
+            rc = copy_bytes(r, recvbuf, nbytes);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Get_count_c(const MPI_Status *status, MPI_Datatype datatype,
+                    MPI_Count *count)
+{
+    if (!status)
+        return MPI_ERR_ARG;
+    size_t esz = dt_sig(datatype);
+    if (!esz)
+        return MPI_ERR_TYPE;
+    if (status->_count % (long long)esz) {
+        *count = MPI_UNDEFINED;
+        return MPI_SUCCESS;
+    }
+    *count = status->_count / (long long)esz;
+    return MPI_SUCCESS;
+}
+
+int PMPI_Get_elements_x(const MPI_Status *status, MPI_Datatype datatype,
+                       MPI_Count *count)
+{
+    if (!status)
+        return MPI_ERR_ARG;
+    size_t base = datatype >= DT_FIRST_DYN
+        ? dyn_query("type_base_bytes", datatype) : dt_size(datatype);
+    if (!base)
+        return MPI_ERR_TYPE;
+    *count = status->_count / (long long)base;
+    return MPI_SUCCESS;
+}
+
+int PMPI_Type_size_c(MPI_Datatype datatype, MPI_Count *size)
+{
+    size_t s = dt_sig(datatype);
+    if (!s && dt_extent(datatype) == 0)
+        return MPI_ERR_TYPE;
+    *size = (MPI_Count)s;
+    return MPI_SUCCESS;
+}
+
+int PMPI_Type_size_x(MPI_Datatype datatype, MPI_Count *size)
+{
+    return PMPI_Type_size_c(datatype, size);
+}
+
+int PMPI_Type_get_extent_c(MPI_Datatype datatype, MPI_Count *lb,
+                          MPI_Count *extent)
+{
+    if (datatype < DT_FIRST_DYN) {
+        size_t s = dt_size(datatype);
+        if (!s)
+            return MPI_ERR_TYPE;
+        *lb = 0;
+        *extent = (MPI_Count)s;
+        return MPI_SUCCESS;
+    }
+    *lb = (MPI_Count)dyn_query_ll("type_lb_bytes", datatype);
+    *extent = (MPI_Count)dt_extent(datatype);
+    return MPI_SUCCESS;
+}
+
+int PMPI_Type_get_extent_x(MPI_Datatype datatype, MPI_Count *lb,
+                          MPI_Count *extent)
+{
+    return PMPI_Type_get_extent_c(datatype, lb, extent);
+}
+
+int PMPI_Type_contiguous_c(MPI_Count count, MPI_Datatype oldtype,
+                          MPI_Datatype *newtype)
+{
+    GIL_BEGIN;
+    int rc = type_ctor_result(
+        PyObject_CallMethod(g_mod, "type_contiguous", "Ll",
+                            (long long)count, (long)oldtype),
+        newtype, "MPI_Type_contiguous_c");
+    GIL_END;
+    return rc;
+}
+
+/* ---- MPI_T events + pvar write (tool chapter closure) ------------ */
+/* ---- partitioned point-to-point (MPI-4 ch. 4: psend_init.c.in,
+ * pready.c.in, parrived.c.in; per-rank engine pml/part_perrank) ---- */
+int PMPI_Psend_init(const void *buf, int partitions, MPI_Count count,
+                   MPI_Datatype datatype, int dest, int tag,
+                   MPI_Comm comm, MPI_Info info, MPI_Request *request)
+{
+    (void)info;
+    size_t esz = dt_size(datatype);
+    if (!esz || partitions < 1 || count < 0)
+        return MPI_ERR_TYPE;
+    size_t nbytes = (size_t)partitions * (size_t)count * esz;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "psend_init", "lNiLlii", (long)comm,
+        mem_ro(buf, nbytes), partitions, (long long)count,
+        (long)datatype, dest, tag);
+    if (!r) {
+        rc = handle_error_comm(comm, "MPI_Psend_init");
+    } else {
+        req_entry *e = req_new();
+        e->persistent = 1;
+        e->is_part = 1;
+        e->pyh = PyLong_AsLong(r);
+        *request = (MPI_Request)(intptr_t)e;
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Precv_init(void *buf, int partitions, MPI_Count count,
+                   MPI_Datatype datatype, int source, int tag,
+                   MPI_Comm comm, MPI_Info info, MPI_Request *request)
+{
+    (void)info;
+    size_t esz = dt_size(datatype);
+    if (!esz || partitions < 1 || count < 0)
+        return MPI_ERR_TYPE;
+    size_t nbytes = (size_t)partitions * (size_t)count * esz;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "precv_init", "liLlii", (long)comm, partitions,
+        (long long)count, (long)datatype, source, tag);
+    if (!r) {
+        rc = handle_error_comm(comm, "MPI_Precv_init");
+    } else {
+        req_entry *e = req_new();
+        e->persistent = 1;
+        e->is_part = 1;
+        e->pyh = PyLong_AsLong(r);
+        e->buf = buf;
+        e->cap = nbytes;
+        e->is_recv = 1;
+        *request = (MPI_Request)(intptr_t)e;
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+static req_entry *part_entry(MPI_Request request)
+{
+    if (request == MPI_REQUEST_NULL)
+        return NULL;
+    req_entry *e = (req_entry *)(intptr_t)request;
+    return e->is_part ? e : NULL;
+}
+
+int PMPI_Pready(int partition, MPI_Request request)
+{
+    req_entry *e = part_entry(request);
+    if (!e)
+        return MPI_ERR_REQUEST;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "part_pready", "li",
+                                      e->pyh, partition);
+    if (!r)
+        rc = handle_error("MPI_Pready");
+    else
+        Py_DECREF(r);
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Pready_range(int partition_low, int partition_high,
+                     MPI_Request request)
+{
+    req_entry *e = part_entry(request);
+    if (!e)
+        return MPI_ERR_REQUEST;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "part_pready_range",
+                                      "lii", e->pyh, partition_low,
+                                      partition_high);
+    if (!r)
+        rc = handle_error("MPI_Pready_range");
+    else
+        Py_DECREF(r);
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Pready_list(int length, const int array_of_partitions[],
+                    MPI_Request request)
+{
+    for (int i = 0; i < length; i++) {
+        int rc = PMPI_Pready(array_of_partitions[i], request);
+        if (rc != MPI_SUCCESS)
+            return rc;
+    }
+    return MPI_SUCCESS;
+}
+
+int PMPI_Parrived(MPI_Request request, int partition, int *flag)
+{
+    req_entry *e = part_entry(request);
+    if (!e)
+        return MPI_ERR_REQUEST;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "part_parrived", "li",
+                                      e->pyh, partition);
+    if (!r) {
+        rc = handle_error("MPI_Parrived");
+    } else {
+        *flag = (int)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_T_pvar_write(MPI_T_pvar_session session,
+                     MPI_T_pvar_handle handle, const void *buf)
+{
+    (void)session;
+    PyObject *r = t_call("t_pvar_write", "(iL)", (int)handle,
+                         *(const long long *)buf);
+    if (!r)
+        return MPI_T_ERR_INVALID_INDEX;
+    t_drop(r);
+    return MPI_SUCCESS;
+}
+
+int PMPI_T_event_get_num(int *num_events)
+{
+    PyObject *r = t_call("t_event_get_num", "()");
+    if (!r)
+        return MPI_T_ERR_NOT_INITIALIZED;
+    *num_events = (int)t_long(r, -1, 0);
+    t_drop(r);
+    return MPI_SUCCESS;
+}
+
+int PMPI_T_event_get_index(const char *name, int *event_index)
+{
+    PyObject *r = t_call("t_event_get_index", "(s)", name);
+    if (!r)
+        return MPI_T_ERR_INVALID_NAME;
+    long idx = t_long(r, -1, -1);
+    t_drop(r);
+    if (idx < 0)
+        return MPI_T_ERR_INVALID_NAME;
+    *event_index = (int)idx;
+    return MPI_SUCCESS;
+}
+
+int PMPI_T_event_get_info(int event_index, char *name, int *name_len,
+                         int *verbosity, MPI_Datatype *types,
+                         int *num_elements, MPI_T_enum *enumtype,
+                         char *info, int *info_len, char *desc,
+                         int *desc_len, int *bind)
+{
+    PyObject *r = t_call("t_event_get_info", "(i)", event_index);
+    if (!r)
+        return MPI_T_ERR_INVALID_INDEX;
+    /* (name, verbosity, dtype_handle, nelems, desc) */
+    PyObject *nm = PyTuple_GetItem(r, 0);
+    const char *s = nm ? PyUnicode_AsUTF8(nm) : NULL;
+    if (name && name_len && *name_len > 0 && s) {
+        strncpy(name, s, (size_t)*name_len - 1);
+        name[*name_len - 1] = '\0';
+        *name_len = (int)strlen(name) + 1;
+    }
+    if (verbosity)
+        *verbosity = (int)t_long(r, 1, MPI_T_VERBOSITY_USER_BASIC);
+    if (types)
+        *types = (MPI_Datatype)t_long(r, 2, MPI_UINT64_T);
+    if (num_elements)
+        *num_elements = (int)t_long(r, 3, 1);
+    if (enumtype)
+        *enumtype = MPI_T_ENUM_NULL;
+    if (info && info_len && *info_len > 0)
+        info[0] = '\0';
+    PyObject *dsc = PyTuple_GetItem(r, 4);
+    const char *ds = dsc ? PyUnicode_AsUTF8(dsc) : NULL;
+    if (desc && desc_len && *desc_len > 0 && ds) {
+        strncpy(desc, ds, (size_t)*desc_len - 1);
+        desc[*desc_len - 1] = '\0';
+        *desc_len = (int)strlen(desc) + 1;
+    }
+    if (bind)
+        *bind = MPI_T_BIND_NO_OBJECT;
+    t_drop(r);
+    return MPI_SUCCESS;
+}
+
+int PMPI_T_event_handle_alloc(int event_index, void *obj_handle,
+                             MPI_Info info,
+                             MPI_T_event_cb_function *event_cb,
+                             void *user_data,
+                             MPI_T_event_registration *registration)
+{
+    (void)obj_handle;
+    (void)info;
+    PyObject *r = t_call("t_event_handle_alloc", "(iLL)", event_index,
+                         (long long)(intptr_t)event_cb,
+                         (long long)(intptr_t)user_data);
+    if (!r)
+        return MPI_T_ERR_INVALID_INDEX;
+    *registration = (MPI_T_event_registration)t_long(r, -1, 0);
+    t_drop(r);
+    return MPI_SUCCESS;
+}
+
+int PMPI_T_event_handle_free(MPI_T_event_registration registration,
+                            void *user_data,
+                            void (*free_cb)(
+                                MPI_T_event_registration, int, void *))
+{
+    PyObject *r = t_call("t_event_handle_free", "(i)",
+                         (int)registration);
+    if (!r)
+        return MPI_T_ERR_INVALID;
+    t_drop(r);
+    if (free_cb)
+        free_cb(registration, MPI_T_CB_REQUIRE_NONE, user_data);
+    return MPI_SUCCESS;
+}
+
+int PMPI_T_event_read(MPI_T_event_instance instance,
+                     int element_index, void *buffer)
+{
+    PyObject *r = t_call("t_event_read", "(ii)", (int)instance,
+                         element_index);
+    if (!r)
+        return MPI_T_ERR_INVALID;
+    *(unsigned long long *)buffer = (unsigned long long)t_long(r, -1,
+                                                               0);
+    t_drop(r);
+    return MPI_SUCCESS;
+}
+
+int PMPI_T_event_get_source(MPI_T_event_instance instance,
+                           int *source_index)
+{
+    (void)instance;
+    *source_index = 0;                   /* one event source: the SPC
+                                          * plane */
     return MPI_SUCCESS;
 }
 

@@ -30,9 +30,10 @@ from typing import Any, Dict, Optional, Tuple
 import numpy as np
 
 from ompi_tpu.core import op as op_mod
-from ompi_tpu.core.errhandler import (ERR_ARG, ERR_COMM, ERR_OP,
-                                      ERR_REQUEST, ERR_TOPOLOGY,
-                                      ERR_TYPE, MPIError, error_string)
+from ompi_tpu.core.errhandler import (ERR_ARG, ERR_COMM, ERR_GROUP,
+                                      ERR_OP, ERR_REQUEST,
+                                      ERR_TOPOLOGY, ERR_TYPE, MPIError,
+                                      error_string)
 
 # ---------------------------------------------------------------------
 # handle tables (mpi.h constants must match these values)
@@ -74,6 +75,9 @@ _DT = {
     21: np.dtype(np.uint16),   # MPI_UINT16_T
     22: np.dtype(np.uint32),   # MPI_UINT32_T
     23: np.dtype(np.uint64),   # MPI_UINT64_T
+    24: np.dtype(np.int64),    # MPI_AINT
+    25: np.dtype(np.int64),    # MPI_COUNT
+    26: np.dtype(np.int64),    # MPI_OFFSET
 }
 
 # mpi.h MPI_Op constants -> predefined ops (op.c:73-80 table).
@@ -168,11 +172,29 @@ def _register_comm(c) -> int:
 
 # ---------------------------------------------------------------------
 # derived datatypes (handles >= 64): the convertor role for the C ABI.
-# A derived type is (base numpy dtype, element-offset pattern within
-# one extent, extent in base elements) — the typemap flattened. Pack
-# gathers the significant elements (only they travel, MPI semantics);
-# unpack overlays them into the receiver's existing buffer so gap
-# bytes stay untouched (opal convertor contract).
+#
+# The GRANULE model (round-5 lb/extent redesign): a derived type is
+# (base, idx, lb, extent) where the granule is one base element when
+# ``base`` is a numpy dtype (homogeneous layouts — reducible, gathered
+# element-wise) and one BYTE when ``base`` is None (heterogeneous
+# structs, byte-strided hvector layouts). ``idx`` holds the granule
+# offsets of the significant granules relative to the buffer pointer —
+# offsets may be NEGATIVE (negative strides, explicit lb), which the
+# old flattened representation rejected. ``lb``/``extent`` are the
+# MPI lower bound and extent in granules (Type_create_resized sets
+# both; extent may be smaller than the true span — overlapping
+# elements are legal). ``idx is None`` is the lazy-contiguous form
+# (``contig_n`` granules back to back) so bigcount types never
+# materialize gigantic index arrays.
+#
+# Buffer-window convention with the C shim: for count elements the C
+# side passes a memory window starting at buf + window_off(dt) of
+# length (count-1)*extent + max(extent, true_span) bytes; positions
+# inside the window are k*extent + idx - min_idx. _count_of() inverts
+# that length back to the count. Pack gathers the significant
+# granules (only they travel, MPI semantics); unpack overlays them
+# into the receiver's existing window so gap bytes stay untouched
+# (opal convertor contract, opal_convertor.c:83-102).
 # ---------------------------------------------------------------------
 _FIRST_DYN_TYPE = 64
 _dyn_types: Dict[int, "DerivedType"] = {}
@@ -180,89 +202,283 @@ _next_dyn_type = itertools.count(_FIRST_DYN_TYPE)
 
 
 class DerivedType:
-    __slots__ = ("base", "idx", "extent")
+    __slots__ = ("base", "idx", "lb", "extent", "contig_n")
 
-    def __init__(self, base: np.dtype, idx: np.ndarray, extent: int):
-        self.base = base
-        self.idx = idx                   # significant element offsets
-        self.extent = extent             # extent in base elements
+    def __init__(self, base: Optional[np.dtype],
+                 idx: Optional[np.ndarray], extent: int,
+                 lb: Optional[int] = None, contig_n: int = 0):
+        self.base = base                 # None => byte granularity
+        self.idx = idx                   # None => lazy contiguous
+        self.contig_n = contig_n         # granules when idx is None
+        self.extent = int(extent)        # granules
+        if lb is None:
+            lb = 0 if idx is None or idx.size == 0 \
+                else min(0, int(idx.min()))
+        self.lb = int(lb)
+
+    @property
+    def granule(self) -> int:
+        return self.base.itemsize if self.base is not None else 1
+
+    @property
+    def nsig(self) -> int:               # significant granules
+        return self.contig_n if self.idx is None else int(self.idx.size)
+
+    @property
+    def min_idx(self) -> int:
+        if self.idx is None or self.idx.size == 0:
+            return 0
+        return int(self.idx.min())
+
+    @property
+    def max_ub(self) -> int:             # one past the last granule
+        if self.idx is None:
+            return self.contig_n
+        if self.idx.size == 0:
+            return 0
+        return int(self.idx.max()) + 1
+
+    @property
+    def span(self) -> int:               # true data span in granules
+        return self.max_ub - self.min_idx
+
+    def materialized_idx(self) -> np.ndarray:
+        if self.idx is not None:
+            return self.idx
+        return np.arange(self.contig_n, dtype=np.int64)
+
+
+def _dyn(dt: int) -> DerivedType:
+    t = _dyn_types.get(dt)
+    if t is None:
+        raise MPIError(ERR_TYPE, f"invalid datatype handle {dt}")
+    return t
+
+
+def _as_granular(dt: int):
+    """(base-or-None, idx-or-None(contig), contig_n, lb, extent) in the
+    GRANULE units of the returned base — the uniform constructor
+    input. Basic types are one contiguous granule."""
+    if dt >= _FIRST_DYN_TYPE:
+        t = _dyn(dt)
+        return t.base, t.idx, t.contig_n, t.lb, t.extent
+    return _dtype(dt), None, 1, 0, 1
+
+
+def _register_type(t: DerivedType) -> int:
+    h = next(_next_dyn_type)
+    _dyn_types[h] = t
+    return h
 
 
 def _type_parts(dt: int):
-    """(base dtype, pattern, extent_elems) for basic OR derived."""
+    """Legacy 3-tuple view for code that predates the granule model:
+    (base dtype — uint8 stands in for byte-granular layouts,
+    materialized granule idx, extent in granules)."""
     if dt >= _FIRST_DYN_TYPE:
-        t = _dyn_types.get(dt)
-        if t is None:
-            raise MPIError(ERR_TYPE, f"invalid datatype handle {dt}")
-        return t.base, t.idx, t.extent
+        t = _dyn(dt)
+        return (t.base if t.base is not None else np.dtype(np.uint8),
+                t.materialized_idx(), t.extent)
     return _dtype(dt), np.array([0], dtype=np.int64), 1
 
 
+def _compose(old: int, placements: np.ndarray,
+             extent_old_units: Optional[int] = None,
+             lb: Optional[int] = None) -> DerivedType:
+    """Build a DerivedType placing one copy of ``old`` at each GRANULE
+    offset in ``placements`` (callers convert their element units to
+    granules of old's base before composing)."""
+    base, idx, contig_n, _olb, _oext = _as_granular(old)
+    if idx is None:
+        old_idx = None if contig_n == 1 else np.arange(contig_n,
+                                                       dtype=np.int64)
+        if old_idx is None:
+            new_idx = placements.astype(np.int64, copy=True)
+        else:
+            new_idx = (placements[:, None] + old_idx[None, :]).ravel()
+    else:
+        new_idx = (placements[:, None] + idx[None, :]).ravel()
+    ext = extent_old_units
+    return DerivedType(base, new_idx,
+                       ext if ext is not None else
+                       (int(new_idx.max()) + 1 if new_idx.size else 0),
+                       lb=lb)
+
+
 def type_contiguous(count: int, oldtype: int) -> int:
-    """MPI_Type_contiguous: count copies of oldtype back to back."""
+    """MPI_Type_contiguous: count copies of oldtype back to back.
+    Contiguous-of-contiguous stays LAZY (no index materialization), so
+    bigcount types (2^31+ elements, c23_bigcount.c) cost O(1)."""
     if count < 0:
         raise MPIError(ERR_ARG, "negative count")
-    base, idx, ext = _type_parts(oldtype)
-    new_idx = np.concatenate([idx + k * ext for k in range(count)]) \
-        if count else np.array([], dtype=np.int64)
-    h = next(_next_dyn_type)
-    _dyn_types[h] = DerivedType(base, new_idx, count * ext)
-    return h
+    base, idx, contig_n, lb, ext = _as_granular(oldtype)
+    if idx is None and lb == 0 and ext == contig_n:
+        return _register_type(DerivedType(base, None, count * contig_n,
+                                          contig_n=count * contig_n))
+    placements = np.arange(count, dtype=np.int64) * ext
+    t = _compose(oldtype, placements, extent_old_units=count * ext)
+    return _register_type(t)
 
 
 def type_vector(count: int, blocklength: int, stride: int,
                 oldtype: int) -> int:
     """MPI_Type_vector: count blocks of blocklength oldtypes, block
-    starts stride oldtypes apart. Negative strides (reversed layouts)
-    need a true lb/extent model this flattened representation lacks —
-    rejected rather than silently producing a negative extent."""
+    starts stride oldtypes apart. Negative strides are now legal: the
+    lb/extent model places elements BEHIND the buffer pointer exactly
+    as the reference's (lb = (count-1)*stride, ub past block 0,
+    ompi_datatype_add semantics)."""
     if count < 0 or blocklength < 0:
         raise MPIError(ERR_ARG, "negative count/blocklength")
-    if stride < 0:
-        raise MPIError(ERR_ARG,
-                       "negative stride is not supported by this "
-                       "binding layer")
-    if count > 1 and stride < blocklength:
-        raise MPIError(ERR_ARG, "stride smaller than blocklength "
-                                "(overlapping blocks)")
-    base, idx, ext = _type_parts(oldtype)
-    blocks = []
-    for k in range(count):
-        for j in range(blocklength):
-            blocks.append(idx + (k * stride + j) * ext)
-    new_idx = (np.concatenate(blocks) if blocks
-               else np.array([], dtype=np.int64))
-    extent = ((count - 1) * stride + blocklength) * ext if count else 0
-    h = next(_next_dyn_type)
-    _dyn_types[h] = DerivedType(base, new_idx, extent)
-    return h
+    base, idx, contig_n, _lb, ext = _as_granular(oldtype)
+    starts = np.arange(count, dtype=np.int64) * stride * ext
+    within = np.arange(blocklength, dtype=np.int64) * ext
+    placements = (starts[:, None] + within[None, :]).ravel()
+    if count == 0:
+        return _register_type(DerivedType(base,
+                                          np.array([], np.int64), 0))
+    lo = min(0, (count - 1) * stride) * ext
+    hi = (max((count - 1) * stride, 0) + blocklength) * ext
+    t = _compose(oldtype, placements, extent_old_units=hi - lo, lb=lo)
+    return _register_type(t)
+
+
+def type_create_hvector(count: int, blocklength: int, stride_bytes: int,
+                        oldtype: int) -> int:
+    """MPI_Type_create_hvector: stride in BYTES. A stride that is not
+    a multiple of the base granule degrades the type to byte
+    granularity (still exact — just ineligible for reductions)."""
+    if count < 0 or blocklength < 0:
+        raise MPIError(ERR_ARG, "negative count/blocklength")
+    base, idx, contig_n, _lb, ext = _as_granular(oldtype)
+    g = base.itemsize if base is not None else 1
+    if stride_bytes % g == 0:
+        stride = stride_bytes // g
+        starts = np.arange(count, dtype=np.int64) * stride
+        within = np.arange(blocklength, dtype=np.int64) * ext
+        placements = (starts[:, None] + within[None, :]).ravel()
+        if count == 0:
+            return _register_type(DerivedType(base,
+                                              np.array([], np.int64),
+                                              0))
+        lo = min(0, (count - 1) * stride)
+        hi = max((count - 1) * stride, 0) + blocklength * ext
+        t = _compose(oldtype, placements, extent_old_units=hi - lo,
+                     lb=lo)
+        return _register_type(t)
+    # byte-granular fallback: expand old significant granules to bytes
+    old_b = _to_byte_idx(oldtype)
+    starts = np.arange(count, dtype=np.int64) * stride_bytes
+    blk = (np.arange(blocklength, dtype=np.int64) * ext * g)
+    place_b = (starts[:, None] + blk[None, :]).ravel()
+    new_idx = (place_b[:, None] + old_b[None, :]).ravel()
+    lo = int(min(0, new_idx.min())) if new_idx.size else 0
+    hi = int(new_idx.max()) + 1 if new_idx.size else 0
+    return _register_type(DerivedType(None, new_idx, hi - lo, lb=lo))
+
+
+def _to_byte_idx(dt: int) -> np.ndarray:
+    """Significant BYTE offsets of one element (degrade helper)."""
+    base, idx, contig_n, _lb, _ext = _as_granular(dt)
+    g = base.itemsize if base is not None else 1
+    gi = (np.arange(contig_n, dtype=np.int64) if idx is None else idx)
+    return (gi[:, None] * g
+            + np.arange(g, dtype=np.int64)[None, :]).ravel()
 
 
 def type_indexed(counts_view, displs_view, oldtype: int) -> int:
     """MPI_Type_indexed: block i has counts[i] oldtypes starting at
-    displacement displs[i] (in oldtype extents). Monotonic
-    non-overlapping displacements required (no lb/extent model)."""
+    displacement displs[i] (in oldtype extents). Arbitrary (including
+    decreasing/negative) displacements are legal under the granule
+    model; overlapping significant granules are rejected (the pack
+    gather would be ambiguous on unpack)."""
     counts, displs = _ints(counts_view), _ints(displs_view)
-    base, idx, ext = _type_parts(oldtype)
+    base, idx, contig_n, _lb, ext = _as_granular(oldtype)
     blocks = []
-    top = 0
-    prev_end = None
     for c, d in zip(counts, displs):
         c, d = int(c), int(d)
-        if c < 0 or d < 0:
-            raise MPIError(ERR_ARG, "negative count/displacement")
-        if prev_end is not None and d < prev_end:
-            raise MPIError(ERR_ARG, "overlapping/decreasing "
-                                    "indexed blocks unsupported")
-        for j in range(c):
-            blocks.append(idx + (d + j) * ext)
-        prev_end = d + c
-        top = max(top, d + c)
-    new_idx = (np.concatenate(blocks) if blocks
-               else np.array([], dtype=np.int64))
-    h = next(_next_dyn_type)
-    _dyn_types[h] = DerivedType(base, new_idx, top * ext)
-    return h
+        if c < 0:
+            raise MPIError(ERR_ARG, "negative block count")
+        if c:
+            blocks.append(np.arange(d * ext, (d + c) * ext - ext + 1,
+                                    ext, dtype=np.int64))
+    placements = (np.concatenate(blocks) if blocks
+                  else np.array([], np.int64))
+    _check_no_overlap(oldtype, placements)
+    if placements.size == 0:
+        return _register_type(DerivedType(base, np.array([], np.int64),
+                                          0))
+    lo = min(0, int(placements.min()))
+    hi = int(placements.max()) + ext
+    t = _compose(oldtype, placements, extent_old_units=hi - lo, lb=lo)
+    return _register_type(t)
+
+
+def _check_no_overlap(oldtype: int, placements: np.ndarray) -> None:
+    base, idx, contig_n, _lb, ext = _as_granular(oldtype)
+    nsig = contig_n if idx is None else idx.size
+    if placements.size and nsig:
+        # distinct placements of the same pattern overlap iff any two
+        # placements are closer than the pattern allows; exact check
+        # via the composed index set
+        test = (placements[:, None]
+                + (np.arange(contig_n, dtype=np.int64)
+                   if idx is None else idx)[None, :]).ravel()
+        if np.unique(test).size != test.size:
+            raise MPIError(ERR_ARG, "overlapping indexed blocks "
+                                    "unsupported")
+
+
+def type_create_hindexed(counts_view, bdispls_view,
+                         oldtype: int) -> int:
+    """MPI_Type_create_hindexed: displacements in BYTES."""
+    counts = _ints(counts_view)
+    bdispls = np.frombuffer(bytes(bdispls_view), dtype=np.int64)
+    base, idx, contig_n, _lb, ext = _as_granular(oldtype)
+    g = base.itemsize if base is not None else 1
+    if all(int(d) % g == 0 for d in bdispls):
+        blocks = []
+        for c, db in zip(counts, bdispls):
+            c, d = int(c), int(db) // g
+            if c < 0:
+                raise MPIError(ERR_ARG, "negative block count")
+            if c:
+                blocks.append(d + np.arange(c, dtype=np.int64) * ext)
+        placements = (np.concatenate(blocks) if blocks
+                      else np.array([], np.int64))
+        _check_no_overlap(oldtype, placements)
+        if placements.size == 0:
+            return _register_type(DerivedType(base,
+                                              np.array([], np.int64),
+                                              0))
+        lo = min(0, int(placements.min()))
+        hi = int(placements.max()) + ext
+        t = _compose(oldtype, placements, extent_old_units=hi - lo,
+                     lb=lo)
+        return _register_type(t)
+    # misaligned byte displacements: byte-granular type
+    old_b = _to_byte_idx(oldtype)
+    pieces = []
+    for c, db in zip(counts, bdispls):
+        c, db = int(c), int(db)
+        for k in range(c):
+            pieces.append(db + k * ext * g + old_b)
+    new_idx = (np.concatenate(pieces) if pieces
+               else np.array([], np.int64))
+    if np.unique(new_idx).size != new_idx.size:
+        raise MPIError(ERR_ARG, "overlapping hindexed blocks")
+    lo = int(min(0, new_idx.min())) if new_idx.size else 0
+    hi = int(new_idx.max()) + 1 if new_idx.size else 0
+    return _register_type(DerivedType(None, new_idx, hi - lo, lb=lo))
+
+
+def type_create_hindexed_block(blocklength: int, bdispls_view,
+                               oldtype: int) -> int:
+    """MPI_Type_create_hindexed_block: uniform blocklength, byte
+    displacements."""
+    bdispls = np.frombuffer(bytes(bdispls_view), dtype=np.int64)
+    counts = np.full(len(bdispls), int(blocklength), np.intc)
+    return type_create_hindexed(counts.tobytes(), bytes(bdispls_view),
+                                oldtype)
 
 
 def type_create_indexed_block(blocklength: int, displs_view,
@@ -273,36 +489,201 @@ def type_create_indexed_block(blocklength: int, displs_view,
     return type_indexed(counts.tobytes(), bytes(displs_view), oldtype)
 
 
+def type_create_struct(counts_view, bdispls_view,
+                       types_view) -> int:
+    """MPI_Type_create_struct: per-block types AND byte displacements.
+    Homogeneous structs (every block the same base granule, aligned)
+    keep element granularity; mixed-base structs become byte-granular
+    (exact layout; reductions reject them, as the standard only
+    defines reductions on basic types)."""
+    counts = _ints(counts_view)
+    bdispls = np.frombuffer(bytes(bdispls_view), dtype=np.int64)
+    types = np.frombuffer(bytes(types_view), dtype=np.int64)
+    if not (len(counts) == len(bdispls) == len(types)):
+        raise MPIError(ERR_ARG, "struct arrays disagree on length")
+    bases = set()
+    for dt in types:
+        b, _i, _c, _l, _e = _as_granular(int(dt))
+        bases.add(b)
+    if len(bases) == 1 and None not in bases:
+        b = next(iter(bases))
+        g = b.itemsize
+        if all(int(d) % g == 0 for d in bdispls):
+            # homogeneous + aligned: granule = base element
+            pieces = []
+            for c, db, dt in zip(counts, bdispls, types):
+                c, d = int(c), int(db) // g
+                _b, idx, contig_n, _l, ext = _as_granular(int(dt))
+                gi = (np.arange(contig_n, dtype=np.int64)
+                      if idx is None else idx)
+                for k in range(c):
+                    pieces.append(d + k * ext + gi)
+            new_idx = (np.concatenate(pieces) if pieces
+                       else np.array([], np.int64))
+            if np.unique(new_idx).size != new_idx.size:
+                raise MPIError(ERR_ARG, "overlapping struct blocks")
+            lo = int(min(0, new_idx.min())) if new_idx.size else 0
+            hi = int(new_idx.max()) + 1 if new_idx.size else 0
+            return _register_type(DerivedType(b, new_idx, hi - lo,
+                                              lb=lo))
+    # heterogeneous: byte-granular
+    pieces = []
+    for c, db, dt in zip(counts, bdispls, types):
+        c, db, dt = int(c), int(db), int(dt)
+        old_b = _to_byte_idx(dt)
+        _bb, _i, _cn, _l, ext = _as_granular(dt)
+        g = _bb.itemsize if _bb is not None else 1
+        for k in range(c):
+            pieces.append(db + k * ext * g + old_b)
+    new_idx = (np.concatenate(pieces) if pieces
+               else np.array([], np.int64))
+    if np.unique(new_idx).size != new_idx.size:
+        raise MPIError(ERR_ARG, "overlapping struct blocks")
+    lo = int(min(0, new_idx.min())) if new_idx.size else 0
+    hi = int(new_idx.max()) + 1 if new_idx.size else 0
+    return _register_type(DerivedType(None, new_idx, hi - lo, lb=lo))
+
+
+def type_create_subarray(sizes_view, subsizes_view, starts_view,
+                         order: int, oldtype: int) -> int:
+    """MPI_Type_create_subarray: an n-D block of an n-D array. The
+    significant granules are the block's positions in the FULL array
+    (extent = whole array) — exactly the flat-index model."""
+    sizes = [int(x) for x in _ints(sizes_view)]
+    subs = [int(x) for x in _ints(subsizes_view)]
+    starts = [int(x) for x in _ints(starts_view)]
+    if not (len(sizes) == len(subs) == len(starts)):
+        raise MPIError(ERR_ARG, "subarray dims disagree")
+    for g_, s_, st_ in zip(sizes, subs, starts):
+        if s_ < 0 or st_ < 0 or st_ + s_ > g_:
+            raise MPIError(ERR_ARG, "subarray block out of range")
+    base, idx, contig_n, _lb, ext = _as_granular(oldtype)
+    # element offsets of the block within the full array, in units of
+    # oldtype elements, honoring C vs Fortran order
+    dims = sizes if order == 0 else list(reversed(sizes))
+    subd = subs if order == 0 else list(reversed(subs))
+    std = starts if order == 0 else list(reversed(starts))
+    grids = np.meshgrid(*[np.arange(st_, st_ + s_, dtype=np.int64)
+                          for st_, s_ in zip(std, subd)],
+                        indexing="ij")
+    flat = np.zeros_like(grids[0])
+    stride = 1
+    for d in range(len(dims) - 1, -1, -1):
+        flat = flat + grids[d] * stride
+        stride *= dims[d]
+    placements = np.sort(flat.ravel()) * ext
+    total = int(np.prod(sizes, dtype=np.int64)) * ext
+    t = _compose(oldtype, placements, extent_old_units=total, lb=0)
+    return _register_type(t)
+
+
+# HPF distribution constants (mpi.h MPI_DISTRIBUTE_*)
+_DIST_BLOCK, _DIST_CYCLIC, _DIST_NONE = 0, 1, 2
+_DIST_DFLT_DARG = -49767
+
+
+def type_create_darray(gsize: int, grank: int, gsizes_view,
+                       distribs_view, dargs_view, psizes_view,
+                       order: int, oldtype: int) -> int:
+    """MPI_Type_create_darray: the HPF block/cyclic decomposition of a
+    global array — the significant granules are exactly the calling
+    rank's shard of the global index space, the same sharding math the
+    framework's mesh layer does (reference:
+    ompi/datatype/ompi_datatype_create_darray.c)."""
+    gsizes = [int(x) for x in _ints(gsizes_view)]
+    distribs = [int(x) for x in _ints(distribs_view)]
+    dargs = [int(x) for x in _ints(dargs_view)]
+    psizes = [int(x) for x in _ints(psizes_view)]
+    ndims = len(gsizes)
+    if not (len(distribs) == len(dargs) == len(psizes) == ndims):
+        raise MPIError(ERR_ARG, "darray dims disagree")
+    if int(np.prod(psizes, dtype=np.int64)) != gsize:
+        raise MPIError(ERR_ARG, "psizes do not multiply to size")
+    # process-grid coordinates: rank decomposed ROW-MAJOR over psizes
+    # (MPI-3.1 15.4.2.2: always C order for the grid)
+    coords = []
+    rem = grank
+    for p in reversed(psizes):
+        coords.append(rem % p)
+        rem //= p
+    coords.reverse()
+    per_dim = []
+    for g_, d_, a_, p_, c_ in zip(gsizes, distribs, dargs, psizes,
+                                  coords):
+        if d_ == _DIST_NONE:
+            if p_ != 1:
+                raise MPIError(ERR_ARG,
+                               "DISTRIBUTE_NONE needs psize 1")
+            per_dim.append(np.arange(g_, dtype=np.int64))
+        elif d_ == _DIST_BLOCK:
+            b = ((g_ + p_ - 1) // p_ if a_ == _DIST_DFLT_DARG
+                 else a_)
+            if b * p_ < g_:
+                raise MPIError(ERR_ARG, "block darg too small")
+            lo = min(c_ * b, g_)
+            hi = min(lo + b, g_)
+            per_dim.append(np.arange(lo, hi, dtype=np.int64))
+        elif d_ == _DIST_CYCLIC:
+            k = 1 if a_ == _DIST_DFLT_DARG else a_
+            j = np.arange(g_, dtype=np.int64)
+            per_dim.append(j[(j // k) % p_ == c_])
+        else:
+            raise MPIError(ERR_ARG, f"bad distribution {d_}")
+    base, idx, contig_n, _lb, ext = _as_granular(oldtype)
+    dims = gsizes if order == 0 else list(reversed(gsizes))
+    pdim = per_dim if order == 0 else list(reversed(per_dim))
+    grids = np.meshgrid(*pdim, indexing="ij")
+    flat = np.zeros_like(grids[0]) if grids else np.zeros(
+        (), np.int64)
+    stride = 1
+    for d in range(len(dims) - 1, -1, -1):
+        flat = flat + grids[d] * stride
+        stride *= dims[d]
+    placements = np.sort(flat.ravel()) * ext
+    total = int(np.prod(gsizes, dtype=np.int64)) * ext
+    t = _compose(oldtype, placements, extent_old_units=total, lb=0)
+    return _register_type(t)
+
+
 def type_dup(dt: int) -> int:
     """MPI_Type_dup."""
-    base, idx, ext = _type_parts(dt)
-    h = next(_next_dyn_type)
-    _dyn_types[h] = DerivedType(base, np.array(idx), int(ext))
-    return h
+    t = _dyn(dt) if dt >= _FIRST_DYN_TYPE else None
+    if t is None:
+        base, idx, contig_n, lb, ext = _as_granular(dt)
+        return _register_type(DerivedType(base, None, ext,
+                                          contig_n=contig_n))
+    return _register_type(DerivedType(
+        t.base, None if t.idx is None else np.array(t.idx),
+        t.extent, lb=t.lb, contig_n=t.contig_n))
 
 
-def type_create_resized(oldtype: int, lb: int, extent: int) -> int:
-    """MPI_Type_create_resized: change the extent (in BYTES). lb must
-    be 0 and the new extent a multiple of the base element size — the
-    flattened representation has no true lb model; out-of-range
-    arguments are rejected rather than mis-laid-out."""
-    base, idx, _ = _type_parts(oldtype)
-    if lb != 0:
-        raise MPIError(ERR_ARG, "nonzero lb unsupported")
-    if extent <= 0 or extent % base.itemsize:
-        raise MPIError(ERR_ARG,
-                       "extent must be a positive multiple of the "
-                       "base element size")
-    h = next(_next_dyn_type)
-    _dyn_types[h] = DerivedType(base, np.array(idx),
-                                extent // base.itemsize)
-    return h
+def type_create_resized(oldtype: int, lb_bytes: int,
+                        extent_bytes: int) -> int:
+    """MPI_Type_create_resized: set lb and extent, in BYTES. Any lb
+    (including negative) and any positive extent (including smaller
+    than the true span — overlapping elements) are now representable."""
+    base, idx, contig_n, _lb, _ext = _as_granular(oldtype)
+    g = base.itemsize if base is not None else 1
+    if lb_bytes % g or extent_bytes % g:
+        # keep the layout exact by degrading to byte granularity
+        bidx = _to_byte_idx(oldtype)
+        return _register_type(DerivedType(None, bidx, int(extent_bytes),
+                                          lb=int(lb_bytes)))
+    if extent_bytes <= 0:
+        raise MPIError(ERR_ARG, "extent must be positive")
+    new_idx = (np.arange(contig_n, dtype=np.int64) if idx is None
+               else np.array(idx))
+    return _register_type(DerivedType(base, new_idx,
+                                      extent_bytes // g,
+                                      lb=lb_bytes // g))
 
 
 def type_base_bytes(dt: int) -> int:
-    """Base-element size (MPI_Get_elements units)."""
-    base, _, _ = _type_parts(dt)
-    return int(base.itemsize)
+    """Base-element size (MPI_Get_elements units); 1 for byte-granular
+    heterogeneous layouts."""
+    if dt >= _FIRST_DYN_TYPE:
+        return _dyn(dt).granule
+    return int(_dtype(dt).itemsize)
 
 
 def op_commutative(o: int) -> int:
@@ -310,7 +691,10 @@ def op_commutative(o: int) -> int:
 
 
 def type_commit(dt: int) -> None:
-    _type_parts(dt)                      # validates the handle
+    if dt >= _FIRST_DYN_TYPE:
+        _dyn(dt)                         # validates the handle
+    else:
+        _dtype(dt)
 
 
 def type_free(dt: int) -> None:
@@ -319,66 +703,125 @@ def type_free(dt: int) -> None:
 
 
 def type_extent_bytes(dt: int) -> int:
-    """Full extent of ONE element of this type, in bytes (buffer
-    sizing; MPI_Type_get_extent)."""
-    base, _, ext = _type_parts(dt)
-    return int(ext) * base.itemsize
+    """MPI extent of ONE element, in bytes (MPI_Type_get_extent)."""
+    if dt >= _FIRST_DYN_TYPE:
+        t = _dyn(dt)
+        return t.extent * t.granule
+    return int(_dtype(dt).itemsize)
+
+
+def type_lb_bytes(dt: int) -> int:
+    """MPI lower bound, in bytes (can be negative)."""
+    if dt >= _FIRST_DYN_TYPE:
+        t = _dyn(dt)
+        return t.lb * t.granule
+    return 0
+
+
+def type_true_lb_bytes(dt: int) -> int:
+    """True lower bound: offset of the first significant granule."""
+    if dt >= _FIRST_DYN_TYPE:
+        t = _dyn(dt)
+        return t.min_idx * t.granule
+    return 0
+
+
+def type_true_span_bytes(dt: int) -> int:
+    """True extent: bytes from the first to one past the last
+    significant granule (MPI_Type_get_true_extent's extent)."""
+    if dt >= _FIRST_DYN_TYPE:
+        t = _dyn(dt)
+        return t.span * t.granule
+    return int(_dtype(dt).itemsize)
+
+
+def type_window_off_bytes(dt: int) -> int:
+    """Byte offset (<= 0) the C side adds to the buffer pointer to
+    form the marshalling window (covers negative displacements)."""
+    return type_true_lb_bytes(dt)
 
 
 def type_size_bytes(dt: int) -> int:
     """Significant bytes of ONE element (MPI_Type_size /
     MPI_Get_count units)."""
-    base, idx, _ = _type_parts(dt)
-    return int(idx.size) * base.itemsize
+    if dt >= _FIRST_DYN_TYPE:
+        t = _dyn(dt)
+        return t.nsig * t.granule
+    return int(_dtype(dt).itemsize)
 
 
 _idx_cache: Dict[Tuple[int, int], np.ndarray] = {}
 
 
-def _full_idx(dt: int, count: int) -> np.ndarray:
-    """Significant-element offsets for ``count`` elements of ``dt``,
-    vectorized and cached — dynamic handles are never recycled
-    (monotonic counter), so (dt, count) keys cannot go stale."""
+def _win_idx(dt: int, count: int) -> Optional[np.ndarray]:
+    """Significant-granule positions of ``count`` elements RELATIVE TO
+    the marshalling window start (buf + min_idx); None for contiguous
+    layouts (a slice suffices). Cached — dynamic handles are never
+    recycled (monotonic counter), so (dt, count) keys cannot go
+    stale."""
+    t = _dyn(dt)
+    if t.idx is None and t.extent == t.contig_n:
+        return None                      # pure contiguous
     key = (dt, count)
     got = _idx_cache.get(key)
     if got is None:
-        _, idx, ext = _type_parts(dt)
-        got = (np.arange(count, dtype=np.int64)[:, None] * ext
-               + idx).ravel() if count else np.array([],
-                                                     dtype=np.int64)
+        idx = t.materialized_idx()
+        got = ((np.arange(count, dtype=np.int64)[:, None] * t.extent
+                + idx).ravel() - t.min_idx) if count else \
+            np.array([], dtype=np.int64)
         if len(_idx_cache) < 4096:
             _idx_cache[key] = got
     return got
 
 
 def _pack(view, dt: int, count: int) -> np.ndarray:
-    """Gather the significant elements of ``count`` type elements from
-    a full-extent buffer."""
-    base, _, _ = _type_parts(dt)
-    a = np.frombuffer(view, dtype=base)
+    """Gather the significant granules of ``count`` type elements from
+    the marshalling window."""
     if dt < _FIRST_DYN_TYPE:
-        return a.copy()
-    return a[_full_idx(dt, count)].copy()
+        return np.frombuffer(view, dtype=_dtype(dt)).copy()
+    t = _dyn(dt)
+    a = np.frombuffer(view, dtype=t.base if t.base is not None
+                      else np.uint8)
+    wi = _win_idx(dt, count)
+    if wi is None:
+        return a[:count * t.contig_n].copy()
+    return a[wi].copy()
 
 
 def _unpack(data, dt: int, count: int,
             curbytes: bytes) -> Tuple[bytes, int]:
-    """Overlay received significant elements into the receiver's
-    current full-extent content; gaps keep their bytes. Returns
-    (buffer image, truncated flag) — a message larger than the posted
+    """Overlay received significant granules into the receiver's
+    current window content; gaps keep their bytes. Returns
+    (window image, truncated flag) — a message larger than the posted
     type signature is MPI_ERR_TRUNCATE even though the C-side cap
     check only sees the (fixed-size) buffer image."""
-    base, _, _ = _type_parts(dt)
+    if dt < _FIRST_DYN_TYPE:
+        base = _dtype(dt)
+        flat = np.asarray(data).ravel()
+        if flat.dtype != base:
+            flat = flat.view(base) if flat.dtype.itemsize == 1 \
+                and flat.size and flat.size % base.itemsize == 0 \
+                else flat.astype(base)
+        return flat.tobytes(), 0
+    t = _dyn(dt)
+    base = t.base if t.base is not None else np.uint8
     flat = np.asarray(data).ravel()
     if flat.dtype != base:
-        flat = flat.astype(base)
-    if dt < _FIRST_DYN_TYPE:
-        return flat.tobytes(), 0
+        # byte-granular types receive raw byte streams; element types
+        # coerce (the wire carries the base dtype already)
+        flat = flat.view(np.uint8) if t.base is None else \
+            flat.astype(base)
+    wi = _win_idx(dt, count)
+    if wi is None:
+        need = count * t.contig_n
+        cur = np.frombuffer(curbytes, dtype=base).copy()
+        n = min(flat.size, need)
+        cur[:n] = flat[:n]
+        return cur.tobytes(), int(flat.size > need)
     cur = np.frombuffer(curbytes, dtype=base).copy()
-    all_idx = _full_idx(dt, count)
-    n = min(flat.size, all_idx.size)
-    cur[all_idx[:n]] = flat[:n]
-    return cur.tobytes(), int(flat.size > all_idx.size)
+    n = min(flat.size, wi.size)
+    cur[wi[:n]] = flat[:n]
+    return cur.tobytes(), int(flat.size > wi.size)
 
 
 def _dtype(dt: int) -> np.dtype:
@@ -476,7 +919,10 @@ def abort(h: int, code: int) -> None:
 
 
 def error_str(code: int) -> str:
-    return error_string(code)
+    # dynamic strings (MPI_Add_error_string) win over the predefined
+    # table; unknown dynamic codes fall through to the generic text
+    s = _err_strings.get(int(code))
+    return s if s is not None else error_string(code)
 
 
 def processor_name() -> str:
@@ -1217,10 +1663,20 @@ def comm_free(h: int) -> None:
 # point-to-point
 # ---------------------------------------------------------------------
 def _count_of(view, dt: int) -> int:
-    """Element count from the C-side buffer size (the C shim sizes
-    views as exactly count x extent)."""
+    """Element count from the C-side window size. The shim sizes
+    windows as (count-1)*extent + true_span bytes (exactly the data,
+    never padded past it — a positive true-lb type would otherwise
+    overrun the user buffer); the single inversion below is exact for
+    every span/extent relation, and degenerates to len//size for
+    basic types (span == extent == size)."""
     ext = type_extent_bytes(dt)
-    return len(view) // ext if ext else 0
+    if not ext:
+        return 0
+    span = type_true_span_bytes(dt)
+    n = len(view)
+    if n < span or n == 0:
+        return 0
+    return (n - span) // ext + 1
 
 
 def send(h: int, view, dt: int, dest: int, tag: int, sync: int) -> None:
@@ -1288,7 +1744,7 @@ def _take_req(rh: int) -> Tuple[Any, int, bytes]:
     return ent
 
 
-def wait(rh: int) -> Tuple[bytes, int, int, int, int]:
+def wait(rh: int) -> Tuple[bytes, int, int, int, int, int]:
     req, dt, snap = _take_req(rh)
     try:
         st = req.wait()
@@ -1302,18 +1758,19 @@ def wait(rh: int) -> Tuple[bytes, int, int, int, int]:
     data = req.get() if hasattr(req, "get") else None
     with _lock:
         _requests.pop(rh, None)
+    canc = 1 if getattr(req, "cancelled", False) else 0
     if data is None:
-        return b"", *_status(st), 0
+        return b"", *_status(st), 0, canc
     if dt == 0:                          # _icoll_bytes: pre-marshalled
         out = bytes(data)
         src, t, _ = _status(st, out)
-        return out, src, t, len(out), 0
+        return out, src, t, len(out), 0, canc
     out, trunc = _unpack(data, dt, _count_of(snap, dt), snap)
     src, t, cnt = _status(st, out)
-    return out, src, t, cnt, trunc
+    return out, src, t, cnt, trunc, canc
 
 
-def test(rh: int) -> Tuple[int, bytes, int, int, int, int]:
+def test(rh: int) -> Tuple[int, bytes, int, int, int, int, int]:
     req, dt, snap = _take_req(rh)
     try:
         done, st = req.test()
@@ -1322,19 +1779,20 @@ def test(rh: int) -> Tuple[int, bytes, int, int, int, int]:
             _requests.pop(rh, None)     # completed in error: reclaim
         raise
     if not done:
-        return 0, b"", -1, -1, 0, 0
+        return 0, b"", -1, -1, 0, 0, 0
     data = req.get() if hasattr(req, "get") else None
     with _lock:
         _requests.pop(rh, None)
+    canc = 1 if getattr(req, "cancelled", False) else 0
     if data is None:
-        return 1, b"", *_status(st), 0
+        return 1, b"", *_status(st), 0, canc
     if dt == 0:                          # _icoll_bytes: pre-marshalled
         out = bytes(data)
         src, t, _ = _status(st, out)
-        return 1, out, src, t, len(out), 0
+        return 1, out, src, t, len(out), 0, canc
     out, trunc = _unpack(data, dt, _count_of(snap, dt), snap)
     src, t, cnt = _status(st, out)
-    return 1, out, src, t, cnt, trunc
+    return 1, out, src, t, cnt, trunc, canc
 
 
 def probe(h: int, source: int, tag: int) -> Tuple[int, int, int]:
@@ -2016,12 +2474,16 @@ def file_open(h: int, path: str, amode: int) -> int:
     with _lock:
         fh = next(_next_file)
         _files[fh] = f
+        _file_amodes[fh] = int(amode)    # MPI_File_get_amode
     return fh
 
 
 def file_close(fh: int) -> None:
     with _lock:
         f = _files.pop(fh, None)
+        _file_amodes.pop(fh, None)
+        _file_views.pop(fh, None)
+        _file_pos.pop(fh, None)
     if f is None:
         raise MPIError(ERR_ARG, f"invalid file handle {fh}")
     f.close()
@@ -2225,6 +2687,878 @@ def t_pvar_read(i: int) -> int:
     from ompi_tpu.mca import pvar as _p
     val = _p.pvar_read(_t_pvar(i)["name"])
     return int(val or 0)
+
+
+# ---------------------------------------------------------------------
+# round-5 wave 3 glue: send modes, matched probe + cancel, dynamic
+# error space, intra-job intercommunicators, Cart_sub,
+# Comm_create_group, Alltoallw, file views + individual pointers,
+# dynamic RMA windows, spawn of executables, MPI_T events.
+# ---------------------------------------------------------------------
+def _window_len(dt: int, count: int) -> int:
+    """Bytes of the marshalling window for ``count`` elements (the C
+    shim's dt_window length, mirrored for in-glue slicing)."""
+    if count <= 0:
+        return 0
+    return ((count - 1) * type_extent_bytes(dt)
+            + type_true_span_bytes(dt))
+
+
+def issend(h: int, view, dt: int, dest: int, tag: int) -> int:
+    """MPI_Issend: completes when the receive is matched — run the
+    blocking ssend (ack-based) on a worker thread."""
+    from ompi_tpu.pml.perrank import thread_request
+    c = _comm(h)
+    data = _pack(view, dt, _count_of(view, dt))
+    req = thread_request(lambda: c.ssend(data, dest, tag))
+    with _lock:
+        rh = next(_next_req)
+        _requests[rh] = (req, 0, b"")
+    return rh
+
+
+def request_cancel(rh: int) -> None:
+    """MPI_Cancel on a glue-side request (receives only matter: sends
+    here complete eagerly and are past the cancellation point)."""
+    req, _dt, _snap = _take_req(rh)
+    fn = getattr(req, "cancel", None)
+    if fn is not None:
+        fn()
+
+
+# ---- matched probe (mprobe.c.in): message handles -------------------
+_messages: Dict[int, Tuple[Any, int]] = {}
+_next_msg = itertools.count(1)
+
+
+def _msg_nbytes(m) -> int:
+    d = m.data
+    nb = getattr(d, "nbytes", None)
+    if nb is not None:
+        return int(nb)
+    return 0
+
+
+def mprobe(h: int, source: int, tag: int) -> Tuple[int, int, int, int]:
+    c = _comm(h)
+    m = c.mprobe(source, tag)
+    with _lock:
+        mh = next(_next_msg)
+        _messages[mh] = (m, h)
+    return mh, int(m.src), int(m.tag), _msg_nbytes(m)
+
+
+def improbe(h: int, source: int, tag: int
+            ) -> Tuple[int, int, int, int, int]:
+    c = _comm(h)
+    ok, m, st = c.improbe(source, tag)
+    if not ok:
+        return 0, 0, -1, -1, 0
+    with _lock:
+        mh = next(_next_msg)
+        _messages[mh] = (m, h)
+    return 1, mh, int(m.src), int(m.tag), _msg_nbytes(m)
+
+
+def _take_msg(mh: int):
+    with _lock:
+        ent = _messages.pop(mh, None)
+    if ent is None:
+        raise MPIError(ERR_ARG, f"invalid message handle {mh}")
+    return ent
+
+
+def mrecv(mh: int, dt: int, curview
+          ) -> Tuple[bytes, int, int, int, int, int]:
+    m, h = _take_msg(mh)
+    data, st = _comm(h).mrecv(m)
+    if data is None:
+        return b"", *_status(st), 0, 0
+    out, trunc = _unpack(data, dt, _count_of(curview, dt),
+                         bytes(curview))
+    src, t, cnt = _status(st, out)
+    return out, src, t, cnt, trunc, 0
+
+
+def imrecv(mh: int, dt: int, curview) -> int:
+    """The message is already matched and local: the request is born
+    complete (imrecv.c.in fast path on an already-arrived frag)."""
+    m, h = _take_msg(mh)
+    from ompi_tpu.pml.perrank import RankRequest
+    req = RankRequest(m.src, m.tag)
+    req._deliver(m)
+    with _lock:
+        rh = next(_next_req)
+        _requests[rh] = (req, dt, bytes(curview))
+    return rh
+
+
+# ---- dynamic error space (add_error_class.c.in) ---------------------
+_err_strings: Dict[int, str] = {}
+_err_class_of: Dict[int, int] = {}
+_next_err_class = itertools.count(101)   # past MPI_ERR_LASTCODE
+_next_err_code = itertools.count(1001)
+
+
+def add_error_class() -> int:
+    c = next(_next_err_class)
+    _err_class_of[c] = c
+    return c
+
+
+def add_error_code(cls: int) -> int:
+    code = next(_next_err_code)
+    _err_class_of[code] = int(cls)
+    return code
+
+
+def add_error_string(code: int, s: str) -> None:
+    _err_strings[int(code)] = str(s)
+
+
+def error_class_of(code: int) -> int:
+    return _err_class_of.get(int(code), int(code))
+
+
+# ---- local reduction (reduce_local.c.in) ----------------------------
+def reduce_local(inview, inoutview, dt: int, o: int) -> bytes:
+    op = _op(o)
+    _op_ctx.dt = dt
+    try:
+        a = np.frombuffer(inview, dtype=_dtype(dt))
+        b = np.frombuffer(inoutview, dtype=_dtype(dt))
+        # MPI contract: inoutbuf = inbuf OP inoutbuf
+        res = np.asarray(op.fn(a, b), dtype=_dtype(dt))
+    finally:
+        _op_ctx.dt = 0
+    return res.tobytes()
+
+
+# ---- Cart_sub (cart_sub.c.in) ---------------------------------------
+def cart_sub(h: int, remain_view) -> int:
+    """Split the cartesian comm into lower-dimension slices: ranks
+    sharing every DROPPED dimension's coordinate land in one new comm,
+    which keeps the remaining dims as its cartesian topology."""
+    c = _comm(h)
+    topo = getattr(c, "topo", None)
+    if topo is None or not hasattr(topo, "sub_keep"):
+        raise MPIError(ERR_TOPOLOGY,
+                       "communicator has no cartesian topology")
+    remain = [bool(x) for x in _ints(remain_view)]
+    colors, new_topo = topo.sub_keep(remain)
+    sub = c.split(colors[c.rank()], key=c.rank())
+    sub.topo = new_topo
+    sub.name = f"{c.name}.sub"
+    return _register_comm(sub)
+
+
+# ---- intra-job intercommunicators (intercomm_create.c.in) -----------
+class _RankIntercomm:
+    """A per-rank intercommunicator between two disjoint groups of ONE
+    job: sends address the REMOTE group through a dedicated CID both
+    sides derive identically; status.MPI_SOURCE is the sender's rank
+    in its own (remote-to-me) group — the MPI intercomm contract."""
+
+    is_per_rank = True
+
+    def __init__(self, local_comm, remote_world, cid):
+        from ompi_tpu.pml.perrank import PerRankEngine
+        self.local_comm = local_comm
+        self.remote_world = list(remote_world)
+        self.remote_size = len(remote_world)
+        self.cid = cid
+        self.name = f"intercomm#{cid[-1]}"
+        outer = self
+
+        class _View:
+            """Engine addressing shim: rank() = MY local rank (the
+            header's source field), world_rank_of = REMOTE group."""
+            cid = outer.cid
+            size = outer.remote_size
+
+            def rank(self):
+                return outer.local_comm.rank()
+
+            def world_rank_of(self, j):
+                return outer.remote_world[j]
+
+        self._pml = PerRankEngine(_View(), local_comm.router)
+
+    @property
+    def size(self) -> int:
+        return self.local_comm.size      # MPI_Comm_size: LOCAL size
+
+    def rank(self) -> int:
+        return self.local_comm.rank()
+
+    def send(self, data, dest: int, tag: int = 0):
+        return self._pml.send(data, dest, tag)
+
+    def ssend(self, data, dest: int, tag: int = 0):
+        return self._pml.send(data, dest, tag, synchronous=True)
+
+    def isend(self, data, dest: int, tag: int = 0):
+        return self._pml.send(data, dest, tag)
+
+    def recv(self, source: int = -1, tag: int = -1):
+        return self._pml.recv(source, tag)
+
+    def irecv(self, source: int = -1, tag: int = -1):
+        return self._pml.irecv(source, tag)
+
+    def sendrecv(self, senddata, dest, source=-1, sendtag=0,
+                 recvtag=-1):
+        req = self._pml.irecv(source, recvtag)
+        self._pml.send(senddata, dest, sendtag)
+        st = req.wait()
+        return req.get(), st
+
+    def free(self) -> None:
+        self._pml.close()
+
+    def disconnect(self) -> None:
+        self.free()
+
+
+def intercomm_create(lh: int, local_leader: int, ph: int,
+                     remote_leader: int, tag: int) -> int:
+    local = _comm(lh)
+    peer = _comm(ph)
+    my_worlds = [local.world_rank_of(i) for i in range(local.size)]
+    # the two leaders swap group rosters through the peer comm; every
+    # member then learns the remote roster via its local leader
+    if local.rank() == local_leader:
+        req = peer.irecv(remote_leader, tag)
+        peer.send(my_worlds, remote_leader, tag)
+        req.wait()
+        remote = req.get()
+    else:
+        remote = None
+    remote = local.bcast(remote, root=local_leader)
+    # identical CID on both sides: the ordered pair of rosters + tag
+    a, b = sorted([tuple(my_worlds), tuple(remote)])
+    cid = ("ic", a, b, int(tag))
+    inter = _RankIntercomm(local, remote, cid)
+    return _register_comm(inter)
+
+
+def intercomm_merge(h: int, high: int) -> int:
+    inter = _comms.get(h) if h >= _FIRST_DYNAMIC else None
+    if not isinstance(inter, _RankIntercomm):
+        raise MPIError(ERR_COMM, "not an intra-job intercommunicator")
+    from ompi_tpu.core.group import Group
+    from ompi_tpu.core.rankcomm import RankCommunicator
+    local = inter.local_comm
+    mine = [local.world_rank_of(i) for i in range(local.size)]
+    # group order: low group first; ties (same high flag both sides)
+    # break on smallest world rank, the reference's documented rule
+    # (intercomm_merge.c.in)
+    me_key = (bool(high), min(mine))     # low group sorts first
+    peer_key = None
+    # the high flag must be consistent within each group; leaders
+    # exchange it so both sides order identically
+    if local.rank() == 0:
+        inter.send(int(high), 0, tag=0)
+        flag, _st = inter.recv(0, tag=0)
+        peer_key = (bool(int(flag)), min(inter.remote_world))
+    peer_key = local.bcast(peer_key, root=0)
+    ordered = (mine + inter.remote_world
+               if me_key < peer_key else
+               inter.remote_world + mine)
+    cid = ("icm", inter.cid)
+    flat = RankCommunicator(Group(ordered), local._my_world,
+                            local.router, cid=cid,
+                            name="intercomm-merge")
+    return _register_comm(flat)
+
+
+def comm_create_group(h: int, gh: int, tag: int) -> int:
+    """MPI_Comm_create_group: collective over the GROUP only — members
+    not in the group never call (comm_create would deadlock there).
+    The CID derives from the member roster + tag, which every member
+    computes identically with zero traffic."""
+    c = _comm(h)
+    g = _group(gh)
+    from ompi_tpu.core.group import Group
+    from ompi_tpu.core.rankcomm import RankCommunicator
+    worlds = list(g.world_ranks)
+    me = c.world_rank_of(c.rank())
+    if me not in worlds:
+        raise MPIError(ERR_GROUP,
+                       "caller is not a member of the group")
+    cid = ("cg", c.cid, tuple(worlds), int(tag))
+    sub = RankCommunicator(Group(worlds), me, c.router, cid=cid,
+                           name=f"comm-group#{tag}", parent=c)
+    return _register_comm(sub)
+
+
+# ---- Alltoallw (alltoallw.c.in) -------------------------------------
+def alltoallw(h: int, sview, scounts_v, sdispls_v, stypes_v,
+              rview, rcounts_v, rdispls_v, rtypes_v) -> bytes:
+    c = _comm(h)
+    n = c.size
+    scounts = [int(x) for x in _ints(scounts_v)]
+    sdispls = [int(x) for x in _ints(sdispls_v)]
+    stypes = np.frombuffer(bytes(stypes_v), dtype=np.int64)
+    rcounts = [int(x) for x in _ints(rcounts_v)]
+    rdispls = [int(x) for x in _ints(rdispls_v)]
+    rtypes = np.frombuffer(bytes(rtypes_v), dtype=np.int64)
+    sbytes = bytes(sview)
+    chunks = []
+    for j in range(n):
+        dtj, cj, off = int(stypes[j]), scounts[j], sdispls[j]
+        wl = _window_len(dtj, cj)
+        chunks.append(_pack(memoryview(sbytes)[off:off + wl], dtj, cj))
+    out = c.alltoall(chunks)
+    cur = bytearray(bytes(rview))
+    for j in range(n):
+        dtj, cj, off = int(rtypes[j]), rcounts[j], rdispls[j]
+        wl = _window_len(dtj, cj)
+        img, _tr = _unpack(out[j], dtj, cj, bytes(cur[off:off + wl]))
+        cur[off:off + wl] = img
+    return bytes(cur)
+
+
+# ---- file views + individual pointers (file_set_view.c.in) ----------
+_file_views: Dict[int, Tuple[int, int, int, str]] = {}
+_file_pos: Dict[int, int] = {}
+_file_amodes: Dict[int, int] = {}
+
+
+def _view_of(fh: int) -> Tuple[int, int, int, str]:
+    return _file_views.get(fh, (0, 4, 4, "native"))   # BYTE/BYTE
+
+
+def file_set_view(fh: int, disp: int, et: int, ft: int,
+                  rep: str) -> None:
+    f = _file(fh)
+    if rep not in ("native", "internal"):
+        raise MPIError(ERR_ARG,
+                       f"unsupported data representation {rep!r} "
+                       f"(native/internal only)")
+    if type_size_bytes(et) <= 0 or type_size_bytes(ft) <= 0:
+        raise MPIError(ERR_TYPE, "zero-size etype/filetype")
+    if type_window_off_bytes(ft) != 0:
+        raise MPIError(ERR_TYPE,
+                       "negative-lb filetypes unsupported in views")
+    _file_views[fh] = (int(disp), int(et), int(ft), rep)
+    _file_pos[fh] = 0
+    f.seek_shared(0)                     # set_view resets BOTH pointers
+
+
+def file_get_view(fh: int) -> Tuple[int, int, int, str]:
+    _file(fh)
+    return _view_of(fh)
+
+
+def file_seek(fh: int, offset: int, whence: int) -> None:
+    _file(fh)
+    disp, et, ft, _rep = _view_of(fh)
+    if whence == 0:                      # MPI_SEEK_SET
+        _file_pos[fh] = int(offset)
+    elif whence == 1:                    # MPI_SEEK_CUR
+        _file_pos[fh] = _file_pos.get(fh, 0) + int(offset)
+    elif whence == 2:                    # MPI_SEEK_END
+        esz = type_size_bytes(et)
+        sigb = type_size_bytes(ft)
+        extb = type_extent_bytes(ft)
+        fsize = _file(fh).get_size()
+        data = max(0, fsize - disp)
+        tiles, rem = divmod(data, extb)
+        vis = tiles * sigb + min(rem, sigb)
+        _file_pos[fh] = vis // esz + int(offset)
+    else:
+        raise MPIError(ERR_ARG, f"bad whence {whence}")
+    if _file_pos[fh] < 0:
+        raise MPIError(ERR_ARG, "file pointer before view start")
+
+
+def file_get_position(fh: int) -> int:
+    _file(fh)
+    return int(_file_pos.get(fh, 0))
+
+
+def _vis_runs(fh: int, vis0: int, n: int):
+    """Map [vis0, vis0+n) visible bytes through the filetype tiling to
+    coalesced (file_offset, length) byte runs (the reference's
+    flattened-filetype iovec, ompio build_io_array role)."""
+    disp, _et, ft, _rep = _view_of(fh)
+    sigb = type_size_bytes(ft)
+    extb = type_extent_bytes(ft)
+    if sigb == extb:                     # trivial (contiguous) view
+        return [(disp + vis0, n)]
+    bidx = _to_byte_idx(ft)              # sig byte offsets in one tile
+    v = np.arange(vis0, vis0 + n, dtype=np.int64)
+    fbyte = disp + (v // sigb) * extb + bidx[v % sigb]
+    runs = []
+    if n:
+        starts = np.flatnonzero(np.diff(fbyte) != 1)
+        prev = 0
+        for s in list(starts) + [n - 1]:
+            runs.append((int(fbyte[prev]), int(s - prev + 1)))
+            prev = s + 1
+    return runs
+
+
+def _vis_read(fh: int, vis0: int, n: int) -> bytes:
+    f = _file(fh)
+    parts = [bytes(f.read_at(off, ln).view(np.uint8).tobytes())
+             for off, ln in _vis_runs(fh, vis0, n)]
+    return b"".join(parts)
+
+
+def _vis_write(fh: int, vis0: int, data: bytes) -> None:
+    f = _file(fh)
+    pos = 0
+    for off, ln in _vis_runs(fh, vis0, len(data)):
+        f.write_at(off, np.frombuffer(data[pos:pos + ln], np.uint8))
+        pos += ln
+
+
+def _ind_offset(fh: int, offset: int, advance_elems: int,
+                et: int) -> int:
+    """Resolve -1 to the individual pointer (etype units) and advance
+    it; explicit offsets leave the pointer alone (MPI _at semantics)."""
+    if offset == -1:
+        pos = _file_pos.get(fh, 0)
+        _file_pos[fh] = pos + advance_elems
+        return pos
+    return int(offset)
+
+
+def file_read_ind(fh: int, offset: int, nbytes: int, dt: int,
+                  curview) -> Tuple[bytes, int]:
+    disp, et, ft, _rep = _view_of(fh)
+    esz = type_size_bytes(et)
+    pos = _ind_offset(fh, offset, int(nbytes) // esz, et)
+    raw = _vis_read(fh, pos * esz, int(nbytes))
+    flat = np.frombuffer(raw, dtype=np.uint8)
+    base = type_base_bytes(dt)
+    usable = (flat.nbytes // base) * base
+    flat = flat[:usable]
+    bdt, _i, _e = _type_parts(dt)
+    flat = flat.view(bdt)
+    cnt = _count_of(curview, dt) if len(curview) else flat.size
+    return _unpack(flat, dt, cnt, bytes(curview))[0], int(flat.nbytes)
+
+
+def file_write_ind(fh: int, offset: int, view, dt: int) -> int:
+    disp, et, ft, _rep = _view_of(fh)
+    esz = type_size_bytes(et)
+    a = _pack(view, dt, _count_of(view, dt))
+    data = a.view(np.uint8).tobytes()
+    pos = _ind_offset(fh, offset, len(data) // esz, et)
+    _vis_write(fh, pos * esz, data)
+    return int(a.nbytes)
+
+
+def file_get_amode(fh: int) -> int:
+    # stored MPI amode (not the translated os flags)
+    return int(_file_amodes.get(fh, 0))
+
+
+def file_preallocate(fh: int, nbytes: int) -> None:
+    _file(fh).preallocate(int(nbytes))
+
+
+def file_seek_shared(fh: int, offset: int, whence: int) -> None:
+    f = _file(fh)
+    disp, et, ft, _rep = _view_of(fh)
+    esz = type_size_bytes(et)
+    if whence == 0:
+        f.seek_shared(int(offset) * esz)
+    elif whence == 1:
+        f.seek_shared(f.get_position_shared() + int(offset) * esz)
+    elif whence == 2:
+        f.seek_shared(max(0, f.get_size() - disp) + int(offset) * esz)
+    else:
+        raise MPIError(ERR_ARG, f"bad whence {whence}")
+
+
+def file_get_position_shared(fh: int) -> int:
+    f = _file(fh)
+    _disp, et, _ft, _rep = _view_of(fh)
+    return int(f.get_position_shared()) // type_size_bytes(et)
+
+
+def file_read_ordered(fh: int, offset: int, nbytes: int, dt: int,
+                      curview) -> Tuple[bytes, int]:
+    f = _file(fh)
+    disp, et, ft, _rep = _view_of(fh)
+    if type_size_bytes(ft) != type_extent_bytes(ft) or disp:
+        raise MPIError(ERR_TYPE, "ordered access needs a trivial view")
+    raw = f.read_ordered(int(nbytes))
+    flat = np.ascontiguousarray(raw).view(np.uint8)
+    bdt, _i, _e = _type_parts(dt)
+    usable = (flat.nbytes // bdt.itemsize) * bdt.itemsize
+    flat = flat[:usable].view(bdt)
+    cnt = _count_of(curview, dt) if len(curview) else flat.size
+    return _unpack(flat, dt, cnt, bytes(curview))[0], int(flat.nbytes)
+
+
+def file_write_ordered(fh: int, offset: int, view, dt: int) -> int:
+    f = _file(fh)
+    disp, et, ft, _rep = _view_of(fh)
+    if type_size_bytes(ft) != type_extent_bytes(ft) or disp:
+        raise MPIError(ERR_TYPE, "ordered access needs a trivial view")
+    a = _pack(view, dt, _count_of(view, dt))
+    f.write_ordered(a.view(np.uint8))
+    return int(a.nbytes)
+
+
+class _FileReadReq:
+    """Request adapter: the inner request completes with raw visible
+    bytes; get() decodes into the posted datatype's base so the glue
+    wait/unpack path can overlay (derived types keep their gaps)."""
+
+    def __init__(self, inner, dt):
+        self._inner = inner
+        self._dt = dt
+
+    def wait(self, timeout=None):
+        return self._inner.wait(timeout)
+
+    def test(self):
+        return self._inner.test()
+
+    def get(self):
+        raw = self._inner.get()
+        bdt, _i, _e = _type_parts(self._dt)
+        flat = np.frombuffer(raw or b"", np.uint8)
+        usable = (flat.nbytes // bdt.itemsize) * bdt.itemsize
+        return flat[:usable].view(bdt)
+
+
+def file_iread(fh: int, offset: int, nbytes: int, dt: int,
+               curview) -> int:
+    c = _file(fh).comm
+    snap = bytes(curview)
+    # resolve the individual pointer NOW (i-ops are ordered at call)
+    _disp, et, _ft, _rep = _view_of(fh)
+    esz = type_size_bytes(et)
+    pos = _ind_offset(fh, offset, int(nbytes) // esz, et)
+    req = c._nb(lambda: _vis_read(fh, pos * esz, int(nbytes)))
+    with _lock:
+        rh = next(_next_req)
+        _requests[rh] = (_FileReadReq(req, dt), dt, snap)
+    return rh
+
+
+def file_iwrite(fh: int, offset: int, view, dt: int) -> int:
+    c = _file(fh).comm
+    a = _pack(view, dt, _count_of(view, dt))
+    data = a.view(np.uint8).tobytes()
+    disp, et, ft, _rep = _view_of(fh)
+    esz = type_size_bytes(et)
+    pos = _ind_offset(fh, offset, len(data) // esz, et)
+    req = c._nb(lambda: _vis_write(fh, pos * esz, data))
+    with _lock:
+        rh = next(_next_req)
+        _requests[rh] = (req, 0, b"")
+    return rh
+
+
+# ---- dynamic RMA windows (win_create_dynamic.c.in) ------------------
+class _DynRegions:
+    """Slice-indexable address-space storage for a dynamic window:
+    resolves absolute addresses into attached regions (win_attach) and
+    exposes the numpy get/set surface RankWindow's handler uses."""
+
+    def __init__(self):
+        self.regions = []                # (addr, size, uint8 view)
+
+    def _resolve(self, start: int, stop: int):
+        for addr, size, view in self.regions:
+            if addr <= start and stop <= addr + size:
+                return view, start - addr
+        raise MPIError(ERR_ARG,
+                       f"RMA range [{start:#x},{stop:#x}) is not "
+                       f"attached to this dynamic window")
+
+    def __getitem__(self, key):
+        if isinstance(key, slice):
+            view, off = self._resolve(key.start, key.stop)
+            return view[off:off + (key.stop - key.start)]
+        view, off = self._resolve(key, key + 1)
+        return view[off]
+
+    def __setitem__(self, key, val):
+        if isinstance(key, slice):
+            view, off = self._resolve(key.start, key.stop)
+            view[off:off + (key.stop - key.start)] = val
+        else:
+            view, off = self._resolve(key, key + 1)
+            view[off] = val
+
+
+def win_create_dynamic(h: int) -> int:
+    from ompi_tpu.osc.perrank import RankWindow
+    c = _comm(h)
+    win = RankWindow(c, 0, dtype=np.uint8, name="cabi_windyn")
+    win.local = _DynRegions()
+    # origin-side bounds checks are impossible (attach sets are local
+    # to each target): advertise an unbounded exposure; the target's
+    # resolve raises on unattached ranges
+    win.size = 1 << 62
+    win.sizes = [1 << 62] * c.size
+    win._disp_units = [1] * c.size       # disps are absolute addresses
+    with _lock:
+        wh = next(_next_win)
+        _wins[wh] = win
+    return wh
+
+
+def win_attach(wh: int, addr: int, size: int) -> None:
+    import ctypes
+    w = _win(wh)
+    if not isinstance(w.local, _DynRegions):
+        raise MPIError(ERR_ARG, "win_attach needs a dynamic window")
+    buf = (ctypes.c_ubyte * int(size)).from_address(int(addr))
+    view = np.frombuffer(buf, dtype=np.uint8)
+    if not view.flags.writeable:
+        view = np.ctypeslib.as_array(buf)
+    w.local.regions.append((int(addr), int(size), view))
+
+
+def win_detach(wh: int, addr: int) -> None:
+    w = _win(wh)
+    if not isinstance(w.local, _DynRegions):
+        raise MPIError(ERR_ARG, "win_detach needs a dynamic window")
+    before = len(w.local.regions)
+    w.local.regions = [r for r in w.local.regions if r[0] != int(addr)]
+    if len(w.local.regions) == before:
+        raise MPIError(ERR_ARG, "address was not attached")
+
+
+# ---- spawn of executables (comm_spawn.c.in) -------------------------
+_parent_comm_handle: Optional[int] = None
+_spawned_procs: list = []                # reaped opportunistically
+
+
+def comm_spawn(h: int, command: str, argv_joined: str, maxprocs: int,
+               root: int) -> int:
+    """MPI_Comm_spawn: the root launches ``maxprocs`` OS processes
+    running ``command`` under a fresh mpirun --per-rank job whose
+    MPI_Init dials back through the dpm port plane
+    (OMPI_TPU_PARENT_PORT); both jobs then hold a cross-job
+    intercommunicator — the PMPI parent-nspace handshake over this
+    runtime's coordination plane (reference: dpm.c:108-170 +
+    comm_spawn.c.in)."""
+    import os as _os
+    import subprocess as _sp
+    import sys as _sys
+    c = _comm(h)
+    from ompi_tpu.core import dpm_perrank as dpm
+    # reap earlier spawns that have since exited (no zombie per spawn)
+    global _spawned_procs
+    _spawned_procs = [p for p in _spawned_procs if p.poll() is None]
+    port = dpm.open_port() if c.rank() == root else None
+    port = c.bcast(port, root=root)
+    if c.rank() == root:
+        mpirun = _os.path.join(
+            _os.path.dirname(_os.path.dirname(
+                _os.path.abspath(__file__))), "tools", "mpirun.py")
+        argv = ([a for a in argv_joined.split("\x1f") if a != ""]
+                if argv_joined else [])
+        env = dict(_os.environ)
+        env["OMPI_TPU_PARENT_PORT"] = port
+        _spawned_procs.append(
+            _sp.Popen([_sys.executable, mpirun, "--per-rank", "-n",
+                       str(int(maxprocs)), command, *argv], env=env))
+    # bounded accept: a command that fails to exec must surface as an
+    # error here, not hang every rank forever
+    inter = dpm.comm_accept(port, c, root=root, timeout=120)
+    if c.rank() == root:
+        dpm.close_port(port)
+    return _register_comm(inter)
+
+
+def comm_get_parent() -> int:
+    """MPI_Comm_get_parent: COMM_NULL unless this world was spawned."""
+    global _parent_comm_handle
+    if _parent_comm_handle is not None:
+        return _parent_comm_handle
+    from ompi_tpu.runtime import init as rt
+    parent = getattr(rt, "_parent_intercomm", None)
+    if parent is None:
+        return COMM_NULL
+    _parent_comm_handle = _register_comm(parent)
+    return _parent_comm_handle
+
+
+# ---- partitioned point-to-point (MPI-4 ch. 4; pml/part_perrank) -----
+_part_reqs: Dict[int, Tuple[Any, int, int]] = {}
+_next_part = itertools.count(1)          # (req, dt, is_recv)
+
+
+def psend_init(h: int, view, partitions: int, count: int, dt: int,
+               dest: int, tag: int) -> int:
+    """MPI_Psend_init: zero-copy per-partition views over the CALLER'S
+    buffer — pready(k) reads partition k's bytes at that moment, the
+    partitioned contract (the buffer must stay valid until freed).
+    Basic datatypes only (the reference's partitioned chapter shares
+    the restriction in practice: partitions are contiguous lanes)."""
+    if dt >= _FIRST_DYN_TYPE:
+        raise MPIError(ERR_TYPE,
+                       "partitioned transfers take basic datatypes")
+    c = _comm(h)
+    base = np.frombuffer(view, dtype=_dtype(dt))
+    per = int(count)
+    parts = [base[k * per:(k + 1) * per] for k in range(partitions)]
+    from ompi_tpu.pml import part_perrank as pp
+    req = pp.psend_init(c, parts, dest, tag)
+    with _lock:
+        ph = next(_next_part)
+        _part_reqs[ph] = (req, dt, 0)
+    return ph
+
+
+def precv_init(h: int, partitions: int, count: int, dt: int,
+               source: int, tag: int) -> int:
+    if dt >= _FIRST_DYN_TYPE:
+        raise MPIError(ERR_TYPE,
+                       "partitioned transfers take basic datatypes")
+    c = _comm(h)
+    from ompi_tpu.pml import part_perrank as pp
+    req = pp.precv_init(c, partitions, source, tag)
+    with _lock:
+        ph = next(_next_part)
+        _part_reqs[ph] = (req, dt, 1)
+    return ph
+
+
+def _part(ph: int):
+    with _lock:
+        ent = _part_reqs.get(ph)
+    if ent is None:
+        raise MPIError(ERR_REQUEST, f"invalid partitioned handle {ph}")
+    return ent
+
+
+def part_start(ph: int) -> None:
+    _part(ph)[0].start()
+
+
+def part_pready(ph: int, k: int) -> None:
+    _part(ph)[0].pready(int(k))
+
+
+def part_pready_range(ph: int, lo: int, hi: int) -> None:
+    _part(ph)[0].pready_range(int(lo), int(hi))
+
+
+def part_parrived(ph: int, k: int) -> int:
+    return int(bool(_part(ph)[0].parrived(int(k))))
+
+
+def part_test(ph: int) -> Tuple[int, bytes, int, int, int, int, int]:
+    """Non-blocking completion check WITHOUT consuming the handle."""
+    req, dt, is_recv = _part(ph)
+    done, st = req.test()
+    if not done:
+        return 0, b"", -1, -1, 0, 0, 0
+    out, src, tag, nb, tr, canc = part_wait(ph)
+    return 1, out, src, tag, nb, tr, canc
+
+
+def part_wait(ph: int) -> Tuple[bytes, int, int, int, int, int]:
+    """Completion WITHOUT consuming the handle (partitioned requests
+    are persistent: Start re-arms them)."""
+    req, dt, is_recv = _part(ph)
+    st = req.wait()
+    if not is_recv:
+        return b"", int(st.source), int(st.tag), 0, 0, 0
+    parts = req.get()
+    out = np.concatenate([np.asarray(p).ravel() for p in parts]) \
+        if parts else np.array([], _dtype(dt))
+    if out.dtype != _dtype(dt):
+        out = out.astype(_dtype(dt))
+    raw = out.tobytes()
+    return raw, int(st.source), int(st.tag), len(raw), 0, 0
+
+
+def part_free(ph: int) -> None:
+    with _lock:
+        if _part_reqs.pop(ph, None) is None:
+            raise MPIError(ERR_REQUEST,
+                           f"invalid partitioned handle {ph}")
+
+
+# ---- MPI_T events + pvar write --------------------------------------
+def t_pvar_write(i: int, value: int) -> None:
+    from ompi_tpu.mca import pvar as _p
+    info = _t_pvar(i)
+    _p.pvar_write(info["name"], int(value))
+
+
+_t_event_regs: Dict[int, Any] = {}
+_next_t_event_reg = itertools.count(1)
+_t_event_instances: Dict[int, Tuple[str, int]] = {}
+_next_t_event_inst = itertools.count(1)
+
+
+def t_event_get_num() -> int:
+    from ompi_tpu.api import tool as _tool
+    return int(_tool.event_get_num())
+
+
+def t_event_get_index(name: str) -> int:
+    from ompi_tpu.api import tool as _tool
+    try:
+        return _tool.event_list().index(name)
+    except ValueError:
+        return -1
+
+
+def t_event_get_info(i: int) -> Tuple[str, int, int, int, str]:
+    from ompi_tpu.api import tool as _tool
+    names = _tool.event_list()
+    if not 0 <= int(i) < len(names):
+        raise MPIError(ERR_ARG, f"bad event index {i}")
+    ev = _tool.event_get_info(int(i))
+    # one MPI_UINT64_T element: the event's value payload
+    return (ev["name"], int(ev.get("verbosity", 1)), 23, 1,
+            ev.get("desc", ""))
+
+
+def t_event_handle_alloc(i: int, cb_ptr: int, user_data: int) -> int:
+    import ctypes
+    from ompi_tpu.api import tool as _tool
+    names = _tool.event_list()
+    if not 0 <= int(i) < len(names):
+        raise MPIError(ERR_ARG, f"bad event index {i}")
+    name = names[int(i)]
+    reg = next(_next_t_event_reg)
+    cfn = ctypes.CFUNCTYPE(None, ctypes.c_long, ctypes.c_long,
+                           ctypes.c_int, ctypes.c_void_p)(cb_ptr)
+
+    def on_event(event: str, comm, info) -> None:
+        inst = next(_next_t_event_inst)
+        _t_event_instances[inst] = (event,
+                                    int(info.get("value", 0) or 0))
+        try:
+            cfn(inst, reg, 0, user_data)
+        finally:
+            _t_event_instances.pop(inst, None)
+
+    handle = _tool.event_handle_alloc(name, on_event)
+    _t_event_regs[reg] = (handle, cfn)   # keep the CFUNCTYPE alive
+    return reg
+
+
+def t_event_handle_free(reg: int) -> None:
+    from ompi_tpu.api import tool as _tool
+    ent = _t_event_regs.pop(int(reg), None)
+    if ent is None:
+        raise MPIError(ERR_ARG, f"bad event registration {reg}")
+    _tool.event_handle_free(ent[0])
+
+
+def t_event_read(inst: int, element_index: int) -> int:
+    ent = _t_event_instances.get(int(inst))
+    if ent is None or element_index != 0:
+        raise MPIError(ERR_ARG, "bad event instance/element")
+    return int(ent[1])
 
 
 def exc_code(exc: BaseException) -> int:

@@ -54,6 +54,7 @@ from ompi_tpu.core.request import Request, Status
 from ompi_tpu.pml.perrank import (ANY_SOURCE, ANY_TAG, PROC_NULL,
                                   PerRankEngine, RankRequest, Router)
 from ompi_tpu.runtime import spc
+from ompi_tpu.utils import hooks as _hooks_mod
 
 AXIS = "mpi_r"
 
@@ -460,6 +461,10 @@ class RankCommunicator:
         self._check()
         self._validate_op(op)
         spc.record("coll_allreduce", 1)
+        if _hooks_mod._hooks:            # tool bound: fire the event
+            _hooks_mod.fire("coll_allreduce", self,
+                            {"value": int(getattr(data, "nbytes", 0)
+                                          or 0)})
         if isinstance(data, _dev_array_type()) and self._mesh() is not None:
             return self._device_allreduce(data, op)
         if self._stageable(data, op):

@@ -30,6 +30,19 @@ def pvar_read(name: str) -> Any:
     return v["read"]()
 
 
+def pvar_write(name: str, value: Any) -> None:
+    """MPI_T_pvar_write: SPC-backed counters accept writes (the
+    watermark/reset tool idiom); read-only pvars refuse."""
+    with _lock:
+        v = _pvars.get(name)
+    if v is None:
+        raise KeyError(f"no such pvar: {name}")
+    wf = v.get("write")
+    if wf is None:
+        raise PermissionError(f"pvar {name} is read-only")
+    wf(value)
+
+
 def pvar_list() -> List[Dict[str, Any]]:
     with _lock:
         items = list(_pvars.items())
@@ -46,10 +59,15 @@ def _install_spc_pvars() -> None:
     def make_reader(key):
         return lambda: spc.read(key)
 
+    def make_writer(key):
+        return lambda value: spc.write(key, int(value))
+
     for key in spc.snapshot():
         if f"spc_{key}" not in _pvars:
             pvar_register(f"spc_{key}", make_reader(key),
                           help=f"SPC counter {key}")
+            with _lock:
+                _pvars[f"spc_{key}"]["write"] = make_writer(key)
 
 
 def refresh() -> None:

@@ -204,12 +204,23 @@ class RankRequest(Request):
     """A receive (or synchronous-send) request completed by the engine
     from a btl reader thread; wait blocks on a real Event."""
 
+    cancelled = False                    # MPI_Cancel outcome
+
     def __init__(self, src: int, tag: int):
         super().__init__(arrays=[])
         self._complete = False
         self._event = threading.Event()
         self._error: Optional[BaseException] = None
         self.status = Status(source=src, tag=tag)
+
+    def cancel(self) -> None:
+        """MPI_Cancel: succeeds only while the receive is still
+        posted (unmatched); a matched/completed request is past the
+        cancellation point and the call is a no-op (cancel.c.in
+        semantics)."""
+        fn = getattr(self, "_cancel_fn", None)
+        if fn is not None:
+            fn()
 
     def _deliver(self, msg: _Msg) -> None:
         self._result = msg.data
@@ -493,9 +504,18 @@ class PerRankEngine:
         return Request.completed()
 
     # -- receive side --------------------------------------------------
+    def _cancel_posted(self, req: RankRequest) -> None:
+        with self._lock:
+            present = any(e[2] is req for e in self.posted)
+            self.posted = [e for e in self.posted if e[2] is not req]
+        if present:
+            req.cancelled = True
+            req._deliver(_Msg(ANY_SOURCE, ANY_TAG, None))
+
     def irecv(self, source: int = ANY_SOURCE,
               tag: int = ANY_TAG) -> RankRequest:
         req = RankRequest(source, tag)
+        req._cancel_fn = lambda: self._cancel_posted(req)
         if source == PROC_NULL:
             req._deliver(_Msg(PROC_NULL, tag, None))
             return req

@@ -42,6 +42,10 @@ _state = {
     "t0": 0.0,
 }
 
+# the parent-job intercommunicator of a spawned world (MPI_Comm_spawn
+# child side); None in a directly-launched job
+_parent_intercomm = None
+
 
 def _register_base_vars() -> None:
     var.var_register("mpi", "base", "num_ranks", vtype="int", default=0,
@@ -194,6 +198,18 @@ def _init_per_rank(requested: int) -> int:
     _state.update(initialized=True, finalized=False, world=world,
                   self=self_comm, router=router, t0=time.perf_counter(),
                   thread_level=min(requested, THREAD_MULTIPLE))
+
+    # Spawned world: dial back to the parent job through the dpm port
+    # plane (MPI_Comm_spawn's PMIx parent-nspace handshake over this
+    # runtime's coordination plane); MPI_Comm_get_parent returns the
+    # resulting intercommunicator (dpm.c:108-170, comm_get_parent
+    # .c.in).
+    parent_port = os.environ.get("OMPI_TPU_PARENT_PORT")
+    if parent_port:
+        from ompi_tpu.core import dpm_perrank as _dpm
+        global _parent_intercomm
+        _parent_intercomm = _dpm.comm_connect(parent_port, world,
+                                              root=0)
     return _state["thread_level"]
 
 

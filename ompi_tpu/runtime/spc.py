@@ -39,6 +39,13 @@ def read(name: str) -> int:
         return _counters.get(name, 0)
 
 
+def write(name: str, value: int) -> None:
+    """Set a counter outright (MPI_T_pvar_write backing; tools reset
+    watermarks this way)."""
+    with _lock:
+        _counters[name] = int(value)
+
+
 def snapshot() -> Dict[str, int]:
     with _lock:
         return dict(_counters)

@@ -15,6 +15,23 @@
         }                                                            \
     } while (0)
 
+/* event tool state: the callback reads the instance's one element */
+static volatile int g_event_fires;
+static volatile unsigned long long g_event_value;
+
+static void event_cb(MPI_T_event_instance instance,
+                     MPI_T_event_registration reg,
+                     MPI_T_cb_safety safety, void *user_data)
+{
+    (void)reg;
+    (void)safety;
+    (void)user_data;
+    unsigned long long v = 0;
+    if (MPI_T_event_read(instance, 0, &v) == MPI_SUCCESS)
+        g_event_value = v;
+    g_event_fires++;
+}
+
 int main(int argc, char **argv)
 {
     int rank, size, provided = -1;
@@ -115,8 +132,47 @@ int main(int argc, char **argv)
     MPI_T_pvar_read(ses, ph, &after);
     CHECK(after >= before + 2, 15);
     MPI_T_pvar_stop(ses, ph);
+
+    /* ---- pvar WRITE: SPC counters accept tool writes ---- */
+    unsigned long long wrote = 4242;
+    CHECK(MPI_T_pvar_write(ses, ph, &wrote) == MPI_SUCCESS, 16);
+    unsigned long long back = 0;
+    MPI_T_pvar_read(ses, ph, &back);
+    CHECK(back == 4242, 17);
     MPI_T_pvar_handle_free(ses, &ph);
     MPI_T_pvar_session_free(&ses);
+
+    /* ---- events: bind a C callback to coll_allreduce ---- */
+    int nev = -1;
+    CHECK(MPI_T_event_get_num(&nev) == MPI_SUCCESS && nev > 0, 18);
+    int eidx = -1;
+    CHECK(MPI_T_event_get_index("coll_allreduce", &eidx)
+          == MPI_SUCCESS && eidx >= 0, 19);
+    char ename[64], edesc[128], einfo[8];
+    int enl = sizeof(ename), edsl = sizeof(edesc),
+        eil = sizeof(einfo);
+    int everb = -1, enelem = -1, ebind = -1;
+    MPI_Datatype etypes;
+    MPI_T_enum eenum;
+    CHECK(MPI_T_event_get_info(eidx, ename, &enl, &everb, &etypes,
+                               &enelem, &eenum, einfo, &eil, edesc,
+                               &edsl, &ebind) == MPI_SUCCESS, 20);
+    CHECK(strcmp(ename, "coll_allreduce") == 0 && enelem == 1, 21);
+    MPI_T_event_registration ereg;
+    CHECK(MPI_T_event_handle_alloc(eidx, NULL, MPI_INFO_NULL,
+                                   event_cb, NULL, &ereg)
+          == MPI_SUCCESS, 22);
+    g_event_fires = 0;
+    g_event_value = 0;
+    int ev = rank, es = -1;
+    MPI_Allreduce(&ev, &es, 1, MPI_INT, MPI_SUM, MPI_COMM_WORLD);
+    CHECK(g_event_fires >= 1, 23);
+    CHECK(g_event_value == (unsigned long long)sizeof(int), 24);
+    CHECK(MPI_T_event_handle_free(ereg, NULL, NULL) == MPI_SUCCESS,
+          25);
+    g_event_fires = 0;
+    MPI_Allreduce(&ev, &es, 1, MPI_INT, MPI_SUM, MPI_COMM_WORLD);
+    CHECK(g_event_fires == 0, 26);       /* unbound: no more fires */
 
     printf("OK c19_mpit rank=%d/%d\n", rank, size);
     MPI_Finalize();

@@ -1,0 +1,155 @@
+/* Partitioned point-to-point from C (MPI-4 chapter 4; reference
+ * ompi/mpi/c/psend_init.c.in, pready.c.in, parrived.c.in over
+ * ompi/mca/part/persist): a persistent partitioned pair moves data in
+ * independently-contributed partitions, is re-armed with MPI_Start for
+ * a second round, and the receiver polls MPI_Parrived. Also covers
+ * the round-5 closers: Status_set_source/tag/error, File_get_amode,
+ * File_preallocate, Ialltoallw. */
+#include <mpi.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <unistd.h>
+
+static int rank, size;
+
+#define CHECK(cond, code)                                            \
+    do {                                                             \
+        if (!(cond)) {                                               \
+            fprintf(stderr, "rank %d: check failed at line %d\n",    \
+                    rank, __LINE__);                                 \
+            MPI_Abort(MPI_COMM_WORLD, code);                         \
+        }                                                            \
+    } while (0)
+
+#define PARTS 4
+#define PER 8
+
+int main(int argc, char **argv)
+{
+    MPI_Init(&argc, &argv);
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &size);
+    CHECK(size >= 2, 1);
+
+    if (rank == 0) {
+        double sbuf[PARTS * PER];
+        MPI_Request pr;
+        CHECK(MPI_Psend_init(sbuf, PARTS, PER, MPI_DOUBLE, 1, 42,
+                             MPI_COMM_WORLD, MPI_INFO_NULL, &pr)
+              == MPI_SUCCESS, 2);
+        for (int round = 0; round < 2; round++) {
+            CHECK(MPI_Start(&pr) == MPI_SUCCESS, 3);
+            /* fill + contribute partitions OUT OF ORDER — each
+             * partition leaves when ready, the entire point */
+            static const int order[PARTS] = {2, 0, 3, 1};
+            for (int i = 0; i < PARTS; i++) {
+                int k = order[i];
+                for (int j = 0; j < PER; j++)
+                    sbuf[k * PER + j] =
+                        1000.0 * round + 10.0 * k + j;
+                if (i < 2)
+                    CHECK(MPI_Pready(k, pr) == MPI_SUCCESS, 4);
+            }
+            /* the rest via range/list */
+            CHECK(MPI_Pready_range(3, 3, pr) == MPI_SUCCESS, 5);
+            int last[1] = {1};
+            CHECK(MPI_Pready_list(1, last, pr) == MPI_SUCCESS, 6);
+            MPI_Status st;
+            CHECK(MPI_Wait(&pr, &st) == MPI_SUCCESS, 7);
+        }
+        CHECK(MPI_Request_free(&pr) == MPI_SUCCESS, 8);
+        CHECK(pr == MPI_REQUEST_NULL, 9);
+    } else if (rank == 1) {
+        double rbuf[PARTS * PER];
+        MPI_Request pr;
+        CHECK(MPI_Precv_init(rbuf, PARTS, PER, MPI_DOUBLE, 0, 42,
+                             MPI_COMM_WORLD, MPI_INFO_NULL, &pr)
+              == MPI_SUCCESS, 10);
+        for (int round = 0; round < 2; round++) {
+            memset(rbuf, 0, sizeof(rbuf));
+            CHECK(MPI_Start(&pr) == MPI_SUCCESS, 11);
+            /* poll partition 2 (sent first) until it lands */
+            int flag = 0;
+            for (int spin = 0; spin < 200000 && !flag; spin++)
+                CHECK(MPI_Parrived(pr, 2, &flag) == MPI_SUCCESS, 12);
+            CHECK(flag, 13);
+            MPI_Status st;
+            CHECK(MPI_Wait(&pr, &st) == MPI_SUCCESS, 14);
+            for (int k = 0; k < PARTS; k++)
+                for (int j = 0; j < PER; j++)
+                    CHECK(rbuf[k * PER + j]
+                              == 1000.0 * round + 10.0 * k + j, 15);
+        }
+        CHECK(MPI_Request_free(&pr) == MPI_SUCCESS, 16);
+    }
+    MPI_Barrier(MPI_COMM_WORLD);
+
+    /* ---- status setters ---- */
+    {
+        MPI_Status st;
+        memset(&st, 0, sizeof(st));
+        CHECK(MPI_Status_set_source(&st, 7) == MPI_SUCCESS, 17);
+        CHECK(MPI_Status_set_tag(&st, 9) == MPI_SUCCESS, 18);
+        CHECK(MPI_Status_set_error(&st, MPI_ERR_OTHER) == MPI_SUCCESS,
+              19);
+        CHECK(st.MPI_SOURCE == 7 && st.MPI_TAG == 9
+              && st.MPI_ERROR == MPI_ERR_OTHER, 20);
+    }
+
+    /* ---- file amode / preallocate / type extent ---- */
+    {
+        char path[256];
+        snprintf(path, sizeof(path), "/tmp/ompi_tpu_c26_%d.bin",
+                 (int)getppid());
+        MPI_File fh;
+        int amode = MPI_MODE_CREATE | MPI_MODE_RDWR;
+        CHECK(MPI_File_open(MPI_COMM_WORLD, path, amode, MPI_INFO_NULL,
+                            &fh) == MPI_SUCCESS, 21);
+        int got = -1;
+        CHECK(MPI_File_get_amode(fh, &got) == MPI_SUCCESS
+              && got == amode, 22);
+        CHECK(MPI_File_preallocate(fh, 4096) == MPI_SUCCESS, 23);
+        MPI_Offset sz = -1;
+        MPI_File_get_size(fh, &sz);
+        CHECK(sz >= 4096, 24);
+        MPI_Aint te = -1;
+        CHECK(MPI_File_get_type_extent(fh, MPI_DOUBLE, &te)
+              == MPI_SUCCESS && te == 8, 25);
+        MPI_File_close(&fh);
+        if (rank == 0)
+            unlink(path);
+    }
+
+    /* ---- Ialltoallw ---- */
+    {
+        CHECK(size <= 16, 26);
+        int scount[16], rcount[16], sdisp[16], rdisp[16];
+        MPI_Datatype stype[16], rtype[16];
+        for (int j = 0; j < size; j++) {
+            scount[j] = rcount[j] = 2;
+            sdisp[j] = rdisp[j] = j * 2 * (int)sizeof(int);
+            stype[j] = rtype[j] = MPI_INT;
+        }
+        int *sb = malloc(2 * size * sizeof(int));
+        int *rb = malloc(2 * size * sizeof(int));
+        for (int j = 0; j < 2 * size; j++)
+            sb[j] = 100 * rank + j;
+        MPI_Request r;
+        CHECK(MPI_Ialltoallw(sb, scount, sdisp, stype, rb, rcount,
+                             rdisp, rtype, MPI_COMM_WORLD, &r)
+              == MPI_SUCCESS, 27);
+        MPI_Wait(&r, MPI_STATUS_IGNORE);
+        for (int j = 0; j < size; j++) {
+            CHECK(rb[2 * j] == 100 * j + 2 * rank, 28);
+            CHECK(rb[2 * j + 1] == 100 * j + 2 * rank + 1, 29);
+        }
+        free(sb);
+        free(rb);
+    }
+
+    MPI_Barrier(MPI_COMM_WORLD);
+    printf("OK c26_partitioned rank=%d/%d\n", rank, size);
+    MPI_Finalize();
+    return 0;
+}
