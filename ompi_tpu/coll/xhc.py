@@ -127,6 +127,7 @@ class XhcComponent(Component):
         if prio < 0:
             return None
         spec = (var.var_get("coll_xhc_levels", "") or "").strip()
+        basis = "var"
         if spec:
             try:
                 sizes = [int(s) for s in spec.split(",") if s.strip()]
@@ -135,10 +136,21 @@ class XhcComponent(Component):
         else:
             sizes = locality_sizes(comm.devices)
             if sizes is None:
-                return None
+                # the hwloc-depth walk (VERDICT r4 next #10): OS
+                # topology levels, else a labeled synthetic
+                # factorization so the ladder still has depth on flat
+                # virtual meshes
+                from ompi_tpu.utils.locality import ladder_sizes
+                sizes, basis = ladder_sizes(comm.size, comm.devices)
+                if sizes is None:
+                    return None
+            else:
+                basis = "device-locality"
         if comm.size <= 1 or not sizes:
             return None
-        return (prio, XhcModule(comm, sizes))
+        mod = XhcModule(comm, sizes)
+        mod.level_basis = basis          # provenance for comm_method
+        return (prio, mod)
 
 
 coll_framework.register(XhcComponent())

@@ -172,3 +172,37 @@ def test_xhc_bcast_reduce_barrier(xhc_world, rng):
     red = np.asarray(xhc_world.reduce(buf, MPI.SUM, root=1))
     np.testing.assert_allclose(red[1], x.sum(0), rtol=1e-4)
     xhc_world.barrier()
+
+
+@pytest.fixture()
+def xhc_auto_world(world, _vars):
+    """xhc preferred but NO explicit level list — the ladder must come
+    from synthesized locality (VERDICT r4 next #10)."""
+    _vars("coll_xhc_priority", 80)
+    return world.dup()
+
+
+def test_xhc_ladder_without_levels_var(xhc_auto_world, rng):
+    """The hwloc-depth walk: with coll_xhc_levels UNSET on this flat
+    8-device CPU mesh, xhc still builds a >= 2-level ladder (OS
+    topology when the host has depth, labeled synthetic factorization
+    otherwise) and the collectives stay correct."""
+    w = xhc_auto_world
+    assert w._coll_winners["allreduce"] == "xhc"
+    m = w.c_coll["allreduce"]
+    assert isinstance(m, XhcModule)
+    assert len(m.levels) >= 2, m.levels
+    assert getattr(m, "level_basis", "") in (
+        "os-topology", "synthetic-mesh", "device-locality")
+    n = w.size
+    x = rng.standard_normal((n, 17)).astype(np.float32)
+    out = np.asarray(w.allreduce(w.stack(list(x)), MPI.SUM))
+    np.testing.assert_allclose(out[0], x.sum(0), rtol=1e-4)
+
+
+def test_ladder_sizes_provenance():
+    from ompi_tpu.utils.locality import ladder_sizes
+    sizes, basis = ladder_sizes(8)
+    assert sizes and basis in ("os-topology", "synthetic-mesh")
+    assert ladder_sizes(2)[0] is None          # trivial stays trivial
+    assert ladder_sizes(7)[0] is None          # prime, single level
