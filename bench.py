@@ -807,7 +807,10 @@ def _ab_matrix_child() -> None:
         lambda: world.allreduce(MPI.IN_PLACE, MPI.SUM, recvbuf=small),
         50, rtt, chunk) * 1e6, 2)
     vec = MPI.FLOAT.create_vector(count=4, blocklength=2, stride=4)
-    vbuf = world.alloc((16,), np.float32, fill=1.0)
+    # exact-fit buffer (last dim == count*extent = 14): the fused
+    # gather->collective->scatter program serves it; other shapes keep
+    # the convertor path (core/communicator.py shape contract)
+    vbuf = world.alloc((14,), np.float32, fill=1.0)
     out["osu_allreduce_vector_dtype_us"] = round(_osu(
         lambda: world.allreduce(vbuf, MPI.SUM, datatype=vec, count=1),
         20, rtt, chunk) * 1e6, 2)

@@ -6107,6 +6107,198 @@ int PMPI_Win_detach(MPI_Win win, const void *base)
     return rc;
 }
 
+/* ---- PSCW active-target epochs (win_post.c.in family) ------------ */
+static int win_group_call(const char *fn, MPI_Win win, MPI_Group group)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, fn, "ll", (long)win,
+                                      (long)group);
+    if (!r)
+        rc = handle_error(fn);
+    else
+        Py_DECREF(r);
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Win_post(MPI_Group group, int assert_, MPI_Win win)
+{
+    (void)assert_;
+    return win_group_call("win_post", win, group);
+}
+
+int PMPI_Win_start(MPI_Group group, int assert_, MPI_Win win)
+{
+    (void)assert_;
+    return win_group_call("win_start", win, group);
+}
+
+int PMPI_Win_complete(MPI_Win win)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "win_complete", "l",
+                                      (long)win);
+    if (!r)
+        rc = handle_error("MPI_Win_complete");
+    else
+        Py_DECREF(r);
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Win_wait(MPI_Win win)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "win_wait", "l",
+                                      (long)win);
+    if (!r)
+        rc = handle_error("MPI_Win_wait");
+    else
+        Py_DECREF(r);
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Win_set_name(MPI_Win win, const char *win_name)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "win_set_name", "ls",
+                                      (long)win, win_name);
+    if (!r)
+        rc = handle_error("MPI_Win_set_name");
+    else
+        Py_DECREF(r);
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Win_get_name(MPI_Win win, char *win_name, int *resultlen)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "win_get_name", "l",
+                                      (long)win);
+    if (!r) {
+        rc = handle_error("MPI_Win_get_name");
+    } else {
+        const char *s = PyUnicode_AsUTF8(r);
+        if (s) {
+            strncpy(win_name, s, MPI_MAX_OBJECT_NAME - 1);
+            win_name[MPI_MAX_OBJECT_NAME - 1] = '\0';
+            *resultlen = (int)strlen(win_name);
+        }
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Comm_idup(MPI_Comm comm, MPI_Comm *newcomm,
+                  MPI_Request *request)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "comm_idup", "l",
+                                      (long)comm);
+    if (!r) {
+        rc = handle_error_comm(comm, "MPI_Comm_idup");
+    } else {
+        *newcomm = (MPI_Comm)PyLong_AsLong(PyTuple_GetItem(r, 0));
+        errh_set(*newcomm, errh_for(comm));   /* derived comms inherit */
+        req_entry *e = req_new();
+        e->pyh = PyLong_AsLong(PyTuple_GetItem(r, 1));
+        *request = (MPI_Request)(intptr_t)e;
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+/* ---- external32 (pack_external.c.in; MPI-3.1 13.5.2) ------------- */
+int PMPI_Pack_external(const char datarep[], const void *inbuf,
+                      int incount, MPI_Datatype datatype, void *outbuf,
+                      MPI_Aint outsize, MPI_Aint *position)
+{
+    if (strcmp(datarep, "external32") != 0)
+        return MPI_ERR_ARG;
+    long long woff, wlen;
+    if (!dt_window(datatype, incount, &woff, &wlen))
+        return MPI_ERR_TYPE;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "pack_external", "Nli",
+        mem_ro((const char *)inbuf + woff, (size_t)wlen),
+        (long)datatype, incount);
+    if (!r)
+        rc = handle_error("MPI_Pack_external");
+    else {
+        char *p;
+        Py_ssize_t n;
+        if (PyBytes_AsStringAndSize(r, &p, &n) == 0) {
+            if (*position + n > outsize)
+                rc = MPI_ERR_TRUNCATE;
+            else {
+                memcpy((char *)outbuf + *position, p, (size_t)n);
+                *position += (MPI_Aint)n;
+            }
+        }
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Unpack_external(const char datarep[], const void *inbuf,
+                        MPI_Aint insize, MPI_Aint *position,
+                        void *outbuf, int outcount,
+                        MPI_Datatype datatype)
+{
+    if (strcmp(datarep, "external32") != 0)
+        return MPI_ERR_ARG;
+    size_t sig = dt_sig(datatype);
+    long long woff, wlen;
+    if (!dt_window(datatype, outcount, &woff, &wlen))
+        return MPI_ERR_TYPE;
+    size_t need = sig * (size_t)outcount;
+    if ((size_t)*position + need > (size_t)insize)
+        return MPI_ERR_TRUNCATE;
+    char *win = (char *)outbuf + woff;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "unpack_external", "NliN",
+        mem_ro((const char *)inbuf + *position, need), (long)datatype,
+        outcount,
+        mem_ro(win, datatype >= DT_FIRST_DYN ? (size_t)wlen : 0));
+    if (!r)
+        rc = handle_error("MPI_Unpack_external");
+    else {
+        rc = copy_bytes(r, win, (size_t)wlen);
+        if (rc == MPI_SUCCESS)
+            *position += (MPI_Aint)need;
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Pack_external_size(const char datarep[], int incount,
+                           MPI_Datatype datatype, MPI_Aint *size)
+{
+    if (strcmp(datarep, "external32") != 0)
+        return MPI_ERR_ARG;
+    size_t sig = dt_sig(datatype);
+    if (!sig && dt_extent(datatype) == 0)
+        return MPI_ERR_TYPE;
+    *size = (MPI_Aint)(sig * (size_t)incount);
+    return MPI_SUCCESS;
+}
+
 /* ---- spawn (comm_spawn.c.in / comm_get_parent.c.in) -------------- */
 int PMPI_Comm_spawn(const char *command, char *argv[], int maxprocs,
                    MPI_Info info, int root, MPI_Comm comm,

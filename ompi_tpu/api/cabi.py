@@ -3330,6 +3330,102 @@ def win_detach(wh: int, addr: int) -> None:
         raise MPIError(ERR_ARG, "address was not attached")
 
 
+# ---- PSCW active-target epochs (win_post.c.in family) ---------------
+def _group_local_ranks(w, gh: int) -> list:
+    g = _group(gh)
+    out = []
+    for wr in g.world_ranks:
+        lr = w.comm.group.rank_of(wr)
+        if lr < 0:
+            raise MPIError(ERR_GROUP,
+                           f"group member {wr} is not in the window's "
+                           f"communicator")
+        out.append(lr)
+    return out
+
+
+def win_post(wh: int, gh: int) -> None:
+    w = _win(wh)
+    w.post(_group_local_ranks(w, gh))
+
+
+def win_start(wh: int, gh: int) -> None:
+    w = _win(wh)
+    w.start(_group_local_ranks(w, gh))
+
+
+def win_complete(wh: int) -> None:
+    _win(wh).complete()
+
+
+def win_wait(wh: int) -> None:
+    _win(wh).wait()
+
+
+def win_set_name(wh: int, name: str) -> None:
+    _win(wh).name = str(name)
+
+
+def win_get_name(wh: int) -> str:
+    return str(_win(wh).name)
+
+
+def comm_idup(h: int) -> Tuple[int, int]:
+    """MPI_Comm_idup: duplication here is synchronous under the hood
+    (deterministic CIDs need no traffic), so the request is born
+    complete — legal: completion at MPI_Wait is a lower bound."""
+    newh = comm_dup(h)
+    from ompi_tpu.pml.perrank import RankRequest, _Msg
+    req = RankRequest(-1, -1)
+    req._deliver(_Msg(-1, 0, None))
+    with _lock:
+        rh = next(_next_req)
+        _requests[rh] = (req, 0, b"")
+    return newh, rh
+
+
+# ---- external32 (pack_external.c.in; MPI-3.1 13.5.2) ----------------
+def _external32_swap(a: np.ndarray) -> np.ndarray:
+    """Native <-> external32: big-endian fixed-size representation.
+    This runtime's basic types already match external32 sizes, so the
+    transform is a byte order swap on little-endian hosts."""
+    if a.dtype.byteorder == ">" or a.dtype.itemsize == 1:
+        return a
+    import sys as _sys
+    if _sys.byteorder == "big":
+        return a
+    return a.byteswap()
+
+
+def _external32_check(dt: int) -> None:
+    """Byte-granular layouts (heterogeneous structs, misaligned
+    h-types) pack as raw uint8 soup with no element structure left to
+    byte-swap — emitting them as 'external32' would silently ship
+    native-endian data. Refuse rather than lie on the wire."""
+    if dt >= _FIRST_DYN_TYPE and _dyn(dt).base is None:
+        raise MPIError(ERR_TYPE,
+                       "external32 requires an element-structured "
+                       "datatype (heterogeneous/misaligned layouts "
+                       "lose the element boundaries needed for byte "
+                       "order conversion)")
+
+
+def pack_external(view, dt: int, count: int) -> bytes:
+    _external32_check(dt)
+    a = _pack(view, dt, count)
+    return _external32_swap(a).tobytes()
+
+
+def unpack_external(data_view, dt: int, count: int, curview) -> bytes:
+    _external32_check(dt)
+    bdt, _i, _e = _type_parts(dt)
+    flat = np.frombuffer(data_view, dtype=np.uint8)
+    usable = (flat.nbytes // bdt.itemsize) * bdt.itemsize
+    typed = flat[:usable].view(bdt)
+    return _unpack(_external32_swap(typed), dt, count,
+                   bytes(curview))[0]
+
+
 # ---- spawn of executables (comm_spawn.c.in) -------------------------
 _parent_comm_handle: Optional[int] = None
 _spawned_procs: list = []                # reaped opportunistically

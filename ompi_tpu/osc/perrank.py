@@ -242,6 +242,49 @@ class RankWindow:
     def flush(self, target: int = -1) -> None:
         pass                            # every op is acked: always flushed
 
+    # -- PSCW active-target epochs (MPI_Win_post/start/complete/wait,
+    # osc_rdma_active_target.c semantics): every RMA op here is
+    # target-acked before returning, so origin completion already
+    # implies remote completion — the epochs reduce to their token
+    # exchanges over a hidden pt2pt channel, which is exactly the
+    # synchronization contract the standard requires.
+    def _pscw_engine(self):
+        from ompi_tpu.core.rankcomm import hidden_engine
+        return hidden_engine(self.comm, "pscw")
+
+    def _pscw_tag(self, phase: int) -> int:
+        # per-window tags: seq * 2 + phase (0 = post, 1 = complete)
+        return int(self.wid[-1]) * 2 + phase
+
+    def post(self, origin_ranks) -> None:
+        """Target side: expose the window to ``origin_ranks``."""
+        eng = self._pscw_engine()
+        self._pscw_origins = list(origin_ranks)
+        for o in self._pscw_origins:
+            eng.send(None, o, self._pscw_tag(0))
+
+    def start(self, target_ranks) -> None:
+        """Origin side: wait for each target's post token."""
+        eng = self._pscw_engine()
+        self._pscw_targets = list(target_ranks)
+        for t in self._pscw_targets:
+            eng.recv(t, self._pscw_tag(0))
+
+    def complete(self) -> None:
+        """Origin side: epoch ends — ops are already target-acked, so
+        one token per target carries the completion."""
+        eng = self._pscw_engine()
+        for t in getattr(self, "_pscw_targets", []):
+            eng.send(None, t, self._pscw_tag(1))
+        self._pscw_targets = []
+
+    def wait(self) -> None:
+        """Target side: block until every origin completed."""
+        eng = self._pscw_engine()
+        for o in getattr(self, "_pscw_origins", []):
+            eng.recv(o, self._pscw_tag(1))
+        self._pscw_origins = []
+
     def free(self) -> None:
         self.comm.barrier()
         self.comm.router.unregister_rma(self.wid)

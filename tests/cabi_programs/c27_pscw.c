@@ -1,0 +1,108 @@
+/* PSCW active-target RMA epochs + external32 + Comm_idup (round-5
+ * closers). References: ompi/mpi/c/win_post.c.in, win_start.c.in,
+ * win_complete.c.in, win_wait.c.in (osc active-target),
+ * pack_external.c.in (MPI-3.1 13.5.2 external32), comm_idup.c.in. */
+#include <mpi.h>
+#include <stdio.h>
+#include <string.h>
+
+static int rank, size;
+
+#define CHECK(cond, code)                                            \
+    do {                                                             \
+        if (!(cond)) {                                               \
+            fprintf(stderr, "rank %d: check failed at line %d\n",    \
+                    rank, __LINE__);                                 \
+            MPI_Abort(MPI_COMM_WORLD, code);                         \
+        }                                                            \
+    } while (0)
+
+int main(int argc, char **argv)
+{
+    MPI_Init(&argc, &argv);
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &size);
+    CHECK(size >= 2, 1);
+
+    /* ---- PSCW: rank 0 is the target, everyone else an origin ---- */
+    {
+        MPI_Win win;
+        double *base = NULL;
+        MPI_Win_allocate((MPI_Aint)(size * sizeof(double)), 8,
+                         MPI_INFO_NULL, MPI_COMM_WORLD, &base, &win);
+        CHECK(MPI_Win_set_name(win, "pscw-demo") == MPI_SUCCESS, 2);
+        char wname[MPI_MAX_OBJECT_NAME];
+        int wl = 0;
+        CHECK(MPI_Win_get_name(win, wname, &wl) == MPI_SUCCESS, 3);
+        CHECK(strcmp(wname, "pscw-demo") == 0 && wl > 0, 4);
+
+        MPI_Group wg, origins, targets;
+        MPI_Comm_group(MPI_COMM_WORLD, &wg);
+        int zero = 0;
+        MPI_Group_incl(wg, 1, &zero, &targets);
+        MPI_Group_excl(wg, 1, &zero, &origins);
+
+        for (int i = 0; i < size; i++)
+            base[i] = -1.0;
+        if (rank == 0) {
+            /* expose to the origin group; wait for their epochs */
+            CHECK(MPI_Win_post(origins, 0, win) == MPI_SUCCESS, 5);
+            CHECK(MPI_Win_wait(win) == MPI_SUCCESS, 6);
+            for (int o = 1; o < size; o++)
+                CHECK(base[o] == 100.0 + o, 7);
+            CHECK(base[0] == -1.0, 8);   /* untouched slot */
+        } else {
+            CHECK(MPI_Win_start(targets, 0, win) == MPI_SUCCESS, 9);
+            double v = 100.0 + rank;
+            CHECK(MPI_Put(&v, 1, MPI_DOUBLE, 0, rank, 1, MPI_DOUBLE,
+                          win) == MPI_SUCCESS, 10);
+            CHECK(MPI_Win_complete(win) == MPI_SUCCESS, 11);
+        }
+        MPI_Group_free(&wg);
+        MPI_Group_free(&origins);
+        MPI_Group_free(&targets);
+        MPI_Win_free(&win);
+    }
+
+    /* ---- external32: byte order is big-endian on the wire -------- */
+    {
+        int vals[3] = {0x01020304, 0x11121314, 0x21222324};
+        MPI_Aint esz = -1;
+        CHECK(MPI_Pack_external_size("external32", 3, MPI_INT, &esz)
+              == MPI_SUCCESS && esz == 12, 12);
+        unsigned char pk[64];
+        MPI_Aint pos = 0;
+        CHECK(MPI_Pack_external("external32", vals, 3, MPI_INT, pk,
+                                sizeof(pk), &pos) == MPI_SUCCESS, 13);
+        CHECK(pos == 12, 14);
+        CHECK(pk[0] == 0x01 && pk[1] == 0x02 && pk[2] == 0x03
+              && pk[3] == 0x04, 15);     /* big-endian bytes */
+        int back[3] = {0, 0, 0};
+        MPI_Aint rpos = 0;
+        CHECK(MPI_Unpack_external("external32", pk, pos, &rpos, back,
+                                  3, MPI_INT) == MPI_SUCCESS, 16);
+        CHECK(back[0] == vals[0] && back[2] == vals[2], 17);
+        /* wrong representation name is refused */
+        CHECK(MPI_Pack_external("native", vals, 1, MPI_INT, pk,
+                                sizeof(pk), &pos) != MPI_SUCCESS, 18);
+    }
+
+    /* ---- Comm_idup --------------------------------------------- */
+    {
+        MPI_Comm dup2 = MPI_COMM_NULL;
+        MPI_Request r;
+        CHECK(MPI_Comm_idup(MPI_COMM_WORLD, &dup2, &r) == MPI_SUCCESS,
+              19);
+        MPI_Wait(&r, MPI_STATUS_IGNORE);
+        CHECK(dup2 != MPI_COMM_NULL, 20);
+        int one = 1, tot = 0;
+        MPI_Allreduce(&one, &tot, 1, MPI_INT, MPI_SUM, dup2);
+        CHECK(tot == size, 21);
+        MPI_Comm_free(&dup2);
+    }
+
+    MPI_Barrier(MPI_COMM_WORLD);
+    printf("OK c27_pscw rank=%d/%d\n", rank, size);
+    MPI_Finalize();
+    return 0;
+}
