@@ -6299,6 +6299,280 @@ int PMPI_Pack_external_size(const char datarep[], int incount,
     return MPI_SUCCESS;
 }
 
+/* ---- wave-4 closers: thread queries, handle conversion, object
+ * info, names, collective individual-pointer IO, bigcount tail ----- */
+int PMPI_Is_thread_main(int *flag)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "is_thread_main", NULL);
+    if (!r)
+        rc = handle_error("MPI_Is_thread_main");
+    else {
+        *flag = (int)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Query_thread(int *provided)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "query_thread", NULL);
+    if (!r)
+        rc = handle_error("MPI_Query_thread");
+    else {
+        *provided = (int)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+/* handle conversion: handles ARE ints here (the f2c indirection the
+ * reference keeps in ompi/mpi/fortran/base — trivially bijective) */
+MPI_Fint PMPI_Comm_c2f(MPI_Comm comm) { return (MPI_Fint)comm; }
+MPI_Comm PMPI_Comm_f2c(MPI_Fint comm) { return (MPI_Comm)comm; }
+MPI_Fint PMPI_Type_c2f(MPI_Datatype dt) { return (MPI_Fint)dt; }
+MPI_Datatype PMPI_Type_f2c(MPI_Fint dt) { return (MPI_Datatype)dt; }
+MPI_Fint PMPI_Group_c2f(MPI_Group g) { return (MPI_Fint)g; }
+MPI_Group PMPI_Group_f2c(MPI_Fint g) { return (MPI_Group)g; }
+MPI_Fint PMPI_Op_c2f(MPI_Op op) { return (MPI_Fint)op; }
+MPI_Op PMPI_Op_f2c(MPI_Fint op) { return (MPI_Op)op; }
+
+int PMPI_Type_match_size(int typeclass, int size,
+                        MPI_Datatype *datatype)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "type_match_size", "ii",
+                                      typeclass, size);
+    if (!r)
+        rc = handle_error("MPI_Type_match_size");
+    else {
+        *datatype = (MPI_Datatype)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Comm_remote_group(MPI_Comm comm, MPI_Group *group)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "comm_remote_group", "l",
+                                      (long)comm);
+    if (!r)
+        rc = handle_error_comm(comm, "MPI_Comm_remote_group");
+    else {
+        *group = (MPI_Group)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+static int obj_info_set(const char *kind, long h, MPI_Info info,
+                        const char *fn)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "obj_set_info", "sll",
+                                      kind, h, (long)info);
+    if (!r)
+        rc = handle_error(fn);
+    else
+        Py_DECREF(r);
+    GIL_END;
+    return rc;
+}
+
+static int obj_info_get(const char *kind, long h, MPI_Info *info,
+                        const char *fn)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "obj_get_info", "sl",
+                                      kind, h);
+    if (!r)
+        rc = handle_error(fn);
+    else {
+        *info = (MPI_Info)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Comm_set_info(MPI_Comm comm, MPI_Info info)
+{
+    return obj_info_set("comm", (long)comm, info, "MPI_Comm_set_info");
+}
+
+int PMPI_Comm_get_info(MPI_Comm comm, MPI_Info *info_used)
+{
+    return obj_info_get("comm", (long)comm, info_used,
+                        "MPI_Comm_get_info");
+}
+
+int PMPI_Win_set_info(MPI_Win win, MPI_Info info)
+{
+    return obj_info_set("win", (long)win, info, "MPI_Win_set_info");
+}
+
+int PMPI_Win_get_info(MPI_Win win, MPI_Info *info_used)
+{
+    return obj_info_get("win", (long)win, info_used,
+                        "MPI_Win_get_info");
+}
+
+int PMPI_File_set_info(MPI_File fh, MPI_Info info)
+{
+    return obj_info_set("file", (long)fh, info, "MPI_File_set_info");
+}
+
+int PMPI_File_get_info(MPI_File fh, MPI_Info *info_used)
+{
+    return obj_info_get("file", (long)fh, info_used,
+                        "MPI_File_get_info");
+}
+
+int PMPI_Type_set_name(MPI_Datatype datatype, const char *type_name)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "type_set_name", "ls",
+                                      (long)datatype, type_name);
+    if (!r)
+        rc = handle_error("MPI_Type_set_name");
+    else
+        Py_DECREF(r);
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Type_get_name(MPI_Datatype datatype, char *type_name,
+                      int *resultlen)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "type_get_name", "l",
+                                      (long)datatype);
+    if (!r) {
+        rc = handle_error("MPI_Type_get_name");
+    } else {
+        const char *s = PyUnicode_AsUTF8(r);
+        if (s) {
+            strncpy(type_name, s, MPI_MAX_OBJECT_NAME - 1);
+            type_name[MPI_MAX_OBJECT_NAME - 1] = '\0';
+            *resultlen = (int)strlen(type_name);
+        } else {
+            PyErr_Clear();               /* unencodable name: defined */
+            type_name[0] = '\0';         /* outputs, honest error */
+            *resultlen = 0;
+            rc = MPI_ERR_INTERN;
+        }
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_File_read_all(MPI_File fh, void *buf, int count,
+                      MPI_Datatype datatype, MPI_Status *status)
+{
+    return file_read_common("file_read_all", fh, (MPI_Offset)-1, buf,
+                            count, datatype, status);
+}
+
+int PMPI_File_write_all(MPI_File fh, const void *buf, int count,
+                       MPI_Datatype datatype, MPI_Status *status)
+{
+    return file_write_common("file_write_all", fh, (MPI_Offset)-1, buf,
+                             count, datatype, status);
+}
+
+int PMPI_Info_get_string(MPI_Info info, const char *key, int *buflen,
+                        char *value, int *flag)
+{
+    /* MPI-4's replacement for Info_get/get_valuelen: one call, the
+     * needed length reported in *buflen */
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "info_get", "ls",
+                                      (long)info, key);
+    if (!r) {
+        rc = handle_error("MPI_Info_get_string");
+    } else {
+        *flag = (int)PyLong_AsLong(PyTuple_GetItem(r, 0));
+        if (*flag) {
+            const char *s =
+                PyUnicode_AsUTF8(PyTuple_GetItem(r, 1));
+            if (!s) {
+                PyErr_Clear();
+                rc = MPI_ERR_INTERN;
+            } else {
+                if (value && *buflen > 0) {
+                    strncpy(value, s, (size_t)*buflen - 1);
+                    value[*buflen - 1] = '\0';
+                }
+                *buflen = (int)strlen(s) + 1;
+            }
+        }
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+/* bigcount tail: 64-bit counts delegate to the size_t marshal the int
+ * paths already use; counts exceeding INT_MAX only matter for the
+ * buffer-window arithmetic, which send/recv/collective commons do in
+ * 64-bit already */
+int PMPI_Ssend_c(const void *buf, MPI_Count count, MPI_Datatype datatype,
+                int dest, int tag, MPI_Comm comm)
+{
+    return send_common_c(buf, count, datatype, dest, tag, comm, 1,
+                         "MPI_Ssend_c");
+}
+
+/* per-peer lanes stay 32-bit in these delegations: an over-INT_MAX
+ * per-peer count refuses with MPI_ERR_COUNT rather than truncating */
+#define BIGC_LANES_FIT(s, r) \
+    ((s) <= 2147483647LL && (r) <= 2147483647LL)
+
+#define BIGC_DELEGATE(name)                                           \
+int PMPI_##name##_c(const void *sendbuf, MPI_Count sendcount,         \
+                   MPI_Datatype sendtype, void *recvbuf,              \
+                   MPI_Count recvcount, MPI_Datatype recvtype,        \
+                   MPI_Comm comm)                                     \
+{                                                                     \
+    if (!BIGC_LANES_FIT(sendcount, recvcount))                        \
+        return MPI_ERR_COUNT;                                         \
+    return PMPI_##name(sendbuf, (int)sendcount, sendtype, recvbuf,    \
+                      (int)recvcount, recvtype, comm);                \
+}
+
+#define BIGC_DELEGATE_ROOT(name)                                      \
+int PMPI_##name##_c(const void *sendbuf, MPI_Count sendcount,         \
+                   MPI_Datatype sendtype, void *recvbuf,              \
+                   MPI_Count recvcount, MPI_Datatype recvtype,        \
+                   int root, MPI_Comm comm)                           \
+{                                                                     \
+    if (!BIGC_LANES_FIT(sendcount, recvcount))                        \
+        return MPI_ERR_COUNT;                                         \
+    return PMPI_##name(sendbuf, (int)sendcount, sendtype, recvbuf,    \
+                      (int)recvcount, recvtype, root, comm);          \
+}
+
+BIGC_DELEGATE(Allgather)
+BIGC_DELEGATE(Alltoall)
+BIGC_DELEGATE_ROOT(Gather)
+BIGC_DELEGATE_ROOT(Scatter)
+
 /* ---- spawn (comm_spawn.c.in / comm_get_parent.c.in) -------------- */
 int PMPI_Comm_spawn(const char *command, char *argv[], int maxprocs,
                    MPI_Info info, int root, MPI_Comm comm,

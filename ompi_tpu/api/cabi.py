@@ -3330,6 +3330,137 @@ def win_detach(wh: int, addr: int) -> None:
         raise MPIError(ERR_ARG, "address was not attached")
 
 
+# ---- wave-4 closers: thread queries, object info, names -------------
+def query_thread() -> int:
+    from ompi_tpu.runtime import init as rt
+    return int(rt.query_thread())
+
+
+def is_thread_main() -> int:
+    import threading
+    return int(threading.current_thread() is threading.main_thread())
+
+
+def comm_remote_group(h: int) -> int:
+    c = _comm(h)
+    from ompi_tpu.core.group import Group
+    if getattr(c, "remote_size", None) is None:
+        raise MPIError(ERR_COMM, "not an intercommunicator")
+    remote = getattr(c, "remote_world", None)
+    if remote is not None:               # intra-job _RankIntercomm
+        return _register_group(Group(list(remote)))
+    rcomm = getattr(c, "remote_comm", None)
+    if rcomm is not None:                # single-controller Intercomm
+        return _register_group(Group(list(rcomm.group.world_ranks)))
+    # cross-job bridge: the remote job's world ranks live in ANOTHER
+    # rank namespace — fabricating 0..rs-1 would alias local ranks and
+    # corrupt group algebra; refuse honestly
+    raise MPIError(ERR_COMM,
+                   "remote group is not addressable across a cross-job "
+                   "bridge intercommunicator (separate world-rank "
+                   "namespaces)")
+
+
+_obj_infos: Dict[Tuple[str, int], int] = {}
+
+
+def _obj_check(kind: str, h: int) -> None:
+    {"comm": _comm, "win": _win, "file": _file}[kind](h)
+
+
+def obj_set_info(kind: str, h: int, ih: int) -> None:
+    """MPI_Comm/Win/File_set_info: hints are accepted and retrievable
+    (none change behavior yet — the reference ignores unknown hints
+    the same way). The handle is validated like every other entry
+    point, and a replaced hint set frees its predecessor."""
+    _obj_check(kind, h)
+    old = _obj_infos.get((kind, int(h)))
+    _obj_infos[(kind, int(h))] = int(info_dup(ih))
+    if old is not None:
+        try:
+            info_free(old)
+        except MPIError:
+            pass
+
+
+def obj_get_info(kind: str, h: int) -> int:
+    _obj_check(kind, h)
+    ih = _obj_infos.get((kind, int(h)))
+    return info_dup(ih) if ih is not None else info_create()
+
+
+_type_names: Dict[int, str] = {}
+
+
+def type_set_name(dt: int, name: str) -> None:
+    type_commit(dt)                      # validates either handle kind
+    _type_names[int(dt)] = str(name)
+
+
+def type_get_name(dt: int) -> str:
+    got = _type_names.get(int(dt))
+    if got is not None:
+        return got
+    if dt >= _FIRST_DYN_TYPE:
+        return ""                        # unnamed derived type
+    return {1: "MPI_CHAR", 2: "MPI_SIGNED_CHAR", 3: "MPI_UNSIGNED_CHAR",
+            4: "MPI_BYTE", 5: "MPI_SHORT", 6: "MPI_UNSIGNED_SHORT",
+            7: "MPI_INT", 8: "MPI_UNSIGNED", 9: "MPI_LONG",
+            10: "MPI_UNSIGNED_LONG", 11: "MPI_LONG_LONG",
+            12: "MPI_UNSIGNED_LONG_LONG", 13: "MPI_FLOAT",
+            14: "MPI_DOUBLE", 15: "MPI_C_BOOL", 16: "MPI_INT8_T",
+            17: "MPI_INT16_T", 18: "MPI_INT32_T", 19: "MPI_INT64_T",
+            20: "MPI_UINT8_T", 21: "MPI_UINT16_T", 22: "MPI_UINT32_T",
+            23: "MPI_UINT64_T", 24: "MPI_AINT", 25: "MPI_COUNT",
+            26: "MPI_OFFSET"}.get(int(dt), "")
+
+
+def type_match_size(typeclass: int, nbytes: int) -> int:
+    """MPI_Type_match_size: the predefined type of a class with the
+    requested size (type_match_size.c.in)."""
+    table = {1: {4: 13, 8: 14},          # REAL: float, double
+             2: {1: 16, 2: 17, 4: 18, 8: 19}}   # INTEGER: intN_t
+    got = table.get(int(typeclass), {}).get(int(nbytes))
+    if got is None:
+        raise MPIError(ERR_ARG,
+                       f"no predefined type of class {typeclass} with "
+                       f"size {nbytes}")
+    return got
+
+
+def _all_with_barrier(fh: int, op):
+    """Collective completion around a fallible per-rank IO op: EVERY
+    rank reaches the barrier even when its own op failed (the
+    collective-hang class io/perrank.py's open avoids the same way),
+    then the local failure surfaces."""
+    exc = None
+    out = None
+    try:
+        out = op()
+    except BaseException as e:           # noqa: BLE001 — re-raised
+        exc = e
+    _file(fh).comm.barrier()
+    if exc is not None:
+        raise exc
+    return out
+
+
+def file_read_all(fh: int, offset: int, nbytes: int, dt: int,
+                  curview) -> Tuple[bytes, int]:
+    """MPI_File_read_all: collective at the INDIVIDUAL pointer — the
+    view-relative read plus the collective completion the two-phase
+    path provides for _at_all; with per-rank individual pointers the
+    aggregation happens at the byte-run level already, so the
+    collective contract reduces to a completion barrier."""
+    return _all_with_barrier(
+        fh, lambda: file_read_ind(fh, offset, nbytes, dt, curview))
+
+
+def file_write_all(fh: int, offset: int, view, dt: int) -> int:
+    return _all_with_barrier(
+        fh, lambda: file_write_ind(fh, offset, view, dt))
+
+
 # ---- PSCW active-target epochs (win_post.c.in family) ---------------
 def _group_local_ranks(w, gh: int) -> list:
     g = _group(gh)

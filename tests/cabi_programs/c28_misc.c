@@ -1,0 +1,167 @@
+/* Round-5 wave-4 closers: thread queries, handle conversion, object
+ * info, type names, Type_match_size, collective individual-pointer
+ * IO, Comm_remote_group, Info_get_string, bigcount collective tail.
+ * References: is_thread_main.c.in, comm_c2f semantics
+ * (ompi/mpi/fortran/base f2c tables), type_match_size.c.in,
+ * comm_set_info.c.in, type_set_name.c.in, file_read_all.c.in,
+ * info_get_string.c.in. */
+#include <mpi.h>
+#include <stdio.h>
+#include <string.h>
+#include <unistd.h>
+
+static int rank, size;
+
+#define CHECK(cond, code)                                            \
+    do {                                                             \
+        if (!(cond)) {                                               \
+            fprintf(stderr, "rank %d: check failed at line %d\n",    \
+                    rank, __LINE__);                                 \
+            MPI_Abort(MPI_COMM_WORLD, code);                         \
+        }                                                            \
+    } while (0)
+
+int main(int argc, char **argv)
+{
+    MPI_Init(&argc, &argv);
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &size);
+    CHECK(size >= 2, 1);
+
+    /* ---- thread queries ---- */
+    int flag = -1, provided = -1;
+    CHECK(MPI_Is_thread_main(&flag) == MPI_SUCCESS && flag == 1, 2);
+    CHECK(MPI_Query_thread(&provided) == MPI_SUCCESS
+          && provided >= MPI_THREAD_SINGLE, 3);
+
+    /* ---- handle conversion round-trips ---- */
+    CHECK(MPI_Comm_f2c(MPI_Comm_c2f(MPI_COMM_WORLD))
+          == MPI_COMM_WORLD, 4);
+    CHECK(MPI_Type_f2c(MPI_Type_c2f(MPI_DOUBLE)) == MPI_DOUBLE, 5);
+    CHECK(MPI_Op_f2c(MPI_Op_c2f(MPI_SUM)) == MPI_SUM, 6);
+
+    /* ---- Type_match_size ---- */
+    MPI_Datatype m;
+    CHECK(MPI_Type_match_size(MPI_TYPECLASS_REAL, 8, &m)
+          == MPI_SUCCESS && m == MPI_DOUBLE, 7);
+    CHECK(MPI_Type_match_size(MPI_TYPECLASS_INTEGER, 4, &m)
+          == MPI_SUCCESS && m == MPI_INT32_T, 8);
+
+    /* ---- type names ---- */
+    char tname[MPI_MAX_OBJECT_NAME];
+    int tl = 0;
+    CHECK(MPI_Type_get_name(MPI_DOUBLE, tname, &tl) == MPI_SUCCESS, 9);
+    CHECK(strcmp(tname, "MPI_DOUBLE") == 0, 10);
+    MPI_Datatype v;
+    MPI_Type_vector(2, 1, 2, MPI_INT, &v);
+    MPI_Type_commit(&v);
+    CHECK(MPI_Type_set_name(v, "my-vector") == MPI_SUCCESS, 11);
+    CHECK(MPI_Type_get_name(v, tname, &tl) == MPI_SUCCESS
+          && strcmp(tname, "my-vector") == 0, 12);
+    MPI_Type_free(&v);
+
+    /* ---- object info round-trips ---- */
+    {
+        MPI_Info in, out;
+        MPI_Info_create(&in);
+        MPI_Info_set(in, "mpi_assert_no_any_tag", "true");
+        CHECK(MPI_Comm_set_info(MPI_COMM_WORLD, in) == MPI_SUCCESS,
+              13);
+        CHECK(MPI_Comm_get_info(MPI_COMM_WORLD, &out) == MPI_SUCCESS,
+              14);
+        int f2 = 0, blen = 64;
+        char val[64];
+        CHECK(MPI_Info_get_string(out, "mpi_assert_no_any_tag", &blen,
+                                  val, &f2) == MPI_SUCCESS, 15);
+        CHECK(f2 == 1 && strcmp(val, "true") == 0 && blen == 5, 16);
+        MPI_Info_free(&in);
+        MPI_Info_free(&out);
+    }
+
+    /* ---- collective individual-pointer IO ---- */
+    {
+        char path[256];
+        snprintf(path, sizeof(path), "/tmp/ompi_tpu_c28_%d.bin",
+                 (int)getppid());
+        MPI_File fh;
+        CHECK(MPI_File_open(MPI_COMM_WORLD, path,
+                            MPI_MODE_CREATE | MPI_MODE_RDWR,
+                            MPI_INFO_NULL, &fh) == MPI_SUCCESS, 17);
+        /* per-rank view: my stripe of interleaved ints */
+        MPI_Datatype ft, ftr;
+        MPI_Type_vector(2, 1, size, MPI_INT, &ft);
+        MPI_Type_create_resized(ft, 0, 2 * size * (int)sizeof(int),
+                                &ftr);
+        MPI_Type_commit(&ftr);
+        CHECK(MPI_File_set_view(fh, (MPI_Offset)(rank * sizeof(int)),
+                                MPI_INT, ftr, "native",
+                                MPI_INFO_NULL) == MPI_SUCCESS, 18);
+        int mine[2] = {10 * rank, 10 * rank + 1};
+        MPI_Status st;
+        CHECK(MPI_File_write_all(fh, mine, 2, MPI_INT, &st)
+              == MPI_SUCCESS, 19);
+        MPI_File_seek(fh, 0, MPI_SEEK_SET);
+        int back[2] = {-1, -1};
+        CHECK(MPI_File_read_all(fh, back, 2, MPI_INT, &st)
+              == MPI_SUCCESS, 20);
+        CHECK(back[0] == 10 * rank && back[1] == 10 * rank + 1, 21);
+        MPI_Type_free(&ft);
+        MPI_Type_free(&ftr);
+        MPI_File_close(&fh);
+        if (rank == 0)
+            unlink(path);
+    }
+
+    /* ---- bigcount collective tail (plumbing smoke) ---- */
+    {
+        int me = rank, all[16];
+        CHECK(size <= 16, 22);
+        CHECK(MPI_Allgather_c(&me, 1, MPI_INT, all, 1, MPI_INT,
+                              MPI_COMM_WORLD) == MPI_SUCCESS, 23);
+        for (int i = 0; i < size; i++)
+            CHECK(all[i] == i, 24);
+        CHECK(MPI_Gather_c(&me, 1, MPI_INT, all, 1, MPI_INT, 0,
+                           MPI_COMM_WORLD) == MPI_SUCCESS, 25);
+        if (rank == 0)
+            for (int i = 0; i < size; i++)
+                CHECK(all[i] == i, 26);
+        double x = rank + 0.5;
+        if (rank == 0) {
+            CHECK(MPI_Ssend_c(&x, 1, MPI_DOUBLE, 1, 5,
+                              MPI_COMM_WORLD) == MPI_SUCCESS, 27);
+        } else if (rank == 1) {
+            MPI_Status st;
+            double y = -1;
+            MPI_Recv(&y, 1, MPI_DOUBLE, 0, 5, MPI_COMM_WORLD, &st);
+            CHECK(y == 0.5, 28);
+        }
+        /* oversized per-peer lanes refuse, never truncate */
+        CHECK(MPI_Allgather_c(&me, (MPI_Count)1 << 33, MPI_INT, all, 1,
+                              MPI_INT, MPI_COMM_WORLD)
+              == MPI_ERR_COUNT, 29);
+    }
+
+    /* ---- Comm_remote_group on an intercomm ---- */
+    {
+        int half = size / 2;
+        int in_low = rank < half;
+        MPI_Comm local, inter;
+        MPI_Comm_split(MPI_COMM_WORLD, in_low ? 0 : 1, rank, &local);
+        CHECK(MPI_Intercomm_create(local, 0, MPI_COMM_WORLD,
+                                   in_low ? half : 0, 31, &inter)
+              == MPI_SUCCESS, 30);
+        MPI_Group rg;
+        CHECK(MPI_Comm_remote_group(inter, &rg) == MPI_SUCCESS, 31);
+        int gsz = -1;
+        MPI_Group_size(rg, &gsz);
+        CHECK(gsz == (in_low ? size - half : half), 32);
+        MPI_Group_free(&rg);
+        MPI_Comm_free(&inter);
+        MPI_Comm_free(&local);
+    }
+
+    MPI_Barrier(MPI_COMM_WORLD);
+    printf("OK c28_misc rank=%d/%d\n", rank, size);
+    MPI_Finalize();
+    return 0;
+}

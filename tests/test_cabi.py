@@ -54,6 +54,7 @@ CASES = [
     ("c25_spawn.c", 2),
     ("c26_partitioned.c", 2),
     ("c27_pscw.c", 3),
+    ("c28_misc.c", 4),
 ]
 
 # per-program argv (c13 runs 4M floats = 16 MB in CI — above the 1 MB
