@@ -729,6 +729,14 @@ int MPI_T_pvar_read(MPI_T_pvar_session session,
                     MPI_T_pvar_handle handle, void *buf);
 int MPI_T_pvar_write(MPI_T_pvar_session session,
                      MPI_T_pvar_handle handle, const void *buf);
+int MPI_T_category_get_num(int *num_cat);
+int MPI_T_category_get_index(const char *name, int *cat_index);
+int MPI_T_category_get_info(int cat_index, char *name, int *name_len,
+                            char *desc, int *desc_len, int *num_cvars,
+                            int *num_pvars, int *num_categories);
+int MPI_T_category_get_cvars(int cat_index, int len, int indices[]);
+int MPI_T_category_get_pvars(int cat_index, int len, int indices[]);
+int MPI_T_category_changed(int *stamp);
 
 /* ---- MPI_T events (round-5 wave: the tool event surface) ---- */
 typedef long MPI_T_event_registration;
@@ -923,6 +931,29 @@ int MPI_Pready_range(int partition_low, int partition_high,
 int MPI_Pready_list(int length, const int array_of_partitions[],
                     MPI_Request request);
 int MPI_Parrived(MPI_Request request, int partition, int *flag);
+
+/* ---- datatype envelopes (tools reconstruct constructors) ---- */
+#define MPI_COMBINER_NAMED          1
+#define MPI_COMBINER_DUP            2
+#define MPI_COMBINER_CONTIGUOUS     3
+#define MPI_COMBINER_VECTOR         4
+#define MPI_COMBINER_HVECTOR        5
+#define MPI_COMBINER_INDEXED        6
+#define MPI_COMBINER_HINDEXED       7
+#define MPI_COMBINER_INDEXED_BLOCK  8
+#define MPI_COMBINER_HINDEXED_BLOCK 9
+#define MPI_COMBINER_STRUCT         10
+#define MPI_COMBINER_SUBARRAY       11
+#define MPI_COMBINER_DARRAY         12
+#define MPI_COMBINER_RESIZED        13
+int MPI_Type_get_envelope(MPI_Datatype datatype, int *num_integers,
+                          int *num_addresses, int *num_datatypes,
+                          int *combiner);
+int MPI_Type_get_contents(MPI_Datatype datatype, int max_integers,
+                          int max_addresses, int max_datatypes,
+                          int array_of_integers[],
+                          MPI_Aint array_of_addresses[],
+                          MPI_Datatype array_of_datatypes[]);
 
 /* ---- round-5 wave 4: thread queries, object info, names ---- */
 int MPI_Is_thread_main(int *flag);

@@ -264,7 +264,11 @@ static int handle_error_eh(const char *func, MPI_Errhandler eh)
 
 static int handle_error(const char *func)
 {
-    return handle_error_eh(func, g_errh);
+    /* errors with no communicator attach to MPI_COMM_WORLD's handler
+     * (MPI-3.1 8.3: "errors that are not associated with any object
+     * are considered attached to MPI_COMM_WORLD"); the global default
+     * backs it when the world has no per-comm entry */
+    return handle_error_eh(func, errh_for(MPI_COMM_WORLD));
 }
 
 static int handle_error_comm(MPI_Comm comm, const char *func)
@@ -6299,6 +6303,172 @@ int PMPI_Pack_external_size(const char datarep[], int incount,
     return MPI_SUCCESS;
 }
 
+/* ---- MPI_T categories (category_get_num.c etc.): variables group
+ * by framework, the reference's category convention --------------- */
+int PMPI_T_category_get_num(int *num_cat)
+{
+    PyObject *r = t_call("t_category_get_num", "()");
+    if (!r)
+        return MPI_T_ERR_NOT_INITIALIZED;
+    *num_cat = (int)t_long(r, -1, 0);
+    t_drop(r);
+    return MPI_SUCCESS;
+}
+
+int PMPI_T_category_get_index(const char *name, int *cat_index)
+{
+    PyObject *r = t_call("t_category_get_index", "(s)", name);
+    if (!r)
+        return MPI_T_ERR_INVALID_NAME;
+    *cat_index = (int)t_long(r, -1, 0);
+    t_drop(r);
+    return MPI_SUCCESS;
+}
+
+int PMPI_T_category_get_info(int cat_index, char *name, int *name_len,
+                            char *desc, int *desc_len, int *num_cvars,
+                            int *num_pvars, int *num_categories)
+{
+    PyObject *r = t_call("t_category_get_info", "(i)", cat_index);
+    if (!r)
+        return MPI_T_ERR_INVALID_INDEX;
+    PyGILState_STATE g = PyGILState_Ensure();
+    const char *nm = PyUnicode_AsUTF8(PyTuple_GetItem(r, 0));
+    const char *ds = PyUnicode_AsUTF8(PyTuple_GetItem(r, 1));
+    if (name && name_len && *name_len > 0 && nm) {
+        strncpy(name, nm, (size_t)*name_len - 1);
+        name[*name_len - 1] = '\0';
+        *name_len = (int)strlen(name) + 1;
+    }
+    if (desc && desc_len && *desc_len > 0 && ds) {
+        strncpy(desc, ds, (size_t)*desc_len - 1);
+        desc[*desc_len - 1] = '\0';
+        *desc_len = (int)strlen(desc) + 1;
+    }
+    if (num_cvars)
+        *num_cvars = (int)PyLong_AsLong(PyTuple_GetItem(r, 2));
+    if (num_pvars)
+        *num_pvars = (int)PyLong_AsLong(PyTuple_GetItem(r, 3));
+    if (num_categories)
+        *num_categories = 0;             /* flat category space */
+    PyGILState_Release(g);
+    t_drop(r);
+    return MPI_SUCCESS;
+}
+
+static int t_category_members(const char *fn, int cat_index, int len,
+                              int indices[])
+{
+    PyObject *r = t_call(fn, "(i)", cat_index);
+    if (!r)
+        return MPI_T_ERR_INVALID_INDEX;
+    PyGILState_STATE g = PyGILState_Ensure();
+    char *p;
+    Py_ssize_t n;
+    if (PyBytes_AsStringAndSize(r, &p, &n) == 0) {
+        int cnt = (int)(n / (Py_ssize_t)sizeof(int));
+        if (cnt > len)
+            cnt = len;
+        memcpy(indices, p, (size_t)cnt * sizeof(int));
+    }
+    PyGILState_Release(g);
+    t_drop(r);
+    return MPI_SUCCESS;
+}
+
+int PMPI_T_category_get_cvars(int cat_index, int len, int indices[])
+{
+    return t_category_members("t_category_get_cvars", cat_index, len,
+                              indices);
+}
+
+int PMPI_T_category_get_pvars(int cat_index, int len, int indices[])
+{
+    return t_category_members("t_category_get_pvars", cat_index, len,
+                              indices);
+}
+
+int PMPI_T_category_changed(int *stamp)
+{
+    /* enumeration is append-only: the count IS the change stamp */
+    return PMPI_T_category_get_num(stamp);
+}
+
+/* ---- datatype envelopes (type_get_envelope.c.in) ----------------- */
+int PMPI_Type_get_envelope(MPI_Datatype datatype, int *num_integers,
+                          int *num_addresses, int *num_datatypes,
+                          int *combiner)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "type_get_envelope", "l",
+                                      (long)datatype);
+    if (!r) {
+        rc = handle_error("MPI_Type_get_envelope");
+    } else {
+        *num_integers = (int)PyLong_AsLong(PyTuple_GetItem(r, 0));
+        *num_addresses = (int)PyLong_AsLong(PyTuple_GetItem(r, 1));
+        *num_datatypes = (int)PyLong_AsLong(PyTuple_GetItem(r, 2));
+        *combiner = (int)PyLong_AsLong(PyTuple_GetItem(r, 3));
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Type_get_contents(MPI_Datatype datatype, int max_integers,
+                          int max_addresses, int max_datatypes,
+                          int array_of_integers[],
+                          MPI_Aint array_of_addresses[],
+                          MPI_Datatype array_of_datatypes[])
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "type_get_contents", "l",
+                                      (long)datatype);
+    if (!r) {
+        rc = handle_error("MPI_Type_get_contents");
+    } else {
+        char *p;
+        Py_ssize_t n;
+        if (PyBytes_AsStringAndSize(PyTuple_GetItem(r, 0), &p, &n)
+            == 0) {
+            int cnt = (int)(n / (Py_ssize_t)sizeof(int));
+            if (cnt > max_integers)
+                rc = MPI_ERR_ARG;
+            else
+                memcpy(array_of_integers, p, (size_t)n);
+        }
+        if (rc == MPI_SUCCESS
+            && PyBytes_AsStringAndSize(PyTuple_GetItem(r, 1), &p, &n)
+               == 0) {
+            int cnt = (int)(n / (Py_ssize_t)sizeof(long long));
+            if (cnt > max_addresses) {
+                rc = MPI_ERR_ARG;
+            } else {
+                const long long *src = (const long long *)p;
+                for (int i = 0; i < cnt; i++)
+                    array_of_addresses[i] = (MPI_Aint)src[i];
+            }
+        }
+        if (rc == MPI_SUCCESS
+            && PyBytes_AsStringAndSize(PyTuple_GetItem(r, 2), &p, &n)
+               == 0) {
+            int cnt = (int)(n / (Py_ssize_t)sizeof(long long));
+            if (cnt > max_datatypes) {
+                rc = MPI_ERR_ARG;
+            } else {
+                const long long *src = (const long long *)p;
+                for (int i = 0; i < cnt; i++)
+                    array_of_datatypes[i] = (MPI_Datatype)src[i];
+            }
+        }
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
 /* ---- wave-4 closers: thread queries, handle conversion, object
  * info, names, collective individual-pointer IO, bigcount tail ----- */
 int PMPI_Is_thread_main(int *flag)
@@ -6978,7 +7148,9 @@ int PMPI_T_event_get_info(int event_index, char *name, int *name_len,
     PyObject *r = t_call("t_event_get_info", "(i)", event_index);
     if (!r)
         return MPI_T_ERR_INVALID_INDEX;
-    /* (name, verbosity, dtype_handle, nelems, desc) */
+    /* (name, verbosity, dtype_handle, nelems, desc); direct object
+     * access needs the GIL (t_call released it) */
+    PyGILState_STATE g = PyGILState_Ensure();
     PyObject *nm = PyTuple_GetItem(r, 0);
     const char *s = nm ? PyUnicode_AsUTF8(nm) : NULL;
     if (name && name_len && *name_len > 0 && s) {
@@ -6986,6 +7158,14 @@ int PMPI_T_event_get_info(int event_index, char *name, int *name_len,
         name[*name_len - 1] = '\0';
         *name_len = (int)strlen(name) + 1;
     }
+    PyObject *dsc = PyTuple_GetItem(r, 4);
+    const char *ds = dsc ? PyUnicode_AsUTF8(dsc) : NULL;
+    if (desc && desc_len && *desc_len > 0 && ds) {
+        strncpy(desc, ds, (size_t)*desc_len - 1);
+        desc[*desc_len - 1] = '\0';
+        *desc_len = (int)strlen(desc) + 1;
+    }
+    PyGILState_Release(g);
     if (verbosity)
         *verbosity = (int)t_long(r, 1, MPI_T_VERBOSITY_USER_BASIC);
     if (types)
@@ -6996,13 +7176,6 @@ int PMPI_T_event_get_info(int event_index, char *name, int *name_len,
         *enumtype = MPI_T_ENUM_NULL;
     if (info && info_len && *info_len > 0)
         info[0] = '\0';
-    PyObject *dsc = PyTuple_GetItem(r, 4);
-    const char *ds = dsc ? PyUnicode_AsUTF8(dsc) : NULL;
-    if (desc && desc_len && *desc_len > 0 && ds) {
-        strncpy(desc, ds, (size_t)*desc_len - 1);
-        desc[*desc_len - 1] = '\0';
-        *desc_len = (int)strlen(desc) + 1;
-    }
     if (bind)
         *bind = MPI_T_BIND_NO_OBJECT;
     t_drop(r);

@@ -678,6 +678,101 @@ def type_create_resized(oldtype: int, lb_bytes: int,
                                       lb=lb_bytes // g))
 
 
+# ---- constructor envelopes (MPI_Type_get_envelope/get_contents:
+# type_get_envelope.c.in — tools reconstruct how a type was built) ----
+_type_env: Dict[int, Tuple[int, list, list, list]] = {}
+COMBINER_NAMED = 1
+
+def _record_env_wrappers() -> None:
+    """Wrap every public constructor so the (combiner, ints, aints,
+    types) envelope is recorded without touching the constructor
+    bodies; nested construction (indexed_block -> indexed) records the
+    OUTERMOST call, matching the standard's user-visible combiner."""
+    def ilist(v):
+        return [int(x) for x in _ints(v)]
+
+    def alist(v):
+        return [int(x) for x in np.frombuffer(bytes(v), np.int64)]
+
+    specs = {
+        "type_contiguous": (3, lambda c, o: ([c], [], [o])),
+        "type_vector": (4, lambda c, b, s, o: ([c, b, s], [], [o])),
+        "type_create_hvector":
+            (5, lambda c, b, s, o: ([c, b], [int(s)], [o])),
+        "type_indexed":
+            (6, lambda cv, dv, o:
+             ([len(ilist(cv))] + ilist(cv) + ilist(dv), [], [o])),
+        "type_create_hindexed":
+            (7, lambda cv, dv, o:
+             ([len(ilist(cv))] + ilist(cv), alist(dv), [o])),
+        "type_create_indexed_block":
+            (8, lambda b, dv, o:
+             ([len(ilist(dv)), b] + ilist(dv), [], [o])),
+        "type_create_hindexed_block":
+            (9, lambda b, dv, o:
+             ([len(alist(dv)), b], alist(dv), [o])),
+        "type_create_struct":
+            (10, lambda cv, dv, tv:
+             ([len(ilist(cv))] + ilist(cv), alist(dv), alist(tv))),
+        "type_create_subarray":
+            (11, lambda sz, sb, st, order, o:
+             ([len(ilist(sz))] + ilist(sz) + ilist(sb) + ilist(st)
+              + [order], [], [o])),
+        "type_create_darray":
+            (12, lambda size, rank, g, d, a, p, order, o:
+             ([size, rank, len(ilist(g))] + ilist(g) + ilist(d)
+              + ilist(a) + ilist(p) + [order], [], [o])),
+        "type_dup": (2, lambda o: ([], [], [o])),
+        "type_create_resized":
+            (13, lambda o, lb, ext: ([], [int(lb), int(ext)], [o])),
+    }
+
+    def wrap(fname, combiner, sig):
+        orig = globals()[fname]
+
+        def wrapped(*args, __orig=orig, __comb=combiner, __sig=sig):
+            h = __orig(*args)
+            try:
+                ints, aints, types = __sig(*args)
+                _type_env[h] = (__comb, [int(x) for x in ints],
+                                [int(x) for x in aints],
+                                [int(x) for x in types])
+            except Exception:            # noqa: BLE001 — envelope is
+                pass                     # advisory metadata
+            return h
+        wrapped.__name__ = fname
+        globals()[fname] = wrapped
+
+    for fname, (comb, sig) in specs.items():
+        wrap(fname, comb, sig)
+
+
+def type_get_envelope(dt: int) -> Tuple[int, int, int, int]:
+    """(num_integers, num_addresses, num_datatypes, combiner)."""
+    if dt < _FIRST_DYN_TYPE:
+        _dtype(dt)
+        return 0, 0, 0, COMBINER_NAMED
+    _dyn(dt)
+    env = _type_env.get(int(dt))
+    if env is None:                      # registered by internal paths
+        return 0, 0, 0, COMBINER_NAMED
+    comb, ints, aints, types = env
+    return len(ints), len(aints), len(types), comb
+
+
+def type_get_contents(dt: int) -> Tuple[bytes, bytes, bytes]:
+    """(int32 array, int64 address array, int64 type-handle array) —
+    erroneous on NAMED types per the standard."""
+    ni, na, nt, comb = type_get_envelope(dt)
+    if comb == COMBINER_NAMED:
+        raise MPIError(ERR_TYPE,
+                       "get_contents on a named/unknown-envelope type")
+    _comb, ints, aints, types = _type_env[int(dt)]
+    return (np.asarray(ints, np.int32).tobytes(),
+            np.asarray(aints, np.int64).tobytes(),
+            np.asarray(types, np.int64).tobytes())
+
+
 def type_base_bytes(dt: int) -> int:
     """Base-element size (MPI_Get_elements units); 1 for byte-granular
     heterogeneous layouts."""
@@ -700,6 +795,8 @@ def type_commit(dt: int) -> None:
 def type_free(dt: int) -> None:
     if _dyn_types.pop(dt, None) is None:
         raise MPIError(ERR_TYPE, f"invalid datatype handle {dt}")
+    _type_env.pop(int(dt), None)
+    _type_names.pop(int(dt), None)
 
 
 def type_extent_bytes(dt: int) -> int:
@@ -3795,3 +3892,56 @@ def exc_code(exc: BaseException) -> int:
     if isinstance(exc, (ValueError, TypeError)):
         return ERR_ARG
     return 16                            # ERR_OTHER
+
+
+# ---- MPI_T categories (ompi/mpi/tool/category_*.c): variables group
+# by FRAMEWORK — the first segment of every var name, exactly the
+# reference's framework-as-category convention ------------------------
+def _t_cvar_names() -> list:
+    return _t_stable("cvar", _t_cvars().keys())
+
+
+def _t_categories() -> list:
+    cats = sorted({n.split("_", 1)[0] for n in _t_cvar_names()}
+                  | {n.split("_", 1)[0] for n in _t_pvar_names()})
+    return cats
+
+
+def t_category_get_num() -> int:
+    return len(_t_categories())
+
+
+def t_category_get_info(i: int) -> Tuple[str, str, int, int]:
+    cats = _t_categories()
+    if not 0 <= int(i) < len(cats):
+        raise MPIError(ERR_ARG, f"bad category index {i}")
+    c = cats[int(i)]
+    ncv = sum(1 for n in _t_cvar_names() if n.split("_", 1)[0] == c)
+    npv = sum(1 for n in _t_pvar_names() if n.split("_", 1)[0] == c)
+    return c, f"framework {c}", ncv, npv
+
+
+def t_category_get_index(name: str) -> int:
+    try:
+        return _t_categories().index(name)
+    except ValueError:
+        raise MPIError(ERR_ARG, f"no such category {name!r}") from None
+
+
+def t_category_get_cvars(i: int) -> bytes:
+    c = _t_categories()[int(i)]
+    idxs = [k for k, n in enumerate(_t_cvar_names())
+            if n.split("_", 1)[0] == c]
+    return np.asarray(idxs, np.int32).tobytes()
+
+
+def t_category_get_pvars(i: int) -> bytes:
+    c = _t_categories()[int(i)]
+    idxs = [k for k, n in enumerate(_t_pvar_names())
+            if n.split("_", 1)[0] == c]
+    return np.asarray(idxs, np.int32).tobytes()
+
+
+# activate the constructor-envelope recorders (must run after every
+# constructor definition; see _record_env_wrappers)
+_record_env_wrappers()

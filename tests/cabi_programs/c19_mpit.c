@@ -142,6 +142,38 @@ int main(int argc, char **argv)
     MPI_T_pvar_handle_free(ses, &ph);
     MPI_T_pvar_session_free(&ses);
 
+    /* ---- categories: variables group by framework ---- */
+    int ncat = -1;
+    CHECK(MPI_T_category_get_num(&ncat) == MPI_SUCCESS && ncat > 3,
+          40);
+    int ci = -1;
+    CHECK(MPI_T_category_get_index("coll", &ci) == MPI_SUCCESS
+          && ci >= 0, 41);
+    char cname[64], cdesc[128];
+    int cnl = sizeof(cname), cdl = sizeof(cdesc);
+    int ncv = -1, npv = -1, ncc = -1;
+    CHECK(MPI_T_category_get_info(ci, cname, &cnl, cdesc, &cdl, &ncv,
+                                  &npv, &ncc) == MPI_SUCCESS, 42);
+    CHECK(strcmp(cname, "coll") == 0 && ncv > 5, 43);
+    int cvars[256];
+    CHECK(ncv <= 256, 44);
+    CHECK(MPI_T_category_get_cvars(ci, ncv, cvars) == MPI_SUCCESS, 45);
+    /* every member index resolves to a cvar whose name starts with
+     * the category */
+    char vn[128];
+    int vnl = sizeof(vn), vverb, vbind, vscope;
+    MPI_Datatype vdt;
+    MPI_T_enum ven;
+    char vds[64];
+    int vdl = sizeof(vds);
+    CHECK(MPI_T_cvar_get_info(cvars[0], vn, &vnl, &vverb, &vdt, &ven,
+                              vds, &vdl, &vbind, &vscope)
+          == MPI_SUCCESS, 46);
+    CHECK(strncmp(vn, "coll", 4) == 0, 47);
+    int stamp = -1;
+    CHECK(MPI_T_category_changed(&stamp) == MPI_SUCCESS
+          && stamp == ncat, 48);
+
     /* ---- events: bind a C callback to coll_allreduce ---- */
     int nev = -1;
     CHECK(MPI_T_event_get_num(&nev) == MPI_SUCCESS && nev > 0, 18);

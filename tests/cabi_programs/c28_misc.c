@@ -60,6 +60,34 @@ int main(int argc, char **argv)
           && strcmp(tname, "my-vector") == 0, 12);
     MPI_Type_free(&v);
 
+    /* ---- datatype envelopes: tools reconstruct constructors ---- */
+    {
+        MPI_Datatype vv;
+        MPI_Type_vector(4, 2, 4, MPI_FLOAT, &vv);
+        int ni = -1, na = -1, nt = -1, comb = -1;
+        CHECK(MPI_Type_get_envelope(vv, &ni, &na, &nt, &comb)
+              == MPI_SUCCESS, 40);
+        CHECK(comb == MPI_COMBINER_VECTOR && ni == 3 && na == 0
+              && nt == 1, 41);
+        int ints[8];
+        MPI_Aint aints[4];
+        MPI_Datatype types[4];
+        CHECK(MPI_Type_get_contents(vv, ni, na, nt, ints, aints,
+                                    types) == MPI_SUCCESS, 42);
+        CHECK(ints[0] == 4 && ints[1] == 2 && ints[2] == 4
+              && types[0] == MPI_FLOAT, 43);
+        MPI_Type_free(&vv);
+        CHECK(MPI_Type_get_envelope(MPI_INT, &ni, &na, &nt, &comb)
+              == MPI_SUCCESS && comb == MPI_COMBINER_NAMED, 44);
+        /* contents on NAMED types is erroneous per the standard —
+         * probe with ERRORS_RETURN so the class comes back */
+        MPI_Comm_set_errhandler(MPI_COMM_WORLD, MPI_ERRORS_RETURN);
+        CHECK(MPI_Type_get_contents(MPI_INT, 0, 0, 0, ints, aints,
+                                    types) != MPI_SUCCESS, 45);
+        MPI_Comm_set_errhandler(MPI_COMM_WORLD,
+                                MPI_ERRORS_ARE_FATAL);
+    }
+
     /* ---- object info round-trips ---- */
     {
         MPI_Info in, out;
