@@ -189,6 +189,7 @@ typedef long MPI_Message;                /* matched-probe messages */
 #define MPI_ERR_INTERN    17
 #define MPI_ERR_PENDING   18
 #define MPI_ERR_IN_STATUS 19
+#define MPI_ERR_SIZE      20
 #define MPI_ERR_REVOKED   72
 #define MPI_ERR_PROC_FAILED 75
 #define MPI_ERR_LASTCODE  100
@@ -891,6 +892,11 @@ int MPI_Ialltoallw(const void *sendbuf, const int sendcounts[],
                    void *recvbuf, const int recvcounts[],
                    const int rdispls[], const MPI_Datatype recvtypes[],
                    MPI_Comm comm, MPI_Request *request);
+int MPI_Win_allocate_shared(MPI_Aint size, int disp_unit,
+                            MPI_Info info, MPI_Comm comm,
+                            void *baseptr, MPI_Win *win);
+int MPI_Win_shared_query(MPI_Win win, int rank, MPI_Aint *size,
+                         int *disp_unit, void *baseptr);
 int MPI_Win_post(MPI_Group group, int assert_, MPI_Win win);
 int MPI_Win_start(MPI_Group group, int assert_, MPI_Win win);
 int MPI_Win_complete(MPI_Win win);

@@ -6111,6 +6111,51 @@ int PMPI_Win_detach(MPI_Win win, const void *base)
     return rc;
 }
 
+/* ---- shared-memory windows (win_allocate_shared.c.in; osc/sm) ---- */
+int PMPI_Win_allocate_shared(MPI_Aint size, int disp_unit,
+                            MPI_Info info, MPI_Comm comm,
+                            void *baseptr, MPI_Win *win)
+{
+    (void)info;
+    if (size < 0)
+        return MPI_ERR_SIZE;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "win_allocate_shared",
+                                      "lLi", (long)comm,
+                                      (long long)size, disp_unit);
+    if (!r) {
+        rc = handle_error_comm(comm, "MPI_Win_allocate_shared");
+    } else {
+        *win = (MPI_Win)PyLong_AsLong(PyTuple_GetItem(r, 0));
+        *(void **)baseptr = (void *)(intptr_t)PyLong_AsLongLong(
+            PyTuple_GetItem(r, 1));
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Win_shared_query(MPI_Win win, int rank, MPI_Aint *size,
+                         int *disp_unit, void *baseptr)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "win_shared_query", "li",
+                                      (long)win, rank);
+    if (!r) {
+        rc = handle_error("MPI_Win_shared_query");
+    } else {
+        *size = (MPI_Aint)PyLong_AsLongLong(PyTuple_GetItem(r, 0));
+        *disp_unit = (int)PyLong_AsLong(PyTuple_GetItem(r, 1));
+        *(void **)baseptr = (void *)(intptr_t)PyLong_AsLongLong(
+            PyTuple_GetItem(r, 2));
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
 /* ---- PSCW active-target epochs (win_post.c.in family) ------------ */
 static int win_group_call(const char *fn, MPI_Win win, MPI_Group group)
 {

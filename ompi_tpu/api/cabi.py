@@ -31,7 +31,7 @@ import numpy as np
 
 from ompi_tpu.core import op as op_mod
 from ompi_tpu.core.errhandler import (ERR_ARG, ERR_COMM, ERR_GROUP,
-                                      ERR_OP, ERR_REQUEST,
+                                      ERR_OP, ERR_RANK, ERR_REQUEST,
                                       ERR_TOPOLOGY, ERR_TYPE, MPIError,
                                       error_string)
 
@@ -3556,6 +3556,63 @@ def file_read_all(fh: int, offset: int, nbytes: int, dt: int,
 def file_write_all(fh: int, offset: int, view, dt: int) -> int:
     return _all_with_barrier(
         fh, lambda: file_write_ind(fh, offset, view, dt))
+
+
+# ---- shared-memory windows (win_allocate_shared.c.in; osc/sm) -------
+def win_allocate_shared(h: int, nbytes: int,
+                        disp_unit: int) -> Tuple[int, int]:
+    """MPI_Win_allocate_shared: ONE /dev/shm segment holds every
+    rank's contribution contiguously; every process maps the whole,
+    so plain C loads/stores reach ANY rank's portion directly (the
+    osc/sm model — no RPC on the load/store path) while the usual
+    acked RMA ops keep working against each rank's slice. Returns
+    (window handle, address of MY portion in THIS process)."""
+    import os as _os
+    c = _comm(h)
+    from ompi_tpu.osc.perrank import RankWindow
+    sizes = [int(s) for s in c.allgather(np.int64(int(nbytes)))]
+    offsets = [0]
+    for s in sizes[:-1]:
+        offsets.append(offsets[-1] + s)
+    total = max(1, sum(sizes))
+    r = c.rank()
+    name = None
+    if r == 0:
+        name = f"ompitpu_shmwin_{_os.getpid()}_{id(c) & 0xffff:x}"
+        with open(f"/dev/shm/{name}", "wb") as f:
+            f.truncate(total)
+    name = c.bcast(name, root=0)
+    mm = np.memmap(f"/dev/shm/{name}", dtype=np.uint8, mode="r+",
+                   shape=(total,))
+    c.barrier()                          # everyone mapped
+    if r == 0:
+        _os.unlink(f"/dev/shm/{name}")   # segment dies with the job
+    my = mm[offsets[r]:offsets[r] + int(nbytes)]
+    win = RankWindow(c, int(nbytes), dtype=np.uint8,
+                     name=f"shmwin:{name}", storage=my)
+    win._shm_map = mm
+    win._shm_offsets = offsets
+    win._shm_sizes = sizes
+    win._disp_units = [int(u) for u in
+                       c.allgather(np.int64(max(int(disp_unit), 1)))]
+    with _lock:
+        wh = next(_next_win)
+        _wins[wh] = win
+    return wh, int(mm.ctypes.data) + offsets[r]
+
+
+def win_shared_query(wh: int, rank: int) -> Tuple[int, int, int]:
+    """(size, disp_unit, address of RANK's portion in MY mapping).
+    rank MPI_PROC_NULL (-2) means 'the lowest rank', per standard."""
+    w = _win(wh)
+    mm = getattr(w, "_shm_map", None)
+    if mm is None:
+        raise MPIError(ERR_ARG, "not a shared-memory window")
+    t = 0 if rank == -2 else int(rank)
+    if not 0 <= t < len(w._shm_sizes):
+        raise MPIError(ERR_RANK, f"bad target rank {rank}")
+    return (w._shm_sizes[t], w._disp_units[t],
+            int(mm.ctypes.data) + w._shm_offsets[t])
 
 
 # ---- PSCW active-target epochs (win_post.c.in family) ---------------

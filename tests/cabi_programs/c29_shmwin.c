@@ -1,0 +1,100 @@
+/* Shared-memory windows from C (win_allocate_shared.c.in over the
+ * osc/sm model): one /dev/shm segment, every process maps the whole,
+ * plain loads/stores reach ANY rank's portion — no MPI call on the
+ * access path — while the acked RMA ops still work on the same
+ * window. Reference: ompi/mca/osc/sm + win_shared_query.c.in. */
+#include <mpi.h>
+#include <stdio.h>
+#include <string.h>
+
+static int rank, size;
+
+#define CHECK(cond, code)                                            \
+    do {                                                             \
+        if (!(cond)) {                                               \
+            fprintf(stderr, "rank %d: check failed at line %d\n",    \
+                    rank, __LINE__);                                 \
+            MPI_Abort(MPI_COMM_WORLD, code);                         \
+        }                                                            \
+    } while (0)
+
+#define SLOTS 8
+
+int main(int argc, char **argv)
+{
+    MPI_Init(&argc, &argv);
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &size);
+    CHECK(size >= 2, 1);
+
+    /* the standard flow: split by shared-memory locality first */
+    MPI_Comm node;
+    CHECK(MPI_Comm_split_type(MPI_COMM_WORLD, MPI_COMM_TYPE_SHARED, 0,
+                              MPI_INFO_NULL, &node) == MPI_SUCCESS, 2);
+    int nrank, nsize;
+    MPI_Comm_rank(node, &nrank);
+    MPI_Comm_size(node, &nsize);
+    CHECK(nsize == size, 3);             /* one host in CI */
+
+    double *mine = NULL;
+    MPI_Win win;
+    CHECK(MPI_Win_allocate_shared(
+              (MPI_Aint)(SLOTS * sizeof(double)), sizeof(double),
+              MPI_INFO_NULL, node, &mine, &win) == MPI_SUCCESS, 4);
+    CHECK(mine != NULL, 5);
+
+    /* my portion is directly writable */
+    for (int i = 0; i < SLOTS; i++)
+        mine[i] = 100.0 * nrank + i;
+    MPI_Barrier(node);
+
+    /* DIRECT loads from every peer's portion — no MPI on the path */
+    for (int t = 0; t < nsize; t++) {
+        MPI_Aint tsz = -1;
+        int tdu = -1;
+        double *tbase = NULL;
+        CHECK(MPI_Win_shared_query(win, t, &tsz, &tdu, &tbase)
+              == MPI_SUCCESS, 6);
+        CHECK(tsz == (MPI_Aint)(SLOTS * sizeof(double))
+              && tdu == (int)sizeof(double) && tbase != NULL, 7);
+        for (int i = 0; i < SLOTS; i++)
+            CHECK(tbase[i] == 100.0 * t + i, 8);
+    }
+    /* readers done before anyone mutates (shared memory: the next
+     * section's stores would race a slow rank's verification loads) */
+    MPI_Barrier(node);
+
+    /* DIRECT store into the right neighbor's slot 0; they observe it
+     * with a plain load after the barrier (true shared memory) */
+    {
+        int t = (nrank + 1) % nsize;
+        MPI_Aint tsz;
+        int tdu;
+        double *tbase = NULL;
+        MPI_Win_shared_query(win, t, &tsz, &tdu, &tbase);
+        tbase[0] = 5000.0 + nrank;
+        MPI_Win_sync(win);
+        MPI_Barrier(node);
+        int left = (nrank - 1 + nsize) % nsize;
+        CHECK(mine[0] == 5000.0 + left, 9);
+    }
+
+    /* the acked RMA path still works on the same window */
+    {
+        double v = 7000.0 + nrank;
+        int t = (nrank + 1) % nsize;
+        MPI_Win_fence(0, win);
+        CHECK(MPI_Put(&v, 1, MPI_DOUBLE, t, 1, 1, MPI_DOUBLE, win)
+              == MPI_SUCCESS, 10);
+        MPI_Win_fence(0, win);
+        int left = (nrank - 1 + nsize) % nsize;
+        CHECK(mine[1] == 7000.0 + left, 11);
+    }
+
+    MPI_Win_free(&win);
+    MPI_Comm_free(&node);
+    MPI_Barrier(MPI_COMM_WORLD);
+    printf("OK c29_shmwin rank=%d/%d\n", rank, size);
+    MPI_Finalize();
+    return 0;
+}

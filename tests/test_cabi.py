@@ -55,6 +55,7 @@ CASES = [
     ("c26_partitioned.c", 2),
     ("c27_pscw.c", 3),
     ("c28_misc.c", 4),
+    ("c29_shmwin.c", 3),
 ]
 
 # per-program argv (c13 runs 4M floats = 16 MB in CI — above the 1 MB
