@@ -155,6 +155,12 @@ typedef struct {
     /* partitioned requests (MPI_Psend_init): persistent handles whose
      * wait must NOT consume the glue entry (Start re-arms) */
     int is_part;
+    /* persistent collectives (MPI_Allreduce_init et al.): the glue
+     * holds the captured nonblocking marshaller; Start dispatches it
+     * and parks the inner handle in pyh (completion via the ordinary
+     * persistent wait/test path) */
+    int is_pcoll;
+    long pcoll_h;
     /* generalized requests (MPI_Grequest_start): completion is driven
      * by the APP via MPI_Grequest_complete; wait/test call query_fn
      * to fill the status (grequest_start.c.in contract) */
@@ -1829,6 +1835,22 @@ int PMPI_Start(MPI_Request *request)
     }
     if (!e->persistent || e->pyh != 0)
         return MPI_ERR_REQUEST;          /* not persistent, or active */
+    if (e->is_pcoll) {                   /* persistent collective:
+                                          * re-dispatch the captured
+                                          * nonblocking marshaller */
+        GIL_BEGIN;
+        int prc = MPI_SUCCESS;
+        PyObject *pr = PyObject_CallMethod(g_mod, "pcoll_start", "l",
+                                           e->pcoll_h);
+        if (!pr)
+            prc = handle_error("MPI_Start");
+        else {
+            e->pyh = PyLong_AsLong(pr);
+            Py_DECREF(pr);
+        }
+        GIL_END;
+        return prc;
+    }
     long long woff, wlen;
     if (!dt_window(e->dt, e->count, &woff, &wlen))
         return MPI_ERR_TYPE;
@@ -1897,6 +1919,17 @@ int PMPI_Request_free(MPI_Request *request)
     /* free means free — even when the drain completed in error (the
      * caller is disposing of the request; leaking the entry and
      * leaving a stale handle would give them nothing to retry with) */
+    if (e->is_pcoll) {                   /* release the captured glue
+                                          * closure */
+        GIL_BEGIN;
+        PyObject *pr = PyObject_CallMethod(g_mod, "pcoll_free", "l",
+                                           e->pcoll_h);
+        if (!pr)
+            PyErr_Clear();
+        else
+            Py_DECREF(pr);
+        GIL_END;
+    }
     free(e);
     *request = MPI_REQUEST_NULL;
     return rc;
@@ -5679,12 +5712,19 @@ int PMPI_Type_get_true_extent(MPI_Datatype datatype, MPI_Aint *true_lb,
     return MPI_SUCCESS;
 }
 
-/* ---- Alltoallw (alltoallw.c.in): per-peer types and displs ------- */
-int PMPI_Alltoallw(const void *sendbuf, const int sendcounts[],
-                  const int sdispls[], const MPI_Datatype sendtypes[],
-                  void *recvbuf, const int recvcounts[],
-                  const int rdispls[], const MPI_Datatype recvtypes[],
-                  MPI_Comm comm)
+/* ---- Alltoallw (alltoallw.c.in): per-peer types and displs.
+ * Shared marshalling for the flat w-variant — mode 0: blocking (copy
+ * result into recvbuf); mode 1: nonblocking (request entry); mode 2:
+ * persistent init (pcoll entry). One copy of the lane-window math so
+ * the three variants cannot desynchronize. ------------------------- */
+static int pcoll_entry(PyObject *r, void *buf, size_t cap,
+                       MPI_Request *request, const char *fn);
+static int flat_w_call(const char *glue, int mode, const void *sendbuf,
+                       const int sendcounts[], const int sdispls[],
+                       const MPI_Datatype sendtypes[], void *recvbuf,
+                       const int recvcounts[], const int rdispls[],
+                       const MPI_Datatype recvtypes[], MPI_Comm comm,
+                       MPI_Request *request, const char *fn)
 {
     int n;
     int rc = PMPI_Comm_size(comm, &n);
@@ -5692,9 +5732,9 @@ int PMPI_Alltoallw(const void *sendbuf, const int sendcounts[],
         return rc;
     /* windows must span every peer lane on both sides */
     long long send_hi = 0, recv_hi = 0;
-    long long *st64 = malloc(sizeof(long long) * (size_t)n);
-    long long *rt64 = malloc(sizeof(long long) * (size_t)n);
-    if ((!st64 || !rt64) && n) {
+    long long *st64 = malloc(sizeof(long long) * (size_t)(n ? n : 1));
+    long long *rt64 = malloc(sizeof(long long) * (size_t)(n ? n : 1));
+    if (!st64 || !rt64) {
         free(st64);
         free(rt64);
         return MPI_ERR_INTERN;
@@ -5723,7 +5763,7 @@ int PMPI_Alltoallw(const void *sendbuf, const int sendcounts[],
     }
     GIL_BEGIN;
     PyObject *r = PyObject_CallMethod(
-        g_mod, "alltoallw", "lNNNNNNNN", (long)comm,
+        g_mod, glue, "lNNNNNNNN", (long)comm,
         mem_ro(sendbuf, (size_t)send_hi),
         mem_ro(sendcounts, sizeof(int) * (size_t)n),
         mem_ro(sdispls, sizeof(int) * (size_t)n),
@@ -5733,7 +5773,11 @@ int PMPI_Alltoallw(const void *sendbuf, const int sendcounts[],
         mem_ro(rdispls, sizeof(int) * (size_t)n),
         mem_ro(rt64, sizeof(long long) * (size_t)n));
     if (!r)
-        rc = handle_error_comm(comm, "MPI_Alltoallw");
+        rc = handle_error_comm(comm, fn);
+    else if (mode == 2)
+        rc = pcoll_entry(r, recvbuf, (size_t)recv_hi, request, fn);
+    else if (mode == 1)
+        rc = icoll_request(r, recvbuf, (size_t)recv_hi, request, fn);
     else {
         rc = copy_bytes(r, recvbuf, (size_t)recv_hi);
         Py_DECREF(r);
@@ -5742,6 +5786,17 @@ int PMPI_Alltoallw(const void *sendbuf, const int sendcounts[],
     free(st64);
     free(rt64);
     return rc;
+}
+
+int PMPI_Alltoallw(const void *sendbuf, const int sendcounts[],
+                  const int sdispls[], const MPI_Datatype sendtypes[],
+                  void *recvbuf, const int recvcounts[],
+                  const int rdispls[], const MPI_Datatype recvtypes[],
+                  MPI_Comm comm)
+{
+    return flat_w_call("alltoallw", 0, sendbuf, sendcounts, sdispls,
+                       sendtypes, recvbuf, recvcounts, rdispls,
+                       recvtypes, comm, NULL, "MPI_Alltoallw");
 }
 
 /* ------------------------------------------------------------------ */
@@ -6049,17 +6104,14 @@ int PMPI_Ialltoallw(const void *sendbuf, const int sendcounts[],
                    const int rdispls[], const MPI_Datatype recvtypes[],
                    MPI_Comm comm, MPI_Request *request)
 {
-    /* single-phase schedule: the w-variant's per-peer marshalling
-     * dominates; completion at wait via the blocking engine on a
-     * worker would race the recv buffer, so complete-at-call like the
-     * other single-controller i-collectives' documented edge — the
-     * per-rank tier still overlaps the underlying alltoall rounds */
-    int rc = PMPI_Alltoallw(sendbuf, sendcounts, sdispls, sendtypes,
-                           recvbuf, recvcounts, rdispls, recvtypes,
-                           comm);
-    if (rc == MPI_SUCCESS)
-        *request = MPI_REQUEST_NULL;     /* born complete */
-    return rc;
+    /* real nonblocking dispatch: the glue snapshots the count/displ/
+     * type arrays at the i-call and runs the per-peer marshalling on
+     * the communicator's nonblocking worker (true overlap on
+     * per-rank comms; single-controller comms complete at the call,
+     * the documented lower-bound edge) */
+    return flat_w_call("ialltoallw", 1, sendbuf, sendcounts, sdispls,
+                       sendtypes, recvbuf, recvcounts, rdispls,
+                       recvtypes, comm, request, "MPI_Ialltoallw");
 }
 
 /* ---- dynamic windows (win_create_dynamic.c.in, win_attach.c.in) -- */
@@ -7280,6 +7332,892 @@ int PMPI_T_event_get_source(MPI_T_event_instance instance,
     *source_index = 0;                   /* one event source: the SPC
                                           * plane */
     return MPI_SUCCESS;
+}
+
+/* ------------------------------------------------------------------ */
+/* round-5 wave 5: neighbor v/w collectives (neighbor_allgatherv.c.in
+ * family) and the MPI-4 persistent collective chapter (*_init,
+ * allreduce_init.c.in family — the reference's coll *_init slots).    */
+/* ------------------------------------------------------------------ */
+
+int PMPI_Neighbor_allgatherv(const void *sendbuf, int sendcount,
+                            MPI_Datatype sendtype, void *recvbuf,
+                            const int recvcounts[], const int displs[],
+                            MPI_Datatype recvtype, MPI_Comm comm)
+{
+    size_t ssz = dt_extent(sendtype), rsz = dt_size(recvtype);
+    if (!ssz || !rsz || sendcount < 0)
+        return MPI_ERR_TYPE;
+    int nslots;
+    int qrc = neighbor_count_of(comm, &nslots);
+    if (qrc != MPI_SUCCESS)
+        return qrc;
+    size_t cap = v_extent(recvcounts, displs, nslots) * rsz;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "neighbor_allgatherv", "lNllNNN", (long)comm,
+        mem_ro(sendbuf, (size_t)sendcount * ssz), (long)sendtype,
+        (long)recvtype,
+        mem_ro(recvcounts, (size_t)nslots * sizeof(int)),
+        mem_ro(displs, (size_t)nslots * sizeof(int)),
+        mem_ro(recvbuf, cap));
+    if (!r)
+        rc = handle_error_comm(comm, "MPI_Neighbor_allgatherv");
+    else {
+        rc = copy_bytes(r, recvbuf, cap);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Neighbor_alltoallv(const void *sendbuf, const int sendcounts[],
+                           const int sdispls[], MPI_Datatype sendtype,
+                           void *recvbuf, const int recvcounts[],
+                           const int rdispls[], MPI_Datatype recvtype,
+                           MPI_Comm comm)
+{
+    size_t ssz = dt_size(sendtype), rsz = dt_size(recvtype);
+    if (!ssz || !rsz)
+        return MPI_ERR_TYPE;
+    int nslots, nout;
+    int qrc = neighbor_count_of(comm, &nslots);
+    if (qrc == MPI_SUCCESS)
+        qrc = neighbor_out_count_of(comm, &nout);
+    if (qrc != MPI_SUCCESS)
+        return qrc;
+    size_t in_bytes = v_extent(sendcounts, sdispls, nout) * ssz;
+    size_t cap = v_extent(recvcounts, rdispls, nslots) * rsz;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "neighbor_alltoallv", "lNlNNlNNN", (long)comm,
+        mem_ro(sendbuf, in_bytes), (long)sendtype,
+        mem_ro(sendcounts, (size_t)nout * sizeof(int)),
+        mem_ro(sdispls, (size_t)nout * sizeof(int)), (long)recvtype,
+        mem_ro(recvcounts, (size_t)nslots * sizeof(int)),
+        mem_ro(rdispls, (size_t)nslots * sizeof(int)),
+        mem_ro(recvbuf, cap));
+    if (!r)
+        rc = handle_error_comm(comm, "MPI_Neighbor_alltoallv");
+    else {
+        rc = copy_bytes(r, recvbuf, cap);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+/* shared marshalling for the neighbor w-variant. mode 0: blocking
+ * (copy result into recvbuf); mode 1: nonblocking (request entry);
+ * mode 2: persistent init (pcoll entry). The glue entry point
+ * differs, the window math is identical. */
+static int pcoll_entry(PyObject *r, void *buf, size_t cap,
+                       MPI_Request *request, const char *fn);
+static int neighbor_w_call(const char *glue, int mode,
+                           const void *sendbuf, const int sendcounts[],
+                           const MPI_Aint sdispls[],
+                           const MPI_Datatype sendtypes[],
+                           void *recvbuf, const int recvcounts[],
+                           const MPI_Aint rdispls[],
+                           const MPI_Datatype recvtypes[],
+                           MPI_Comm comm, MPI_Request *request,
+                           const char *fn)
+{
+    int nslots, nout;
+    int rc = neighbor_count_of(comm, &nslots);
+    if (rc == MPI_SUCCESS)
+        rc = neighbor_out_count_of(comm, &nout);
+    if (rc != MPI_SUCCESS)
+        return rc;
+    long long send_hi = 0, recv_hi = 0;
+    long long *st64 = malloc(sizeof(long long) * (size_t)(nout ? nout : 1));
+    long long *rt64 = malloc(sizeof(long long) * (size_t)(nslots ? nslots : 1));
+    if (!st64 || !rt64) {
+        free(st64);
+        free(rt64);
+        return MPI_ERR_INTERN;
+    }
+    for (int j = 0; j < nout; j++) {
+        long long off, len;
+        if (sdispls[j] < 0
+            || !dt_window(sendtypes[j], sendcounts[j], &off, &len)
+            || off != 0) {
+            free(st64);
+            free(rt64);
+            return MPI_ERR_TYPE;
+        }
+        if (sdispls[j] + len > send_hi)
+            send_hi = sdispls[j] + len;
+        st64[j] = (long long)sendtypes[j];
+    }
+    for (int j = 0; j < nslots; j++) {
+        long long off, len;
+        if (rdispls[j] < 0
+            || !dt_window(recvtypes[j], recvcounts[j], &off, &len)
+            || off != 0) {
+            free(st64);
+            free(rt64);
+            return MPI_ERR_TYPE;
+        }
+        if (rdispls[j] + len > recv_hi)
+            recv_hi = rdispls[j] + len;
+        rt64[j] = (long long)recvtypes[j];
+    }
+    GIL_BEGIN;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, glue, "lNNNNNNNN", (long)comm,
+        mem_ro(sendbuf, (size_t)send_hi),
+        mem_ro(sendcounts, sizeof(int) * (size_t)nout),
+        mem_ro(sdispls, sizeof(MPI_Aint) * (size_t)nout),
+        mem_ro(st64, sizeof(long long) * (size_t)nout),
+        mem_ro(recvbuf, (size_t)recv_hi),
+        mem_ro(recvcounts, sizeof(int) * (size_t)nslots),
+        mem_ro(rdispls, sizeof(MPI_Aint) * (size_t)nslots),
+        mem_ro(rt64, sizeof(long long) * (size_t)nslots));
+    if (!r)
+        rc = handle_error_comm(comm, fn);
+    else if (mode == 2)
+        rc = pcoll_entry(r, recvbuf, (size_t)recv_hi, request, fn);
+    else if (mode == 1)
+        rc = icoll_request(r, recvbuf, (size_t)recv_hi, request, fn);
+    else {
+        rc = copy_bytes(r, recvbuf, (size_t)recv_hi);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    free(st64);
+    free(rt64);
+    return rc;
+}
+
+int PMPI_Neighbor_alltoallw(const void *sendbuf, const int sendcounts[],
+                           const MPI_Aint sdispls[],
+                           const MPI_Datatype sendtypes[],
+                           void *recvbuf, const int recvcounts[],
+                           const MPI_Aint rdispls[],
+                           const MPI_Datatype recvtypes[],
+                           MPI_Comm comm)
+{
+    return neighbor_w_call("neighbor_alltoallw", 0, sendbuf,
+                           sendcounts, sdispls, sendtypes, recvbuf,
+                           recvcounts, rdispls, recvtypes, comm, NULL,
+                           "MPI_Neighbor_alltoallw");
+}
+
+int PMPI_Ineighbor_alltoallw(const void *sendbuf, const int sendcounts[],
+                            const MPI_Aint sdispls[],
+                            const MPI_Datatype sendtypes[],
+                            void *recvbuf, const int recvcounts[],
+                            const MPI_Aint rdispls[],
+                            const MPI_Datatype recvtypes[],
+                            MPI_Comm comm, MPI_Request *request)
+{
+    return neighbor_w_call("ineighbor_alltoallw", 1, sendbuf,
+                           sendcounts, sdispls, sendtypes, recvbuf,
+                           recvcounts, rdispls, recvtypes, comm,
+                           request, "MPI_Ineighbor_alltoallw");
+}
+
+int PMPI_Neighbor_alltoallw_init(const void *sendbuf,
+                                const int sendcounts[],
+                                const MPI_Aint sdispls[],
+                                const MPI_Datatype sendtypes[],
+                                void *recvbuf, const int recvcounts[],
+                                const MPI_Aint rdispls[],
+                                const MPI_Datatype recvtypes[],
+                                MPI_Comm comm, MPI_Info info,
+                                MPI_Request *request)
+{
+    (void)info;
+    return neighbor_w_call("pcoll_neighbor_alltoallw_init", 2, sendbuf,
+                           sendcounts, sdispls, sendtypes, recvbuf,
+                           recvcounts, rdispls, recvtypes, comm,
+                           request, "MPI_Neighbor_alltoallw_init");
+}
+
+int PMPI_Ineighbor_allgatherv(const void *sendbuf, int sendcount,
+                             MPI_Datatype sendtype, void *recvbuf,
+                             const int recvcounts[], const int displs[],
+                             MPI_Datatype recvtype, MPI_Comm comm,
+                             MPI_Request *request)
+{
+    size_t ssz = dt_extent(sendtype), rsz = dt_size(recvtype);
+    if (!ssz || !rsz || sendcount < 0)
+        return MPI_ERR_TYPE;
+    int nslots;
+    int qrc = neighbor_count_of(comm, &nslots);
+    if (qrc != MPI_SUCCESS)
+        return qrc;
+    size_t cap = v_extent(recvcounts, displs, nslots) * rsz;
+    GIL_BEGIN;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "ineighbor_allgatherv", "lNllNNN", (long)comm,
+        mem_ro(sendbuf, (size_t)sendcount * ssz), (long)sendtype,
+        (long)recvtype,
+        mem_ro(recvcounts, (size_t)nslots * sizeof(int)),
+        mem_ro(displs, (size_t)nslots * sizeof(int)),
+        mem_ro(recvbuf, cap));
+    int rc = icoll_request(r, recvbuf, cap, request,
+                           "MPI_Ineighbor_allgatherv");
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Ineighbor_alltoallv(const void *sendbuf, const int sendcounts[],
+                            const int sdispls[], MPI_Datatype sendtype,
+                            void *recvbuf, const int recvcounts[],
+                            const int rdispls[], MPI_Datatype recvtype,
+                            MPI_Comm comm, MPI_Request *request)
+{
+    size_t ssz = dt_size(sendtype), rsz = dt_size(recvtype);
+    if (!ssz || !rsz)
+        return MPI_ERR_TYPE;
+    int nslots, nout;
+    int qrc = neighbor_count_of(comm, &nslots);
+    if (qrc == MPI_SUCCESS)
+        qrc = neighbor_out_count_of(comm, &nout);
+    if (qrc != MPI_SUCCESS)
+        return qrc;
+    size_t in_bytes = v_extent(sendcounts, sdispls, nout) * ssz;
+    size_t cap = v_extent(recvcounts, rdispls, nslots) * rsz;
+    GIL_BEGIN;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "ineighbor_alltoallv", "lNlNNlNNN", (long)comm,
+        mem_ro(sendbuf, in_bytes), (long)sendtype,
+        mem_ro(sendcounts, (size_t)nout * sizeof(int)),
+        mem_ro(sdispls, (size_t)nout * sizeof(int)), (long)recvtype,
+        mem_ro(recvcounts, (size_t)nslots * sizeof(int)),
+        mem_ro(rdispls, (size_t)nslots * sizeof(int)),
+        mem_ro(recvbuf, cap));
+    int rc = icoll_request(r, recvbuf, cap, request,
+                           "MPI_Ineighbor_alltoallv");
+    GIL_END;
+    return rc;
+}
+
+/* ---- persistent collectives (MPI-4 *_init): each init marshals
+ * exactly like its nonblocking twin but hands the views to
+ * pcoll_init, which captures the marshaller for MPI_Start to
+ * re-dispatch (buffers re-read at every start — persistent
+ * semantics); completion rides the ordinary persistent wait/test
+ * path and the entry survives until MPI_Request_free. ------------- */
+static int pcoll_entry(PyObject *r, void *buf, size_t cap,
+                       MPI_Request *request, const char *fn)
+{
+    if (!r)
+        return handle_error(fn);
+    req_entry *e = req_new();
+    e->persistent = 1;
+    e->is_pcoll = 1;
+    e->pcoll_h = PyLong_AsLong(r);
+    e->buf = buf;
+    e->cap = cap;
+    Py_DECREF(r);
+    *request = (MPI_Request)(intptr_t)e;
+    return MPI_SUCCESS;
+}
+
+int PMPI_Barrier_init(MPI_Comm comm, MPI_Info info,
+                     MPI_Request *request)
+{
+    (void)info;
+    GIL_BEGIN;
+    PyObject *r = PyObject_CallMethod(g_mod, "pcoll_init", "sl",
+                                      "barrier", (long)comm);
+    int rc = pcoll_entry(r, NULL, 0, request, "MPI_Barrier_init");
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Bcast_init(void *buffer, int count, MPI_Datatype datatype,
+                   int root, MPI_Comm comm, MPI_Info info,
+                   MPI_Request *request)
+{
+    (void)info;
+    size_t esz = dt_extent(datatype);
+    if (!esz || count < 0)
+        return MPI_ERR_TYPE;
+    size_t nbytes = (size_t)count * esz;
+    GIL_BEGIN;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "pcoll_init", "slNli", "bcast", (long)comm,
+        mem_ro(buffer, nbytes), (long)datatype, root);
+    int rc = pcoll_entry(r, buffer, nbytes, request,
+                         "MPI_Bcast_init");
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Allreduce_init(const void *sendbuf, void *recvbuf, int count,
+                       MPI_Datatype datatype, MPI_Op op, MPI_Comm comm,
+                       MPI_Info info, MPI_Request *request)
+{
+    (void)info;
+    size_t esz = dt_extent(datatype);
+    if (!esz || count < 0)
+        return MPI_ERR_TYPE;
+    size_t nbytes = (size_t)count * esz;
+    GIL_BEGIN;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "pcoll_init", "slNll", "allreduce", (long)comm,
+        mem_ro(sendbuf == MPI_IN_PLACE ? recvbuf : sendbuf, nbytes),
+        (long)datatype, (long)op);
+    int rc = pcoll_entry(r, recvbuf, nbytes, request,
+                         "MPI_Allreduce_init");
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Reduce_init(const void *sendbuf, void *recvbuf, int count,
+                    MPI_Datatype datatype, MPI_Op op, int root,
+                    MPI_Comm comm, MPI_Info info, MPI_Request *request)
+{
+    (void)info;
+    size_t esz = dt_extent(datatype);
+    if (!esz || count < 0)
+        return MPI_ERR_TYPE;
+    int rank;
+    int qrc = PMPI_Comm_rank(comm, &rank);
+    if (qrc != MPI_SUCCESS)
+        return qrc;
+    size_t nbytes = (size_t)count * esz;
+    GIL_BEGIN;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "pcoll_init", "slNlli", "reduce", (long)comm,
+        mem_ro(pick_in(sendbuf, recvbuf), nbytes), (long)datatype,
+        (long)op, root);
+    int rc = pcoll_entry(r, rank == root ? recvbuf : NULL,
+                         rank == root ? nbytes : 0, request,
+                         "MPI_Reduce_init");
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Scan_init(const void *sendbuf, void *recvbuf, int count,
+                  MPI_Datatype datatype, MPI_Op op, MPI_Comm comm,
+                  MPI_Info info, MPI_Request *request)
+{
+    (void)info;
+    size_t esz = dt_size(datatype);
+    if (!esz || count < 0)
+        return MPI_ERR_TYPE;
+    size_t nbytes = (size_t)count * esz;
+    GIL_BEGIN;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "pcoll_init", "slNll", "scan", (long)comm,
+        mem_ro(pick_in(sendbuf, recvbuf), nbytes), (long)datatype,
+        (long)op);
+    int rc = pcoll_entry(r, recvbuf, nbytes, request,
+                         "MPI_Scan_init");
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Exscan_init(const void *sendbuf, void *recvbuf, int count,
+                    MPI_Datatype datatype, MPI_Op op, MPI_Comm comm,
+                    MPI_Info info, MPI_Request *request)
+{
+    (void)info;
+    size_t esz = dt_size(datatype);
+    if (!esz || count < 0)
+        return MPI_ERR_TYPE;
+    size_t nbytes = (size_t)count * esz;
+    GIL_BEGIN;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "pcoll_init", "slNll", "exscan", (long)comm,
+        mem_ro(pick_in(sendbuf, recvbuf), nbytes), (long)datatype,
+        (long)op);
+    int rc = pcoll_entry(r, recvbuf, nbytes, request,
+                         "MPI_Exscan_init");
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Gather_init(const void *sendbuf, int sendcount,
+                    MPI_Datatype sendtype, void *recvbuf, int recvcount,
+                    MPI_Datatype recvtype, int root, MPI_Comm comm,
+                    MPI_Info info, MPI_Request *request)
+{
+    (void)info;
+    int size, rank;
+    int qrc = PMPI_Comm_size(comm, &size);
+    if (qrc == MPI_SUCCESS)
+        qrc = PMPI_Comm_rank(comm, &rank);
+    if (qrc != MPI_SUCCESS)
+        return qrc;
+    size_t rsz = 0;
+    if (rank == root) {
+        rsz = dt_size(recvtype);
+        if (!rsz || recvcount < 0)
+            return MPI_ERR_TYPE;
+        if (sendbuf == MPI_IN_PLACE) {
+            sendbuf = (const char *)recvbuf
+                + (size_t)rank * (size_t)recvcount * rsz;
+            sendcount = recvcount;
+            sendtype = recvtype;
+        }
+    } else if (sendbuf == MPI_IN_PLACE) {
+        return MPI_ERR_BUFFER;
+    }
+    size_t ssz = dt_size(sendtype);
+    if (!ssz || sendcount < 0)
+        return MPI_ERR_TYPE;
+    GIL_BEGIN;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "pcoll_init", "slNlil", "gather", (long)comm,
+        mem_ro(sendbuf, (size_t)sendcount * ssz), (long)sendtype, root,
+        (long)(rank == root ? recvtype : 0));
+    int rc = pcoll_entry(
+        r, rank == root ? recvbuf : NULL,
+        rank == root ? (size_t)size * (size_t)recvcount * rsz : 0,
+        request, "MPI_Gather_init");
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Gatherv_init(const void *sendbuf, int sendcount,
+                     MPI_Datatype sendtype, void *recvbuf,
+                     const int recvcounts[], const int displs[],
+                     MPI_Datatype recvtype, int root, MPI_Comm comm,
+                     MPI_Info info, MPI_Request *request)
+{
+    (void)info;
+    size_t ssz = dt_size(sendtype);
+    if (!ssz || sendcount < 0)
+        return MPI_ERR_TYPE;
+    int size, rank;
+    int qrc = PMPI_Comm_size(comm, &size);
+    if (qrc == MPI_SUCCESS)
+        qrc = PMPI_Comm_rank(comm, &rank);
+    if (qrc != MPI_SUCCESS)
+        return qrc;
+    size_t cap = 0, rsz = 0;
+    if (rank == root) {
+        rsz = dt_size(recvtype);
+        if (!rsz)
+            return MPI_ERR_TYPE;
+        cap = v_extent(recvcounts, displs, size) * rsz;
+    }
+    GIL_BEGIN;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "pcoll_init", "slNlilNNN", "gatherv", (long)comm,
+        mem_ro(sendbuf, (size_t)sendcount * ssz), (long)sendtype, root,
+        (long)(rank == root ? recvtype : 0),
+        mem_ro(recvcounts, rank == root
+               ? (size_t)size * sizeof(int) : 0),
+        mem_ro(displs, rank == root ? (size_t)size * sizeof(int) : 0),
+        mem_ro(recvbuf, cap));
+    int rc = pcoll_entry(r, rank == root ? recvbuf : NULL, cap,
+                         request, "MPI_Gatherv_init");
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Scatter_init(const void *sendbuf, int sendcount,
+                     MPI_Datatype sendtype, void *recvbuf,
+                     int recvcount, MPI_Datatype recvtype, int root,
+                     MPI_Comm comm, MPI_Info info, MPI_Request *request)
+{
+    (void)info;
+    int size, rank;
+    int qrc = PMPI_Comm_size(comm, &size);
+    if (qrc == MPI_SUCCESS)
+        qrc = PMPI_Comm_rank(comm, &rank);
+    if (qrc != MPI_SUCCESS)
+        return qrc;
+    size_t ssz = 0;
+    if (rank == root) {
+        ssz = dt_size(sendtype);
+        if (!ssz || sendcount < 0)
+            return MPI_ERR_TYPE;
+    }
+    int in_place = (recvbuf == MPI_IN_PLACE);
+    size_t rsz = 0;
+    if (!in_place) {
+        rsz = dt_size(recvtype);
+        if (!rsz || recvcount < 0)
+            return MPI_ERR_TYPE;
+    }
+    GIL_BEGIN;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "pcoll_init", "slNliil", "scatter", (long)comm,
+        mem_ro(sendbuf, rank == root
+               ? (size_t)size * (size_t)sendcount * ssz : 0),
+        (long)(rank == root ? sendtype : 0), sendcount, root,
+        (long)(in_place ? 0 : recvtype));
+    int rc = pcoll_entry(r, in_place ? NULL : recvbuf,
+                         in_place ? 0 : (size_t)recvcount * rsz,
+                         request, "MPI_Scatter_init");
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Scatterv_init(const void *sendbuf, const int sendcounts[],
+                      const int displs[], MPI_Datatype sendtype,
+                      void *recvbuf, int recvcount,
+                      MPI_Datatype recvtype, int root, MPI_Comm comm,
+                      MPI_Info info, MPI_Request *request)
+{
+    (void)info;
+    size_t rsz = dt_size(recvtype);
+    if (!rsz || recvcount < 0)
+        return MPI_ERR_TYPE;
+    int size, rank;
+    int qrc = PMPI_Comm_size(comm, &size);
+    if (qrc == MPI_SUCCESS)
+        qrc = PMPI_Comm_rank(comm, &rank);
+    if (qrc != MPI_SUCCESS)
+        return qrc;
+    size_t ssz = 0, in_bytes = 0;
+    if (rank == root) {
+        ssz = dt_size(sendtype);
+        if (!ssz)
+            return MPI_ERR_TYPE;
+        in_bytes = v_extent(sendcounts, displs, size) * ssz;
+    }
+    GIL_BEGIN;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "pcoll_init", "slNlNNil", "scatterv", (long)comm,
+        mem_ro(sendbuf, in_bytes),
+        (long)(rank == root ? sendtype : 0),
+        mem_ro(sendcounts, rank == root
+               ? (size_t)size * sizeof(int) : 0),
+        mem_ro(displs, rank == root ? (size_t)size * sizeof(int) : 0),
+        root, (long)recvtype);
+    int rc = pcoll_entry(r, recvbuf, (size_t)recvcount * rsz,
+                         request, "MPI_Scatterv_init");
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Allgather_init(const void *sendbuf, int sendcount,
+                       MPI_Datatype sendtype, void *recvbuf,
+                       int recvcount, MPI_Datatype recvtype,
+                       MPI_Comm comm, MPI_Info info,
+                       MPI_Request *request)
+{
+    (void)info;
+    size_t rsz = dt_size(recvtype);
+    if (!rsz || recvcount < 0)
+        return MPI_ERR_TYPE;
+    int size, rank;
+    int qrc = PMPI_Comm_size(comm, &size);
+    if (qrc == MPI_SUCCESS)
+        qrc = PMPI_Comm_rank(comm, &rank);
+    if (qrc != MPI_SUCCESS)
+        return qrc;
+    if (sendbuf == MPI_IN_PLACE) {
+        sendbuf = (const char *)recvbuf
+            + (size_t)rank * (size_t)recvcount * rsz;
+        sendcount = recvcount;
+        sendtype = recvtype;
+    }
+    size_t ssz = dt_size(sendtype);
+    if (!ssz || sendcount < 0)
+        return MPI_ERR_TYPE;
+    GIL_BEGIN;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "pcoll_init", "slNll", "allgather", (long)comm,
+        mem_ro(sendbuf, (size_t)sendcount * ssz), (long)sendtype,
+        (long)recvtype);
+    int rc = pcoll_entry(r, recvbuf,
+                         (size_t)size * (size_t)recvcount * rsz,
+                         request, "MPI_Allgather_init");
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Allgatherv_init(const void *sendbuf, int sendcount,
+                        MPI_Datatype sendtype, void *recvbuf,
+                        const int recvcounts[], const int displs[],
+                        MPI_Datatype recvtype, MPI_Comm comm,
+                        MPI_Info info, MPI_Request *request)
+{
+    (void)info;
+    size_t ssz = dt_size(sendtype), rsz = dt_size(recvtype);
+    if (!ssz || !rsz || sendcount < 0)
+        return MPI_ERR_TYPE;
+    int size;
+    int qrc = PMPI_Comm_size(comm, &size);
+    if (qrc != MPI_SUCCESS)
+        return qrc;
+    size_t cap = v_extent(recvcounts, displs, size) * rsz;
+    GIL_BEGIN;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "pcoll_init", "slNllNNN", "allgatherv", (long)comm,
+        mem_ro(sendbuf, (size_t)sendcount * ssz), (long)sendtype,
+        (long)recvtype, mem_ro(recvcounts, (size_t)size * sizeof(int)),
+        mem_ro(displs, (size_t)size * sizeof(int)),
+        mem_ro(recvbuf, cap));
+    int rc = pcoll_entry(r, recvbuf, cap, request,
+                         "MPI_Allgatherv_init");
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Alltoall_init(const void *sendbuf, int sendcount,
+                      MPI_Datatype sendtype, void *recvbuf,
+                      int recvcount, MPI_Datatype recvtype,
+                      MPI_Comm comm, MPI_Info info,
+                      MPI_Request *request)
+{
+    (void)info;
+    size_t rsz = dt_size(recvtype);
+    if (!rsz || recvcount < 0)
+        return MPI_ERR_TYPE;
+    int size;
+    int qrc = PMPI_Comm_size(comm, &size);
+    if (qrc != MPI_SUCCESS)
+        return qrc;
+    if (sendbuf == MPI_IN_PLACE) {
+        sendbuf = recvbuf;
+        sendcount = recvcount;
+        sendtype = recvtype;
+    }
+    size_t ssz = dt_size(sendtype);
+    if (!ssz || sendcount < 0)
+        return MPI_ERR_TYPE;
+    GIL_BEGIN;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "pcoll_init", "slNlil", "alltoall", (long)comm,
+        mem_ro(sendbuf, (size_t)size * (size_t)sendcount * ssz),
+        (long)sendtype, sendcount, (long)recvtype);
+    int rc = pcoll_entry(r, recvbuf,
+                         (size_t)size * (size_t)recvcount * rsz,
+                         request, "MPI_Alltoall_init");
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Alltoallv_init(const void *sendbuf, const int sendcounts[],
+                       const int sdispls[], MPI_Datatype sendtype,
+                       void *recvbuf, const int recvcounts[],
+                       const int rdispls[], MPI_Datatype recvtype,
+                       MPI_Comm comm, MPI_Info info,
+                       MPI_Request *request)
+{
+    (void)info;
+    size_t ssz = dt_size(sendtype), rsz = dt_size(recvtype);
+    if (!ssz || !rsz)
+        return MPI_ERR_TYPE;
+    int size;
+    int qrc = PMPI_Comm_size(comm, &size);
+    if (qrc != MPI_SUCCESS)
+        return qrc;
+    size_t in_bytes = v_extent(sendcounts, sdispls, size) * ssz;
+    size_t cap = v_extent(recvcounts, rdispls, size) * rsz;
+    GIL_BEGIN;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "pcoll_init", "slNlNNlNNN", "alltoallv", (long)comm,
+        mem_ro(sendbuf, in_bytes), (long)sendtype,
+        mem_ro(sendcounts, (size_t)size * sizeof(int)),
+        mem_ro(sdispls, (size_t)size * sizeof(int)), (long)recvtype,
+        mem_ro(recvcounts, (size_t)size * sizeof(int)),
+        mem_ro(rdispls, (size_t)size * sizeof(int)),
+        mem_ro(recvbuf, cap));
+    int rc = pcoll_entry(r, recvbuf, cap, request,
+                         "MPI_Alltoallv_init");
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Alltoallw_init(const void *sendbuf, const int sendcounts[],
+                       const int sdispls[],
+                       const MPI_Datatype sendtypes[], void *recvbuf,
+                       const int recvcounts[], const int rdispls[],
+                       const MPI_Datatype recvtypes[], MPI_Comm comm,
+                       MPI_Info info, MPI_Request *request)
+{
+    (void)info;
+    return flat_w_call("pcoll_alltoallw_init", 2, sendbuf, sendcounts,
+                       sdispls, sendtypes, recvbuf, recvcounts,
+                       rdispls, recvtypes, comm, request,
+                       "MPI_Alltoallw_init");
+}
+
+int PMPI_Reduce_scatter_init(const void *sendbuf, void *recvbuf,
+                            const int recvcounts[],
+                            MPI_Datatype datatype, MPI_Op op,
+                            MPI_Comm comm, MPI_Info info,
+                            MPI_Request *request)
+{
+    (void)info;
+    size_t esz = dt_size(datatype);
+    if (!esz)
+        return MPI_ERR_TYPE;
+    int size, rank;
+    int qrc = PMPI_Comm_size(comm, &size);
+    if (qrc == MPI_SUCCESS)
+        qrc = PMPI_Comm_rank(comm, &rank);
+    if (qrc != MPI_SUCCESS)
+        return qrc;
+    size_t total = 0;
+    for (int i = 0; i < size; i++) {
+        if (recvcounts[i] < 0)
+            return MPI_ERR_COUNT;
+        total += (size_t)recvcounts[i];
+    }
+    GIL_BEGIN;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "pcoll_init", "slNllN", "reduce_scatter", (long)comm,
+        mem_ro(pick_in(sendbuf, recvbuf), total * esz),
+        (long)datatype, (long)op,
+        mem_ro(recvcounts, (size_t)size * sizeof(int)));
+    int rc = pcoll_entry(r, recvbuf,
+                         (size_t)recvcounts[rank] * esz, request,
+                         "MPI_Reduce_scatter_init");
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Reduce_scatter_block_init(const void *sendbuf, void *recvbuf,
+                                  int recvcount, MPI_Datatype datatype,
+                                  MPI_Op op, MPI_Comm comm,
+                                  MPI_Info info, MPI_Request *request)
+{
+    (void)info;
+    size_t esz = dt_size(datatype);
+    if (!esz || recvcount < 0)
+        return MPI_ERR_TYPE;
+    int size;
+    int qrc = PMPI_Comm_size(comm, &size);
+    if (qrc != MPI_SUCCESS)
+        return qrc;
+    GIL_BEGIN;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "pcoll_init", "slNlli", "reduce_scatter_block",
+        (long)comm,
+        mem_ro(pick_in(sendbuf, recvbuf),
+               (size_t)size * (size_t)recvcount * esz),
+        (long)datatype, (long)op, recvcount);
+    int rc = pcoll_entry(r, recvbuf, (size_t)recvcount * esz,
+                         request, "MPI_Reduce_scatter_block_init");
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Neighbor_allgather_init(const void *sendbuf, int sendcount,
+                                MPI_Datatype sendtype, void *recvbuf,
+                                int recvcount, MPI_Datatype recvtype,
+                                MPI_Comm comm, MPI_Info info,
+                                MPI_Request *request)
+{
+    (void)info;
+    size_t ssz = dt_extent(sendtype), rsz = dt_size(recvtype);
+    if (!ssz || !rsz || sendcount < 0 || recvcount < 0)
+        return MPI_ERR_TYPE;
+    int nslots;
+    int qrc = neighbor_count_of(comm, &nslots);
+    if (qrc != MPI_SUCCESS)
+        return qrc;
+    size_t cap = (size_t)nslots * (size_t)recvcount * rsz;
+    GIL_BEGIN;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "pcoll_init", "slNllN", "neighbor_allgather",
+        (long)comm, mem_ro(sendbuf, (size_t)sendcount * ssz),
+        (long)sendtype, (long)recvtype, mem_ro(recvbuf, cap));
+    int rc = pcoll_entry(r, recvbuf, cap, request,
+                         "MPI_Neighbor_allgather_init");
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Neighbor_allgatherv_init(const void *sendbuf, int sendcount,
+                                 MPI_Datatype sendtype, void *recvbuf,
+                                 const int recvcounts[],
+                                 const int displs[],
+                                 MPI_Datatype recvtype, MPI_Comm comm,
+                                 MPI_Info info, MPI_Request *request)
+{
+    (void)info;
+    size_t ssz = dt_extent(sendtype), rsz = dt_size(recvtype);
+    if (!ssz || !rsz || sendcount < 0)
+        return MPI_ERR_TYPE;
+    int nslots;
+    int qrc = neighbor_count_of(comm, &nslots);
+    if (qrc != MPI_SUCCESS)
+        return qrc;
+    size_t cap = v_extent(recvcounts, displs, nslots) * rsz;
+    GIL_BEGIN;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "pcoll_init", "slNllNNN", "neighbor_allgatherv",
+        (long)comm, mem_ro(sendbuf, (size_t)sendcount * ssz),
+        (long)sendtype, (long)recvtype,
+        mem_ro(recvcounts, (size_t)nslots * sizeof(int)),
+        mem_ro(displs, (size_t)nslots * sizeof(int)),
+        mem_ro(recvbuf, cap));
+    int rc = pcoll_entry(r, recvbuf, cap, request,
+                         "MPI_Neighbor_allgatherv_init");
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Neighbor_alltoall_init(const void *sendbuf, int sendcount,
+                               MPI_Datatype sendtype, void *recvbuf,
+                               int recvcount, MPI_Datatype recvtype,
+                               MPI_Comm comm, MPI_Info info,
+                               MPI_Request *request)
+{
+    (void)info;
+    size_t ssz = dt_extent(sendtype), rsz = dt_size(recvtype);
+    if (!ssz || !rsz || sendcount < 0 || recvcount < 0)
+        return MPI_ERR_TYPE;
+    int nslots, nout;
+    int qrc = neighbor_count_of(comm, &nslots);
+    if (qrc == MPI_SUCCESS)
+        qrc = neighbor_out_count_of(comm, &nout);
+    if (qrc != MPI_SUCCESS)
+        return qrc;
+    size_t cap = (size_t)nslots * (size_t)recvcount * rsz;
+    GIL_BEGIN;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "pcoll_init", "slNlilN", "neighbor_alltoall",
+        (long)comm,
+        mem_ro(sendbuf, (size_t)nout * (size_t)sendcount * ssz),
+        (long)sendtype, sendcount, (long)recvtype,
+        mem_ro(recvbuf, cap));
+    int rc = pcoll_entry(r, recvbuf, cap, request,
+                         "MPI_Neighbor_alltoall_init");
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Neighbor_alltoallv_init(const void *sendbuf,
+                                const int sendcounts[],
+                                const int sdispls[],
+                                MPI_Datatype sendtype, void *recvbuf,
+                                const int recvcounts[],
+                                const int rdispls[],
+                                MPI_Datatype recvtype, MPI_Comm comm,
+                                MPI_Info info, MPI_Request *request)
+{
+    (void)info;
+    size_t ssz = dt_size(sendtype), rsz = dt_size(recvtype);
+    if (!ssz || !rsz)
+        return MPI_ERR_TYPE;
+    int nslots, nout;
+    int qrc = neighbor_count_of(comm, &nslots);
+    if (qrc == MPI_SUCCESS)
+        qrc = neighbor_out_count_of(comm, &nout);
+    if (qrc != MPI_SUCCESS)
+        return qrc;
+    size_t in_bytes = v_extent(sendcounts, sdispls, nout) * ssz;
+    size_t cap = v_extent(recvcounts, rdispls, nslots) * rsz;
+    GIL_BEGIN;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "pcoll_init", "slNlNNlNNN", "neighbor_alltoallv",
+        (long)comm, mem_ro(sendbuf, in_bytes), (long)sendtype,
+        mem_ro(sendcounts, (size_t)nout * sizeof(int)),
+        mem_ro(sdispls, (size_t)nout * sizeof(int)), (long)recvtype,
+        mem_ro(recvcounts, (size_t)nslots * sizeof(int)),
+        mem_ro(rdispls, (size_t)nslots * sizeof(int)),
+        mem_ro(recvbuf, cap));
+    int rc = pcoll_entry(r, recvbuf, cap, request,
+                         "MPI_Neighbor_alltoallv_init");
+    GIL_END;
+    return rc;
 }
 
 /* ------------------------------------------------------------------ */

@@ -3999,6 +3999,174 @@ def t_category_get_pvars(i: int) -> bytes:
     return np.asarray(idxs, np.int32).tobytes()
 
 
+# ---------------------------------------------------------------------
+# neighbor v/w collectives (neighbor_allgatherv.c.in,
+# neighbor_alltoallv.c.in, neighbor_alltoallw.c.in)
+# ---------------------------------------------------------------------
+def _overlay_v_rows(rows, rdt: int, counts, displs, curview) -> bytes:
+    """Per-slot overlay at explicit element displacements in
+    topology-neighbor order; None slots (PROC_NULL neighbors on
+    non-periodic edges) keep the caller's bytes."""
+    cur = np.frombuffer(curview, _dtype(rdt)).copy()
+    for i, row in enumerate(rows):
+        if row is None:
+            continue
+        seg = np.asarray(row).ravel()[:int(counts[i])]
+        if seg.dtype != cur.dtype:
+            seg = seg.astype(cur.dtype)
+        cur[int(displs[i]):int(displs[i]) + seg.size] = seg
+    return cur.tobytes()
+
+
+def neighbor_allgatherv(h: int, view, sdt: int, rdt: int, counts_view,
+                        displs_view, curview) -> bytes:
+    c = _comm(h)
+    rows = c.neighbor_allgather(_pack(view, sdt, _count_of(view, sdt)))
+    return _overlay_v_rows(rows, rdt, _ints(counts_view),
+                           _ints(displs_view), curview)
+
+
+def neighbor_alltoallv(h: int, view, sdt: int, scounts_v, sdispls_v,
+                       rdt: int, rcounts_v, rdispls_v,
+                       curview) -> bytes:
+    c = _comm(h)
+    sc, sd = _ints(scounts_v), _ints(sdispls_v)
+    a = _arr(view, sdt)
+    n_out = neighbor_out_count(h)
+    chunks = [a[int(sd[i]):int(sd[i]) + int(sc[i])]
+              for i in range(n_out)]
+    rows = c.neighbor_alltoall(chunks)
+    return _overlay_v_rows(rows, rdt, _ints(rcounts_v),
+                           _ints(rdispls_v), curview)
+
+
+def neighbor_alltoallw(h: int, sview, scounts_v, sdispls_v, stypes_v,
+                       rview, rcounts_v, rdispls_v, rtypes_v) -> bytes:
+    """w-variant over the topology: per-neighbor datatypes with BYTE
+    (MPI_Aint) displacements, exactly the flat alltoallw marshalling
+    per slot."""
+    c = _comm(h)
+    n_out = neighbor_out_count(h)
+    n_in = neighbor_count(h)
+    scounts = [int(x) for x in _ints(scounts_v)]
+    sdispls = np.frombuffer(bytes(sdispls_v), dtype=np.int64)
+    stypes = np.frombuffer(bytes(stypes_v), dtype=np.int64)
+    sbytes = bytes(sview)
+    chunks = []
+    for j in range(n_out):
+        dtj, cj, off = int(stypes[j]), scounts[j], int(sdispls[j])
+        wl = _window_len(dtj, cj)
+        chunks.append(_pack(memoryview(sbytes)[off:off + wl], dtj, cj))
+    rows = c.neighbor_alltoall(chunks)
+    rcounts = [int(x) for x in _ints(rcounts_v)]
+    rdispls = np.frombuffer(bytes(rdispls_v), dtype=np.int64)
+    rtypes = np.frombuffer(bytes(rtypes_v), dtype=np.int64)
+    cur = bytearray(bytes(rview))
+    for j in range(n_in):
+        if j >= len(rows) or rows[j] is None:
+            continue
+        dtj, cj, off = int(rtypes[j]), rcounts[j], int(rdispls[j])
+        wl = _window_len(dtj, cj)
+        img, _tr = _unpack(rows[j], dtj, cj, bytes(cur[off:off + wl]))
+        cur[off:off + wl] = img
+    return bytes(cur)
+
+
+def ineighbor_allgatherv(h: int, view, sdt: int, rdt: int, counts_view,
+                         displs_view, curview) -> int:
+    counts, displs = bytes(counts_view), bytes(displs_view)
+    snap = bytes(curview)
+    return _icoll_bytes(h, lambda: neighbor_allgatherv(
+        h, view, sdt, rdt, counts, displs, snap))
+
+
+def ineighbor_alltoallv(h: int, view, sdt: int, sc_v, sd_v, rdt: int,
+                        rc_v, rd_v, curview) -> int:
+    sc, sd, rc_, rd = bytes(sc_v), bytes(sd_v), bytes(rc_v), bytes(rd_v)
+    snap = bytes(curview)
+    return _icoll_bytes(h, lambda: neighbor_alltoallv(
+        h, view, sdt, sc, sd, rdt, rc_, rd, snap))
+
+
+def ineighbor_alltoallw(h: int, sview, sc_v, sd_v, st_v, rview, rc_v,
+                        rd_v, rt_v) -> int:
+    sc, sd, st = bytes(sc_v), bytes(sd_v), bytes(st_v)
+    rc_, rd, rt = bytes(rc_v), bytes(rd_v), bytes(rt_v)
+    return _icoll_bytes(h, lambda: neighbor_alltoallw(
+        h, sview, sc, sd, st, rview, rc_, rd, rt))
+
+
+def ialltoallw(h: int, sview, sc_v, sd_v, st_v, rview, rc_v, rd_v,
+               rt_v) -> int:
+    """MPI_Ialltoallw over the nonblocking worker (the per-peer
+    marshalling runs there too — real overlap on per-rank comms)."""
+    sc, sd, st = bytes(sc_v), bytes(sd_v), bytes(st_v)
+    rc_, rd, rt = bytes(rc_v), bytes(rd_v), bytes(rt_v)
+    return _icoll_bytes(h, lambda: alltoallw(
+        h, sview, sc, sd, st, rview, rc_, rd, rt))
+
+
+# ---------------------------------------------------------------------
+# persistent collectives (MPI-4 *_init family; allreduce_init.c.in,
+# barrier_init.c.in, ... — the reference routes them through
+# ompi/mca/coll's *_init slots). Each MPI_X_init captures the
+# nonblocking marshaller with its C-side argument VIEWS held live (not
+# snapshotted): persistent semantics — the send buffer and the
+# count/displacement arrays are re-read at every MPI_Start, and MPI-4
+# requires the caller keep them valid and unchanged until
+# MPI_Request_free.
+# ---------------------------------------------------------------------
+_pcolls: Dict[int, Any] = {}
+_next_pcoll = itertools.count(1)
+
+
+def _pcoll_register(thunk) -> int:
+    with _lock:
+        ph = next(_next_pcoll)
+        _pcolls[ph] = thunk
+    return ph
+
+
+def pcoll_init(name: str, *args) -> int:
+    fn = globals()["i" + name]
+    return _pcoll_register(lambda: fn(*args))
+
+
+def pcoll_alltoallw_init(h: int, sview, sc_v, sd_v, st_v, rview, rc_v,
+                         rd_v, rt_v) -> int:
+    """The w-variants' datatype arrays are C-side TEMPORARIES (the
+    wrapper widens MPI_Datatype[] to int64 in malloc'd scratch freed
+    on return), so they are snapshotted at init; the data buffers
+    stay live per persistent semantics."""
+    sc, sd, st = bytes(sc_v), bytes(sd_v), bytes(st_v)
+    rc_, rd, rt = bytes(rc_v), bytes(rd_v), bytes(rt_v)
+    return _pcoll_register(lambda: ialltoallw(
+        h, sview, sc, sd, st, rview, rc_, rd, rt))
+
+
+def pcoll_neighbor_alltoallw_init(h: int, sview, sc_v, sd_v, st_v,
+                                  rview, rc_v, rd_v, rt_v) -> int:
+    sc, sd, st = bytes(sc_v), bytes(sd_v), bytes(st_v)
+    rc_, rd, rt = bytes(rc_v), bytes(rd_v), bytes(rt_v)
+    return _pcoll_register(lambda: ineighbor_alltoallw(
+        h, sview, sc, sd, st, rview, rc_, rd, rt))
+
+
+def pcoll_start(ph: int) -> int:
+    """MPI_Start on a persistent collective: dispatch a fresh
+    nonblocking operation; returns the inner request handle the
+    ordinary wait/test paths complete."""
+    thunk = _pcolls.get(ph)
+    if thunk is None:
+        raise MPIError(ERR_REQUEST,
+                       "stale persistent-collective handle")
+    return thunk()
+
+
+def pcoll_free(ph: int) -> None:
+    _pcolls.pop(ph, None)
+
+
 # activate the constructor-envelope recorders (must run after every
 # constructor definition; see _record_env_wrappers)
 _record_env_wrappers()

@@ -1,0 +1,291 @@
+/* MPI-4 persistent collectives (*_init/Start/Wait rounds: buffers
+ * re-read at every Start, request reusable until Request_free) and
+ * the neighbor v/w collective family (Neighbor_allgatherv/alltoallv/
+ * alltoallw + nonblocking variants) on a 2x2 periodic cartesian
+ * grid.  Runs with -n 4. */
+#include <mpi.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#define CHECK(cond, code)                                            \
+    do {                                                             \
+        if (!(cond)) {                                               \
+            fprintf(stderr, "rank %d: check failed at line %d\n",    \
+                    rank, __LINE__);                                 \
+            MPI_Abort(MPI_COMM_WORLD, code);                         \
+        }                                                            \
+    } while (0)
+
+int main(int argc, char **argv)
+{
+    MPI_Init(&argc, &argv);
+    int rank, size;
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &size);
+    CHECK(size == 4, 1);
+
+    /* ---- persistent allreduce: 3 rounds, the send buffer refilled
+     * between Starts (the round counter must be re-read each time) */
+    double in[4], out[4];
+    MPI_Request pr;
+    CHECK(MPI_Allreduce_init(in, out, 4, MPI_DOUBLE, MPI_SUM,
+                             MPI_COMM_WORLD, MPI_INFO_NULL,
+                             &pr) == MPI_SUCCESS, 2);
+    for (int round = 1; round <= 3; round++) {
+        for (int i = 0; i < 4; i++)
+            in[i] = (double)(rank + round * 10 + i);
+        CHECK(MPI_Start(&pr) == MPI_SUCCESS, 3);
+        MPI_Status st;
+        CHECK(MPI_Wait(&pr, &st) == MPI_SUCCESS, 4);
+        CHECK(pr != MPI_REQUEST_NULL, 5);   /* persistent survives */
+        for (int i = 0; i < 4; i++) {
+            double want = (double)(0 + 1 + 2 + 3)
+                + 4.0 * (double)(round * 10 + i);
+            CHECK(out[i] == want, 6);
+        }
+    }
+    /* inactive wait completes immediately */
+    MPI_Status ist;
+    CHECK(MPI_Wait(&pr, &ist) == MPI_SUCCESS, 7);
+    CHECK(MPI_Request_free(&pr) == MPI_SUCCESS, 8);
+    CHECK(pr == MPI_REQUEST_NULL, 9);
+
+    /* ---- persistent bcast + barrier via Startall */
+    int payload[2] = {-1, -1};
+    MPI_Request duo[2];
+    CHECK(MPI_Bcast_init(payload, 2, MPI_INT, 0, MPI_COMM_WORLD,
+                         MPI_INFO_NULL, &duo[0]) == MPI_SUCCESS, 10);
+    CHECK(MPI_Barrier_init(MPI_COMM_WORLD, MPI_INFO_NULL,
+                           &duo[1]) == MPI_SUCCESS, 11);
+    for (int round = 0; round < 2; round++) {
+        if (rank == 0) {
+            payload[0] = 100 + round;
+            payload[1] = 200 + round;
+        } else {
+            payload[0] = payload[1] = -1;
+        }
+        CHECK(MPI_Startall(2, duo) == MPI_SUCCESS, 12);
+        CHECK(MPI_Waitall(2, duo, MPI_STATUSES_IGNORE)
+              == MPI_SUCCESS, 13);
+        CHECK(payload[0] == 100 + round && payload[1] == 200 + round,
+              14);
+    }
+    MPI_Request_free(&duo[0]);
+    MPI_Request_free(&duo[1]);
+
+    /* ---- persistent gatherv: uneven counts at explicit displs */
+    int mine[3];
+    int nmine = rank % 2 + 1;            /* ranks contribute 1 or 2 */
+    int counts[4], displs[4], rbuf[12];
+    int total = 0;
+    for (int i = 0; i < 4; i++) {
+        counts[i] = i % 2 + 1;
+        displs[i] = 3 * i;               /* gaps between segments */
+        total += counts[i];
+    }
+    MPI_Request gv;
+    CHECK(MPI_Gatherv_init(mine, nmine, MPI_INT, rbuf, counts, displs,
+                           MPI_INT, 0, MPI_COMM_WORLD, MPI_INFO_NULL,
+                           &gv) == MPI_SUCCESS, 15);
+    for (int round = 0; round < 2; round++) {
+        for (int i = 0; i < nmine; i++)
+            mine[i] = 1000 * round + 10 * rank + i;
+        for (int i = 0; i < 12; i++)
+            rbuf[i] = -7;                /* gap sentinel */
+        CHECK(MPI_Start(&gv) == MPI_SUCCESS, 16);
+        CHECK(MPI_Wait(&gv, MPI_STATUS_IGNORE) == MPI_SUCCESS, 17);
+        if (rank == 0) {
+            for (int i = 0; i < 4; i++)
+                for (int k = 0; k < counts[i]; k++)
+                    CHECK(rbuf[displs[i] + k]
+                          == 1000 * round + 10 * i + k, 18);
+            CHECK(rbuf[1] == -7 && rbuf[2] == -7, 19);  /* gaps live */
+        }
+    }
+    MPI_Request_free(&gv);
+
+    /* ---- 2x2 periodic cart for the neighbor family: every rank has
+     * 4 neighbor slots (-x, +x, -y, +y); on a 2-torus the two x
+     * neighbors coincide, as do the two y neighbors */
+    int dims[2] = {2, 2}, periods[2] = {1, 1};
+    MPI_Comm cart;
+    CHECK(MPI_Cart_create(MPI_COMM_WORLD, 2, dims, periods, 0, &cart)
+          == MPI_SUCCESS, 20);
+    int xsrc, xdst, ysrc, ydst;
+    MPI_Cart_shift(cart, 0, 1, &xsrc, &xdst);
+    MPI_Cart_shift(cart, 1, 1, &ysrc, &ydst);
+    int nb[4] = {xsrc, xdst, ysrc, ydst};
+
+    /* neighbor_allgatherv: each rank publishes (rank+1) ints; slots
+     * land at spaced displacements */
+    int ncounts[4], ndispls[4], nrbuf[20];
+    for (int i = 0; i < 4; i++) {
+        ncounts[i] = nb[i] + 1;
+        ndispls[i] = 5 * i;
+    }
+    int pub[5];
+    for (int i = 0; i < rank + 1; i++)
+        pub[i] = 100 * rank + i;
+    for (int i = 0; i < 20; i++)
+        nrbuf[i] = -3;
+    CHECK(MPI_Neighbor_allgatherv(pub, rank + 1, MPI_INT, nrbuf,
+                                  ncounts, ndispls, MPI_INT, cart)
+          == MPI_SUCCESS, 21);
+    for (int i = 0; i < 4; i++)
+        for (int k = 0; k < ncounts[i]; k++)
+            CHECK(nrbuf[ndispls[i] + k] == 100 * nb[i] + k, 22);
+
+    /* ineighbor_allgatherv matches the blocking result */
+    int nrbuf2[20];
+    for (int i = 0; i < 20; i++)
+        nrbuf2[i] = -3;
+    MPI_Request nreq;
+    CHECK(MPI_Ineighbor_allgatherv(pub, rank + 1, MPI_INT, nrbuf2,
+                                   ncounts, ndispls, MPI_INT, cart,
+                                   &nreq) == MPI_SUCCESS, 23);
+    CHECK(MPI_Wait(&nreq, MPI_STATUS_IGNORE) == MPI_SUCCESS, 24);
+    CHECK(memcmp(nrbuf, nrbuf2, sizeof nrbuf) == 0, 25);
+
+    /* neighbor_alltoallv: distinct chunk per neighbor slot */
+    int sc[4], sd[4], rc[4], rd[4], sbuf[8], rbufv[8];
+    for (int i = 0; i < 4; i++) {
+        sc[i] = 2;
+        sd[i] = 2 * i;
+        rc[i] = 2;
+        rd[i] = 2 * i;
+        sbuf[2 * i] = 1000 * rank + 10 * i;
+        sbuf[2 * i + 1] = 1000 * rank + 10 * i + 1;
+    }
+    memset(rbufv, 0xff, sizeof rbufv);
+    CHECK(MPI_Neighbor_alltoallv(sbuf, sc, sd, MPI_INT, rbufv, rc, rd,
+                                 MPI_INT, cart) == MPI_SUCCESS, 26);
+    /* slot i received what nb[i] sent in ITS lane i: on a 2-torus
+     * both lanes of a dimension address the SAME peer, so message
+     * pairing is by posting order (non-overtaking) — the mapping is
+     * identity, unlike the swapped -/+ mapping on rings >= 3 */
+    {
+        static const int peer_slot[4] = {0, 1, 2, 3};
+        for (int i = 0; i < 4; i++) {
+            CHECK(rbufv[2 * i]
+                  == 1000 * nb[i] + 10 * peer_slot[i], 27);
+            CHECK(rbufv[2 * i + 1]
+                  == 1000 * nb[i] + 10 * peer_slot[i] + 1, 28);
+        }
+    }
+
+    /* neighbor_alltoallw: per-slot types with byte displacements.
+     * Slot i's arriving data is the peer's lane i (2-torus identity
+     * pairing), so recv types mirror the send types (signature
+     * match). */
+    {
+        static const int pslot[4] = {0, 1, 2, 3};
+        int wsend_i[2] = {7 + rank, 8 + rank};
+        double wsend_d[2] = {0.5 + rank, 1.5 + rank};
+        char wsbuf[64], wrbuf[64];
+        memcpy(wsbuf, wsend_i, sizeof wsend_i);          /* lane 0 */
+        memcpy(wsbuf + 16, wsend_d, sizeof wsend_d);     /* lane 1 */
+        memcpy(wsbuf + 32, wsend_i, sizeof wsend_i);     /* lane 2 */
+        memcpy(wsbuf + 48, wsend_d, sizeof wsend_d);     /* lane 3 */
+        int wsc[4] = {2, 2, 2, 2}, wrc[4] = {2, 2, 2, 2};
+        MPI_Aint wsd[4] = {0, 16, 32, 48}, wrd[4] = {0, 16, 32, 48};
+        MPI_Datatype wst[4] = {MPI_INT, MPI_DOUBLE, MPI_INT,
+                               MPI_DOUBLE};
+        MPI_Datatype wrt[4] = {MPI_INT, MPI_DOUBLE, MPI_INT,
+                               MPI_DOUBLE};
+        memset(wrbuf, 0, sizeof wrbuf);
+        CHECK(MPI_Neighbor_alltoallw(wsbuf, wsc, wsd, wst, wrbuf, wrc,
+                                     wrd, wrt, cart) == MPI_SUCCESS,
+              29);
+        for (int i = 0; i < 4; i++) {
+            if (pslot[i] % 2 == 0) {         /* peer lane sent ints */
+                int got[2];
+                memcpy(got, wrbuf + wrd[i], sizeof got);
+                CHECK(got[0] == 7 + nb[i] && got[1] == 8 + nb[i], 30);
+            } else {                         /* peer lane sent dbls */
+                double got[2];
+                memcpy(got, wrbuf + wrd[i], sizeof got);
+                CHECK(got[0] == 0.5 + nb[i] && got[1] == 1.5 + nb[i],
+                      31);
+            }
+        }
+    }
+
+    /* ---- persistent neighbor_alltoall: 2 rounds on the cart */
+    {
+        int ps[4], prv[4];
+        MPI_Request pn;
+        CHECK(MPI_Neighbor_alltoall_init(ps, 1, MPI_INT, prv, 1,
+                                         MPI_INT, cart, MPI_INFO_NULL,
+                                         &pn) == MPI_SUCCESS, 32);
+        static const int pslot[4] = {0, 1, 2, 3};
+        for (int round = 0; round < 2; round++) {
+            for (int i = 0; i < 4; i++)
+                ps[i] = 100 * round + 10 * rank + i;
+            CHECK(MPI_Start(&pn) == MPI_SUCCESS, 33);
+            CHECK(MPI_Wait(&pn, MPI_STATUS_IGNORE) == MPI_SUCCESS,
+                  34);
+            for (int i = 0; i < 4; i++)
+                CHECK(prv[i] == 100 * round + 10 * nb[i] + pslot[i],
+                      35);
+        }
+        MPI_Request_free(&pn);
+    }
+
+    /* ---- persistent alltoallw on WORLD: per-peer dtypes, 2 rounds.
+     * My send lane j is typed wt[j]; peer j hands me its lane of
+     * index MY RANK, typed wt[rank] — so every recv slot uses
+     * wt[rank] (signature match). */
+    {
+        char wsbuf[64], wrbuf[64];
+        int wsc[4] = {2, 2, 2, 2}, wrc[4] = {2, 2, 2, 2};
+        int wsd[4] = {0, 16, 32, 48}, wrd[4] = {0, 16, 32, 48};
+        MPI_Datatype wt[4] = {MPI_INT, MPI_DOUBLE, MPI_INT,
+                              MPI_DOUBLE};
+        MPI_Datatype wrt[4];
+        for (int j = 0; j < 4; j++)
+            wrt[j] = wt[rank];
+        MPI_Request wreq;
+        CHECK(MPI_Alltoallw_init(wsbuf, wsc, wsd, wt, wrbuf, wrc, wrd,
+                                 wrt, MPI_COMM_WORLD, MPI_INFO_NULL,
+                                 &wreq) == MPI_SUCCESS, 36);
+        for (int round = 0; round < 2; round++) {
+            for (int j = 0; j < 4; j++) {
+                if (j % 2 == 0) {
+                    int v[2] = {round + rank * 10 + j,
+                                round + rank * 10 + j + 1};
+                    memcpy(wsbuf + wsd[j], v, sizeof v);
+                } else {
+                    double v[2] = {round + rank * 10 + j + 0.25,
+                                   round + rank * 10 + j + 0.75};
+                    memcpy(wsbuf + wsd[j], v, sizeof v);
+                }
+            }
+            memset(wrbuf, 0, sizeof wrbuf);
+            CHECK(MPI_Start(&wreq) == MPI_SUCCESS, 37);
+            CHECK(MPI_Wait(&wreq, MPI_STATUS_IGNORE) == MPI_SUCCESS,
+                  38);
+            /* slot j holds peer j's lane #rank, typed wt[rank] */
+            for (int j = 0; j < 4; j++) {
+                if (rank % 2 == 0) {
+                    int got[2];
+                    memcpy(got, wrbuf + wrd[j], sizeof got);
+                    CHECK(got[0] == round + j * 10 + rank
+                          && got[1] == round + j * 10 + rank + 1, 39);
+                } else {
+                    double got[2];
+                    memcpy(got, wrbuf + wrd[j], sizeof got);
+                    CHECK(got[0] == round + j * 10 + rank + 0.25
+                          && got[1] == round + j * 10 + rank + 0.75,
+                          40);
+                }
+            }
+        }
+        MPI_Request_free(&wreq);
+    }
+
+    MPI_Comm_free(&cart);
+    printf("OK c30_persist_coll\n");
+    MPI_Finalize();
+    return 0;
+}
