@@ -95,6 +95,7 @@ typedef void (MPI_User_function)(void *invec, void *inoutvec, int *len,
 #define MPI_ROOT        (-3)
 #define MPI_UNDEFINED   (-32766)
 #define MPI_IN_PLACE    ((void *)1)
+#define MPI_BOTTOM      ((void *)0)
 
 #define MPI_KEYVAL_INVALID (-1)
 typedef int (MPI_Copy_function)(MPI_Comm, int, void *, void *, void *,
@@ -104,6 +105,38 @@ typedef int (MPI_Delete_function)(MPI_Comm, int, void *, void *);
 #define MPI_COMM_NULL_COPY_FN   ((MPI_Copy_function *)0)
 #define MPI_COMM_DUP_FN         ((MPI_Copy_function *)1)
 #define MPI_COMM_NULL_DELETE_FN ((MPI_Delete_function *)0)
+/* modern attr-callback names (identical signatures — handles are
+ * integer tokens here, so the comm shapes carry over) plus the
+ * win/type attribute chapters */
+typedef MPI_Copy_function MPI_Comm_copy_attr_function;
+typedef MPI_Delete_function MPI_Comm_delete_attr_function;
+typedef int (MPI_Type_copy_attr_function)(MPI_Datatype, int, void *,
+                                          void *, void *, int *);
+typedef int (MPI_Type_delete_attr_function)(MPI_Datatype, int, void *,
+                                            void *);
+#define MPI_TYPE_NULL_COPY_FN   ((MPI_Type_copy_attr_function *)0)
+#define MPI_TYPE_DUP_FN         ((MPI_Type_copy_attr_function *)1)
+#define MPI_TYPE_NULL_DELETE_FN ((MPI_Type_delete_attr_function *)0)
+/* predefined attributes (odd small ints, the OMPI convention; user
+ * keyvals start far above) */
+#define MPI_TAG_UB          11
+#define MPI_HOST            13
+#define MPI_IO              15
+#define MPI_WTIME_IS_GLOBAL 17
+#define MPI_WIN_BASE          21
+#define MPI_WIN_SIZE          23
+#define MPI_WIN_DISP_UNIT     25
+#define MPI_WIN_CREATE_FLAVOR 27
+#define MPI_WIN_MODEL         29
+#define MPI_WIN_FLAVOR_CREATE   1
+#define MPI_WIN_FLAVOR_ALLOCATE 2
+#define MPI_WIN_FLAVOR_DYNAMIC  3
+#define MPI_WIN_FLAVOR_SHARED   4
+#define MPI_WIN_SEPARATE 1
+#define MPI_WIN_UNIFIED  2
+/* user errhandler callbacks (MPI_Comm_create_errhandler family) */
+typedef void (MPI_Comm_errhandler_function)(MPI_Comm *, int *, ...);
+typedef MPI_Comm_errhandler_function MPI_Comm_errhandler_fn;
 #define MPI_MAX_INFO_KEY 256
 #define MPI_MAX_INFO_VAL 1024
 
@@ -132,6 +165,17 @@ typedef long MPI_Session;
 #define MPI_MAX_STRINGTAG_LEN 256
 typedef long MPI_Win;
 typedef long MPI_File;
+typedef int (MPI_Win_copy_attr_function)(MPI_Win, int, void *, void *,
+                                         void *, int *);
+typedef int (MPI_Win_delete_attr_function)(MPI_Win, int, void *,
+                                           void *);
+#define MPI_WIN_NULL_COPY_FN    ((MPI_Win_copy_attr_function *)0)
+#define MPI_WIN_DUP_FN          ((MPI_Win_copy_attr_function *)1)
+#define MPI_WIN_NULL_DELETE_FN  ((MPI_Win_delete_attr_function *)0)
+typedef void (MPI_Win_errhandler_function)(MPI_Win *, int *, ...);
+typedef void (MPI_File_errhandler_function)(MPI_File *, int *, ...);
+typedef void (MPI_Session_errhandler_function)(MPI_Session *, int *,
+                                               ...);
 typedef long long MPI_Offset;
 typedef long long MPI_Count;             /* MPI-4 bigcount */
 typedef long MPI_Message;                /* matched-probe messages */
@@ -271,6 +315,58 @@ int MPI_Comm_set_attr(MPI_Comm comm, int comm_keyval,
 int MPI_Comm_get_attr(MPI_Comm comm, int comm_keyval,
                       void *attribute_val, int *flag);
 int MPI_Comm_delete_attr(MPI_Comm comm, int comm_keyval);
+
+/* ---- win/type keyvals, deprecated attr API, errhandler chapter ---- */
+int MPI_Win_create_keyval(MPI_Win_copy_attr_function *win_copy_attr_fn,
+                          MPI_Win_delete_attr_function
+                          *win_delete_attr_fn,
+                          int *win_keyval, void *extra_state);
+int MPI_Win_free_keyval(int *win_keyval);
+int MPI_Win_set_attr(MPI_Win win, int win_keyval, void *attribute_val);
+int MPI_Win_get_attr(MPI_Win win, int win_keyval, void *attribute_val,
+                     int *flag);
+int MPI_Win_delete_attr(MPI_Win win, int win_keyval);
+int MPI_Type_create_keyval(MPI_Type_copy_attr_function
+                           *type_copy_attr_fn,
+                           MPI_Type_delete_attr_function
+                           *type_delete_attr_fn,
+                           int *type_keyval, void *extra_state);
+int MPI_Type_free_keyval(int *type_keyval);
+int MPI_Type_set_attr(MPI_Datatype datatype, int type_keyval,
+                      void *attribute_val);
+int MPI_Type_get_attr(MPI_Datatype datatype, int type_keyval,
+                      void *attribute_val, int *flag);
+int MPI_Type_delete_attr(MPI_Datatype datatype, int type_keyval);
+int MPI_Keyval_create(MPI_Copy_function *copy_fn,
+                      MPI_Delete_function *delete_fn, int *keyval,
+                      void *extra_state);
+int MPI_Keyval_free(int *keyval);
+int MPI_Attr_put(MPI_Comm comm, int keyval, void *attribute_val);
+int MPI_Attr_get(MPI_Comm comm, int keyval, void *attribute_val,
+                 int *flag);
+int MPI_Attr_delete(MPI_Comm comm, int keyval);
+int MPI_Comm_create_errhandler(MPI_Comm_errhandler_function *fn,
+                               MPI_Errhandler *errhandler);
+int MPI_Win_create_errhandler(MPI_Win_errhandler_function *fn,
+                              MPI_Errhandler *errhandler);
+int MPI_Win_set_errhandler(MPI_Win win, MPI_Errhandler errhandler);
+int MPI_Win_get_errhandler(MPI_Win win, MPI_Errhandler *errhandler);
+int MPI_Win_call_errhandler(MPI_Win win, int errorcode);
+int MPI_File_create_errhandler(MPI_File_errhandler_function *fn,
+                               MPI_Errhandler *errhandler);
+int MPI_File_set_errhandler(MPI_File file, MPI_Errhandler errhandler);
+int MPI_File_get_errhandler(MPI_File file, MPI_Errhandler *errhandler);
+int MPI_File_call_errhandler(MPI_File fh, int errorcode);
+int MPI_Session_create_errhandler(MPI_Session_errhandler_function *fn,
+                                  MPI_Errhandler *errhandler);
+int MPI_Session_set_errhandler(MPI_Session session,
+                               MPI_Errhandler errhandler);
+int MPI_Session_get_errhandler(MPI_Session session,
+                               MPI_Errhandler *errhandler);
+int MPI_Session_call_errhandler(MPI_Session session, int errorcode);
+int MPI_Remove_error_class(int errorclass);
+int MPI_Remove_error_code(int errorcode);
+int MPI_Remove_error_string(int errorcode);
 int MPI_Comm_get_errhandler(MPI_Comm comm, MPI_Errhandler *errhandler);
 int MPI_Errhandler_free(MPI_Errhandler *errhandler);
 int MPI_Comm_call_errhandler(MPI_Comm comm, int errorcode);

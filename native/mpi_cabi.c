@@ -241,7 +241,19 @@ static void errh_set(MPI_Comm c, MPI_Errhandler eh)
 
 /* Called with the GIL held and a Python exception set.  Returns the
  * error code to hand back (ERRORS_RETURN) or exits (ERRORS_ARE_FATAL). */
-static int handle_error_eh(const char *func, MPI_Errhandler eh)
+/* USER errhandlers (MPI_Comm/Win/File/Session_create_errhandler):
+ * handles >= ERRH_USER_BASE index a table of C function pointers.
+ * Every object-handle class is a long here, so one generic shape —
+ * void fn(long *handle, int *code, ...) — serves all four object
+ * classes (errhandler.h's per-class function types coincide). */
+#define ERRH_USER_BASE 16
+#define ERRH_USER_MAX 64
+typedef void (uerrh_fn)(long *, int *, ...);
+static uerrh_fn *g_uerrh[ERRH_USER_MAX];
+static int g_uerrh_n;
+
+static int handle_error_eh_obj(const char *func, MPI_Errhandler eh,
+                               long obj)
 {
     PyObject *type, *value, *tb;
     PyErr_Fetch(&type, &value, &tb);
@@ -254,6 +266,15 @@ static int handle_error_eh(const char *func, MPI_Errhandler eh)
         } else {
             PyErr_Clear();
         }
+    }
+    if (eh >= ERRH_USER_BASE
+        && eh - ERRH_USER_BASE < (MPI_Errhandler)g_uerrh_n
+        && g_uerrh[eh - ERRH_USER_BASE]) {
+        Py_XDECREF(type);
+        Py_XDECREF(value);
+        Py_XDECREF(tb);
+        g_uerrh[eh - ERRH_USER_BASE](&obj, &code);
+        return code;                     /* handler returned: resume */
     }
     if (eh == MPI_ERRORS_RETURN) {
         Py_XDECREF(type);
@@ -268,19 +289,104 @@ static int handle_error_eh(const char *func, MPI_Errhandler eh)
     exit(code > 0 && code < 126 ? code : 1);
 }
 
+static int handle_error_eh(const char *func, MPI_Errhandler eh)
+{
+    return handle_error_eh_obj(func, eh, (long)MPI_COMM_WORLD);
+}
+
 static int handle_error(const char *func)
 {
     /* errors with no communicator attach to MPI_COMM_WORLD's handler
      * (MPI-3.1 8.3: "errors that are not associated with any object
      * are considered attached to MPI_COMM_WORLD"); the global default
      * backs it when the world has no per-comm entry */
-    return handle_error_eh(func, errh_for(MPI_COMM_WORLD));
+    return handle_error_eh_obj(func, errh_for(MPI_COMM_WORLD),
+                               (long)MPI_COMM_WORLD);
 }
 
 static int handle_error_comm(MPI_Comm comm, const char *func)
 {
-    return handle_error_eh(func, errh_for(comm));
+    return handle_error_eh_obj(func, errh_for(comm), (long)comm);
 }
+
+/* per-object errhandler tables for windows/files/sessions (the
+ * errhandler.h object classes beyond communicators). Files default
+ * to MPI_ERRORS_RETURN (MPI-4 14.7); windows and sessions inherit
+ * the process default. */
+#define OBJ_ERRH_MAX 128
+static struct { long obj; MPI_Errhandler errh; }
+    g_win_errh[OBJ_ERRH_MAX], g_file_errh[OBJ_ERRH_MAX],
+    g_sess_errh[OBJ_ERRH_MAX];
+static int g_win_errh_n, g_file_errh_n, g_sess_errh_n;
+
+static MPI_Errhandler obj_errh_get(const void *tab_, int n, long obj,
+                                   MPI_Errhandler dflt)
+{
+    const struct { long obj; MPI_Errhandler errh; } *tab = tab_;
+    for (int i = n - 1; i >= 0; i--)
+        if (tab[i].obj == obj)
+            return tab[i].errh;
+    return dflt;
+}
+
+static int obj_errh_set(void *tab_, int *n, long obj,
+                        MPI_Errhandler eh)
+{
+    struct { long obj; MPI_Errhandler errh; } *tab = tab_;
+    for (int i = 0; i < *n; i++)
+        if (tab[i].obj == obj) {
+            tab[i].errh = eh;
+            return 1;
+        }
+    if (*n >= OBJ_ERRH_MAX)
+        return 0;                        /* full: caller surfaces it */
+    tab[*n].obj = obj;
+    tab[*n].errh = eh;
+    (*n)++;
+    return 1;
+}
+
+static void obj_errh_drop(void *tab_, int *n, long obj)
+{
+    struct { long obj; MPI_Errhandler errh; } *tab = tab_;
+    for (int i = 0; i < *n; i++)
+        if (tab[i].obj == obj) {
+            tab[i] = tab[--(*n)];
+            return;
+        }
+}
+
+static int handle_error_win(MPI_Win win, const char *func)
+{
+    return handle_error_eh_obj(func,
+                               obj_errh_get(g_win_errh, g_win_errh_n,
+                                            (long)win, g_errh),
+                               (long)win);
+}
+
+static int handle_error_file(MPI_File fh, const char *func)
+{
+    return handle_error_eh_obj(func,
+                               obj_errh_get(g_file_errh,
+                                            g_file_errh_n, (long)fh,
+                                            MPI_ERRORS_RETURN),
+                               (long)fh);
+}
+
+static int handle_error_session(MPI_Session s, const char *func)
+{
+    return handle_error_eh_obj(func,
+                               obj_errh_get(g_sess_errh,
+                                            g_sess_errh_n, (long)s,
+                                            g_errh),
+                               (long)s);
+}
+
+/* window-info registration for the predefined attributes (defined
+ * with the wave-6 attribute chapter below) */
+static void win_tab_add(MPI_Win w, void *base, MPI_Aint size, int du,
+                        int flavor);
+static void win_tab_drop(MPI_Win w);
 
 #define GIL_BEGIN PyGILState_STATE _gst = PyGILState_Ensure()
 #define GIL_END   PyGILState_Release(_gst)
@@ -604,15 +710,22 @@ int PMPI_Comm_free(MPI_Comm *comm)
 int PMPI_Comm_set_errhandler(MPI_Comm comm, MPI_Errhandler errhandler)
 {
     if (errhandler != MPI_ERRORS_ARE_FATAL
-        && errhandler != MPI_ERRORS_RETURN)
+        && errhandler != MPI_ERRORS_RETURN
+        && !(errhandler >= ERRH_USER_BASE
+             && errhandler - ERRH_USER_BASE
+                < (MPI_Errhandler)g_uerrh_n))
         return MPI_ERR_ARG;
     /* Propagate into the Python layer too: its communicator-level
      * errhandler fires first, and must raise (not SystemExit) for the
-     * real error class to reach ERRORS_RETURN callers. */
+     * real error class to reach ERRORS_RETURN callers. A USER handler
+     * needs the exception delivered back to C (where the function
+     * pointer lives), so the Python side treats it as ERRORS_RETURN
+     * and the C table keeps the real handle. */
     GIL_BEGIN;
     int rc = MPI_SUCCESS;
-    PyObject *r = PyObject_CallMethod(g_mod, "comm_set_errhandler", "li",
-                                      (long)comm, (int)errhandler);
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "comm_set_errhandler", "li", (long)comm,
+        errhandler >= ERRH_USER_BASE ? 2 : (int)errhandler);
     if (!r)
         rc = handle_error_comm(comm, "MPI_Comm_set_errhandler");
     else
@@ -2517,6 +2630,8 @@ int PMPI_Win_allocate(MPI_Aint size, int disp_unit, MPI_Info info,
          * puts land in it asynchronously, visible after a fence */
         *(void **)baseptr =
             (void *)(intptr_t)PyLong_AsLongLong(PyTuple_GetItem(r, 1));
+        win_tab_add(*win, *(void **)baseptr, size, disp_unit,
+                    MPI_WIN_FLAVOR_ALLOCATE);
         Py_DECREF(r);
     }
     GIL_END;
@@ -2530,7 +2645,7 @@ static int win_simple(const char *fn, MPI_Win win, const char *fmt,
     int rc = MPI_SUCCESS;
     PyObject *r = PyObject_CallMethod(g_mod, fn, fmt, (long)win, a, b);
     if (!r)
-        rc = handle_error(fn);
+        rc = handle_error_win(win, fn);
     else
         Py_DECREF(r);
     GIL_END;
@@ -2560,6 +2675,8 @@ int PMPI_Win_unlock(int rank, MPI_Win win)
 int PMPI_Win_free(MPI_Win *win)
 {
     int rc = win_simple("win_free", *win, "l", 0, 0);
+    win_tab_drop(*win);
+    obj_errh_drop(g_win_errh, &g_win_errh_n, (long)*win);
     *win = MPI_WIN_NULL;
     return rc;
 }
@@ -2581,7 +2698,7 @@ int PMPI_Put(const void *origin_addr, int origin_count,
         mem_ro(origin_addr, (size_t)origin_count * esz),
         (long)origin_datatype, target_rank, (long)target_disp);
     if (!r)
-        rc = handle_error("MPI_Put");
+        rc = handle_error_win(win, "MPI_Put");
     else
         Py_DECREF(r);
     GIL_END;
@@ -2610,7 +2727,7 @@ int PMPI_Get(void *origin_addr, int origin_count,
         mem_ro(origin_addr,
                origin_datatype >= DT_FIRST_DYN ? extent_bytes : 0));
     if (!r)
-        rc = handle_error("MPI_Get");
+        rc = handle_error_win(win, "MPI_Get");
     else {
         rc = copy_bytes(r, origin_addr, extent_bytes);
         Py_DECREF(r);
@@ -2637,7 +2754,7 @@ int PMPI_Accumulate(const void *origin_addr, int origin_count,
         (long)origin_datatype, (long)op, target_rank,
         (long)target_disp);
     if (!r)
-        rc = handle_error("MPI_Accumulate");
+        rc = handle_error_win(win, "MPI_Accumulate");
     else
         Py_DECREF(r);
     GIL_END;
@@ -2657,7 +2774,7 @@ int PMPI_File_open(MPI_Comm comm, const char *filename, int amode,
     PyObject *r = PyObject_CallMethod(g_mod, "file_open", "lsi",
                                       (long)comm, filename, amode);
     if (!r)
-        rc = handle_error_comm(comm, "MPI_File_open");
+        rc = handle_error_file(MPI_FILE_NULL, "MPI_File_open");
     else {
         *fh = (MPI_File)PyLong_AsLong(r);
         Py_DECREF(r);
@@ -2672,7 +2789,7 @@ static int file_simple(const char *fn, MPI_File fh, long a)
     int rc = MPI_SUCCESS;
     PyObject *r = PyObject_CallMethod(g_mod, fn, "ll", (long)fh, a);
     if (!r)
-        rc = handle_error(fn);
+        rc = handle_error_file(fh, fn);
     else
         Py_DECREF(r);
     GIL_END;
@@ -2686,10 +2803,11 @@ int PMPI_File_close(MPI_File *fh)
     PyObject *r = PyObject_CallMethod(g_mod, "file_close", "l",
                                       (long)*fh);
     if (!r)
-        rc = handle_error("MPI_File_close");
+        rc = handle_error_file(*fh, "MPI_File_close");
     else
         Py_DECREF(r);
     GIL_END;
+    obj_errh_drop(g_file_errh, &g_file_errh_n, (long)*fh);
     *fh = MPI_FILE_NULL;
     return rc;
 }
@@ -2702,7 +2820,7 @@ int PMPI_File_delete(const char *filename, MPI_Info info)
     PyObject *r = PyObject_CallMethod(g_mod, "file_delete", "s",
                                       filename);
     if (!r)
-        rc = handle_error("MPI_File_delete");
+        rc = handle_error_file((MPI_File)0, "MPI_File_delete");
     else
         Py_DECREF(r);
     GIL_END;
@@ -2723,7 +2841,7 @@ static int file_write_common(const char *fn, MPI_File fh,
         g_mod, fn, "llNl", (long)fh, (long)offset,
         mem_ro(buf, (size_t)count * esz), (long)datatype);
     if (!r)
-        rc = handle_error(fn);
+        rc = handle_error_file(fh, fn);
     else {
         set_status(status, 0, 0, (int)PyLong_AsLong(r));
         Py_DECREF(r);
@@ -2764,7 +2882,7 @@ static int file_read_common(const char *fn, MPI_File fh,
         (long)(sig * (size_t)count), (long long)datatype,
         mem_ro(buf, datatype >= DT_FIRST_DYN ? extent_bytes : 0));
     if (!r)
-        rc = handle_error(fn);
+        rc = handle_error_file(fh, fn);
     else {
         rc = copy_bytes(PyTuple_GetItem(r, 0), buf, extent_bytes);
         /* a short read at EOF reports the bytes ACTUALLY read */
@@ -2804,7 +2922,7 @@ int PMPI_File_write_shared(MPI_File fh, const void *buf, int count,
         g_mod, "file_write_shared", "lNl", (long)fh,
         mem_ro(buf, (size_t)count * esz), (long)datatype);
     if (!r)
-        rc = handle_error("MPI_File_write_shared");
+        rc = handle_error_file(fh, "MPI_File_write_shared");
     else {
         /* significant bytes actually written (a derived type's gaps
          * never hit the file) */
@@ -2830,7 +2948,7 @@ int PMPI_File_read_shared(MPI_File fh, void *buf, int count,
         (long)(sig * (size_t)count), (long)datatype,
         mem_ro(buf, datatype >= DT_FIRST_DYN ? extent_bytes : 0));
     if (!r)
-        rc = handle_error("MPI_File_read_shared");
+        rc = handle_error_file(fh, "MPI_File_read_shared");
     else {
         rc = copy_bytes(PyTuple_GetItem(r, 0), buf, extent_bytes);
         set_status(status, 0, 0,
@@ -2848,7 +2966,7 @@ int PMPI_File_get_size(MPI_File fh, MPI_Offset *size)
     PyObject *r = PyObject_CallMethod(g_mod, "file_get_size", "l",
                                       (long)fh);
     if (!r)
-        rc = handle_error("MPI_File_get_size");
+        rc = handle_error_file(fh, "MPI_File_get_size");
     else {
         *size = (MPI_Offset)PyLong_AsLongLong(r);
         Py_DECREF(r);
@@ -2869,7 +2987,7 @@ int PMPI_File_sync(MPI_File fh)
     PyObject *r = PyObject_CallMethod(g_mod, "file_sync", "l",
                                       (long)fh);
     if (!r)
-        rc = handle_error("MPI_File_sync");
+        rc = handle_error_file(fh, "MPI_File_sync");
     else
         Py_DECREF(r);
     GIL_END;
@@ -3045,6 +3163,35 @@ int PMPI_Comm_set_attr(MPI_Comm comm, int comm_keyval,
 int PMPI_Comm_get_attr(MPI_Comm comm, int comm_keyval,
                       void *attribute_val, int *flag)
 {
+    /* predefined attributes (attr_fn.c environment set): value slots
+     * are pointers to static ints, the standard's access pattern */
+    static int tag_ub = 1048575;         /* headroom under the
+                                          * partitioned-channel tag
+                                          * multiplexing */
+    static int host = MPI_PROC_NULL;
+    static int io = MPI_ANY_SOURCE;      /* any process can do IO */
+    static int wtime_global = 0;
+    (void)comm;
+    switch (comm_keyval) {
+    case MPI_TAG_UB:
+        *flag = 1;
+        *(int **)attribute_val = &tag_ub;
+        return MPI_SUCCESS;
+    case MPI_HOST:
+        *flag = 1;
+        *(int **)attribute_val = &host;
+        return MPI_SUCCESS;
+    case MPI_IO:
+        *flag = 1;
+        *(int **)attribute_val = &io;
+        return MPI_SUCCESS;
+    case MPI_WTIME_IS_GLOBAL:
+        *flag = 1;
+        *(int **)attribute_val = &wtime_global;
+        return MPI_SUCCESS;
+    default:
+        break;
+    }
     GIL_BEGIN;
     int rc = MPI_SUCCESS;
     PyObject *r = PyObject_CallMethod(g_mod, "comm_get_attr", "li",
@@ -3589,6 +3736,8 @@ int PMPI_Win_create(void *base, MPI_Aint size, int disp_unit,
         rc = handle_error_comm(comm, "MPI_Win_create");
     else {
         *win = (MPI_Win)PyLong_AsLong(r);
+        win_tab_add(*win, base, size, disp_unit,
+                    MPI_WIN_FLAVOR_CREATE);
         Py_DECREF(r);
     }
     GIL_END;
@@ -3602,7 +3751,7 @@ int PMPI_Win_flush(int rank, MPI_Win win)
     PyObject *r = PyObject_CallMethod(g_mod, "win_flush", "li",
                                       (long)win, rank);
     if (!r)
-        rc = handle_error("MPI_Win_flush");
+        rc = handle_error_win(win, "MPI_Win_flush");
     else
         Py_DECREF(r);
     GIL_END;
@@ -3621,7 +3770,7 @@ int PMPI_Win_flush_all(MPI_Win win)
     PyObject *r = PyObject_CallMethod(g_mod, "win_flush_all", "l",
                                       (long)win);
     if (!r)
-        rc = handle_error("MPI_Win_flush_all");
+        rc = handle_error_win(win, "MPI_Win_flush_all");
     else
         Py_DECREF(r);
     GIL_END;
@@ -3647,7 +3796,7 @@ int PMPI_Win_lock_all(int assert_, MPI_Win win)
     PyObject *r = PyObject_CallMethod(g_mod, "win_lock_all", "l",
                                       (long)win);
     if (!r)
-        rc = handle_error("MPI_Win_lock_all");
+        rc = handle_error_win(win, "MPI_Win_lock_all");
     else
         Py_DECREF(r);
     GIL_END;
@@ -3661,7 +3810,7 @@ int PMPI_Win_unlock_all(MPI_Win win)
     PyObject *r = PyObject_CallMethod(g_mod, "win_unlock_all", "l",
                                       (long)win);
     if (!r)
-        rc = handle_error("MPI_Win_unlock_all");
+        rc = handle_error_win(win, "MPI_Win_unlock_all");
     else
         Py_DECREF(r);
     GIL_END;
@@ -3675,7 +3824,7 @@ int PMPI_Win_get_group(MPI_Win win, MPI_Group *group)
     PyObject *r = PyObject_CallMethod(g_mod, "win_get_group", "l",
                                       (long)win);
     if (!r)
-        rc = handle_error("MPI_Win_get_group");
+        rc = handle_error_win(win, "MPI_Win_get_group");
     else {
         *group = (MPI_Group)PyLong_AsLong(r);
         Py_DECREF(r);
@@ -3698,7 +3847,7 @@ int PMPI_Fetch_and_op(const void *origin_addr, void *result_addr,
         mem_ro(origin_addr ? origin_addr : result_addr, esz),
         (long)datatype, (long)op, target_rank, (long)target_disp);
     if (!r)
-        rc = handle_error("MPI_Fetch_and_op");
+        rc = handle_error_win(win, "MPI_Fetch_and_op");
     else {
         rc = copy_bytes(r, result_addr, esz);
         Py_DECREF(r);
@@ -3722,7 +3871,7 @@ int PMPI_Compare_and_swap(const void *origin_addr,
         mem_ro(origin_addr, esz), mem_ro(compare_addr, esz),
         (long)datatype, target_rank, (long)target_disp);
     if (!r)
-        rc = handle_error("MPI_Compare_and_swap");
+        rc = handle_error_win(win, "MPI_Compare_and_swap");
     else {
         rc = copy_bytes(r, result_addr, esz);
         Py_DECREF(r);
@@ -3754,7 +3903,7 @@ int PMPI_Get_accumulate(const void *origin_addr, int origin_count,
         (long)origin_datatype, (long)op, target_rank,
         (long)target_disp, result_count, (long)result_datatype);
     if (!r)
-        rc = handle_error("MPI_Get_accumulate");
+        rc = handle_error_win(win, "MPI_Get_accumulate");
     else {
         rc = copy_bytes(r, result_addr, (size_t)result_count * rsz);
         Py_DECREF(r);
@@ -3842,13 +3991,26 @@ int PMPI_Errhandler_free(MPI_Errhandler *errhandler)
 {
     if (!errhandler)
         return MPI_ERR_ARG;
-    *errhandler = 0;                     /* predefined handles only */
+    if (*errhandler >= ERRH_USER_BASE
+        && *errhandler - ERRH_USER_BASE < (MPI_Errhandler)g_uerrh_n)
+        /* reclaim the slot: create/free cycles must not exhaust the
+         * table (uerrh_create reuses holes) */
+        g_uerrh[*errhandler - ERRH_USER_BASE] = NULL;
+    *errhandler = 0;
     return MPI_SUCCESS;
 }
 
 int PMPI_Comm_call_errhandler(MPI_Comm comm, int errorcode)
 {
-    if (errh_for(comm) == MPI_ERRORS_RETURN)
+    MPI_Errhandler eh = errh_for(comm);
+    if (eh >= ERRH_USER_BASE
+        && eh - ERRH_USER_BASE < (MPI_Errhandler)g_uerrh_n
+        && g_uerrh[eh - ERRH_USER_BASE]) {
+        long obj = (long)comm;
+        g_uerrh[eh - ERRH_USER_BASE](&obj, &errorcode);
+        return MPI_SUCCESS;
+    }
+    if (eh == MPI_ERRORS_RETURN)
         return MPI_SUCCESS;      /* the handler "ran" and returned:
                                   * the call itself succeeded */
     fprintf(stderr, "*** MPI_Comm_call_errhandler: error %d on comm "
@@ -4373,12 +4535,18 @@ int PMPI_Session_init(MPI_Info info, MPI_Errhandler errhandler,
     (void)info;
     GIL_BEGIN;
     int rc = MPI_SUCCESS;
-    PyObject *r = PyObject_CallMethod(g_mod, "session_init", "i",
-                                      (int)errhandler);
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "session_init", "i",
+        errhandler >= ERRH_USER_BASE ? 2 : (int)errhandler);
     if (!r)
+        /* no session exists on this path: the error attaches to the
+         * environment (MPI-4 11.3), not the uninitialized output */
         rc = handle_error("MPI_Session_init");
     else {
         *session = (MPI_Session)PyLong_AsLong(r);
+        /* the init-time errhandler IS the session's handler */
+        obj_errh_set(g_sess_errh, &g_sess_errh_n, (long)*session,
+                     errhandler);
         Py_DECREF(r);
     }
     GIL_END;
@@ -4392,8 +4560,9 @@ int PMPI_Session_finalize(MPI_Session *session)
     PyObject *r = PyObject_CallMethod(g_mod, "session_finalize", "l",
                                       (long)*session);
     if (!r)
-        rc = handle_error("MPI_Session_finalize");
+        rc = handle_error_session(*session, "MPI_Session_finalize");
     else {
+        obj_errh_drop(g_sess_errh, &g_sess_errh_n, (long)*session);
         *session = MPI_SESSION_NULL;
         Py_DECREF(r);
     }
@@ -4410,7 +4579,7 @@ int PMPI_Session_get_num_psets(MPI_Session session, MPI_Info info,
     PyObject *r = PyObject_CallMethod(g_mod, "session_get_num_psets",
                                       "l", (long)session);
     if (!r)
-        rc = handle_error("MPI_Session_get_num_psets");
+        rc = handle_error_session(session, "MPI_Session_get_num_psets");
     else {
         *npset_names = (int)PyLong_AsLong(r);
         Py_DECREF(r);
@@ -4428,7 +4597,7 @@ int PMPI_Session_get_nth_pset(MPI_Session session, MPI_Info info,
     PyObject *r = PyObject_CallMethod(g_mod, "session_get_nth_pset",
                                       "li", (long)session, n);
     if (!r)
-        rc = handle_error("MPI_Session_get_nth_pset");
+        rc = handle_error_session(session, "MPI_Session_get_nth_pset");
     else {
         const char *s = PyUnicode_AsUTF8(r);
         size_t len = s ? strlen(s) : 0;
@@ -5819,7 +5988,7 @@ int PMPI_File_set_view(MPI_File fh, MPI_Offset disp, MPI_Datatype etype,
                                       (long)etype, (long)filetype,
                                       datarep ? datarep : "native");
     if (!r)
-        rc = handle_error("MPI_File_set_view");
+        rc = handle_error_file(fh, "MPI_File_set_view");
     else
         Py_DECREF(r);
     GIL_END;
@@ -5835,7 +6004,7 @@ int PMPI_File_get_view(MPI_File fh, MPI_Offset *disp,
     PyObject *r = PyObject_CallMethod(g_mod, "file_get_view", "l",
                                       (long)fh);
     if (!r) {
-        rc = handle_error("MPI_File_get_view");
+        rc = handle_error_file(fh, "MPI_File_get_view");
     } else {
         *disp = (MPI_Offset)PyLong_AsLongLong(PyTuple_GetItem(r, 0));
         *etype = (MPI_Datatype)PyLong_AsLong(PyTuple_GetItem(r, 1));
@@ -5859,7 +6028,7 @@ int PMPI_File_seek(MPI_File fh, MPI_Offset offset, int whence)
                                       (long)fh, (long long)offset,
                                       whence);
     if (!r)
-        rc = handle_error("MPI_File_seek");
+        rc = handle_error_file(fh, "MPI_File_seek");
     else
         Py_DECREF(r);
     GIL_END;
@@ -5873,7 +6042,7 @@ int PMPI_File_get_position(MPI_File fh, MPI_Offset *offset)
     PyObject *r = PyObject_CallMethod(g_mod, "file_get_position", "l",
                                       (long)fh);
     if (!r) {
-        rc = handle_error("MPI_File_get_position");
+        rc = handle_error_file(fh, "MPI_File_get_position");
     } else {
         *offset = (MPI_Offset)PyLong_AsLongLong(r);
         Py_DECREF(r);
@@ -5931,7 +6100,7 @@ static int file_iread_common(const char *fn, MPI_File fh,
         (long)(sig * (size_t)count), (long long)datatype,
         mem_ro(buf, datatype >= DT_FIRST_DYN ? extent_bytes : 0));
     if (!r) {
-        rc = handle_error(fn);
+        rc = handle_error_file(fh, fn);
     } else {
         req_entry *e = req_new();
         e->pyh = PyLong_AsLong(r);
@@ -5958,7 +6127,7 @@ static int file_iwrite_common(const char *fn, MPI_File fh,
         g_mod, fn, "lLNl", (long)fh, (long long)offset,
         mem_ro(buf, (size_t)count * esz), (long)datatype);
     if (!r) {
-        rc = handle_error(fn);
+        rc = handle_error_file(fh, fn);
     } else {
         req_entry *e = req_new();
         e->pyh = PyLong_AsLong(r);
@@ -6007,7 +6176,7 @@ int PMPI_File_seek_shared(MPI_File fh, MPI_Offset offset, int whence)
                                       (long)fh, (long long)offset,
                                       whence);
     if (!r)
-        rc = handle_error("MPI_File_seek_shared");
+        rc = handle_error_file(fh, "MPI_File_seek_shared");
     else
         Py_DECREF(r);
     GIL_END;
@@ -6022,7 +6191,7 @@ int PMPI_File_get_position_shared(MPI_File fh, MPI_Offset *offset)
                                       "file_get_position_shared", "l",
                                       (long)fh);
     if (!r) {
-        rc = handle_error("MPI_File_get_position_shared");
+        rc = handle_error_file(fh, "MPI_File_get_position_shared");
     } else {
         *offset = (MPI_Offset)PyLong_AsLongLong(r);
         Py_DECREF(r);
@@ -6062,7 +6231,7 @@ int PMPI_File_get_amode(MPI_File fh, int *amode)
     PyObject *r = PyObject_CallMethod(g_mod, "file_get_amode", "l",
                                       (long)fh);
     if (!r) {
-        rc = handle_error("MPI_File_get_amode");
+        rc = handle_error_file(fh, "MPI_File_get_amode");
     } else {
         *amode = (int)PyLong_AsLong(r);
         Py_DECREF(r);
@@ -6078,7 +6247,7 @@ int PMPI_File_preallocate(MPI_File fh, MPI_Offset size)
     PyObject *r = PyObject_CallMethod(g_mod, "file_preallocate", "lL",
                                       (long)fh, (long long)size);
     if (!r)
-        rc = handle_error("MPI_File_preallocate");
+        rc = handle_error_file(fh, "MPI_File_preallocate");
     else
         Py_DECREF(r);
     GIL_END;
@@ -6126,6 +6295,7 @@ int PMPI_Win_create_dynamic(MPI_Info info, MPI_Comm comm, MPI_Win *win)
         rc = handle_error_comm(comm, "MPI_Win_create_dynamic");
     } else {
         *win = (MPI_Win)PyLong_AsLong(r);
+        win_tab_add(*win, MPI_BOTTOM, 0, 1, MPI_WIN_FLAVOR_DYNAMIC);
         Py_DECREF(r);
     }
     GIL_END;
@@ -6141,7 +6311,7 @@ int PMPI_Win_attach(MPI_Win win, void *base, MPI_Aint size)
                                       (long long)(intptr_t)base,
                                       (long long)size);
     if (!r)
-        rc = handle_error("MPI_Win_attach");
+        rc = handle_error_win(win, "MPI_Win_attach");
     else
         Py_DECREF(r);
     GIL_END;
@@ -6156,7 +6326,7 @@ int PMPI_Win_detach(MPI_Win win, const void *base)
                                       (long)win,
                                       (long long)(intptr_t)base);
     if (!r)
-        rc = handle_error("MPI_Win_detach");
+        rc = handle_error_win(win, "MPI_Win_detach");
     else
         Py_DECREF(r);
     GIL_END;
@@ -6182,6 +6352,8 @@ int PMPI_Win_allocate_shared(MPI_Aint size, int disp_unit,
         *win = (MPI_Win)PyLong_AsLong(PyTuple_GetItem(r, 0));
         *(void **)baseptr = (void *)(intptr_t)PyLong_AsLongLong(
             PyTuple_GetItem(r, 1));
+        win_tab_add(*win, *(void **)baseptr, size, disp_unit,
+                    MPI_WIN_FLAVOR_SHARED);
         Py_DECREF(r);
     }
     GIL_END;
@@ -6196,7 +6368,7 @@ int PMPI_Win_shared_query(MPI_Win win, int rank, MPI_Aint *size,
     PyObject *r = PyObject_CallMethod(g_mod, "win_shared_query", "li",
                                       (long)win, rank);
     if (!r) {
-        rc = handle_error("MPI_Win_shared_query");
+        rc = handle_error_win(win, "MPI_Win_shared_query");
     } else {
         *size = (MPI_Aint)PyLong_AsLongLong(PyTuple_GetItem(r, 0));
         *disp_unit = (int)PyLong_AsLong(PyTuple_GetItem(r, 1));
@@ -6216,7 +6388,7 @@ static int win_group_call(const char *fn, MPI_Win win, MPI_Group group)
     PyObject *r = PyObject_CallMethod(g_mod, fn, "ll", (long)win,
                                       (long)group);
     if (!r)
-        rc = handle_error(fn);
+        rc = handle_error_win(win, fn);
     else
         Py_DECREF(r);
     GIL_END;
@@ -6242,7 +6414,7 @@ int PMPI_Win_complete(MPI_Win win)
     PyObject *r = PyObject_CallMethod(g_mod, "win_complete", "l",
                                       (long)win);
     if (!r)
-        rc = handle_error("MPI_Win_complete");
+        rc = handle_error_win(win, "MPI_Win_complete");
     else
         Py_DECREF(r);
     GIL_END;
@@ -6256,7 +6428,7 @@ int PMPI_Win_wait(MPI_Win win)
     PyObject *r = PyObject_CallMethod(g_mod, "win_wait", "l",
                                       (long)win);
     if (!r)
-        rc = handle_error("MPI_Win_wait");
+        rc = handle_error_win(win, "MPI_Win_wait");
     else
         Py_DECREF(r);
     GIL_END;
@@ -6270,7 +6442,7 @@ int PMPI_Win_set_name(MPI_Win win, const char *win_name)
     PyObject *r = PyObject_CallMethod(g_mod, "win_set_name", "ls",
                                       (long)win, win_name);
     if (!r)
-        rc = handle_error("MPI_Win_set_name");
+        rc = handle_error_win(win, "MPI_Win_set_name");
     else
         Py_DECREF(r);
     GIL_END;
@@ -6284,7 +6456,7 @@ int PMPI_Win_get_name(MPI_Win win, char *win_name, int *resultlen)
     PyObject *r = PyObject_CallMethod(g_mod, "win_get_name", "l",
                                       (long)win);
     if (!r) {
-        rc = handle_error("MPI_Win_get_name");
+        rc = handle_error_win(win, "MPI_Win_get_name");
     } else {
         const char *s = PyUnicode_AsUTF8(r);
         if (s) {
@@ -8218,6 +8390,452 @@ int PMPI_Neighbor_alltoallv_init(const void *sendbuf,
                          "MPI_Neighbor_alltoallv_init");
     GIL_END;
     return rc;
+}
+
+/* ------------------------------------------------------------------ */
+/* round-5 wave 6: keyvals + errhandlers on every object class
+ * (win_create_keyval.c.in, type_create_keyval.c.in,
+ * comm_create_errhandler.c.in family, the deprecated attr API
+ * keyval_create.c.in, remove_error_class.c.in).                       */
+/* ------------------------------------------------------------------ */
+
+static int obj_kv_create(const char *fn, void *copy_fn, void *del_fn,
+                        int *keyval, void *extra)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "obj_create_keyval_c", "LLL",
+        (long long)(intptr_t)copy_fn, (long long)(intptr_t)del_fn,
+        (long long)(intptr_t)extra);
+    if (!r)
+        rc = handle_error(fn);
+    else {
+        *keyval = (int)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Win_create_keyval(MPI_Win_copy_attr_function *win_copy_attr_fn,
+                          MPI_Win_delete_attr_function
+                          *win_delete_attr_fn,
+                          int *win_keyval, void *extra_state)
+{
+    return obj_kv_create("MPI_Win_create_keyval",
+                         (void *)win_copy_attr_fn,
+                         (void *)win_delete_attr_fn, win_keyval,
+                         extra_state);
+}
+
+int PMPI_Type_create_keyval(MPI_Type_copy_attr_function
+                           *type_copy_attr_fn,
+                           MPI_Type_delete_attr_function
+                           *type_delete_attr_fn,
+                           int *type_keyval, void *extra_state)
+{
+    return obj_kv_create("MPI_Type_create_keyval",
+                         (void *)type_copy_attr_fn,
+                         (void *)type_delete_attr_fn, type_keyval,
+                         extra_state);
+}
+
+static int obj_kv_free(const char *fn, int *keyval)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "obj_free_keyval", "i",
+                                      *keyval);
+    if (!r)
+        rc = handle_error(fn);
+    else
+        Py_DECREF(r);
+    GIL_END;
+    *keyval = MPI_KEYVAL_INVALID;
+    return rc;
+}
+
+int PMPI_Win_free_keyval(int *win_keyval)
+{
+    return obj_kv_free("MPI_Win_free_keyval", win_keyval);
+}
+
+int PMPI_Type_free_keyval(int *type_keyval)
+{
+    return obj_kv_free("MPI_Type_free_keyval", type_keyval);
+}
+
+static int obj_attr_set(const char *kind, const char *fn, long h,
+                       int keyval, void *val)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "obj_set_attr", "sliL", kind, h, keyval,
+        (long long)(intptr_t)val);
+    if (!r)
+        rc = handle_error(fn);
+    else
+        Py_DECREF(r);
+    GIL_END;
+    return rc;
+}
+
+static int obj_attr_get(const char *kind, const char *fn, long h,
+                       int keyval, void *attribute_val, int *flag)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "obj_get_attr", "sli",
+                                      kind, h, keyval);
+    if (!r) {
+        rc = handle_error(fn);
+    } else {
+        *flag = (int)PyLong_AsLong(PyTuple_GetItem(r, 0));
+        if (*flag)
+            *(void **)attribute_val = (void *)(intptr_t)
+                PyLong_AsLongLong(PyTuple_GetItem(r, 1));
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+static int obj_attr_del(const char *kind, const char *fn, long h,
+                       int keyval)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "obj_delete_attr", "sli",
+                                      kind, h, keyval);
+    if (!r)
+        rc = handle_error(fn);
+    else
+        Py_DECREF(r);
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Type_set_attr(MPI_Datatype datatype, int type_keyval,
+                      void *attribute_val)
+{
+    return obj_attr_set("type", "MPI_Type_set_attr", (long)datatype,
+                        type_keyval, attribute_val);
+}
+
+int PMPI_Type_get_attr(MPI_Datatype datatype, int type_keyval,
+                      void *attribute_val, int *flag)
+{
+    return obj_attr_get("type", "MPI_Type_get_attr", (long)datatype,
+                        type_keyval, attribute_val, flag);
+}
+
+int PMPI_Type_delete_attr(MPI_Datatype datatype, int type_keyval)
+{
+    return obj_attr_del("type", "MPI_Type_delete_attr",
+                        (long)datatype, type_keyval);
+}
+
+/* window info for the predefined attributes (win_get_attr.c.in:
+ * MPI_WIN_BASE/SIZE/DISP_UNIT/CREATE_FLAVOR/MODEL) — recorded at
+ * creation, where the C side has all three values in hand */
+#define WIN_TAB_MAX 128
+static struct {
+    MPI_Win win;
+    void *base;
+    MPI_Aint size;
+    int disp_unit;
+    int flavor;
+} g_win_tab[WIN_TAB_MAX];
+static int g_win_tab_n;
+
+static void win_tab_add(MPI_Win w, void *base, MPI_Aint size, int du,
+                        int flavor)
+{
+    /* slots are STABLE (Win_get_attr hands out pointers into them):
+     * freed slots become tombstones (win = -1) and are reused; the
+     * table never compacts under a live pointer */
+    int slot = -1;
+    for (int i = 0; i < g_win_tab_n; i++)
+        if (g_win_tab[i].win == (MPI_Win)-1) {
+            slot = i;
+            break;
+        }
+    if (slot < 0) {
+        if (g_win_tab_n >= WIN_TAB_MAX) {
+            fprintf(stderr, "ompi_tpu: window table full (%d); "
+                            "predefined attributes unavailable for "
+                            "this window\n", WIN_TAB_MAX);
+            return;
+        }
+        slot = g_win_tab_n++;
+    }
+    g_win_tab[slot].win = w;
+    g_win_tab[slot].base = base;
+    g_win_tab[slot].size = size;
+    g_win_tab[slot].disp_unit = du;
+    g_win_tab[slot].flavor = flavor;
+}
+
+static void win_tab_drop(MPI_Win w)
+{
+    for (int i = 0; i < g_win_tab_n; i++)
+        if (g_win_tab[i].win == w) {
+            g_win_tab[i].win = (MPI_Win)-1;   /* tombstone */
+            return;
+        }
+}
+
+int PMPI_Win_set_attr(MPI_Win win, int win_keyval, void *attribute_val)
+{
+    if (win_keyval >= MPI_WIN_BASE && win_keyval <= MPI_WIN_MODEL)
+        return MPI_ERR_ARG;              /* predefined: read-only */
+    return obj_attr_set("win", "MPI_Win_set_attr", (long)win,
+                        win_keyval, attribute_val);
+}
+
+int PMPI_Win_get_attr(MPI_Win win, int win_keyval, void *attribute_val,
+                     int *flag)
+{
+    for (int i = g_win_tab_n - 1; i >= 0; i--) {
+        if (g_win_tab[i].win != win)
+            continue;
+        *flag = 1;
+        switch (win_keyval) {
+        case MPI_WIN_BASE:
+            *(void **)attribute_val = g_win_tab[i].base;
+            return MPI_SUCCESS;
+        case MPI_WIN_SIZE:
+            /* attribute_val receives a POINTER to the value
+             * (MPI-4 7.8: "a pointer to an MPI_Aint") */
+            *(MPI_Aint **)attribute_val = &g_win_tab[i].size;
+            return MPI_SUCCESS;
+        case MPI_WIN_DISP_UNIT:
+            *(int **)attribute_val = &g_win_tab[i].disp_unit;
+            return MPI_SUCCESS;
+        case MPI_WIN_CREATE_FLAVOR:
+            *(int **)attribute_val = &g_win_tab[i].flavor;
+            return MPI_SUCCESS;
+        case MPI_WIN_MODEL: {
+            static int model = MPI_WIN_UNIFIED;
+            *(int **)attribute_val = &model;
+            return MPI_SUCCESS;
+        }
+        default:
+            break;
+        }
+        break;
+    }
+    return obj_attr_get("win", "MPI_Win_get_attr", (long)win,
+                        win_keyval, attribute_val, flag);
+}
+
+int PMPI_Win_delete_attr(MPI_Win win, int win_keyval)
+{
+    return obj_attr_del("win", "MPI_Win_delete_attr", (long)win,
+                        win_keyval);
+}
+
+/* ---- the deprecated attr API (keyval_create.c.in, attr_put.c.in):
+ * thin aliases over the comm keyval chapter, kept for MPI-1 texts -- */
+int PMPI_Keyval_create(MPI_Copy_function *copy_fn,
+                      MPI_Delete_function *delete_fn, int *keyval,
+                      void *extra_state)
+{
+    return PMPI_Comm_create_keyval(copy_fn, delete_fn, keyval,
+                                  extra_state);
+}
+
+int PMPI_Keyval_free(int *keyval)
+{
+    return PMPI_Comm_free_keyval(keyval);
+}
+
+int PMPI_Attr_put(MPI_Comm comm, int keyval, void *attribute_val)
+{
+    return PMPI_Comm_set_attr(comm, keyval, attribute_val);
+}
+
+int PMPI_Attr_get(MPI_Comm comm, int keyval, void *attribute_val,
+                 int *flag)
+{
+    return PMPI_Comm_get_attr(comm, keyval, attribute_val, flag);
+}
+
+int PMPI_Attr_delete(MPI_Comm comm, int keyval)
+{
+    return PMPI_Comm_delete_attr(comm, keyval);
+}
+
+/* ---- user errhandlers (comm_create_errhandler.c.in family) ------- */
+static int uerrh_create(void *fn, MPI_Errhandler *errhandler)
+{
+    if (!fn)
+        return MPI_ERR_ARG;
+    for (int i = 0; i < g_uerrh_n; i++)
+        if (!g_uerrh[i]) {               /* reuse a freed slot */
+            g_uerrh[i] = (uerrh_fn *)fn;
+            *errhandler = (MPI_Errhandler)(ERRH_USER_BASE + i);
+            return MPI_SUCCESS;
+        }
+    if (g_uerrh_n >= ERRH_USER_MAX)
+        return MPI_ERR_INTERN;
+    g_uerrh[g_uerrh_n] = (uerrh_fn *)fn;
+    *errhandler = (MPI_Errhandler)(ERRH_USER_BASE + g_uerrh_n);
+    g_uerrh_n++;
+    return MPI_SUCCESS;
+}
+
+int PMPI_Comm_create_errhandler(MPI_Comm_errhandler_function *fn,
+                               MPI_Errhandler *errhandler)
+{
+    return uerrh_create((void *)fn, errhandler);
+}
+
+int PMPI_Win_create_errhandler(MPI_Win_errhandler_function *fn,
+                              MPI_Errhandler *errhandler)
+{
+    return uerrh_create((void *)fn, errhandler);
+}
+
+int PMPI_File_create_errhandler(MPI_File_errhandler_function *fn,
+                               MPI_Errhandler *errhandler)
+{
+    return uerrh_create((void *)fn, errhandler);
+}
+
+int PMPI_Session_create_errhandler(MPI_Session_errhandler_function *fn,
+                                  MPI_Errhandler *errhandler)
+{
+    return uerrh_create((void *)fn, errhandler);
+}
+
+int PMPI_Win_set_errhandler(MPI_Win win, MPI_Errhandler errhandler)
+{
+    return obj_errh_set(g_win_errh, &g_win_errh_n, (long)win,
+                        errhandler) ? MPI_SUCCESS : MPI_ERR_INTERN;
+}
+
+int PMPI_Win_get_errhandler(MPI_Win win, MPI_Errhandler *errhandler)
+{
+    *errhandler = obj_errh_get(g_win_errh, g_win_errh_n, (long)win,
+                               g_errh);
+    return MPI_SUCCESS;
+}
+
+int PMPI_Win_call_errhandler(MPI_Win win, int errorcode)
+{
+    MPI_Errhandler eh = obj_errh_get(g_win_errh, g_win_errh_n,
+                                     (long)win, g_errh);
+    if (eh >= ERRH_USER_BASE
+        && eh - ERRH_USER_BASE < (MPI_Errhandler)g_uerrh_n
+        && g_uerrh[eh - ERRH_USER_BASE]) {
+        long obj = (long)win;
+        g_uerrh[eh - ERRH_USER_BASE](&obj, &errorcode);
+        return MPI_SUCCESS;
+    }
+    if (eh == MPI_ERRORS_RETURN)
+        return MPI_SUCCESS;
+    fprintf(stderr, "*** MPI_Win_call_errhandler: error %d — aborting "
+                    "(MPI_ERRORS_ARE_FATAL)\n", errorcode);
+    exit(errorcode > 0 && errorcode < 126 ? errorcode : 1);
+}
+
+int PMPI_File_set_errhandler(MPI_File file, MPI_Errhandler errhandler)
+{
+    return obj_errh_set(g_file_errh, &g_file_errh_n, (long)file,
+                        errhandler) ? MPI_SUCCESS : MPI_ERR_INTERN;
+}
+
+int PMPI_File_get_errhandler(MPI_File file, MPI_Errhandler *errhandler)
+{
+    *errhandler = obj_errh_get(g_file_errh, g_file_errh_n, (long)file,
+                               MPI_ERRORS_RETURN);
+    return MPI_SUCCESS;
+}
+
+int PMPI_File_call_errhandler(MPI_File fh, int errorcode)
+{
+    MPI_Errhandler eh = obj_errh_get(g_file_errh, g_file_errh_n,
+                                     (long)fh, MPI_ERRORS_RETURN);
+    if (eh >= ERRH_USER_BASE
+        && eh - ERRH_USER_BASE < (MPI_Errhandler)g_uerrh_n
+        && g_uerrh[eh - ERRH_USER_BASE]) {
+        long obj = (long)fh;
+        g_uerrh[eh - ERRH_USER_BASE](&obj, &errorcode);
+        return MPI_SUCCESS;
+    }
+    if (eh == MPI_ERRORS_RETURN)
+        return MPI_SUCCESS;
+    fprintf(stderr, "*** MPI_File_call_errhandler: error %d — aborting"
+                    " (MPI_ERRORS_ARE_FATAL)\n", errorcode);
+    exit(errorcode > 0 && errorcode < 126 ? errorcode : 1);
+}
+
+int PMPI_Session_set_errhandler(MPI_Session session,
+                               MPI_Errhandler errhandler)
+{
+    return obj_errh_set(g_sess_errh, &g_sess_errh_n, (long)session,
+                        errhandler) ? MPI_SUCCESS : MPI_ERR_INTERN;
+}
+
+int PMPI_Session_get_errhandler(MPI_Session session,
+                               MPI_Errhandler *errhandler)
+{
+    *errhandler = obj_errh_get(g_sess_errh, g_sess_errh_n,
+                               (long)session, g_errh);
+    return MPI_SUCCESS;
+}
+
+int PMPI_Session_call_errhandler(MPI_Session session, int errorcode)
+{
+    MPI_Errhandler eh = obj_errh_get(g_sess_errh, g_sess_errh_n,
+                                     (long)session, g_errh);
+    if (eh >= ERRH_USER_BASE
+        && eh - ERRH_USER_BASE < (MPI_Errhandler)g_uerrh_n
+        && g_uerrh[eh - ERRH_USER_BASE]) {
+        long obj = (long)session;
+        g_uerrh[eh - ERRH_USER_BASE](&obj, &errorcode);
+        return MPI_SUCCESS;
+    }
+    if (eh == MPI_ERRORS_RETURN)
+        return MPI_SUCCESS;
+    fprintf(stderr, "*** MPI_Session_call_errhandler: error %d — "
+                    "aborting (MPI_ERRORS_ARE_FATAL)\n", errorcode);
+    exit(errorcode > 0 && errorcode < 126 ? errorcode : 1);
+}
+
+/* ---- dynamic error-space removal (LIFO, MPI-4.1) ----------------- */
+static int err_remove(const char *glue, const char *fn, int code)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, glue, "i", code);
+    if (!r)
+        rc = handle_error(fn);
+    else
+        Py_DECREF(r);
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Remove_error_class(int errorclass)
+{
+    return err_remove("remove_error_class", "MPI_Remove_error_class",
+                      errorclass);
+}
+
+int PMPI_Remove_error_code(int errorcode)
+{
+    return err_remove("remove_error_code", "MPI_Remove_error_code",
+                      errorcode);
+}
+
+int PMPI_Remove_error_string(int errorcode)
+{
+    return err_remove("remove_error_string",
+                      "MPI_Remove_error_string", errorcode);
 }
 
 /* ------------------------------------------------------------------ */

@@ -646,15 +646,19 @@ def type_create_darray(gsize: int, grank: int, gsizes_view,
 
 
 def type_dup(dt: int) -> int:
-    """MPI_Type_dup."""
+    """MPI_Type_dup; cached attributes propagate through their
+    copy_fn (veto/transform, the comm-dup contract)."""
     t = _dyn(dt) if dt >= _FIRST_DYN_TYPE else None
     if t is None:
         base, idx, contig_n, lb, ext = _as_granular(dt)
-        return _register_type(DerivedType(base, None, ext,
-                                          contig_n=contig_n))
-    return _register_type(DerivedType(
-        t.base, None if t.idx is None else np.array(t.idx),
-        t.extent, lb=t.lb, contig_n=t.contig_n))
+        new = _register_type(DerivedType(base, None, ext,
+                                         contig_n=contig_n))
+    else:
+        new = _register_type(DerivedType(
+            t.base, None if t.idx is None else np.array(t.idx),
+            t.extent, lb=t.lb, contig_n=t.contig_n))
+    _obj_attrs_dup("type", dt, new)
+    return new
 
 
 def type_create_resized(oldtype: int, lb_bytes: int,
@@ -793,8 +797,9 @@ def type_commit(dt: int) -> None:
 
 
 def type_free(dt: int) -> None:
-    if _dyn_types.pop(dt, None) is None:
+    if _dyn_types.pop(dt, None) is None:  # atomic: double-free raises
         raise MPIError(ERR_TYPE, f"invalid datatype handle {dt}")
+    _obj_attrs_free("type", dt)          # attr delete_fns fire
     _type_env.pop(int(dt), None)
     _type_names.pop(int(dt), None)
 
@@ -1551,16 +1556,17 @@ def _handle_of(c) -> int:
 _keyval_refs: Dict[int, Any] = {}
 
 
-def comm_create_keyval_c(copy_ptr: int, delete_ptr: int,
-                         extra: int) -> int:
-    """MPI_Comm_create_keyval with REAL callback invocation
-    (attribute.c:349-384): copy_fn runs at every MPI_Comm_dup and may
-    veto/transform the value; delete_fn runs at delete/overwrite/free.
-    copy_ptr 0 = MPI_COMM_NULL_COPY_FN (never propagated), 1 =
-    MPI_COMM_DUP_FN (propagate verbatim); likewise delete_ptr 0 =
-    MPI_COMM_NULL_DELETE_FN."""
+def _attr_trampolines(copy_ptr: int, delete_ptr: int, extra: int,
+                      handle_map) -> Tuple[Any, Any, list]:
+    """Shared copy/delete trampoline builder for every attribute-
+    bearing object class (comm/win/type): wraps the C function
+    pointers via ctypes, firing them with handle_map(obj) as the
+    first argument. copy_ptr 0 = NULL_COPY_FN (never propagated),
+    1 = DUP_FN (propagate verbatim); delete_ptr 0 = NULL_DELETE_FN.
+    Returns (copy_py, delete_py, keepalive-list) — the keepalive list
+    must outlive the keyval (a collected trampoline is a dangling C
+    function pointer)."""
     import ctypes
-    from ompi_tpu.core.communicator import create_keyval
     CopyFn = ctypes.CFUNCTYPE(
         ctypes.c_int, ctypes.c_long, ctypes.c_int, ctypes.c_void_p,
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
@@ -1570,18 +1576,18 @@ def comm_create_keyval_c(copy_ptr: int, delete_ptr: int,
         ctypes.c_void_p)
     keep = []
     copy_py = None
-    if copy_ptr == 1:                    # MPI_COMM_DUP_FN
+    if copy_ptr == 1:                    # DUP_FN
 
-        def copy_py(comm, kv, val):
+        def copy_py(obj, kv, val):
             return True, val
     elif copy_ptr:
         cfn = CopyFn(copy_ptr)
         keep.append(cfn)
 
-        def copy_py(comm, kv, val):
+        def copy_py(obj, kv, val):
             out = ctypes.c_void_p(0)
             flag = ctypes.c_int(0)
-            rc = cfn(_handle_of(comm), int(kv), extra, int(val),
+            rc = cfn(handle_map(obj), int(kv), extra, int(val),
                      ctypes.byref(out), ctypes.byref(flag))
             if rc != 0:
                 raise MPIError(rc, "user attribute copy_fn failed")
@@ -1591,10 +1597,22 @@ def comm_create_keyval_c(copy_ptr: int, delete_ptr: int,
         dfn = DelFn(delete_ptr)
         keep.append(dfn)
 
-        def delete_py(comm, kv, val):
-            rc = dfn(_handle_of(comm), int(kv), int(val), extra)
+        def delete_py(obj, kv, val):
+            rc = dfn(handle_map(obj), int(kv), int(val), extra)
             if rc != 0:
                 raise MPIError(rc, "user attribute delete_fn failed")
+    return copy_py, delete_py, keep
+
+
+def comm_create_keyval_c(copy_ptr: int, delete_ptr: int,
+                         extra: int) -> int:
+    """MPI_Comm_create_keyval with REAL callback invocation
+    (attribute.c:349-384): copy_fn runs at every MPI_Comm_dup and may
+    veto/transform the value; delete_fn runs at delete/overwrite/
+    free."""
+    from ompi_tpu.core.communicator import create_keyval
+    copy_py, delete_py, keep = _attr_trampolines(
+        copy_ptr, delete_ptr, extra, _handle_of)
     kv = create_keyval(copy_py, delete_py)
     if keep:
         _keyval_refs[kv] = keep
@@ -2466,10 +2484,11 @@ def win_raccumulate(wh: int, view, dt: int, o: int, target: int,
 
 
 def win_free(wh: int) -> None:
-    with _lock:
+    with _lock:                          # atomic: double-free raises
         w = _wins.pop(wh, None)
     if w is None:
         raise MPIError(ERR_ARG, f"invalid window handle {wh}")
+    _obj_attrs_free("win", wh)           # attr delete_fns fire
     w.free()
 
 
@@ -2900,12 +2919,14 @@ _next_err_code = itertools.count(1001)
 def add_error_class() -> int:
     c = next(_next_err_class)
     _err_class_of[c] = c
+    _added_classes.append(c)             # LIFO removal bookkeeping
     return c
 
 
 def add_error_code(cls: int) -> int:
     code = next(_next_err_code)
     _err_class_of[code] = int(cls)
+    _added_codes.append(code)
     return code
 
 
@@ -4165,6 +4186,122 @@ def pcoll_start(ph: int) -> int:
 
 def pcoll_free(ph: int) -> None:
     _pcolls.pop(ph, None)
+
+
+# ---------------------------------------------------------------------
+# win/type keyvals + attributes (win_create_keyval.c.in,
+# type_create_keyval.c.in): the comm attribute model over a generic
+# (kind, handle)-keyed registry. delete_fn fires on delete/overwrite/
+# free; MPI_Type_dup propagates attributes through copy_fn (the only
+# dup operation these object classes have).
+# ---------------------------------------------------------------------
+_obj_keyvals: Dict[int, Tuple[Any, Any]] = {}
+_next_obj_kv = itertools.count(1 << 20)   # disjoint from comm keyvals
+_obj_attrs: Dict[Tuple[str, int], Dict[int, int]] = {}
+
+
+def obj_create_keyval_c(copy_ptr: int, delete_ptr: int,
+                        extra: int) -> int:
+    """Keyval for win/type attributes with real C callback invocation;
+    first callback argument is the raw integer handle (every handle
+    class here is an int token, so the comm trampoline shape serves
+    all — see _attr_trampolines)."""
+    copy_py, delete_py, keep = _attr_trampolines(
+        copy_ptr, delete_ptr, extra, int)
+    with _lock:
+        kv = next(_next_obj_kv)
+        _obj_keyvals[kv] = (copy_py, delete_py)
+    if keep:
+        _keyval_refs[kv] = keep
+    return kv
+
+
+def obj_free_keyval(kv: int) -> None:
+    _obj_keyvals.pop(int(kv), None)
+    _keyval_refs.pop(int(kv), None)
+
+
+def obj_set_attr(kind: str, h: int, keyval: int, value: int) -> None:
+    kv = int(keyval)
+    if kv not in _obj_keyvals:
+        raise MPIError(ERR_ARG, f"unknown {kind} keyval {kv}")
+    d = _obj_attrs.setdefault((kind, int(h)), {})
+    if kv in d:                          # overwrite fires delete_fn
+        cb = _obj_keyvals.get(kv)
+        if cb and cb[1]:
+            cb[1](h, kv, d[kv])
+    d[kv] = int(value)
+
+
+def obj_get_attr(kind: str, h: int, keyval: int) -> Tuple[int, int]:
+    d = _obj_attrs.get((kind, int(h)), {})
+    if int(keyval) in d:
+        return 1, int(d[int(keyval)])
+    return 0, 0
+
+
+def obj_delete_attr(kind: str, h: int, keyval: int) -> None:
+    kv = int(keyval)
+    d = _obj_attrs.get((kind, int(h)), {})
+    if kv not in d:
+        raise MPIError(ERR_ARG, f"attribute {kv} not set")
+    cb = _obj_keyvals.get(kv)
+    if cb and cb[1]:
+        cb[1](h, kv, d[kv])
+    del d[kv]
+
+
+def _obj_attrs_free(kind: str, h: int) -> None:
+    """Object teardown: fire delete_fn for every cached attribute."""
+    d = _obj_attrs.pop((kind, int(h)), None)
+    if not d:
+        return
+    for kv, val in list(d.items()):
+        cb = _obj_keyvals.get(kv)
+        if cb and cb[1]:
+            cb[1](h, kv, val)
+
+
+def _obj_attrs_dup(kind: str, old: int, new: int) -> None:
+    """Type_dup attribute propagation through copy_fn (veto or
+    transform, the comm-dup contract)."""
+    d = _obj_attrs.get((kind, int(old)), {})
+    for kv, val in list(d.items()):
+        cb = _obj_keyvals.get(kv)
+        if cb and cb[0]:
+            flag, out = cb[0](old, kv, val)
+            if flag:
+                _obj_attrs.setdefault((kind, int(new)), {})[kv] = out
+
+
+# ---- dynamic error-space removal (remove_error_class.c.in family):
+# MPI-4.1 requires LIFO removal — only the most recently added
+# class/code may be removed ------------------------------------------
+_added_classes: list = []
+_added_codes: list = []
+
+
+def remove_error_class(c: int) -> None:
+    if not _added_classes or _added_classes[-1] != int(c):
+        raise MPIError(ERR_ARG,
+                       "error classes must be removed in LIFO order")
+    _added_classes.pop()
+    _err_class_of.pop(int(c), None)
+    _err_strings.pop(int(c), None)
+
+
+def remove_error_code(code: int) -> None:
+    if not _added_codes or _added_codes[-1] != int(code):
+        raise MPIError(ERR_ARG,
+                       "error codes must be removed in LIFO order")
+    _added_codes.pop()
+    _err_class_of.pop(int(code), None)
+    _err_strings.pop(int(code), None)
+
+
+def remove_error_string(code: int) -> None:
+    if _err_strings.pop(int(code), None) is None:
+        raise MPIError(ERR_ARG, f"no string set for code {code}")
 
 
 # activate the constructor-envelope recorders (must run after every

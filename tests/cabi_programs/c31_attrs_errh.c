@@ -1,0 +1,261 @@
+/* Wave 6: keyvals + attributes on windows and datatypes (copy/delete
+ * callbacks firing on dup/overwrite/free), predefined attributes
+ * (MPI_TAG_UB, MPI_WIN_BASE/SIZE/DISP_UNIT/CREATE_FLAVOR/MODEL), the
+ * deprecated MPI-1 attr API, USER errhandlers on comm/win/file/
+ * session, and LIFO dynamic error-space removal.  Runs with -n 2. */
+#include <mpi.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#define CHECK(cond, code)                                            \
+    do {                                                             \
+        if (!(cond)) {                                               \
+            fprintf(stderr, "rank %d: check failed at line %d\n",    \
+                    rank, __LINE__);                                 \
+            MPI_Abort(MPI_COMM_WORLD, code);                         \
+        }                                                            \
+    } while (0)
+
+static int g_type_deletes;
+static int g_type_copies;
+static int g_win_deletes;
+
+static int type_copy_cb(MPI_Datatype dt, int kv, void *extra,
+                        void *in, void *out, int *flag)
+{
+    (void)dt;
+    (void)kv;
+    (void)extra;
+    g_type_copies++;
+    *(void **)out = (void *)((intptr_t)in + 1000);   /* transform */
+    *flag = 1;
+    return MPI_SUCCESS;
+}
+
+static int type_delete_cb(MPI_Datatype dt, int kv, void *val,
+                          void *extra)
+{
+    (void)dt;
+    (void)kv;
+    (void)val;
+    (void)extra;
+    g_type_deletes++;
+    return MPI_SUCCESS;
+}
+
+static int win_delete_cb(MPI_Win w, int kv, void *val, void *extra)
+{
+    (void)w;
+    (void)kv;
+    (void)val;
+    (void)extra;
+    g_win_deletes++;
+    return MPI_SUCCESS;
+}
+
+static int g_errh_fired;
+static int g_errh_code;
+
+static void comm_errh_fn(MPI_Comm *comm, int *code, ...)
+{
+    (void)comm;
+    g_errh_fired++;
+    g_errh_code = *code;
+}
+
+static void win_errh_fn(MPI_Win *win, int *code, ...)
+{
+    (void)win;
+    g_errh_fired += 100;
+    g_errh_code = *code;
+}
+
+int main(int argc, char **argv)
+{
+    MPI_Init(&argc, &argv);
+    int rank, size;
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &size);
+    CHECK(size == 2, 1);
+
+    /* ---- predefined comm attribute: MPI_TAG_UB >= 32767 ---- */
+    int *tag_ub, flag;
+    CHECK(MPI_Comm_get_attr(MPI_COMM_WORLD, MPI_TAG_UB, &tag_ub,
+                            &flag) == MPI_SUCCESS, 2);
+    CHECK(flag && *tag_ub >= 32767, 3);
+
+    /* ---- type keyvals: transform-on-dup, delete on free ---- */
+    int tkv;
+    CHECK(MPI_Type_create_keyval(type_copy_cb, type_delete_cb, &tkv,
+                                 NULL) == MPI_SUCCESS, 4);
+    MPI_Datatype vec;
+    MPI_Type_vector(3, 1, 2, MPI_INT, &vec);
+    MPI_Type_commit(&vec);
+    CHECK(MPI_Type_set_attr(vec, tkv, (void *)42) == MPI_SUCCESS, 5);
+    void *got;
+    CHECK(MPI_Type_get_attr(vec, tkv, &got, &flag) == MPI_SUCCESS, 6);
+    CHECK(flag && (intptr_t)got == 42, 7);
+    MPI_Datatype vec2;
+    MPI_Type_dup(vec, &vec2);            /* copy_cb transforms 42->1042 */
+    CHECK(g_type_copies == 1, 8);
+    CHECK(MPI_Type_get_attr(vec2, tkv, &got, &flag) == MPI_SUCCESS, 9);
+    CHECK(flag && (intptr_t)got == 1042, 10);
+    /* overwrite fires delete on the old value */
+    CHECK(MPI_Type_set_attr(vec, tkv, (void *)43) == MPI_SUCCESS, 11);
+    CHECK(g_type_deletes == 1, 12);
+    MPI_Type_free(&vec2);                /* delete fires for its attr */
+    CHECK(g_type_deletes == 2, 13);
+    CHECK(MPI_Type_delete_attr(vec, tkv) == MPI_SUCCESS, 14);
+    CHECK(g_type_deletes == 3, 15);
+    MPI_Type_free(&vec);
+    CHECK(g_type_deletes == 3, 16);      /* no attr left: no callback */
+    CHECK(MPI_Type_free_keyval(&tkv) == MPI_SUCCESS, 17);
+    CHECK(tkv == MPI_KEYVAL_INVALID, 18);
+
+    /* ---- win keyvals + predefined window attributes ---- */
+    int wkv;
+    CHECK(MPI_Win_create_keyval(MPI_WIN_NULL_COPY_FN, win_delete_cb,
+                                &wkv, NULL) == MPI_SUCCESS, 19);
+    double wbuf_store[32];
+    void *base;
+    MPI_Win win;
+    CHECK(MPI_Win_allocate(256, 8, MPI_INFO_NULL, MPI_COMM_WORLD,
+                           &base, &win) == MPI_SUCCESS, 20);
+    CHECK(MPI_Win_set_attr(win, wkv, (void *)7) == MPI_SUCCESS, 21);
+    CHECK(MPI_Win_get_attr(win, wkv, &got, &flag) == MPI_SUCCESS, 22);
+    CHECK(flag && (intptr_t)got == 7, 23);
+    /* predefined: BASE/SIZE/DISP_UNIT/CREATE_FLAVOR/MODEL */
+    void *qbase;
+    CHECK(MPI_Win_get_attr(win, MPI_WIN_BASE, &qbase, &flag)
+          == MPI_SUCCESS, 24);
+    CHECK(flag && qbase == base, 25);
+    MPI_Aint *qsize;
+    CHECK(MPI_Win_get_attr(win, MPI_WIN_SIZE, &qsize, &flag)
+          == MPI_SUCCESS, 26);
+    CHECK(flag && *qsize == 256, 27);
+    int *qdu;
+    CHECK(MPI_Win_get_attr(win, MPI_WIN_DISP_UNIT, &qdu, &flag)
+          == MPI_SUCCESS, 28);
+    CHECK(flag && *qdu == 8, 29);
+    int *qflavor;
+    CHECK(MPI_Win_get_attr(win, MPI_WIN_CREATE_FLAVOR, &qflavor,
+                           &flag) == MPI_SUCCESS, 30);
+    CHECK(flag && *qflavor == MPI_WIN_FLAVOR_ALLOCATE, 31);
+    int *qmodel;
+    CHECK(MPI_Win_get_attr(win, MPI_WIN_MODEL, &qmodel, &flag)
+          == MPI_SUCCESS, 32);
+    CHECK(flag && (*qmodel == MPI_WIN_UNIFIED
+                   || *qmodel == MPI_WIN_SEPARATE), 33);
+    /* predefined attrs are read-only */
+    CHECK(MPI_Win_set_attr(win, MPI_WIN_SIZE, (void *)1)
+          == MPI_ERR_ARG, 34);
+    /* a second window (CREATE flavor over user memory) */
+    MPI_Win win2;
+    CHECK(MPI_Win_create(wbuf_store, sizeof wbuf_store, 8,
+                         MPI_INFO_NULL, MPI_COMM_WORLD, &win2)
+          == MPI_SUCCESS, 35);
+    CHECK(MPI_Win_get_attr(win2, MPI_WIN_CREATE_FLAVOR, &qflavor,
+                           &flag) == MPI_SUCCESS, 36);
+    CHECK(flag && *qflavor == MPI_WIN_FLAVOR_CREATE, 37);
+    CHECK(MPI_Win_get_attr(win2, MPI_WIN_BASE, &qbase, &flag)
+          == MPI_SUCCESS, 38);
+    CHECK(flag && qbase == (void *)wbuf_store, 39);
+    MPI_Win_free(&win2);
+    MPI_Win_free(&win);                  /* fires win_delete_cb */
+    CHECK(g_win_deletes == 1, 40);
+    CHECK(MPI_Win_free_keyval(&wkv) == MPI_SUCCESS, 41);
+
+    /* ---- deprecated MPI-1 attr API (aliases over comm keyvals) -- */
+    int okv;
+    CHECK(MPI_Keyval_create(MPI_COMM_NULL_COPY_FN,
+                            MPI_COMM_NULL_DELETE_FN, &okv, NULL)
+          == MPI_SUCCESS, 42);
+    CHECK(MPI_Attr_put(MPI_COMM_WORLD, okv, (void *)99)
+          == MPI_SUCCESS, 43);
+    CHECK(MPI_Attr_get(MPI_COMM_WORLD, okv, &got, &flag)
+          == MPI_SUCCESS, 44);
+    CHECK(flag && (intptr_t)got == 99, 45);
+    CHECK(MPI_Attr_delete(MPI_COMM_WORLD, okv) == MPI_SUCCESS, 46);
+    CHECK(MPI_Attr_get(MPI_COMM_WORLD, okv, &got, &flag)
+          == MPI_SUCCESS && !flag, 47);
+    CHECK(MPI_Keyval_free(&okv) == MPI_SUCCESS, 48);
+
+    /* ---- USER errhandler on a dup'd comm: fires on a real error,
+     * call resumes (the library-recovery idiom) ---- */
+    MPI_Comm dup;
+    MPI_Comm_dup(MPI_COMM_WORLD, &dup);
+    MPI_Errhandler ueh;
+    CHECK(MPI_Comm_create_errhandler(comm_errh_fn, &ueh)
+          == MPI_SUCCESS, 49);
+    CHECK(MPI_Comm_set_errhandler(dup, ueh) == MPI_SUCCESS, 50);
+    MPI_Errhandler qeh;
+    int dummy = 5;
+    int rc = MPI_Send(&dummy, 1, MPI_INT, 77, 0, dup);  /* bad rank */
+    CHECK(g_errh_fired == 1, 51);
+    CHECK(rc == g_errh_code && rc != MPI_SUCCESS, 52);
+    /* Comm_call_errhandler drives it directly */
+    CHECK(MPI_Comm_call_errhandler(dup, MPI_ERR_OTHER)
+          == MPI_SUCCESS, 53);
+    CHECK(g_errh_fired == 2 && g_errh_code == MPI_ERR_OTHER, 54);
+    MPI_Comm_free(&dup);
+
+    /* ---- win errhandler: set/get/call with a user function ---- */
+    MPI_Win win3;
+    CHECK(MPI_Win_allocate(64, 1, MPI_INFO_NULL, MPI_COMM_WORLD,
+                           &base, &win3) == MPI_SUCCESS, 55);
+    MPI_Errhandler weh;
+    CHECK(MPI_Win_create_errhandler(win_errh_fn, &weh)
+          == MPI_SUCCESS, 56);
+    CHECK(MPI_Win_set_errhandler(win3, weh) == MPI_SUCCESS, 57);
+    CHECK(MPI_Win_get_errhandler(win3, &qeh) == MPI_SUCCESS
+          && qeh == weh, 58);
+    CHECK(MPI_Win_call_errhandler(win3, MPI_ERR_ARG)
+          == MPI_SUCCESS, 59);
+    CHECK(g_errh_fired == 102 && g_errh_code == MPI_ERR_ARG, 60);
+    MPI_Win_free(&win3);
+
+    /* ---- file errhandler: default is MPI_ERRORS_RETURN ---- */
+    MPI_Errhandler feh;
+    CHECK(MPI_File_get_errhandler(MPI_FILE_NULL, &feh) == MPI_SUCCESS,
+          61);
+    CHECK(feh == MPI_ERRORS_RETURN, 62);
+    /* an erroneous open RETURNS instead of aborting */
+    MPI_File bad;
+    rc = MPI_File_open(MPI_COMM_WORLD, "/nonexistent-dir/x",
+                       MPI_MODE_RDONLY, MPI_INFO_NULL, &bad);
+    CHECK(rc != MPI_SUCCESS, 63);
+
+    /* ---- session errhandler surface ---- */
+    MPI_Session sess;
+    CHECK(MPI_Session_init(MPI_INFO_NULL, MPI_ERRORS_RETURN, &sess)
+          == MPI_SUCCESS, 64);
+    CHECK(MPI_Session_set_errhandler(sess, MPI_ERRORS_RETURN)
+          == MPI_SUCCESS, 65);
+    CHECK(MPI_Session_get_errhandler(sess, &qeh) == MPI_SUCCESS
+          && qeh == MPI_ERRORS_RETURN, 66);
+    CHECK(MPI_Session_call_errhandler(sess, MPI_ERR_OTHER)
+          == MPI_SUCCESS, 67);
+    MPI_Session_finalize(&sess);
+
+    /* ---- dynamic error space: LIFO removal enforced (the
+     * out-of-order probe must RETURN its error, not abort) ---- */
+    MPI_Comm_set_errhandler(MPI_COMM_WORLD, MPI_ERRORS_RETURN);
+    int ec1, ec2, code1;
+    CHECK(MPI_Add_error_class(&ec1) == MPI_SUCCESS, 68);
+    CHECK(MPI_Add_error_class(&ec2) == MPI_SUCCESS, 69);
+    CHECK(MPI_Add_error_code(ec2, &code1) == MPI_SUCCESS, 70);
+    CHECK(MPI_Add_error_string(code1, "homemade failure")
+          == MPI_SUCCESS, 71);
+    CHECK(MPI_Remove_error_class(ec1) != MPI_SUCCESS, 72);  /* not last */
+    CHECK(MPI_Remove_error_string(code1) == MPI_SUCCESS, 73);
+    CHECK(MPI_Remove_error_code(code1) == MPI_SUCCESS, 74);
+    CHECK(MPI_Remove_error_class(ec2) == MPI_SUCCESS, 75);
+    CHECK(MPI_Remove_error_class(ec1) == MPI_SUCCESS, 76);
+
+    MPI_Barrier(MPI_COMM_WORLD);
+    printf("OK c31_attrs_errh\n");
+    MPI_Finalize();
+    return 0;
+}
