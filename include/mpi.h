@@ -1211,6 +1211,65 @@ MPI_Fint MPI_Group_c2f(MPI_Group group);
 MPI_Group MPI_Group_f2c(MPI_Fint group);
 MPI_Fint MPI_Op_c2f(MPI_Op op);
 MPI_Op MPI_Op_f2c(MPI_Fint op);
+MPI_Fint MPI_Errhandler_c2f(MPI_Errhandler errhandler);
+MPI_Errhandler MPI_Errhandler_f2c(MPI_Fint errhandler);
+MPI_Fint MPI_File_c2f(MPI_File file);
+MPI_File MPI_File_f2c(MPI_Fint file);
+MPI_Fint MPI_Info_c2f(MPI_Info info);
+MPI_Info MPI_Info_f2c(MPI_Fint info);
+MPI_Fint MPI_Message_c2f(MPI_Message message);
+MPI_Message MPI_Message_f2c(MPI_Fint message);
+MPI_Fint MPI_Request_c2f(MPI_Request request);
+MPI_Request MPI_Request_f2c(MPI_Fint request);
+MPI_Fint MPI_Session_c2f(MPI_Session session);
+MPI_Session MPI_Session_f2c(MPI_Fint session);
+MPI_Fint MPI_Win_c2f(MPI_Win win);
+MPI_Win MPI_Win_f2c(MPI_Fint win);
+
+/* ---- round-5 wave 7: Fortran status forms, status/request-set
+ * queries, f90 parametric types, value-index pairs ---- */
+#define MPI_F_STATUS_SIZE 6
+typedef MPI_Status MPI_F08_status;       /* same layout by design */
+#define MPI_STATUS_IGNORE_F ((MPI_Fint *)0)
+int MPI_Status_c2f(const MPI_Status *c_status, MPI_Fint *f_status);
+int MPI_Status_f2c(const MPI_Fint *f_status, MPI_Status *c_status);
+int MPI_Status_c2f08(const MPI_Status *c_status,
+                     MPI_F08_status *f08_status);
+int MPI_Status_f082c(const MPI_F08_status *f08_status,
+                     MPI_Status *c_status);
+int MPI_Status_f2f08(const MPI_Fint *f_status,
+                     MPI_F08_status *f08_status);
+int MPI_Status_f082f(const MPI_F08_status *f08_status,
+                     MPI_Fint *f_status);
+int MPI_Status_get_source(const MPI_Status *status, int *source);
+int MPI_Status_get_tag(const MPI_Status *status, int *tag);
+int MPI_Status_get_error(const MPI_Status *status, int *error);
+int MPI_Request_get_status_all(int count,
+                               MPI_Request array_of_requests[],
+                               int *flag,
+                               MPI_Status array_of_statuses[]);
+int MPI_Request_get_status_any(int count,
+                               MPI_Request array_of_requests[],
+                               int *index, int *flag,
+                               MPI_Status *status);
+int MPI_Request_get_status_some(int incount,
+                                MPI_Request array_of_requests[],
+                                int *outcount, int array_of_indices[],
+                                MPI_Status array_of_statuses[]);
+int MPI_Testsome(int incount, MPI_Request array_of_requests[],
+                 int *outcount, int array_of_indices[],
+                 MPI_Status array_of_statuses[]);
+int MPI_Type_get_true_extent_x(MPI_Datatype datatype,
+                               MPI_Count *true_lb,
+                               MPI_Count *true_extent);
+int MPI_Type_get_value_index(MPI_Datatype value_type,
+                             MPI_Datatype index_type,
+                             MPI_Datatype *pair_type);
+int MPI_Type_create_f90_real(int precision, int range,
+                             MPI_Datatype *newtype);
+int MPI_Type_create_f90_complex(int precision, int range,
+                                MPI_Datatype *newtype);
+int MPI_Type_create_f90_integer(int range, MPI_Datatype *newtype);
 int MPI_Type_match_size(int typeclass, int size,
                         MPI_Datatype *datatype);
 #define MPI_TYPECLASS_REAL    1

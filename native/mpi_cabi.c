@@ -177,6 +177,24 @@ static req_entry *req_new(void)
     return (req_entry *)calloc(1, sizeof(req_entry));
 }
 
+/* Fortran-index table for request handles (defined with the wave-7
+ * conversion chapter; slots reclaimed here when an entry dies) */
+#define REQ_F_MAX 1024
+static MPI_Request g_req_f[REQ_F_MAX];
+static int g_req_f_n;
+
+static void req_f_drop(req_entry *e)
+{
+    /* PyGILState_Ensure nests: callers may or may not hold the GIL */
+    PyGILState_STATE g = PyGILState_Ensure();
+    for (int i = 0; i < g_req_f_n; i++)
+        if (g_req_f[i] == (MPI_Request)(intptr_t)e) {
+            g_req_f[i] = MPI_REQUEST_NULL;
+            break;
+        }
+    PyGILState_Release(g);
+}
+
 /* ------------------------------------------------------------------ */
 /* bring-up                                                            */
 /* ------------------------------------------------------------------ */
@@ -954,7 +972,8 @@ int PMPI_Wait(MPI_Request *request, MPI_Status *status)
             *status = tmp;
         if (e->greq_free)
             e->greq_free(e->greq_extra);
-        free(e);
+                req_f_drop(e);
+                free(e);
         *request = MPI_REQUEST_NULL;
         return rc;
     }
@@ -972,7 +991,8 @@ int PMPI_Wait(MPI_Request *request, MPI_Status *status)
         e->pyh = 0;
         return rc;
     }
-    free(e);
+        req_f_drop(e);
+        free(e);
     *request = MPI_REQUEST_NULL;
     return rc;
 }
@@ -1039,7 +1059,8 @@ int PMPI_Test(MPI_Request *request, int *flag, MPI_Status *status)
             *status = tmp;
         if (e->greq_free)
             e->greq_free(e->greq_extra);
-        free(e);
+                req_f_drop(e);
+                free(e);
         *request = MPI_REQUEST_NULL;
         return rc;
     }
@@ -1058,7 +1079,8 @@ int PMPI_Test(MPI_Request *request, int *flag, MPI_Status *status)
         if (e->persistent) {
             e->pyh = 0;
         } else {
-            free(e);
+                        req_f_drop(e);
+                        free(e);
             *request = MPI_REQUEST_NULL;
         }
         if (status)
@@ -1072,7 +1094,8 @@ int PMPI_Test(MPI_Request *request, int *flag, MPI_Status *status)
             if (e->persistent) {
                 e->pyh = 0;              /* inactive, reusable */
             } else {
-                free(e);
+                                req_f_drop(e);
+                                free(e);
                 *request = MPI_REQUEST_NULL;
             }
         }
@@ -2019,7 +2042,8 @@ int PMPI_Request_free(MPI_Request *request)
         else
             Py_DECREF(r);
         GIL_END;
-        free(e);
+                req_f_drop(e);
+                free(e);
         *request = MPI_REQUEST_NULL;
         return MPI_SUCCESS;
     }
@@ -2043,7 +2067,8 @@ int PMPI_Request_free(MPI_Request *request)
             Py_DECREF(pr);
         GIL_END;
     }
-    free(e);
+        req_f_drop(e);
+        free(e);
     *request = MPI_REQUEST_NULL;
     return rc;
 }
@@ -8836,6 +8861,363 @@ int PMPI_Remove_error_string(int errorcode)
 {
     return err_remove("remove_error_string",
                       "MPI_Remove_error_string", errorcode);
+}
+
+/* ------------------------------------------------------------------ */
+/* round-5 wave 7: handle-conversion closure (errhandler/file/info/
+ * message/request/session/win _c2f/_f2c), Fortran status forms,
+ * status/request-set queries, f90 parametric types
+ * (type_create_f90_real.c.in family).                                 */
+/* ------------------------------------------------------------------ */
+
+MPI_Fint PMPI_Errhandler_c2f(MPI_Errhandler e) { return (MPI_Fint)e; }
+MPI_Errhandler PMPI_Errhandler_f2c(MPI_Fint e)
+{
+    return (MPI_Errhandler)e;
+}
+MPI_Fint PMPI_File_c2f(MPI_File f) { return (MPI_Fint)f; }
+MPI_File PMPI_File_f2c(MPI_Fint f) { return (MPI_File)f; }
+MPI_Fint PMPI_Info_c2f(MPI_Info i) { return (MPI_Fint)i; }
+MPI_Info PMPI_Info_f2c(MPI_Fint i) { return (MPI_Info)i; }
+MPI_Fint PMPI_Message_c2f(MPI_Message m) { return (MPI_Fint)m; }
+MPI_Message PMPI_Message_f2c(MPI_Fint m) { return (MPI_Message)m; }
+MPI_Fint PMPI_Session_c2f(MPI_Session s) { return (MPI_Fint)s; }
+MPI_Session PMPI_Session_f2c(MPI_Fint s) { return (MPI_Session)s; }
+MPI_Fint PMPI_Win_c2f(MPI_Win w) { return (MPI_Fint)w; }
+MPI_Win PMPI_Win_f2c(MPI_Fint w) { return (MPI_Win)w; }
+
+/* Requests are POINTER handles (req_entry*): a 64-bit pointer does
+ * not fit a Fortran INTEGER, so c2f hands out indices into a live
+ * table (the reference's f2c pointer-array role, ompi_request_t
+ * f_to_c_index). Slots are reclaimed when the request is destroyed
+ * (req_f_drop at every free(e) site) and reused; access is
+ * serialized by the GIL — THREAD_MULTIPLE programs may convert
+ * concurrently. */
+MPI_Fint PMPI_Request_c2f(MPI_Request request)
+{
+    if (request == MPI_REQUEST_NULL)
+        return -1;
+    GIL_BEGIN;
+    MPI_Fint out = -1;
+    int hole = -1;
+    for (int i = 0; i < g_req_f_n; i++) {
+        if (g_req_f[i] == request) {
+            out = (MPI_Fint)i;
+            break;
+        }
+        if (g_req_f[i] == MPI_REQUEST_NULL && hole < 0)
+            hole = i;
+    }
+    if (out < 0) {
+        if (hole >= 0) {
+            g_req_f[hole] = request;
+            out = (MPI_Fint)hole;
+        } else if (g_req_f_n < REQ_F_MAX) {
+            g_req_f[g_req_f_n] = request;
+            out = (MPI_Fint)g_req_f_n++;
+        }
+    }
+    GIL_END;
+    return out;
+}
+
+MPI_Request PMPI_Request_f2c(MPI_Fint f)
+{
+    GIL_BEGIN;
+    MPI_Request out = (f < 0 || f >= g_req_f_n) ? MPI_REQUEST_NULL
+                                                : g_req_f[f];
+    GIL_END;
+    return out;
+}
+
+/* ---- Fortran status forms (status_c2f.c.in family): the Fortran
+ * status is MPI_F_STATUS_SIZE integers mirroring the C struct; the
+ * f08 form shares the C layout outright ---------------------------- */
+int PMPI_Status_c2f(const MPI_Status *c_status, MPI_Fint *f_status)
+{
+    if (!c_status || !f_status)
+        return MPI_ERR_ARG;
+    f_status[0] = c_status->MPI_SOURCE;
+    f_status[1] = c_status->MPI_TAG;
+    f_status[2] = c_status->MPI_ERROR;
+    f_status[3] = c_status->_cancelled;
+    f_status[4] = (MPI_Fint)(c_status->_count & 0xffffffffLL);
+    f_status[5] = (MPI_Fint)(c_status->_count >> 32);
+    return MPI_SUCCESS;
+}
+
+int PMPI_Status_f2c(const MPI_Fint *f_status, MPI_Status *c_status)
+{
+    if (!f_status || !c_status)
+        return MPI_ERR_ARG;
+    c_status->MPI_SOURCE = f_status[0];
+    c_status->MPI_TAG = f_status[1];
+    c_status->MPI_ERROR = f_status[2];
+    c_status->_cancelled = f_status[3];
+    c_status->_count = ((long long)f_status[5] << 32)
+        | (unsigned int)f_status[4];
+    return MPI_SUCCESS;
+}
+
+int PMPI_Status_c2f08(const MPI_Status *c_status,
+                     MPI_F08_status *f08_status)
+{
+    if (!c_status || !f08_status)
+        return MPI_ERR_ARG;
+    *f08_status = *c_status;
+    return MPI_SUCCESS;
+}
+
+int PMPI_Status_f082c(const MPI_F08_status *f08_status,
+                     MPI_Status *c_status)
+{
+    if (!f08_status || !c_status)
+        return MPI_ERR_ARG;
+    *c_status = *f08_status;
+    return MPI_SUCCESS;
+}
+
+int PMPI_Status_f2f08(const MPI_Fint *f_status,
+                     MPI_F08_status *f08_status)
+{
+    return PMPI_Status_f2c(f_status, f08_status);
+}
+
+int PMPI_Status_f082f(const MPI_F08_status *f08_status,
+                     MPI_Fint *f_status)
+{
+    return PMPI_Status_c2f(f08_status, f_status);
+}
+
+int PMPI_Status_get_source(const MPI_Status *status, int *source)
+{
+    if (!status || !source)
+        return MPI_ERR_ARG;
+    *source = status->MPI_SOURCE;
+    return MPI_SUCCESS;
+}
+
+int PMPI_Status_get_tag(const MPI_Status *status, int *tag)
+{
+    if (!status || !tag)
+        return MPI_ERR_ARG;
+    *tag = status->MPI_TAG;
+    return MPI_SUCCESS;
+}
+
+int PMPI_Status_get_error(const MPI_Status *status, int *error)
+{
+    if (!status || !error)
+        return MPI_ERR_ARG;
+    *error = status->MPI_ERROR;
+    return MPI_SUCCESS;
+}
+
+/* ---- non-destructive request-set queries
+ * (request_get_status_all.c.in family, MPI-4): Request_get_status
+ * per entry — nothing completes, nothing is freed ------------------ */
+int PMPI_Request_get_status_all(int count,
+                               MPI_Request array_of_requests[],
+                               int *flag,
+                               MPI_Status array_of_statuses[])
+{
+    *flag = 1;
+    for (int i = 0; i < count; i++) {
+        int f1 = 0;
+        int rc = PMPI_Request_get_status(
+            array_of_requests[i], &f1,
+            array_of_statuses ? &array_of_statuses[i]
+                              : MPI_STATUS_IGNORE);
+        if (rc != MPI_SUCCESS)
+            return rc;
+        if (!f1) {
+            *flag = 0;                   /* statuses undefined then */
+            return MPI_SUCCESS;
+        }
+    }
+    return MPI_SUCCESS;
+}
+
+int PMPI_Request_get_status_any(int count,
+                               MPI_Request array_of_requests[],
+                               int *index, int *flag,
+                               MPI_Status *status)
+{
+    int active = 0;
+    *flag = 0;
+    *index = MPI_UNDEFINED;
+    for (int i = 0; i < count; i++) {
+        if (array_of_requests[i] == MPI_REQUEST_NULL)
+            continue;
+        req_entry *e = (req_entry *)(intptr_t)array_of_requests[i];
+        if (e->persistent && e->pyh == 0)
+            continue;                    /* inactive: not in the set */
+        active++;
+        int f1 = 0;
+        int rc = PMPI_Request_get_status(array_of_requests[i], &f1,
+                                        status);
+        if (rc != MPI_SUCCESS)
+            return rc;
+        if (f1) {
+            *flag = 1;
+            *index = i;
+            return MPI_SUCCESS;
+        }
+    }
+    if (!active) {                       /* nothing to wait on */
+        *flag = 1;
+        set_status(status, MPI_ANY_SOURCE, MPI_ANY_TAG, 0);
+    }
+    return MPI_SUCCESS;
+}
+
+int PMPI_Request_get_status_some(int incount,
+                                MPI_Request array_of_requests[],
+                                int *outcount,
+                                int array_of_indices[],
+                                MPI_Status array_of_statuses[])
+{
+    int active = 0, done = 0;
+    for (int i = 0; i < incount; i++) {
+        if (array_of_requests[i] == MPI_REQUEST_NULL)
+            continue;
+        req_entry *e = (req_entry *)(intptr_t)array_of_requests[i];
+        if (e->persistent && e->pyh == 0)
+            continue;                    /* inactive: not in the set */
+        active++;
+        int f1 = 0;
+        int rc = PMPI_Request_get_status(
+            array_of_requests[i], &f1,
+            array_of_statuses ? &array_of_statuses[done]
+                              : MPI_STATUS_IGNORE);
+        if (rc != MPI_SUCCESS)
+            return rc;
+        if (f1)
+            array_of_indices[done++] = i;
+    }
+    *outcount = active ? done : MPI_UNDEFINED;
+    return MPI_SUCCESS;
+}
+
+int PMPI_Testsome(int incount, MPI_Request array_of_requests[],
+                 int *outcount, int array_of_indices[],
+                 MPI_Status array_of_statuses[])
+{
+    int active = 0, done = 0;
+    for (int i = 0; i < incount; i++) {
+        if (array_of_requests[i] == MPI_REQUEST_NULL)
+            continue;
+        req_entry *e = (req_entry *)(intptr_t)array_of_requests[i];
+        if (e->persistent && e->pyh == 0)
+            continue;                    /* inactive: not in the set */
+        active++;
+        int f1 = 0;
+        int rc = PMPI_Test(&array_of_requests[i], &f1,
+                          array_of_statuses ? &array_of_statuses[done]
+                                            : MPI_STATUS_IGNORE);
+        if (rc != MPI_SUCCESS)
+            return rc;
+        if (f1)
+            array_of_indices[done++] = i;
+    }
+    *outcount = active ? done : MPI_UNDEFINED;
+    return MPI_SUCCESS;
+}
+
+int PMPI_Type_get_true_extent_x(MPI_Datatype datatype,
+                               MPI_Count *true_lb,
+                               MPI_Count *true_extent)
+{
+    MPI_Aint lb, ext;
+    int rc = PMPI_Type_get_true_extent(datatype, &lb, &ext);
+    if (rc == MPI_SUCCESS) {
+        *true_lb = (MPI_Count)lb;
+        *true_extent = (MPI_Count)ext;
+    }
+    return rc;
+}
+
+int PMPI_Type_get_value_index(MPI_Datatype value_type,
+                             MPI_Datatype index_type,
+                             MPI_Datatype *pair_type)
+{
+    /* invalid handles are ERRORS, not the standard's NULL escape
+     * hatch (that hatch means "valid types, no pair representable") */
+    if (!dt_extent(value_type) || !dt_extent(index_type))
+        return MPI_ERR_TYPE;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "type_get_value_index",
+                                      "ll", (long)value_type,
+                                      (long)index_type);
+    if (!r) {
+        rc = handle_error("MPI_Type_get_value_index");
+    } else {
+        *pair_type = (MPI_Datatype)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+/* ---- f90 parametric types (type_create_f90_real.c.in family): map
+ * (precision, range) requests onto the IEEE basic types exactly as
+ * selected_real_kind/selected_int_kind would ---------------------- */
+int PMPI_Type_create_f90_real(int precision, int range,
+                             MPI_Datatype *newtype)
+{
+    int p_ok_f = (precision == MPI_UNDEFINED || precision <= 6);
+    int r_ok_f = (range == MPI_UNDEFINED || range <= 37);
+    int p_ok_d = (precision == MPI_UNDEFINED || precision <= 15);
+    int r_ok_d = (range == MPI_UNDEFINED || range <= 307);
+    if (p_ok_f && r_ok_f)
+        *newtype = MPI_FLOAT;
+    else if (p_ok_d && r_ok_d)
+        *newtype = MPI_DOUBLE;
+    else
+        return MPI_ERR_ARG;
+    return MPI_SUCCESS;
+}
+
+int PMPI_Type_create_f90_integer(int range, MPI_Datatype *newtype)
+{
+    if (range <= 2)
+        *newtype = MPI_INT8_T;
+    else if (range <= 4)
+        *newtype = MPI_INT16_T;
+    else if (range <= 9)
+        *newtype = MPI_INT32_T;
+    else if (range <= 18)
+        *newtype = MPI_INT64_T;
+    else
+        return MPI_ERR_ARG;
+    return MPI_SUCCESS;
+}
+
+int PMPI_Type_create_f90_complex(int precision, int range,
+                                MPI_Datatype *newtype)
+{
+    /* a complex is two reals of the selected kind: a committed
+     * contiguous(2, real) derived type, usable for pt2pt/collective
+     * data movement. CACHED per kind — repeated calls with the same
+     * (p, r) must return the identical handle (MPI-4 19.1.5), and
+     * the result is predefined-like (the user never frees it). */
+    static MPI_Datatype cache[2];        /* [0] float, [1] double */
+    MPI_Datatype real_t;
+    int rc = PMPI_Type_create_f90_real(precision, range, &real_t);
+    if (rc != MPI_SUCCESS)
+        return rc;
+    int k = (real_t == MPI_DOUBLE);
+    if (cache[k] != MPI_DATATYPE_NULL) {
+        *newtype = cache[k];
+        return MPI_SUCCESS;
+    }
+    rc = PMPI_Type_contiguous(2, real_t, newtype);
+    if (rc == MPI_SUCCESS)
+        rc = PMPI_Type_commit(newtype);
+    if (rc == MPI_SUCCESS)
+        cache[k] = *newtype;
+    return rc;
 }
 
 /* ------------------------------------------------------------------ */

@@ -4304,6 +4304,35 @@ def remove_error_string(code: int) -> None:
         raise MPIError(ERR_ARG, f"no string set for code {code}")
 
 
+# ---- MPI_Type_get_value_index (MPI-4.1, type_get_value_index.c.in):
+# the (value, index) pair datatype. Built lazily as a packed struct
+# over the existing constructor machinery and cached, so the returned
+# handle is USABLE from C (send/recv/pack) — stronger than the
+# standard's MPI_DATATYPE_NULL escape hatch. -------------------------
+_value_index_cache: Dict[Tuple[int, int], int] = {}
+
+
+def type_get_value_index(vdt: int, idt: int) -> int:
+    key = (int(vdt), int(idt))
+    h = _value_index_cache.get(key)
+    if h is None:
+        vsz = type_size_bytes(vdt)
+        isz = type_size_bytes(idt)
+        counts = np.array([1, 1], np.intc).tobytes()
+        displs = np.array([0, vsz], np.int64).tobytes()
+        types = np.array([int(vdt), int(idt)], np.int64).tobytes()
+        h = type_create_struct(counts, displs, types)
+        # pad the extent to the C struct's (basic types: alignment ==
+        # size), so an array of `struct {value; index;}` strides right
+        align = max(vsz, isz, 1)
+        ext = -(-(vsz + isz) // align) * align
+        if type_extent_bytes(h) != ext:
+            h = type_create_resized(h, 0, ext)
+        type_commit(h)
+        _value_index_cache[key] = h
+    return h
+
+
 # activate the constructor-envelope recorders (must run after every
 # constructor definition; see _record_env_wrappers)
 _record_env_wrappers()

@@ -1,0 +1,173 @@
+/* Wave 7: handle-conversion closure (every object class c2f/f2c,
+ * requests through the pointer->index table), Fortran status forms,
+ * the MPI-4 Status_get_* and Request_get_status_all/any/some
+ * queries, Testsome, bigcount true-extent, value-index pair types
+ * (usable for real data movement), and f90 parametric types.
+ * Runs with -n 2. */
+#include <mpi.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <string.h>
+
+#define CHECK(cond, code)                                            \
+    do {                                                             \
+        if (!(cond)) {                                               \
+            fprintf(stderr, "rank %d: check failed at line %d\n",    \
+                    rank, __LINE__);                                 \
+            MPI_Abort(MPI_COMM_WORLD, code);                         \
+        }                                                            \
+    } while (0)
+
+int main(int argc, char **argv)
+{
+    MPI_Init(&argc, &argv);
+    int rank, size;
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &size);
+    CHECK(size == 2, 1);
+
+    /* ---- c2f/f2c: every class round-trips ---- */
+    CHECK(MPI_Comm_f2c(MPI_Comm_c2f(MPI_COMM_WORLD))
+          == MPI_COMM_WORLD, 2);
+    CHECK(MPI_Type_f2c(MPI_Type_c2f(MPI_DOUBLE)) == MPI_DOUBLE, 3);
+    CHECK(MPI_Errhandler_f2c(MPI_Errhandler_c2f(MPI_ERRORS_RETURN))
+          == MPI_ERRORS_RETURN, 4);
+    MPI_Info info;
+    MPI_Info_create(&info);
+    CHECK(MPI_Info_f2c(MPI_Info_c2f(info)) == info, 5);
+    MPI_Info_free(&info);
+    /* requests: pointer handles ride the index table */
+    int rxbuf = -1;
+    MPI_Request rq;
+    MPI_Irecv(&rxbuf, 1, MPI_INT, 1 - rank, 7, MPI_COMM_WORLD, &rq);
+    MPI_Fint frq = MPI_Request_c2f(rq);
+    CHECK(frq >= 0, 6);
+    CHECK(MPI_Request_f2c(frq) == rq, 7);
+    CHECK(MPI_Request_c2f(MPI_REQUEST_NULL) == -1, 8);
+    CHECK(MPI_Request_f2c(-1) == MPI_REQUEST_NULL, 9);
+
+    /* ---- Request_get_status_* are NON-destructive ---- */
+    int flag, idx;
+    MPI_Status st;
+    CHECK(MPI_Request_get_status_any(1, &rq, &idx, &flag, &st)
+          == MPI_SUCCESS, 10);           /* likely pending; any is ok */
+    int sendval = 40 + rank;
+    MPI_Send(&sendval, 1, MPI_INT, 1 - rank, 7, MPI_COMM_WORLD);
+    /* poll non-destructively until complete */
+    for (;;) {
+        CHECK(MPI_Request_get_status(rq, &flag, &st) == MPI_SUCCESS,
+              11);
+        if (flag)
+            break;
+    }
+    /* handle still live after get_status: the real Wait consumes */
+    CHECK(rq != MPI_REQUEST_NULL, 12);
+    int out;
+    CHECK(MPI_Request_get_status_all(1, &rq, &flag, &st)
+          == MPI_SUCCESS && flag == 1, 13);
+    MPI_Wait(&rq, &st);
+    CHECK(rxbuf == 40 + (1 - rank), 14);
+    CHECK(st.MPI_SOURCE == 1 - rank && st.MPI_TAG == 7, 15);
+
+    /* ---- Status getters + Fortran forms ---- */
+    int src, tag, err;
+    CHECK(MPI_Status_get_source(&st, &src) == MPI_SUCCESS
+          && src == 1 - rank, 16);
+    CHECK(MPI_Status_get_tag(&st, &tag) == MPI_SUCCESS && tag == 7,
+          17);
+    CHECK(MPI_Status_get_error(&st, &err) == MPI_SUCCESS, 18);
+    MPI_Fint fst[MPI_F_STATUS_SIZE];
+    CHECK(MPI_Status_c2f(&st, fst) == MPI_SUCCESS, 19);
+    CHECK(fst[0] == st.MPI_SOURCE && fst[1] == st.MPI_TAG, 20);
+    MPI_Status back;
+    CHECK(MPI_Status_f2c(fst, &back) == MPI_SUCCESS, 21);
+    int cnt_orig, cnt_back;
+    MPI_Get_count(&st, MPI_INT, &cnt_orig);
+    MPI_Get_count(&back, MPI_INT, &cnt_back);
+    CHECK(cnt_orig == 1 && cnt_back == 1, 22);
+    MPI_F08_status f08;
+    CHECK(MPI_Status_c2f08(&st, &f08) == MPI_SUCCESS, 23);
+    CHECK(f08.MPI_SOURCE == st.MPI_SOURCE, 24);
+    CHECK(MPI_Status_f082f(&f08, fst) == MPI_SUCCESS, 25);
+    CHECK(MPI_Status_f2f08(fst, &f08) == MPI_SUCCESS, 26);
+    CHECK(MPI_Status_f082c(&f08, &back) == MPI_SUCCESS
+          && back.MPI_TAG == 7, 27);
+
+    /* ---- Testsome: a mixed set (one ready, one pending) ---- */
+    int a = -1, b = -1;
+    MPI_Request duo[2];
+    MPI_Irecv(&a, 1, MPI_INT, 1 - rank, 21, MPI_COMM_WORLD, &duo[0]);
+    MPI_Irecv(&b, 1, MPI_INT, 1 - rank, 22, MPI_COMM_WORLD, &duo[1]);
+    int v = 60 + rank;
+    MPI_Send(&v, 1, MPI_INT, 1 - rank, 21, MPI_COMM_WORLD);
+    int indices[2];
+    MPI_Status sts[2];
+    int total = 0;
+    while (total < 1) {                  /* drain at least tag 21 */
+        CHECK(MPI_Testsome(2, duo, &out, indices, sts)
+              == MPI_SUCCESS, 28);
+        CHECK(out != MPI_UNDEFINED, 29);
+        total += out;
+    }
+    CHECK(a == 60 + (1 - rank), 30);
+    int w = 80 + rank;
+    MPI_Send(&w, 1, MPI_INT, 1 - rank, 22, MPI_COMM_WORLD);
+    while (total < 2) {                  /* tag 22 via Testsome too */
+        CHECK(MPI_Testsome(2, duo, &out, indices, sts)
+              == MPI_SUCCESS && out != MPI_UNDEFINED, 31);
+        total += out;
+    }
+    CHECK(b == 80 + (1 - rank), 31);
+    /* all NULL now: Testsome reports MPI_UNDEFINED */
+    CHECK(MPI_Testsome(2, duo, &out, indices, sts) == MPI_SUCCESS
+          && out == MPI_UNDEFINED, 32);
+
+    /* ---- bigcount true extent ---- */
+    MPI_Datatype vec;
+    MPI_Type_vector(3, 1, 4, MPI_INT, &vec);
+    MPI_Type_commit(&vec);
+    MPI_Count tlb, text;
+    CHECK(MPI_Type_get_true_extent_x(vec, &tlb, &text)
+          == MPI_SUCCESS, 33);
+    CHECK(tlb == 0 && text == (MPI_Count)(8 * 4 + 4), 34);
+    MPI_Type_free(&vec);
+
+    /* ---- value-index pair type: usable for real traffic ---- */
+    MPI_Datatype pair;
+    CHECK(MPI_Type_get_value_index(MPI_DOUBLE, MPI_INT, &pair)
+          == MPI_SUCCESS, 35);
+    CHECK(pair != MPI_DATATYPE_NULL, 36);
+    struct { double v; int i; } pbuf[2], prx[2];
+    memset(prx, 0, sizeof prx);
+    for (int k = 0; k < 2; k++) {
+        pbuf[k].v = rank * 10.0 + k + 0.5;
+        pbuf[k].i = rank * 100 + k;
+    }
+    MPI_Sendrecv(pbuf, 2, pair, 1 - rank, 5, prx, 2, pair, 1 - rank,
+                 5, MPI_COMM_WORLD, MPI_STATUS_IGNORE);
+    for (int k = 0; k < 2; k++) {
+        CHECK(prx[k].v == (1 - rank) * 10.0 + k + 0.5, 37);
+        CHECK(prx[k].i == (1 - rank) * 100 + k, 38);
+    }
+
+    /* ---- f90 parametric types ---- */
+    MPI_Datatype t;
+    CHECK(MPI_Type_create_f90_real(6, 30, &t) == MPI_SUCCESS
+          && t == MPI_FLOAT, 39);
+    CHECK(MPI_Type_create_f90_real(15, 300, &t) == MPI_SUCCESS
+          && t == MPI_DOUBLE, 40);
+    CHECK(MPI_Type_create_f90_real(40, 40, &t) != MPI_SUCCESS, 41);
+    CHECK(MPI_Type_create_f90_integer(4, &t) == MPI_SUCCESS
+          && t == MPI_INT16_T, 42);
+    CHECK(MPI_Type_create_f90_integer(18, &t) == MPI_SUCCESS
+          && t == MPI_INT64_T, 43);
+    CHECK(MPI_Type_create_f90_complex(6, 30, &t) == MPI_SUCCESS, 44);
+    int tsz;
+    MPI_Type_size(t, &tsz);
+    CHECK(tsz == 8, 45);                 /* two floats */
+
+    MPI_Barrier(MPI_COMM_WORLD);
+    printf("OK c32_convert_status\n");
+    MPI_Finalize();
+    return 0;
+}
