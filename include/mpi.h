@@ -1270,6 +1270,54 @@ int MPI_Type_create_f90_real(int precision, int range,
 int MPI_Type_create_f90_complex(int precision, int range,
                                 MPI_Datatype *newtype);
 int MPI_Type_create_f90_integer(int range, MPI_Datatype *newtype);
+
+/* ---- round-5 wave 8: the MPI-IO chapter closers ---- */
+int MPI_File_set_atomicity(MPI_File fh, int flag);
+int MPI_File_get_atomicity(MPI_File fh, int *flag);
+int MPI_File_get_byte_offset(MPI_File fh, MPI_Offset offset,
+                             MPI_Offset *disp);
+int MPI_File_get_group(MPI_File fh, MPI_Group *group);
+int MPI_File_iread_all(MPI_File fh, void *buf, int count,
+                       MPI_Datatype datatype, MPI_Request *request);
+int MPI_File_iwrite_all(MPI_File fh, const void *buf, int count,
+                        MPI_Datatype datatype, MPI_Request *request);
+int MPI_File_iread_at_all(MPI_File fh, MPI_Offset offset, void *buf,
+                          int count, MPI_Datatype datatype,
+                          MPI_Request *request);
+int MPI_File_iwrite_at_all(MPI_File fh, MPI_Offset offset,
+                           const void *buf, int count,
+                           MPI_Datatype datatype,
+                           MPI_Request *request);
+int MPI_File_iread_shared(MPI_File fh, void *buf, int count,
+                          MPI_Datatype datatype, MPI_Request *request);
+int MPI_File_iwrite_shared(MPI_File fh, const void *buf, int count,
+                           MPI_Datatype datatype,
+                           MPI_Request *request);
+int MPI_File_read_all_begin(MPI_File fh, void *buf, int count,
+                            MPI_Datatype datatype);
+int MPI_File_read_all_end(MPI_File fh, void *buf, MPI_Status *status);
+int MPI_File_write_all_begin(MPI_File fh, const void *buf, int count,
+                             MPI_Datatype datatype);
+int MPI_File_write_all_end(MPI_File fh, const void *buf,
+                           MPI_Status *status);
+int MPI_File_read_at_all_begin(MPI_File fh, MPI_Offset offset,
+                               void *buf, int count,
+                               MPI_Datatype datatype);
+int MPI_File_read_at_all_end(MPI_File fh, void *buf,
+                             MPI_Status *status);
+int MPI_File_write_at_all_begin(MPI_File fh, MPI_Offset offset,
+                                const void *buf, int count,
+                                MPI_Datatype datatype);
+int MPI_File_write_at_all_end(MPI_File fh, const void *buf,
+                              MPI_Status *status);
+int MPI_File_read_ordered_begin(MPI_File fh, void *buf, int count,
+                                MPI_Datatype datatype);
+int MPI_File_read_ordered_end(MPI_File fh, void *buf,
+                              MPI_Status *status);
+int MPI_File_write_ordered_begin(MPI_File fh, const void *buf,
+                                 int count, MPI_Datatype datatype);
+int MPI_File_write_ordered_end(MPI_File fh, const void *buf,
+                               MPI_Status *status);
 int MPI_Type_match_size(int typeclass, int size,
                         MPI_Datatype *datatype);
 #define MPI_TYPECLASS_REAL    1

@@ -178,10 +178,12 @@ static req_entry *req_new(void)
 }
 
 /* Fortran-index table for request handles (defined with the wave-7
- * conversion chapter; slots reclaimed here when an entry dies) */
-#define REQ_F_MAX 1024
-static MPI_Request g_req_f[REQ_F_MAX];
+ * conversion chapter; slots reclaimed here when an entry dies).
+ * GROWABLE: a full table must never alias a live request to the
+ * MPI_REQUEST_NULL sentinel. */
+static MPI_Request *g_req_f;
 static int g_req_f_n;
+static int g_req_f_cap;
 
 static void req_f_drop(req_entry *e)
 {
@@ -405,6 +407,7 @@ static int handle_error_session(MPI_Session s, const char *func)
 static void win_tab_add(MPI_Win w, void *base, MPI_Aint size, int du,
                         int flavor);
 static void win_tab_drop(MPI_Win w);
+static void split_drop_file(MPI_File fh);
 
 #define GIL_BEGIN PyGILState_STATE _gst = PyGILState_Ensure()
 #define GIL_END   PyGILState_Release(_gst)
@@ -2833,6 +2836,7 @@ int PMPI_File_close(MPI_File *fh)
         Py_DECREF(r);
     GIL_END;
     obj_errh_drop(g_file_errh, &g_file_errh_n, (long)*fh);
+    split_drop_file(*fh);
     *fh = MPI_FILE_NULL;
     return rc;
 }
@@ -8912,9 +8916,20 @@ MPI_Fint PMPI_Request_c2f(MPI_Request request)
         if (hole >= 0) {
             g_req_f[hole] = request;
             out = (MPI_Fint)hole;
-        } else if (g_req_f_n < REQ_F_MAX) {
-            g_req_f[g_req_f_n] = request;
-            out = (MPI_Fint)g_req_f_n++;
+        } else {
+            if (g_req_f_n >= g_req_f_cap) {
+                int ncap = g_req_f_cap ? g_req_f_cap * 2 : 256;
+                MPI_Request *nt = realloc(
+                    g_req_f, sizeof(MPI_Request) * (size_t)ncap);
+                if (nt) {
+                    g_req_f = nt;
+                    g_req_f_cap = ncap;
+                }
+            }
+            if (g_req_f_n < g_req_f_cap) {
+                g_req_f[g_req_f_n] = request;
+                out = (MPI_Fint)g_req_f_n++;
+            }
         }
     }
     GIL_END;
@@ -9218,6 +9233,319 @@ int PMPI_Type_create_f90_complex(int precision, int range,
     if (rc == MPI_SUCCESS)
         cache[k] = *newtype;
     return rc;
+}
+
+/* ------------------------------------------------------------------ */
+/* round-5 wave 8: the MPI-IO chapter closers — atomicity mode,
+ * byte-offset queries, the file group, nonblocking collective/shared
+ * variants, and the split-collective begin/end pairs
+ * (file_set_atomicity.c.in, file_read_all_begin.c.in families).      */
+/* ------------------------------------------------------------------ */
+
+int PMPI_File_set_atomicity(MPI_File fh, int flag)
+{
+    return file_simple("file_set_atomicity", fh, flag);
+}
+
+int PMPI_File_get_atomicity(MPI_File fh, int *flag)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "file_get_atomicity",
+                                      "l", (long)fh);
+    if (!r) {
+        rc = handle_error_file(fh, "MPI_File_get_atomicity");
+    } else {
+        *flag = (int)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_File_get_byte_offset(MPI_File fh, MPI_Offset offset,
+                             MPI_Offset *disp)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "file_get_byte_offset",
+                                      "lL", (long)fh,
+                                      (long long)offset);
+    if (!r) {
+        rc = handle_error_file(fh, "MPI_File_get_byte_offset");
+    } else {
+        *disp = (MPI_Offset)PyLong_AsLongLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_File_get_group(MPI_File fh, MPI_Group *group)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "file_get_group", "l",
+                                      (long)fh);
+    if (!r) {
+        rc = handle_error_file(fh, "MPI_File_get_group");
+    } else {
+        *group = (MPI_Group)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+/* Nonblocking COLLECTIVE variants take the independent worker path
+ * (collectiveness is the performance contract, not an observable
+ * one here — the blocking _all variants keep the real two-phase
+ * engine); the shared-pointer variants claim the pointer on the
+ * worker, the serialized-but-unspecified order MPI allows. */
+int PMPI_File_iread_all(MPI_File fh, void *buf, int count,
+                       MPI_Datatype datatype, MPI_Request *request)
+{
+    return PMPI_File_iread(fh, buf, count, datatype, request);
+}
+
+int PMPI_File_iwrite_all(MPI_File fh, const void *buf, int count,
+                        MPI_Datatype datatype, MPI_Request *request)
+{
+    return PMPI_File_iwrite(fh, buf, count, datatype, request);
+}
+
+int PMPI_File_iread_at_all(MPI_File fh, MPI_Offset offset, void *buf,
+                          int count, MPI_Datatype datatype,
+                          MPI_Request *request)
+{
+    return PMPI_File_iread_at(fh, offset, buf, count, datatype,
+                             request);
+}
+
+int PMPI_File_iwrite_at_all(MPI_File fh, MPI_Offset offset,
+                           const void *buf, int count,
+                           MPI_Datatype datatype, MPI_Request *request)
+{
+    return PMPI_File_iwrite_at(fh, offset, buf, count, datatype,
+                              request);
+}
+
+int PMPI_File_iread_shared(MPI_File fh, void *buf, int count,
+                          MPI_Datatype datatype, MPI_Request *request)
+{
+    long long woff, wlen;
+    if (!dt_window(datatype, count, &woff, &wlen))
+        return MPI_ERR_TYPE;
+    size_t sig = dt_sig(datatype) * (size_t)count;
+    GIL_BEGIN;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "file_iread_shared", "lLlN", (long)fh, (long long)sig,
+        (long)datatype, mem_ro((const char *)buf + woff,
+                               (size_t)wlen));
+    int rc = icoll_request(r, (char *)buf + woff, (size_t)wlen,
+                           request, "MPI_File_iread_shared");
+    GIL_END;
+    return rc;
+}
+
+int PMPI_File_iwrite_shared(MPI_File fh, const void *buf, int count,
+                           MPI_Datatype datatype, MPI_Request *request)
+{
+    long long woff, wlen;
+    if (!dt_window(datatype, count, &woff, &wlen))
+        return MPI_ERR_TYPE;
+    GIL_BEGIN;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "file_iwrite_shared", "lNl", (long)fh,
+        mem_ro((const char *)buf + woff, (size_t)wlen),
+        (long)datatype);
+    int rc = icoll_request(r, NULL, 0, request,
+                           "MPI_File_iwrite_shared");
+    GIL_END;
+    return rc;
+}
+
+/* ---- split collectives (read_all_begin/end families): the work
+ * runs at BEGIN through the blocking collective engine (two-phase /
+ * rank-ordered), END reports its status — the zero-overlap lower
+ * bound the standard permits, mirroring the documented i-collective
+ * edge. One outstanding split op per file (the standard's limit). -- */
+#define SPLIT_MAX 16
+static struct {
+    MPI_File fh;
+    int active;
+    MPI_Status st;
+} g_split[SPLIT_MAX];
+
+/* reserve BEFORE the blocking collective runs: a refused begin must
+ * not touch the file or the caller's buffer. GIL-serialized for
+ * THREAD_MULTIPLE callers (like the request-index table). */
+static int split_reserve(MPI_File fh)
+{
+    PyGILState_STATE g = PyGILState_Ensure();
+    int slot = -1;
+    for (int i = 0; i < SPLIT_MAX; i++) {
+        if (g_split[i].active && g_split[i].fh == fh) {
+            PyGILState_Release(g);
+            return -1;                   /* already one outstanding */
+        }
+        if (!g_split[i].active && slot < 0)
+            slot = i;
+    }
+    if (slot >= 0) {
+        g_split[slot].fh = fh;
+        g_split[slot].active = 1;
+        set_status(&g_split[slot].st, MPI_ANY_SOURCE, MPI_ANY_TAG, 0);
+    }
+    PyGILState_Release(g);
+    return slot;
+}
+
+static int split_begin(MPI_File fh, int slot, int rc,
+                       const MPI_Status *st)
+{
+    PyGILState_STATE g = PyGILState_Ensure();
+    if (rc != MPI_SUCCESS)
+        g_split[slot].active = 0;        /* failed: release */
+    else
+        g_split[slot].st = *st;
+    PyGILState_Release(g);
+    return rc;
+}
+
+static int split_end(MPI_File fh, MPI_Status *status)
+{
+    PyGILState_STATE g = PyGILState_Ensure();
+    for (int i = 0; i < SPLIT_MAX; i++)
+        if (g_split[i].active && g_split[i].fh == fh) {
+            g_split[i].active = 0;
+            if (status && status != MPI_STATUS_IGNORE)
+                *status = g_split[i].st;
+            PyGILState_Release(g);
+            return MPI_SUCCESS;
+        }
+    PyGILState_Release(g);
+    return MPI_ERR_OTHER;                /* no matching begin */
+}
+
+static void split_drop_file(MPI_File fh)
+{
+    PyGILState_STATE g = PyGILState_Ensure();
+    for (int i = 0; i < SPLIT_MAX; i++)
+        if (g_split[i].active && g_split[i].fh == fh)
+            g_split[i].active = 0;
+    PyGILState_Release(g);
+}
+
+int PMPI_File_read_all_begin(MPI_File fh, void *buf, int count,
+                            MPI_Datatype datatype)
+{
+    int slot = split_reserve(fh);
+    if (slot < 0)
+        return MPI_ERR_OTHER;            /* refused: file untouched */
+    MPI_Status st;
+    int rc = PMPI_File_read_all(fh, buf, count, datatype, &st);
+    return split_begin(fh, slot, rc, &st);
+}
+
+int PMPI_File_read_all_end(MPI_File fh, void *buf, MPI_Status *status)
+{
+    (void)buf;
+    return split_end(fh, status);
+}
+
+int PMPI_File_write_all_begin(MPI_File fh, const void *buf, int count,
+                             MPI_Datatype datatype)
+{
+    int slot = split_reserve(fh);
+    if (slot < 0)
+        return MPI_ERR_OTHER;            /* refused: file untouched */
+    MPI_Status st;
+    int rc = PMPI_File_write_all(fh, buf, count, datatype, &st);
+    return split_begin(fh, slot, rc, &st);
+}
+
+int PMPI_File_write_all_end(MPI_File fh, const void *buf,
+                           MPI_Status *status)
+{
+    (void)buf;
+    return split_end(fh, status);
+}
+
+int PMPI_File_read_at_all_begin(MPI_File fh, MPI_Offset offset,
+                               void *buf, int count,
+                               MPI_Datatype datatype)
+{
+    int slot = split_reserve(fh);
+    if (slot < 0)
+        return MPI_ERR_OTHER;            /* refused: file untouched */
+    MPI_Status st;
+    int rc = PMPI_File_read_at_all(fh, offset, buf, count, datatype,
+                                  &st);
+    return split_begin(fh, slot, rc, &st);
+}
+
+int PMPI_File_read_at_all_end(MPI_File fh, void *buf,
+                             MPI_Status *status)
+{
+    (void)buf;
+    return split_end(fh, status);
+}
+
+int PMPI_File_write_at_all_begin(MPI_File fh, MPI_Offset offset,
+                                const void *buf, int count,
+                                MPI_Datatype datatype)
+{
+    int slot = split_reserve(fh);
+    if (slot < 0)
+        return MPI_ERR_OTHER;            /* refused: file untouched */
+    MPI_Status st;
+    int rc = PMPI_File_write_at_all(fh, offset, buf, count, datatype,
+                                   &st);
+    return split_begin(fh, slot, rc, &st);
+}
+
+int PMPI_File_write_at_all_end(MPI_File fh, const void *buf,
+                              MPI_Status *status)
+{
+    (void)buf;
+    return split_end(fh, status);
+}
+
+int PMPI_File_read_ordered_begin(MPI_File fh, void *buf, int count,
+                                MPI_Datatype datatype)
+{
+    int slot = split_reserve(fh);
+    if (slot < 0)
+        return MPI_ERR_OTHER;            /* refused: file untouched */
+    MPI_Status st;
+    int rc = PMPI_File_read_ordered(fh, buf, count, datatype, &st);
+    return split_begin(fh, slot, rc, &st);
+}
+
+int PMPI_File_read_ordered_end(MPI_File fh, void *buf,
+                              MPI_Status *status)
+{
+    (void)buf;
+    return split_end(fh, status);
+}
+
+int PMPI_File_write_ordered_begin(MPI_File fh, const void *buf,
+                                 int count, MPI_Datatype datatype)
+{
+    int slot = split_reserve(fh);
+    if (slot < 0)
+        return MPI_ERR_OTHER;            /* refused: file untouched */
+    MPI_Status st;
+    int rc = PMPI_File_write_ordered(fh, buf, count, datatype, &st);
+    return split_begin(fh, slot, rc, &st);
+}
+
+int PMPI_File_write_ordered_end(MPI_File fh, const void *buf,
+                               MPI_Status *status)
+{
+    (void)buf;
+    return split_end(fh, status);
 }
 
 /* ------------------------------------------------------------------ */

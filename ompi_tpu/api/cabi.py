@@ -2600,6 +2600,7 @@ def file_close(fh: int) -> None:
         _file_amodes.pop(fh, None)
         _file_views.pop(fh, None)
         _file_pos.pop(fh, None)
+        _file_atomicity.pop(fh, None)
     if f is None:
         raise MPIError(ERR_ARG, f"invalid file handle {fh}")
     f.close()
@@ -4331,6 +4332,76 @@ def type_get_value_index(vdt: int, idt: int) -> int:
         type_commit(h)
         _value_index_cache[key] = h
     return h
+
+
+# ---------------------------------------------------------------------
+# wave 8: MPI-IO chapter closers (file_set_atomicity.c.in,
+# file_get_byte_offset.c.in, file_iread_shared.c.in families)
+# ---------------------------------------------------------------------
+_file_atomicity: Dict[int, int] = {}
+
+
+def file_set_atomicity(fh: int, flag: int) -> None:
+    """Recorded and reported; writes on this runtime are pwrite-run
+    atomic already (one OS write per coalesced run), the property the
+    flag requests."""
+    _file(fh)
+    _file_atomicity[fh] = int(bool(flag))
+
+
+def file_get_atomicity(fh: int) -> int:
+    _file(fh)
+    return _file_atomicity.get(fh, 0)
+
+
+def file_get_byte_offset(fh: int, offset: int) -> int:
+    """MPI_File_get_byte_offset: a view-relative offset in ETYPE units
+    -> the absolute byte displacement in the file (through the
+    filetype tiling)."""
+    _file(fh)
+    disp, et, ft, _rep = _view_of(fh)
+    esz = type_size_bytes(et)
+    vis = int(offset) * esz
+    sigb = type_size_bytes(ft)
+    extb = type_extent_bytes(ft)
+    if sigb == extb:                     # contiguous view
+        return disp + vis
+    bidx = _to_byte_idx(ft)
+    return disp + (vis // sigb) * extb + int(bidx[vis % sigb])
+
+
+def file_get_group(fh: int) -> int:
+    return _register_group(_file(fh).comm.group)
+
+
+def _file_nb(fh: int, job) -> int:
+    """Nonblocking file op on the communicator's worker; the request
+    entry's dt==0 delivers the job's byte image verbatim at Wait."""
+    c = _file(fh).comm
+    req = c._nb(job) if hasattr(c, "_nb") else _DoneReq(job())
+    with _lock:
+        rh = next(_next_req)
+        _requests[rh] = (req, 0, b"")
+    return rh
+
+
+def file_iread_shared(fh: int, nbytes: int, dt: int, curview) -> int:
+    snap = bytes(curview)
+    return _file_nb(fh, lambda: _file_read(
+        fh, nbytes, dt, snap, False, None)[0])
+
+
+def file_iwrite_shared(fh: int, view, dt: int) -> int:
+    a = _pack(view, dt, _count_of(view, dt))
+    data = a.view(np.uint8).tobytes()
+
+    def job() -> bytes:
+        # write_shared returns the claimed start offset; the request
+        # payload contract wants bytes/None (write side: no payload)
+        _file(fh).write_shared(np.frombuffer(data, np.uint8))
+        return b""
+
+    return _file_nb(fh, job)
 
 
 # activate the constructor-envelope recorders (must run after every

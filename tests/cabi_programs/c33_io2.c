@@ -1,0 +1,177 @@
+/* Wave 8: the MPI-IO chapter closers — atomicity mode, byte-offset
+ * queries through a strided view, the file group, nonblocking
+ * collective/shared variants, and split-collective begin/end pairs
+ * (independent + ordered).  Runs with -n 3. */
+#include <mpi.h>
+#include <stdio.h>
+#include <string.h>
+#include <unistd.h>
+
+#define CHECK(cond, code)                                            \
+    do {                                                             \
+        if (!(cond)) {                                               \
+            fprintf(stderr, "rank %d: check failed at line %d\n",    \
+                    rank, __LINE__);                                 \
+            MPI_Abort(MPI_COMM_WORLD, code);                         \
+        }                                                            \
+    } while (0)
+
+int main(int argc, char **argv)
+{
+    MPI_Init(&argc, &argv);
+    int rank, size;
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &size);
+    CHECK(size == 3, 1);
+
+    char path[128];
+    snprintf(path, sizeof path, "/tmp/c33_io2_%ld.dat",
+             (long)getuid());
+    MPI_File fh;
+    CHECK(MPI_File_open(MPI_COMM_WORLD, path,
+                        MPI_MODE_CREATE | MPI_MODE_RDWR,
+                        MPI_INFO_NULL, &fh) == MPI_SUCCESS, 2);
+
+    /* ---- atomicity mode round-trips ---- */
+    int flag;
+    CHECK(MPI_File_get_atomicity(fh, &flag) == MPI_SUCCESS
+          && flag == 0, 3);
+    CHECK(MPI_File_set_atomicity(fh, 1) == MPI_SUCCESS, 4);
+    CHECK(MPI_File_get_atomicity(fh, &flag) == MPI_SUCCESS
+          && flag == 1, 5);
+
+    /* ---- the file group mirrors WORLD's ---- */
+    MPI_Group fg, wg;
+    CHECK(MPI_File_get_group(fh, &fg) == MPI_SUCCESS, 6);
+    MPI_Comm_group(MPI_COMM_WORLD, &wg);
+    int cmp;
+    MPI_Group_compare(fg, wg, &cmp);
+    CHECK(cmp == MPI_IDENT, 7);
+    MPI_Group_free(&fg);
+    MPI_Group_free(&wg);
+
+    /* ---- byte offset through a strided view: filetype = vector of
+     * 2 ints every 4 (8 sig bytes per 16-byte tile), disp 8 ---- */
+    MPI_Datatype ftype;
+    MPI_Type_vector(2, 1, 2, MPI_INT, &ftype);
+    MPI_Datatype ftype_r;
+    MPI_Type_create_resized(ftype, 0, 16, &ftype_r);
+    MPI_Type_commit(&ftype_r);
+    CHECK(MPI_File_set_view(fh, 8, MPI_INT, ftype_r, "native",
+                            MPI_INFO_NULL) == MPI_SUCCESS, 8);
+    MPI_Offset bo;
+    CHECK(MPI_File_get_byte_offset(fh, 0, &bo) == MPI_SUCCESS
+          && bo == 8, 9);                /* first visible int */
+    CHECK(MPI_File_get_byte_offset(fh, 1, &bo) == MPI_SUCCESS
+          && bo == 16, 10);              /* second sig int: +8 gap */
+    CHECK(MPI_File_get_byte_offset(fh, 2, &bo) == MPI_SUCCESS
+          && bo == 24, 11);              /* next tile */
+    MPI_Type_free(&ftype);
+    MPI_Type_free(&ftype_r);
+    CHECK(MPI_File_set_view(fh, 0, MPI_BYTE, MPI_BYTE, "native",
+                            MPI_INFO_NULL) == MPI_SUCCESS, 12);
+
+    /* ---- split collectives at explicit offsets: each rank writes
+     * its lane, reads a neighbor's back ---- */
+    int lane[4], got[4];
+    for (int i = 0; i < 4; i++)
+        lane[i] = 100 * rank + i;
+    CHECK(MPI_File_write_at_all_begin(fh, rank * 16, lane, 4,
+                                      MPI_INT) == MPI_SUCCESS, 13);
+    MPI_Status st;
+    CHECK(MPI_File_write_at_all_end(fh, lane, &st) == MPI_SUCCESS,
+          14);
+    int cnt;
+    MPI_Get_count(&st, MPI_INT, &cnt);
+    CHECK(cnt == 4, 15);
+    /* a second begin before end must be refused */
+    CHECK(MPI_File_write_at_all_begin(fh, rank * 16, lane, 4,
+                                      MPI_INT) == MPI_SUCCESS, 16);
+    CHECK(MPI_File_read_at_all_begin(fh, rank * 16, got, 4, MPI_INT)
+          != MPI_SUCCESS, 17);
+    CHECK(MPI_File_write_at_all_end(fh, lane, MPI_STATUS_IGNORE)
+          == MPI_SUCCESS, 18);
+    MPI_Barrier(MPI_COMM_WORLD);
+    int peer = (rank + 1) % size;
+    CHECK(MPI_File_read_at_all_begin(fh, peer * 16, got, 4, MPI_INT)
+          == MPI_SUCCESS, 19);
+    CHECK(MPI_File_read_at_all_end(fh, got, &st) == MPI_SUCCESS, 20);
+    for (int i = 0; i < 4; i++)
+        CHECK(got[i] == 100 * peer + i, 21);
+    /* end without begin is an error */
+    CHECK(MPI_File_read_at_all_end(fh, got, &st) != MPI_SUCCESS, 22);
+
+    /* ---- ordered split collectives: rank-sequential lanes from the
+     * SHARED pointer ---- */
+    MPI_File_seek_shared(fh, 48, MPI_SEEK_SET);
+    MPI_Barrier(MPI_COMM_WORLD);
+    int two[2] = {10 * rank, 10 * rank + 1};
+    CHECK(MPI_File_write_ordered_begin(fh, two, 2, MPI_INT)
+          == MPI_SUCCESS, 23);
+    CHECK(MPI_File_write_ordered_end(fh, two, &st) == MPI_SUCCESS,
+          24);
+    MPI_Get_count(&st, MPI_INT, &cnt);
+    CHECK(cnt == 2, 25);
+    MPI_Barrier(MPI_COMM_WORLD);
+    /* ordered read-back: every rank gets ITS rank-ordered region */
+    MPI_File_seek_shared(fh, 48, MPI_SEEK_SET);
+    int back[2] = {-1, -1};
+    CHECK(MPI_File_read_ordered_begin(fh, back, 2, MPI_INT)
+          == MPI_SUCCESS, 26);
+    CHECK(MPI_File_read_ordered_end(fh, back, &st) == MPI_SUCCESS,
+          27);
+    CHECK(back[0] == 10 * rank && back[1] == 10 * rank + 1, 28);
+
+    /* ---- nonblocking shared-pointer ops: 3 concurrent appends land
+     * disjoint; total content is the union ---- */
+    MPI_File_seek_shared(fh, 72, MPI_SEEK_SET);
+    MPI_Barrier(MPI_COMM_WORLD);
+    int tok = 1000 + rank;
+    MPI_Request wr;
+    CHECK(MPI_File_iwrite_shared(fh, &tok, 1, MPI_INT, &wr)
+          == MPI_SUCCESS, 29);
+    CHECK(MPI_Wait(&wr, MPI_STATUS_IGNORE) == MPI_SUCCESS, 30);
+    MPI_Barrier(MPI_COMM_WORLD);
+    int trio[3] = {0, 0, 0};
+    CHECK(MPI_File_read_at(fh, 72, trio, 3, MPI_INT, &st)
+          == MPI_SUCCESS, 31);
+    int seen[3] = {0, 0, 0};
+    for (int i = 0; i < 3; i++) {
+        CHECK(trio[i] >= 1000 && trio[i] <= 1002, 32);
+        seen[trio[i] - 1000]++;
+    }
+    CHECK(seen[0] == 1 && seen[1] == 1 && seen[2] == 1, 33);
+    /* nonblocking shared READ drains one of them again */
+    MPI_File_seek_shared(fh, 72, MPI_SEEK_SET);
+    MPI_Barrier(MPI_COMM_WORLD);
+    int one = -1;
+    MPI_Request rr;
+    CHECK(MPI_File_iread_shared(fh, &one, 1, MPI_INT, &rr)
+          == MPI_SUCCESS, 34);
+    CHECK(MPI_Wait(&rr, MPI_STATUS_IGNORE) == MPI_SUCCESS, 35);
+    CHECK(one >= 1000 && one <= 1002, 36);
+
+    /* ---- nonblocking collective variants complete the family ---- */
+    int ibuf[4];
+    for (int i = 0; i < 4; i++)
+        ibuf[i] = 7000 + 10 * rank + i;
+    MPI_Request ir;
+    CHECK(MPI_File_iwrite_at_all(fh, 96 + rank * 16, ibuf, 4, MPI_INT,
+                                 &ir) == MPI_SUCCESS, 37);
+    CHECK(MPI_Wait(&ir, MPI_STATUS_IGNORE) == MPI_SUCCESS, 38);
+    MPI_Barrier(MPI_COMM_WORLD);
+    int iback[4] = {0, 0, 0, 0};
+    CHECK(MPI_File_iread_at_all(fh, 96 + peer * 16, iback, 4, MPI_INT,
+                                &ir) == MPI_SUCCESS, 39);
+    CHECK(MPI_Wait(&ir, MPI_STATUS_IGNORE) == MPI_SUCCESS, 40);
+    for (int i = 0; i < 4; i++)
+        CHECK(iback[i] == 7000 + 10 * peer + i, 41);
+
+    MPI_File_close(&fh);
+    MPI_Barrier(MPI_COMM_WORLD);
+    if (rank == 0)
+        MPI_File_delete(path, MPI_INFO_NULL);
+    printf("OK c33_io2\n");
+    MPI_Finalize();
+    return 0;
+}

@@ -59,6 +59,7 @@ CASES = [
     ("c30_persist_coll.c", 4),
     ("c31_attrs_errh.c", 2),
     ("c32_convert_status.c", 2),
+    ("c33_io2.c", 3),
 ]
 
 # per-program argv (c13 runs 4M floats = 16 MB in CI — above the 1 MB
