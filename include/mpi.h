@@ -234,6 +234,11 @@ typedef long MPI_Message;                /* matched-probe messages */
 #define MPI_ERR_PENDING   18
 #define MPI_ERR_IN_STATUS 19
 #define MPI_ERR_SIZE      20
+#define MPI_ERR_NO_MEM    21
+#define MPI_ERR_DUP_DATAREP 22
+#define MPI_ERR_PORT      51
+#define MPI_ERR_SERVICE   52
+#define MPI_ERR_NAME      53
 #define MPI_ERR_REVOKED   72
 #define MPI_ERR_PROC_FAILED 75
 #define MPI_ERR_LASTCODE  100
@@ -1318,6 +1323,98 @@ int MPI_File_write_ordered_begin(MPI_File fh, const void *buf,
                                  int count, MPI_Datatype datatype);
 int MPI_File_write_ordered_end(MPI_File fh, const void *buf,
                                MPI_Status *status);
+
+/* ---- round-5 wave 9: the closure set to the full 447-template
+ * surface ---- */
+int MPI_Alloc_mem(MPI_Aint size, MPI_Info info, void *baseptr);
+int MPI_Free_mem(void *base);
+int MPI_Buffer_flush(void);
+int MPI_Buffer_iflush(MPI_Request *request);
+int MPI_Comm_attach_buffer(MPI_Comm comm, void *buffer, int size);
+int MPI_Comm_buffer_attach(MPI_Comm comm, void *buffer, int size);
+int MPI_Comm_detach_buffer(MPI_Comm comm, void *buffer_addr,
+                           int *size);
+int MPI_Comm_flush_buffer(MPI_Comm comm);
+int MPI_Comm_iflush_buffer(MPI_Comm comm, MPI_Request *request);
+int MPI_Session_attach_buffer(MPI_Session session, void *buffer,
+                              int size);
+int MPI_Session_detach_buffer(MPI_Session session, void *buffer_addr,
+                              int *size);
+int MPI_Session_flush_buffer(MPI_Session session);
+int MPI_Session_iflush_buffer(MPI_Session session,
+                              MPI_Request *request);
+int MPI_Cart_map(MPI_Comm comm, int ndims, const int dims[],
+                 const int periods[], int *newrank);
+int MPI_Graph_map(MPI_Comm comm, int nnodes, const int index[],
+                  const int edges[], int *newrank);
+int MPI_Comm_dup_with_info(MPI_Comm comm, MPI_Info info,
+                           MPI_Comm *newcomm);
+int MPI_Comm_idup_with_info(MPI_Comm comm, MPI_Info info,
+                            MPI_Comm *newcomm, MPI_Request *request);
+int MPI_Comm_join(int fd, MPI_Comm *intercomm);
+int MPI_Comm_spawn_multiple(int count, char *array_of_commands[],
+                            char **array_of_argv[],
+                            const int array_of_maxprocs[],
+                            const MPI_Info array_of_info[], int root,
+                            MPI_Comm comm, MPI_Comm *intercomm,
+                            int array_of_errcodes[]);
+int MPI_Dist_graph_create(MPI_Comm comm_old, int n,
+                          const int sources[], const int degrees[],
+                          const int destinations[],
+                          const int weights[], MPI_Info info,
+                          int reorder, MPI_Comm *comm_dist_graph);
+int MPI_Get_hw_resource_info(MPI_Info *hw_info);
+int MPI_Info_create_env(int argc, char *argv[], MPI_Info *info);
+int MPI_Intercomm_create_from_groups(MPI_Group local_group,
+                                     int local_leader,
+                                     MPI_Group remote_group,
+                                     int remote_leader,
+                                     const char *stringtag,
+                                     MPI_Info info,
+                                     MPI_Errhandler errhandler,
+                                     MPI_Comm *newintercomm);
+int MPI_Isendrecv(const void *sendbuf, int sendcount,
+                  MPI_Datatype sendtype, int dest, int sendtag,
+                  void *recvbuf, int recvcount, MPI_Datatype recvtype,
+                  int source, int recvtag, MPI_Comm comm,
+                  MPI_Request *request);
+int MPI_Isendrecv_replace(void *buf, int count, MPI_Datatype datatype,
+                          int dest, int sendtag, int source,
+                          int recvtag, MPI_Comm comm,
+                          MPI_Request *request);
+int MPI_Publish_name(const char *service_name, MPI_Info info,
+                     const char *port_name);
+int MPI_Unpublish_name(const char *service_name, MPI_Info info,
+                       const char *port_name);
+int MPI_Lookup_name(const char *service_name, MPI_Info info,
+                    char *port_name);
+typedef int (MPI_Datarep_conversion_function)(void *userbuf,
+                                              MPI_Datatype datatype,
+                                              int count, void *filebuf,
+                                              MPI_Offset position,
+                                              void *extra_state);
+typedef int (MPI_Datarep_extent_function)(MPI_Datatype datatype,
+                                          MPI_Aint *extent,
+                                          void *extra_state);
+#define MPI_CONVERSION_FN_NULL ((MPI_Datarep_conversion_function *)0)
+int MPI_Register_datarep(const char *datarep,
+                         MPI_Datarep_conversion_function
+                         *read_conversion_fn,
+                         MPI_Datarep_conversion_function
+                         *write_conversion_fn,
+                         MPI_Datarep_extent_function *dtype_file_extent_fn,
+                         void *extra_state);
+int MPI_Rget_accumulate(const void *origin_addr, int origin_count,
+                        MPI_Datatype origin_datatype,
+                        void *result_addr, int result_count,
+                        MPI_Datatype result_datatype, int target_rank,
+                        MPI_Aint target_disp, int target_count,
+                        MPI_Datatype target_datatype, MPI_Op op,
+                        MPI_Win win, MPI_Request *request);
+int MPI_Session_get_info(MPI_Session session, MPI_Info *info_used);
+int MPI_Session_get_pset_info(MPI_Session session,
+                              const char *pset_name, MPI_Info *info);
+int MPI_Win_test(MPI_Win win, int *flag);
 int MPI_Type_match_size(int typeclass, int size,
                         MPI_Datatype *datatype);
 #define MPI_TYPECLASS_REAL    1

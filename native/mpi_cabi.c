@@ -408,6 +408,7 @@ static void win_tab_add(MPI_Win w, void *base, MPI_Aint size, int du,
                         int flavor);
 static void win_tab_drop(MPI_Win w);
 static void split_drop_file(MPI_File fh);
+static int datarep_registered(const char *name);
 
 #define GIL_BEGIN PyGILState_STATE _gst = PyGILState_Ensure()
 #define GIL_END   PyGILState_Release(_gst)
@@ -6012,10 +6013,16 @@ int PMPI_File_set_view(MPI_File fh, MPI_Offset disp, MPI_Datatype etype,
     (void)info;
     GIL_BEGIN;
     int rc = MPI_SUCCESS;
+    /* registered user representations (MPI_Register_datarep) are
+     * identity on this single-architecture runtime: accepted here,
+     * stored as native (docs/CABI.md honest edges) */
+    const char *rep = datarep ? datarep : "native";
+    if (datarep_registered(rep))
+        rep = "native";
     PyObject *r = PyObject_CallMethod(g_mod, "file_set_view", "lLlls",
                                       (long)fh, (long long)disp,
                                       (long)etype, (long)filetype,
-                                      datarep ? datarep : "native");
+                                      rep);
     if (!r)
         rc = handle_error_file(fh, "MPI_File_set_view");
     else
@@ -9546,6 +9553,621 @@ int PMPI_File_write_ordered_end(MPI_File fh, const void *buf,
 {
     (void)buf;
     return split_end(fh, status);
+}
+
+/* ------------------------------------------------------------------ */
+/* round-5 wave 9: the closure set — memory allocation, the MPI-4.1
+ * per-comm/session buffer chapter, topology maps, dup_with_info,
+ * Comm_join (alloc_mem.c.in, comm_attach_buffer.c.in, cart_map.c.in,
+ * comm_join.c.in families), MPMD spawn, the general dist_graph
+ * constructor, intercomms from groups, nonblocking sendrecv, the
+ * naming service, datarep registration, Rget_accumulate, env/hw
+ * info, session queries, and PSCW Win_test.                           */
+/* ------------------------------------------------------------------ */
+
+int PMPI_Alloc_mem(MPI_Aint size, MPI_Info info, void *baseptr)
+{
+    (void)info;
+    if (size < 0)
+        return MPI_ERR_ARG;
+    void *p = malloc(size ? (size_t)size : 1);
+    if (!p)
+        return MPI_ERR_NO_MEM;
+    *(void **)baseptr = p;
+    return MPI_SUCCESS;
+}
+
+int PMPI_Free_mem(void *base)
+{
+    free(base);
+    return MPI_SUCCESS;
+}
+
+/* ---- the MPI-4.1 buffer chapter: buffered sends complete EAGERLY
+ * on this runtime (the payload is copied into the transport at the
+ * Bsend), so flush has nothing pending by construction — the
+ * attach/detach bookkeeping is per-object real, the flushes are
+ * immediate. ------------------------------------------------------- */
+#define OBJ_BUF_MAX 64
+static struct { long obj; void *buf; int size; }
+    g_comm_bufs[OBJ_BUF_MAX], g_sess_bufs[OBJ_BUF_MAX];
+static int g_comm_bufs_n, g_sess_bufs_n;
+
+static int obj_buf_attach(void *tab_, int *n, long obj, void *buf,
+                          int size)
+{
+    struct { long obj; void *buf; int size; } *tab = tab_;
+    for (int i = 0; i < *n; i++)
+        if (tab[i].obj == obj && tab[i].buf)
+            return MPI_ERR_BUFFER;       /* one buffer per object */
+    if (*n >= OBJ_BUF_MAX)
+        return MPI_ERR_INTERN;
+    tab[*n].obj = obj;
+    tab[*n].buf = buf;
+    tab[*n].size = size;
+    (*n)++;
+    return MPI_SUCCESS;
+}
+
+static int obj_buf_detach(void *tab_, int *n, long obj,
+                          void *buffer_addr, int *size)
+{
+    struct { long obj; void *buf; int size; } *tab = tab_;
+    for (int i = 0; i < *n; i++)
+        if (tab[i].obj == obj && tab[i].buf) {
+            *(void **)buffer_addr = tab[i].buf;
+            *size = tab[i].size;
+            tab[i] = tab[--(*n)];
+            return MPI_SUCCESS;
+        }
+    return MPI_ERR_BUFFER;
+}
+
+int PMPI_Buffer_flush(void)
+{
+    return MPI_SUCCESS;                  /* eager: nothing pending */
+}
+
+int PMPI_Buffer_iflush(MPI_Request *request)
+{
+    *request = MPI_REQUEST_NULL;         /* born complete */
+    return MPI_SUCCESS;
+}
+
+int PMPI_Comm_attach_buffer(MPI_Comm comm, void *buffer, int size)
+{
+    if (size < 0)
+        return MPI_ERR_ARG;
+    return obj_buf_attach(g_comm_bufs, &g_comm_bufs_n, (long)comm,
+                          buffer, size);
+}
+
+int PMPI_Comm_buffer_attach(MPI_Comm comm, void *buffer, int size)
+{
+    return PMPI_Comm_attach_buffer(comm, buffer, size);
+}
+
+int PMPI_Comm_detach_buffer(MPI_Comm comm, void *buffer_addr,
+                           int *size)
+{
+    return obj_buf_detach(g_comm_bufs, &g_comm_bufs_n, (long)comm,
+                          buffer_addr, size);
+}
+
+int PMPI_Comm_flush_buffer(MPI_Comm comm)
+{
+    (void)comm;
+    return MPI_SUCCESS;
+}
+
+int PMPI_Comm_iflush_buffer(MPI_Comm comm, MPI_Request *request)
+{
+    (void)comm;
+    *request = MPI_REQUEST_NULL;
+    return MPI_SUCCESS;
+}
+
+int PMPI_Session_attach_buffer(MPI_Session session, void *buffer,
+                              int size)
+{
+    if (size < 0)
+        return MPI_ERR_ARG;
+    return obj_buf_attach(g_sess_bufs, &g_sess_bufs_n, (long)session,
+                          buffer, size);
+}
+
+int PMPI_Session_detach_buffer(MPI_Session session, void *buffer_addr,
+                              int *size)
+{
+    return obj_buf_detach(g_sess_bufs, &g_sess_bufs_n, (long)session,
+                          buffer_addr, size);
+}
+
+int PMPI_Session_flush_buffer(MPI_Session session)
+{
+    (void)session;
+    return MPI_SUCCESS;
+}
+
+int PMPI_Session_iflush_buffer(MPI_Session session,
+                              MPI_Request *request)
+{
+    (void)session;
+    *request = MPI_REQUEST_NULL;
+    return MPI_SUCCESS;
+}
+
+/* ---- topology maps (cart_map.c.in, graph_map.c.in): the reference
+ * base returns the identity placement (mca/topo/base/
+ * topo_base_cart_map.c) — ranks beyond the grid get MPI_UNDEFINED -- */
+int PMPI_Cart_map(MPI_Comm comm, int ndims, const int dims[],
+                 const int periods[], int *newrank)
+{
+    (void)periods;
+    int rank, size;
+    int rc = PMPI_Comm_rank(comm, &rank);
+    if (rc == MPI_SUCCESS)
+        rc = PMPI_Comm_size(comm, &size);
+    if (rc != MPI_SUCCESS)
+        return rc;
+    long long cells = 1;
+    for (int d = 0; d < ndims; d++) {
+        if (dims[d] <= 0)
+            return MPI_ERR_DIMS;
+        cells *= dims[d];
+    }
+    if (cells > size)
+        return MPI_ERR_DIMS;
+    *newrank = rank < cells ? rank : MPI_UNDEFINED;
+    return MPI_SUCCESS;
+}
+
+int PMPI_Graph_map(MPI_Comm comm, int nnodes, const int index[],
+                  const int edges[], int *newrank)
+{
+    (void)index;
+    (void)edges;
+    int rank, size;
+    int rc = PMPI_Comm_rank(comm, &rank);
+    if (rc == MPI_SUCCESS)
+        rc = PMPI_Comm_size(comm, &size);
+    if (rc != MPI_SUCCESS)
+        return rc;
+    if (nnodes <= 0 || nnodes > size)
+        return MPI_ERR_ARG;
+    *newrank = rank < nnodes ? rank : MPI_UNDEFINED;
+    return MPI_SUCCESS;
+}
+
+int PMPI_Comm_dup_with_info(MPI_Comm comm, MPI_Info info,
+                           MPI_Comm *newcomm)
+{
+    int rc = PMPI_Comm_dup(comm, newcomm);
+    if (rc == MPI_SUCCESS && info != MPI_INFO_NULL)
+        rc = PMPI_Comm_set_info(*newcomm, info);
+    return rc;
+}
+
+int PMPI_Comm_idup_with_info(MPI_Comm comm, MPI_Info info,
+                            MPI_Comm *newcomm, MPI_Request *request)
+{
+    int rc = PMPI_Comm_idup(comm, newcomm, request);
+    if (rc == MPI_SUCCESS && info != MPI_INFO_NULL)
+        rc = PMPI_Comm_set_info(*newcomm, info);
+    return rc;
+}
+
+int PMPI_Comm_join(int fd, MPI_Comm *intercomm)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "comm_join", "i", fd);
+    if (!r) {
+        rc = handle_error("MPI_Comm_join");
+    } else {
+        *intercomm = (MPI_Comm)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Comm_spawn_multiple(int count, char *array_of_commands[],
+                            char **array_of_argv[],
+                            const int array_of_maxprocs[],
+                            const MPI_Info array_of_info[], int root,
+                            MPI_Comm comm, MPI_Comm *intercomm,
+                            int array_of_errcodes[])
+{
+    (void)array_of_info;
+    /* count/commands/argv/maxprocs are significant ONLY AT ROOT
+     * (comm_spawn_multiple.c.in): non-root ranks ship empty strings
+     * and join the collective accept inside the glue. Joins:
+     * commands with \x1e, each argv with \x1f inside its \x1e
+     * group, maxprocs with commas (up to 12 chars per entry). */
+    int rank;
+    int qrc = PMPI_Comm_rank(comm, &rank);
+    if (qrc != MPI_SUCCESS)
+        return qrc;
+    int at_root = (rank == root);
+    size_t cap = 256;
+    if (at_root)
+        for (int i = 0; i < count; i++) {
+            cap += strlen(array_of_commands[i]) + 2 + 16;
+            if (array_of_argv && array_of_argv != MPI_ARGVS_NULL
+                && array_of_argv[i])
+                for (char **a = array_of_argv[i]; *a; a++)
+                    cap += strlen(*a) + 2;
+        }
+    char *cmds = malloc(cap), *argvs = malloc(cap), *mp = malloc(cap);
+    if (!cmds || !argvs || !mp) {
+        free(cmds);
+        free(argvs);
+        free(mp);
+        return MPI_ERR_INTERN;
+    }
+    cmds[0] = argvs[0] = mp[0] = '\0';
+    size_t cl = 0, al = 0, ml = 0;
+    if (at_root)
+        for (int i = 0; i < count; i++) {
+            if (i) {
+                cmds[cl++] = '\x1e';
+                argvs[al++] = '\x1e';
+                mp[ml++] = ',';
+            }
+            cl += (size_t)sprintf(cmds + cl, "%s",
+                                  array_of_commands[i]);
+            cmds[cl] = '\0';
+            if (array_of_argv && array_of_argv != MPI_ARGVS_NULL
+                && array_of_argv[i])
+                for (char **a = array_of_argv[i]; *a; a++) {
+                    if (a != array_of_argv[i])
+                        argvs[al++] = '\x1f';
+                    al += (size_t)sprintf(argvs + al, "%s", *a);
+                }
+            argvs[al] = '\0';
+            ml += (size_t)sprintf(mp + ml, "%d",
+                                  array_of_maxprocs[i]);
+            mp[ml] = '\0';
+        }
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "comm_spawn_multiple", "lisssi", (long)comm, count,
+        cmds, argvs, mp, root);
+    if (!r) {
+        rc = handle_error_comm(comm, "MPI_Comm_spawn_multiple");
+    } else {
+        *intercomm = (MPI_Comm)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    free(cmds);
+    free(argvs);
+    free(mp);
+    /* errcodes are returned at EVERY rank that passes an array (the
+     * whole spawn either succeeded or the call errored); a rank whose
+     * count/maxprocs are garbage passes MPI_ERRCODES_IGNORE per the
+     * root-only significance rule */
+    if (rc == MPI_SUCCESS && array_of_errcodes
+        && array_of_errcodes != MPI_ERRCODES_IGNORE) {
+        int total = 0;
+        for (int i = 0; i < count; i++)
+            total += array_of_maxprocs[i];
+        for (int i = 0; i < total; i++)
+            array_of_errcodes[i] = MPI_SUCCESS;
+    }
+    return rc;
+}
+
+int PMPI_Dist_graph_create(MPI_Comm comm_old, int n,
+                          const int sources[], const int degrees[],
+                          const int destinations[],
+                          const int weights[], MPI_Info info,
+                          int reorder, MPI_Comm *comm_dist_graph)
+{
+    (void)weights;
+    (void)info;
+    long long ndest = 0;
+    for (int i = 0; i < n; i++) {
+        if (degrees[i] < 0)
+            return MPI_ERR_ARG;
+        ndest += degrees[i];
+    }
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "dist_graph_create", "liNNNi", (long)comm_old, n,
+        mem_ro(sources, (size_t)n * sizeof(int)),
+        mem_ro(degrees, (size_t)n * sizeof(int)),
+        mem_ro(destinations, (size_t)ndest * sizeof(int)), reorder);
+    if (!r) {
+        rc = handle_error_comm(comm_old, "MPI_Dist_graph_create");
+    } else {
+        *comm_dist_graph = (MPI_Comm)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Get_hw_resource_info(MPI_Info *hw_info)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "get_hw_resource_info",
+                                      NULL);
+    if (!r) {
+        rc = handle_error("MPI_Get_hw_resource_info");
+    } else {
+        *hw_info = (MPI_Info)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Info_create_env(int argc, char *argv[], MPI_Info *info)
+{
+    (void)argc;
+    (void)argv;                          /* the glue reads sys.argv */
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "info_create_env", NULL);
+    if (!r) {
+        rc = handle_error("MPI_Info_create_env");
+    } else {
+        *info = (MPI_Info)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Intercomm_create_from_groups(MPI_Group local_group,
+                                     int local_leader,
+                                     MPI_Group remote_group,
+                                     int remote_leader,
+                                     const char *stringtag,
+                                     MPI_Info info,
+                                     MPI_Errhandler errhandler,
+                                     MPI_Comm *newintercomm)
+{
+    (void)info;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "intercomm_create_from_groups", "lilis",
+        (long)local_group, local_leader, (long)remote_group,
+        remote_leader, stringtag ? stringtag : "");
+    if (!r) {
+        rc = handle_error("MPI_Intercomm_create_from_groups");
+    } else {
+        *newintercomm = (MPI_Comm)PyLong_AsLong(r);
+        errh_set(*newintercomm, errhandler);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Isendrecv(const void *sendbuf, int sendcount,
+                  MPI_Datatype sendtype, int dest, int sendtag,
+                  void *recvbuf, int recvcount, MPI_Datatype recvtype,
+                  int source, int recvtag, MPI_Comm comm,
+                  MPI_Request *request)
+{
+    long long soff, slen, roff, rlen;
+    if (!dt_window(sendtype, sendcount, &soff, &slen)
+        || !dt_window(recvtype, recvcount, &roff, &rlen))
+        return MPI_ERR_TYPE;
+    GIL_BEGIN;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "isendrecv", "lNliiiilN", (long)comm,
+        mem_ro((const char *)sendbuf + soff, (size_t)slen),
+        (long)sendtype, dest, sendtag, source, recvtag,
+        (long)recvtype,
+        mem_ro((const char *)recvbuf + roff, (size_t)rlen));
+    int rc = icoll_request(r, (char *)recvbuf + roff, (size_t)rlen,
+                           request, "MPI_Isendrecv");
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Isendrecv_replace(void *buf, int count, MPI_Datatype datatype,
+                          int dest, int sendtag, int source,
+                          int recvtag, MPI_Comm comm,
+                          MPI_Request *request)
+{
+    long long off, len;
+    if (!dt_window(datatype, count, &off, &len))
+        return MPI_ERR_TYPE;
+    GIL_BEGIN;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "isendrecv_replace", "lNliiii", (long)comm,
+        mem_ro((const char *)buf + off, (size_t)len), (long)datatype,
+        dest, sendtag, source, recvtag);
+    int rc = icoll_request(r, (char *)buf + off, (size_t)len, request,
+                           "MPI_Isendrecv_replace");
+    GIL_END;
+    return rc;
+}
+
+/* ---- naming service (publish_name.c.in family) ------------------- */
+int PMPI_Publish_name(const char *service_name, MPI_Info info,
+                     const char *port_name)
+{
+    (void)info;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "publish_name", "ss",
+                                      service_name, port_name);
+    if (!r)
+        rc = handle_error("MPI_Publish_name");
+    else
+        Py_DECREF(r);
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Unpublish_name(const char *service_name, MPI_Info info,
+                       const char *port_name)
+{
+    (void)info;
+    (void)port_name;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "unpublish_name", "s",
+                                      service_name);
+    if (!r)
+        rc = handle_error("MPI_Unpublish_name");
+    else
+        Py_DECREF(r);
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Lookup_name(const char *service_name, MPI_Info info,
+                    char *port_name)
+{
+    (void)info;
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "lookup_name", "s",
+                                      service_name);
+    if (!r) {
+        rc = handle_error("MPI_Lookup_name");
+    } else {
+        const char *p = PyUnicode_AsUTF8(r);
+        if (p) {
+            strncpy(port_name, p, MPI_MAX_PORT_NAME - 1);
+            port_name[MPI_MAX_PORT_NAME - 1] = '\0';
+        }
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+/* ---- datarep registration (register_datarep.c.in): names are
+ * recorded and accepted by File_set_view; this single-architecture
+ * runtime stores data natively, so the conversion callbacks have
+ * nothing to convert and are NOT invoked (docs/CABI.md honest
+ * edges) -------------------------------------------------------- */
+#define DATAREP_MAX 16
+static char g_datareps[DATAREP_MAX][64];
+static int g_datareps_n;
+
+int PMPI_Register_datarep(const char *datarep,
+                         MPI_Datarep_conversion_function
+                         *read_conversion_fn,
+                         MPI_Datarep_conversion_function
+                         *write_conversion_fn,
+                         MPI_Datarep_extent_function
+                         *dtype_file_extent_fn,
+                         void *extra_state)
+{
+    (void)read_conversion_fn;
+    (void)write_conversion_fn;
+    (void)dtype_file_extent_fn;
+    (void)extra_state;
+    if (!datarep || strlen(datarep) >= 64)
+        return MPI_ERR_ARG;
+    for (int i = 0; i < g_datareps_n; i++)
+        if (!strcmp(g_datareps[i], datarep))
+            return MPI_ERR_DUP_DATAREP;
+    if (g_datareps_n >= DATAREP_MAX)
+        return MPI_ERR_INTERN;
+    strcpy(g_datareps[g_datareps_n++], datarep);
+    return MPI_SUCCESS;
+}
+
+static int datarep_registered(const char *name)
+{
+    for (int i = 0; i < g_datareps_n; i++)
+        if (!strcmp(g_datareps[i], name))
+            return 1;
+    return 0;
+}
+
+int PMPI_Rget_accumulate(const void *origin_addr, int origin_count,
+                        MPI_Datatype origin_datatype,
+                        void *result_addr, int result_count,
+                        MPI_Datatype result_datatype, int target_rank,
+                        MPI_Aint target_disp, int target_count,
+                        MPI_Datatype target_datatype, MPI_Op op,
+                        MPI_Win win, MPI_Request *request)
+{
+    (void)target_count;
+    (void)target_datatype;               /* same-typemap subset */
+    size_t esz = dt_extent(origin_datatype);
+    size_t rsz = dt_size(result_datatype);
+    if (!rsz || result_count < 0)
+        return MPI_ERR_TYPE;
+    if (op != 12 && (!esz || origin_count < 0))   /* 12 = MPI_NO_OP */
+        return MPI_ERR_TYPE;
+    GIL_BEGIN;
+    PyObject *r = PyObject_CallMethod(
+        g_mod, "rget_accumulate", "lNlliLil", (long)win,
+        mem_ro(origin_addr, op == 12 ? 0
+               : (size_t)origin_count * esz),
+        (long)origin_datatype, (long)op, target_rank,
+        (long long)target_disp, result_count, (long)result_datatype);
+    int rc = icoll_request(r, result_addr,
+                           (size_t)result_count * rsz, request,
+                           "MPI_Rget_accumulate");
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Session_get_info(MPI_Session session, MPI_Info *info_used)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "session_get_info", "l",
+                                      (long)session);
+    if (!r) {
+        rc = handle_error_session(session, "MPI_Session_get_info");
+    } else {
+        *info_used = (MPI_Info)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Session_get_pset_info(MPI_Session session,
+                              const char *pset_name, MPI_Info *info)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "session_get_pset_info",
+                                      "ls", (long)session, pset_name);
+    if (!r) {
+        rc = handle_error_session(session,
+                                  "MPI_Session_get_pset_info");
+    } else {
+        *info = (MPI_Info)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
+}
+
+int PMPI_Win_test(MPI_Win win, int *flag)
+{
+    GIL_BEGIN;
+    int rc = MPI_SUCCESS;
+    PyObject *r = PyObject_CallMethod(g_mod, "win_test", "l",
+                                      (long)win);
+    if (!r) {
+        rc = handle_error_win(win, "MPI_Win_test");
+    } else {
+        *flag = (int)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    GIL_END;
+    return rc;
 }
 
 /* ------------------------------------------------------------------ */

@@ -3751,6 +3751,20 @@ def comm_spawn(h: int, command: str, argv_joined: str, maxprocs: int,
     import subprocess as _sp
     import sys as _sys
     c = _comm(h)
+    argv = ([a for a in argv_joined.split("\x1f") if a != ""]
+            if argv_joined else [])
+    return _spawn_launch(c, root, int(maxprocs), [command, *argv])
+
+
+def _spawn_launch(c, root: int, nprocs: int, cmdline: list) -> int:
+    """Shared launch/accept plumbing for Comm_spawn and
+    Comm_spawn_multiple: the root forks an mpirun --per-rank job with
+    the parent port in its env; every rank joins the bounded
+    collective accept (a command that fails to exec surfaces as an
+    error, not a hang)."""
+    import os as _os
+    import subprocess as _sp
+    import sys as _sys
     from ompi_tpu.core import dpm_perrank as dpm
     # reap earlier spawns that have since exited (no zombie per spawn)
     global _spawned_procs
@@ -3761,15 +3775,11 @@ def comm_spawn(h: int, command: str, argv_joined: str, maxprocs: int,
         mpirun = _os.path.join(
             _os.path.dirname(_os.path.dirname(
                 _os.path.abspath(__file__))), "tools", "mpirun.py")
-        argv = ([a for a in argv_joined.split("\x1f") if a != ""]
-                if argv_joined else [])
         env = dict(_os.environ)
         env["OMPI_TPU_PARENT_PORT"] = port
         _spawned_procs.append(
             _sp.Popen([_sys.executable, mpirun, "--per-rank", "-n",
-                       str(int(maxprocs)), command, *argv], env=env))
-    # bounded accept: a command that fails to exec must surface as an
-    # error here, not hang every rank forever
+                       str(nprocs), *cmdline], env=env))
     inter = dpm.comm_accept(port, c, root=root, timeout=120)
     if c.rank() == root:
         dpm.close_port(port)
@@ -4402,6 +4412,328 @@ def file_iwrite_shared(fh: int, view, dt: int) -> int:
         return b""
 
     return _file_nb(fh, job)
+
+
+# ---------------------------------------------------------------------
+# wave 9: the closure set — nonblocking sendrecv (isendrecv.c.in),
+# the general dist_graph constructor, intercomms from groups, the
+# cross-process naming service, Comm_join, MPMD spawn, request-based
+# get_accumulate, environment/hardware info, session queries, and
+# PSCW Win_test.
+# ---------------------------------------------------------------------
+class _PairReq:
+    """MPI_Isendrecv compound request: complete when BOTH inner ops
+    are; status and payload come from the receive side."""
+
+    def __init__(self, sreq, rreq):
+        self._s = sreq
+        self._r = rreq
+
+    def wait(self, timeout=None):
+        del timeout                      # request classes differ here
+        self._s.wait()
+        return self._r.wait()
+
+    def test(self):
+        ds = self._s.test()
+        done_s = ds[0] if isinstance(ds, tuple) else bool(ds)
+        if not done_s:
+            return False, None
+        return self._r.test()
+
+    def get(self):
+        return self._r.get()
+
+
+def isendrecv(h: int, view, sdt: int, dest: int, stag: int,
+              source: int, rtag: int, rdt: int, curview) -> int:
+    c = _comm(h)
+    rreq = c.irecv(source, rtag)
+    sreq = c.isend(_pack(view, sdt, _count_of(view, sdt)), dest, stag)
+    with _lock:
+        rh = next(_next_req)
+        _requests[rh] = (_PairReq(sreq, rreq), rdt, bytes(curview))
+    return rh
+
+
+def isendrecv_replace(h: int, view, dt: int, dest: int, stag: int,
+                      source: int, rtag: int) -> int:
+    c = _comm(h)
+    data = _pack(view, dt, _count_of(view, dt))   # send image NOW
+    rreq = c.irecv(source, rtag)
+    sreq = c.isend(data, dest, stag)
+    with _lock:
+        rh = next(_next_req)
+        _requests[rh] = (_PairReq(sreq, rreq), dt, bytes(view))
+    return rh
+
+
+def rget_accumulate(wh: int, view, dt: int, o: int, target: int,
+                    disp: int, result_count: int, rdt: int) -> int:
+    """MPI_Rget_accumulate: the blocking fetch-then-accumulate on a
+    completion thread; the request payload is the result image."""
+    from ompi_tpu.pml.perrank import thread_request
+    w = _win(wh)
+    op = _rma_op(o)
+    if not op.predefined:
+        raise MPIError(ERR_OP,
+                       "MPI_Rget_accumulate needs a predefined op")
+    if op.name == "no_op":
+        data = np.zeros(result_count, _dtype(rdt))
+        out_dt = rdt
+    else:
+        data = _arr(view, dt).copy()     # origin image at call time
+        out_dt = rdt if rdt else dt
+    bd = _byte_disp(w, target, disp)
+
+    def job() -> bytes:
+        old = w.get_accumulate_typed(data, target, bd, op=op.name)
+        return _out(np.asarray(old), out_dt)
+    return _icoll_handle(thread_request(job), 0)
+
+
+def win_test(wh: int) -> int:
+    """MPI_Win_test: nonblocking Win_wait — 1 only when every origin's
+    completion token is already here (then consumed, ending the
+    exposure epoch exactly as Win_wait would)."""
+    w = _win(wh)
+    origins = getattr(w, "_pscw_origins", [])
+    if not origins:
+        return 1
+    eng = w._pscw_engine()
+    for o in origins:
+        ok, _st = eng.iprobe(o, w._pscw_tag(1))
+        if not ok:
+            return 0
+    w.wait()                             # all present: cannot block
+    return 1
+
+
+def dist_graph_create(h: int, n: int, sources_v, degrees_v, dests_v,
+                      reorder: int) -> int:
+    """MPI_Dist_graph_create: arbitrary edge contributions are
+    allgathered and redistributed so every rank learns its own
+    adjacency, then the adjacent constructor takes over."""
+    c = _comm(h)
+    srcs = _ints(sources_v)
+    degs = _ints(degrees_v)
+    dsts = _ints(dests_v)
+    edges = []
+    k = 0
+    for i in range(int(n)):
+        for _ in range(int(degs[i])):
+            edges.append((int(srcs[i]), int(dsts[k])))
+            k += 1
+    flat = [e for sub in c.allgather(edges) for e in sub]
+    me = c.rank()
+    ins = np.array([s for (s, d) in flat if d == me], np.intc)
+    outs = np.array([d for (s, d) in flat if s == me], np.intc)
+    return dist_graph_create_adjacent(h, ins.tobytes(),
+                                      outs.tobytes(), reorder)
+
+
+def intercomm_create_from_groups(lgh: int, local_leader: int,
+                                 rgh: int, remote_leader: int,
+                                 stringtag: str) -> int:
+    """MPI_Intercomm_create_from_groups: no peer communicator — the
+    remote roster IS the remote group, and the local intracomm forms
+    under the (stringtag, group) CID rule directly (the Sessions-
+    world constructor; any group works, not only pset-derived ones —
+    intercomm_create_from_groups.c.in takes arbitrary groups)."""
+    from ompi_tpu.core.group import Group
+    from ompi_tpu.core.rankcomm import RankCommunicator
+    w = _comm(COMM_WORLD)
+    if not getattr(w, "is_per_rank", False):
+        raise MPIError(ERR_COMM,
+                       "intercomm_create_from_groups needs the "
+                       "per-rank world")
+    mine = list(_group(lgh).world_ranks)
+    remote = list(_group(rgh).world_ranks)
+    local = RankCommunicator(
+        Group(mine), w._my_world, w.router,
+        cid=("icfg-l", tuple(mine), str(stringtag)),
+        name="icfg-local")
+    a, b = sorted([tuple(mine), tuple(remote)])
+    cid = ("icg", a, b, str(stringtag))
+    return _register_comm(_RankIntercomm(local, remote, cid))
+
+
+# ---- the naming service (publish_name.c.in family): a cross-process
+# fcntl-locked JSON registry — the ompi-server role played by the
+# filesystem, reachable from independently-launched jobs -------------
+def _namesvc_path() -> str:
+    import os as _os
+    return _os.environ.get(
+        "OMPI_TPU_NAME_SERVER_FILE",
+        f"/tmp/ompi_tpu_names_{_os.getuid()}.json")
+
+
+def _namesvc_update(fn):
+    import fcntl
+    import json
+    import os as _os
+    path = _namesvc_path()
+    with open(path + ".lock", "a+") as lk:
+        fcntl.flock(lk, fcntl.LOCK_EX)
+        try:
+            try:
+                with open(path) as f:
+                    d = json.load(f)
+            except (OSError, ValueError):
+                d = {}
+            out = fn(d)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(d, f)
+            _os.replace(tmp, path)
+            return out
+        finally:
+            fcntl.flock(lk, fcntl.LOCK_UN)
+
+
+def publish_name(service: str, port: str) -> None:
+    def put(d):
+        if service in d:
+            from ompi_tpu.core.errhandler import ERR_SERVICE
+            raise MPIError(ERR_SERVICE,
+                           f"service {service!r} already published")
+        d[str(service)] = str(port)
+    _namesvc_update(put)
+
+
+def lookup_name(service: str) -> str:
+    def get(d):
+        if service not in d:
+            from ompi_tpu.core.errhandler import ERR_NAME
+            raise MPIError(ERR_NAME,
+                           f"service {service!r} not published")
+        return d[str(service)]
+    return _namesvc_update(get)
+
+
+def unpublish_name(service: str) -> None:
+    def drop(d):
+        if str(service) not in d:
+            from ompi_tpu.core.errhandler import ERR_SERVICE
+            raise MPIError(ERR_SERVICE,
+                           f"service {service!r} not published")
+        del d[str(service)]
+    _namesvc_update(drop)
+
+
+def comm_join(fd: int) -> int:
+    """MPI_Comm_join: the two processes swap port strings over the
+    caller-provided socket/pipe fd; the lexicographically smaller
+    port accepts, the other connects — a size-1 x size-1 intercomm."""
+    import os as _os
+    port = dpm_open_port(COMM_SELF)
+    _os.write(int(fd), port.encode().ljust(256, b"\0"))
+    peer = b""
+    while len(peer) < 256:
+        chunk = _os.read(int(fd), 256 - len(peer))
+        if not chunk:
+            from ompi_tpu.core.errhandler import ERR_INTERN
+            raise MPIError(ERR_INTERN,
+                           "MPI_Comm_join: peer closed fd")
+        peer += chunk
+    peer_port = peer.rstrip(b"\0").decode()
+    if port < peer_port:
+        out = dpm_comm_accept(port, COMM_SELF, 0)
+    else:
+        out = dpm_comm_connect(peer_port, COMM_SELF, 0)
+    dpm_close_port(COMM_SELF, port)
+    return out
+
+
+def comm_spawn_multiple(h: int, count: int, cmds_joined: str,
+                        argvs_joined: str, maxprocs_joined: str,
+                        root: int) -> int:
+    """MPI_Comm_spawn_multiple: ONE child world running different
+    binaries — the job launches the MPMD dispatch shim, which execs
+    entry i for ranks [sum(maxprocs[:i]), sum(maxprocs[:i+1]))."""
+    import json
+    import sys as _sys
+    import tempfile
+    c = _comm(h)
+    # spec arguments are significant ONLY at root; the launch rides
+    # the shared plumbing with the MPMD shim as the command (it reads
+    # OMPI_TPU_MCA_mpi_base_process_id to pick its entry, then execs
+    # the real binary with env intact)
+    total = 0
+    specfile = ""
+    if c.rank() == root:
+        cmds = cmds_joined.split("\x1e")
+        argvs = [([a for a in grp.split("\x1f") if a != ""]
+                  if grp else [])
+                 for grp in argvs_joined.split("\x1e")]
+        maxprocs = [int(x) for x in maxprocs_joined.split(",")]
+        spec = [{"command": cmds[i], "argv": argvs[i],
+                 "maxprocs": maxprocs[i]} for i in range(int(count))]
+        total = sum(maxprocs)
+        tf = tempfile.NamedTemporaryFile(
+            "w", suffix=".mpmd.json", delete=False)
+        json.dump(spec, tf)
+        tf.close()
+        specfile = tf.name
+    return _spawn_launch(c, root, total,
+                         [_sys.executable, "-m",
+                          "ompi_tpu.tools.mpmd_exec", specfile])
+
+
+def info_create_env() -> int:
+    """MPI_Info_create_env: the launch environment's info keys."""
+    import os as _os
+    import sys as _sys
+    ih = info_create()
+    info_set(ih, "command", _sys.argv[0] if _sys.argv else "")
+    info_set(ih, "argv", "\x1f".join(_sys.argv[1:]))
+    info_set(ih, "maxprocs", str(
+        _os.environ.get("OMPI_TPU_MCA_mpi_base_num_processes", "1")))
+    info_set(ih, "host", _os.uname().nodename)
+    info_set(ih, "wdir", _os.getcwd())
+    info_set(ih, "soft", "")
+    info_set(ih, "arch", _os.uname().machine)
+    info_set(ih, "thread_level", "MPI_THREAD_MULTIPLE")
+    return ih
+
+
+def get_hw_resource_info() -> int:
+    """MPI_Get_hw_resource_info (MPI-4.1): what this runtime can see
+    of the hardware."""
+    import os as _os
+    ih = info_create()
+    info_set(ih, "mpi_hw_resource_type", "host")
+    info_set(ih, "num_cpus", str(_os.cpu_count() or 1))
+    try:
+        import jax
+        info_set(ih, "num_accelerators", str(jax.device_count()))
+        info_set(ih, "accelerator_kind",
+                 jax.devices()[0].device_kind)
+    except Exception:                    # noqa: BLE001 — no backend
+        pass
+    return ih
+
+
+def session_get_info(sh: int) -> int:
+    _session(sh)
+    ih = info_create()
+    info_set(ih, "thread_level", "MPI_THREAD_MULTIPLE")
+    info_set(ih, "mpi_size", str(comm_size(COMM_WORLD)))
+    return ih
+
+
+def session_get_pset_info(sh: int, name: str) -> int:
+    _session(sh)
+    names = [session_get_nth_pset(sh, i)
+             for i in range(session_get_num_psets(sh))]
+    if str(name) not in names:
+        raise MPIError(ERR_ARG, f"unknown pset {name!r}")
+    gh = group_from_session_pset(sh, str(name))
+    n = group_size(gh)
+    group_free(gh)
+    ih = info_create()
+    info_set(ih, "mpi_size", str(n))
+    return ih
 
 
 # activate the constructor-envelope recorders (must run after every

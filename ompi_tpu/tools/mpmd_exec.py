@@ -1,0 +1,39 @@
+"""MPMD dispatch shim for MPI_Comm_spawn_multiple.
+
+`mpirun --per-rank` launches one executable for every rank; the
+reference's spawn_multiple builds a single child world out of
+DIFFERENT binaries (dpm_dyn_init / comm_spawn_multiple.c.in). This
+shim closes that gap: the spawn root writes a JSON spec
+``[{command, argv, maxprocs}, ...]`` and launches ``python -m
+ompi_tpu.tools.mpmd_exec spec.json`` for the whole world; each
+process looks up its rank (``OMPI_TPU_MCA_mpi_base_process_id``,
+set by mpirun) and execs the entry owning that rank slice — env
+intact, so the child's MPI_Init still dials the parent port
+(OMPI_TPU_PARENT_PORT) and the usual coordination plane.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    if len(sys.argv) != 2:
+        sys.stderr.write("usage: mpmd_exec spec.json\n")
+        sys.exit(2)
+    with open(sys.argv[1]) as f:
+        spec = json.load(f)
+    r = int(os.environ.get("OMPI_TPU_MCA_mpi_base_process_id", "0"))
+    for ent in spec:
+        n = int(ent["maxprocs"])
+        if r < n:
+            cmd = ent["command"]
+            os.execv(cmd, [cmd] + list(ent.get("argv", [])))
+        r -= n
+    sys.stderr.write(f"mpmd_exec: rank beyond spec total\n")
+    sys.exit(3)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,286 @@
+/* Wave 9: Alloc_mem/Free_mem, the MPI-4.1 buffer chapter,
+ * Cart/Graph_map, Comm_dup_with_info, nonblocking sendrecv, the
+ * cross-process naming service, Register_datarep, Rget_accumulate,
+ * the general Dist_graph_create, Info_create_env /
+ * Get_hw_resource_info, Session info queries, PSCW Win_test, and
+ * Intercomm_create_from_groups.  Runs with -n 3. */
+#include <mpi.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#define CHECK(cond, code)                                            \
+    do {                                                             \
+        if (!(cond)) {                                               \
+            fprintf(stderr, "rank %d: check failed at line %d\n",    \
+                    rank, __LINE__);                                 \
+            MPI_Abort(MPI_COMM_WORLD, code);                         \
+        }                                                            \
+    } while (0)
+
+int main(int argc, char **argv)
+{
+    MPI_Init(&argc, &argv);
+    int rank, size;
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &size);
+    CHECK(size == 3, 1);
+
+    /* ---- Alloc_mem / Free_mem ---- */
+    double *mem;
+    CHECK(MPI_Alloc_mem(64 * sizeof(double), MPI_INFO_NULL, &mem)
+          == MPI_SUCCESS, 2);
+    mem[0] = 1.5;
+    mem[63] = 2.5;
+    CHECK(mem[0] + mem[63] == 4.0, 3);
+    CHECK(MPI_Free_mem(mem) == MPI_SUCCESS, 4);
+
+    /* ---- the MPI-4.1 buffer chapter ---- */
+    static char bb[4096];
+    CHECK(MPI_Comm_attach_buffer(MPI_COMM_WORLD, bb, sizeof bb)
+          == MPI_SUCCESS, 5);
+    CHECK(MPI_Comm_attach_buffer(MPI_COMM_WORLD, bb, sizeof bb)
+          != MPI_SUCCESS, 6);            /* one per comm */
+    CHECK(MPI_Comm_flush_buffer(MPI_COMM_WORLD) == MPI_SUCCESS, 7);
+    MPI_Request fr;
+    CHECK(MPI_Comm_iflush_buffer(MPI_COMM_WORLD, &fr) == MPI_SUCCESS,
+          8);
+    CHECK(MPI_Wait(&fr, MPI_STATUS_IGNORE) == MPI_SUCCESS, 9);
+    void *bback;
+    int bsz;
+    CHECK(MPI_Comm_detach_buffer(MPI_COMM_WORLD, &bback, &bsz)
+          == MPI_SUCCESS, 10);
+    CHECK(bback == (void *)bb && bsz == sizeof bb, 11);
+    CHECK(MPI_Buffer_flush() == MPI_SUCCESS, 12);
+    CHECK(MPI_Buffer_iflush(&fr) == MPI_SUCCESS, 13);
+    CHECK(MPI_Wait(&fr, MPI_STATUS_IGNORE) == MPI_SUCCESS, 14);
+
+    /* ---- topology maps ---- */
+    int dims[1] = {3}, periods[1] = {1}, newrank;
+    CHECK(MPI_Cart_map(MPI_COMM_WORLD, 1, dims, periods, &newrank)
+          == MPI_SUCCESS, 15);
+    CHECK(newrank == rank, 16);
+    int gindex[2] = {1, 2}, gedges[2] = {1, 0};
+    CHECK(MPI_Graph_map(MPI_COMM_WORLD, 2, gindex, gedges, &newrank)
+          == MPI_SUCCESS, 17);
+    CHECK(newrank == (rank < 2 ? rank : MPI_UNDEFINED), 18);
+
+    /* ---- Comm_dup_with_info ---- */
+    MPI_Info di;
+    MPI_Info_create(&di);
+    MPI_Info_set(di, "mpi_assert_no_any_tag", "true");
+    MPI_Comm dup;
+    CHECK(MPI_Comm_dup_with_info(MPI_COMM_WORLD, di, &dup)
+          == MPI_SUCCESS, 19);
+    MPI_Info used;
+    CHECK(MPI_Comm_get_info(dup, &used) == MPI_SUCCESS, 20);
+    char val[64];
+    int vflag;
+    MPI_Info_get(used, "mpi_assert_no_any_tag", 63, val, &vflag);
+    CHECK(vflag && !strcmp(val, "true"), 21);
+    MPI_Info_free(&used);
+    MPI_Info_free(&di);
+
+    /* ---- nonblocking sendrecv around the ring ---- */
+    int right = (rank + 1) % size, left = (rank + size - 1) % size;
+    int sval = 300 + rank, rval = -1;
+    MPI_Request sr;
+    CHECK(MPI_Isendrecv(&sval, 1, MPI_INT, right, 9, &rval, 1,
+                        MPI_INT, left, 9, dup, &sr) == MPI_SUCCESS,
+          22);
+    MPI_Status st;
+    CHECK(MPI_Wait(&sr, &st) == MPI_SUCCESS, 23);
+    CHECK(rval == 300 + left && st.MPI_SOURCE == left, 24);
+    /* replace form: same buffer carries out the send, in the recv */
+    int xval = 500 + rank;
+    CHECK(MPI_Isendrecv_replace(&xval, 1, MPI_INT, right, 11, left,
+                                11, dup, &sr) == MPI_SUCCESS, 25);
+    CHECK(MPI_Wait(&sr, &st) == MPI_SUCCESS, 26);
+    CHECK(xval == 500 + left, 27);
+    MPI_Comm_free(&dup);
+
+    /* ---- naming service: rank 0 publishes, every rank resolves ---- */
+    char sname[64], pname[MPI_MAX_PORT_NAME];
+    snprintf(sname, sizeof sname, "c34-svc-%d", 0);
+    if (rank == 0) {
+        /* clear any stale registration from an earlier run; the
+         * not-published error is expected and must RETURN */
+        MPI_Comm_set_errhandler(MPI_COMM_WORLD, MPI_ERRORS_RETURN);
+        int urc = MPI_Unpublish_name(sname, MPI_INFO_NULL, pname);
+        CHECK(urc == MPI_SUCCESS || urc == MPI_ERR_SERVICE, 60);
+        MPI_Comm_set_errhandler(MPI_COMM_WORLD,
+                                MPI_ERRORS_ARE_FATAL);
+        CHECK(MPI_Publish_name(sname, MPI_INFO_NULL,
+                               "tpu://fake/endpoint") == MPI_SUCCESS,
+              28);
+    }
+    MPI_Barrier(MPI_COMM_WORLD);
+    CHECK(MPI_Lookup_name(sname, MPI_INFO_NULL, pname) == MPI_SUCCESS,
+          29);
+    CHECK(!strcmp(pname, "tpu://fake/endpoint"), 30);
+    MPI_Barrier(MPI_COMM_WORLD);
+    if (rank == 0)
+        CHECK(MPI_Unpublish_name(sname, MPI_INFO_NULL, pname)
+              == MPI_SUCCESS, 31);
+
+    /* ---- datarep registration ---- */
+    CHECK(MPI_Register_datarep("c34rep", MPI_CONVERSION_FN_NULL,
+                               MPI_CONVERSION_FN_NULL, NULL, NULL)
+          == MPI_SUCCESS, 32);
+    CHECK(MPI_Register_datarep("c34rep", MPI_CONVERSION_FN_NULL,
+                               MPI_CONVERSION_FN_NULL, NULL, NULL)
+          == MPI_ERR_DUP_DATAREP, 33);
+
+    /* ---- Rget_accumulate: request-based fetch-and-add ---- */
+    long *wbase;
+    MPI_Win win;
+    CHECK(MPI_Win_allocate(sizeof(long), sizeof(long), MPI_INFO_NULL,
+                           MPI_COMM_WORLD, &wbase, &win)
+          == MPI_SUCCESS, 34);
+    *wbase = 1000 * rank;
+    MPI_Win_fence(0, win);
+    long add = rank + 1, old = -1;
+    MPI_Request rr;
+    CHECK(MPI_Rget_accumulate(&add, 1, MPI_LONG, &old, 1, MPI_LONG, 0,
+                              0, 1, MPI_LONG, MPI_SUM, win, &rr)
+          == MPI_SUCCESS, 35);
+    CHECK(MPI_Wait(&rr, MPI_STATUS_IGNORE) == MPI_SUCCESS, 36);
+    CHECK(old >= 0 && old <= 0 + 1 + 2 + 3, 37);   /* some prefix */
+    MPI_Win_fence(0, win);
+    if (rank == 0)
+        CHECK(*wbase == 0 + 1 + 2 + 3, 38);        /* all deltas in */
+    MPI_Win_free(&win);
+
+    /* ---- general Dist_graph_create: rank 0 contributes the whole
+     * directed ring; every rank must learn ITS adjacency ---- */
+    {
+        int srcs[3] = {0, 1, 2}, degs[3] = {1, 1, 1};
+        int dsts[3] = {1, 2, 0};
+        int mine = rank == 0 ? 3 : 0;
+        MPI_Comm dg;
+        CHECK(MPI_Dist_graph_create(MPI_COMM_WORLD, mine, srcs, degs,
+                                    dsts, MPI_UNWEIGHTED,
+                                    MPI_INFO_NULL, 0, &dg)
+              == MPI_SUCCESS, 39);
+        int nin, nout, wtd;
+        CHECK(MPI_Dist_graph_neighbors_count(dg, &nin, &nout, &wtd)
+              == MPI_SUCCESS, 40);
+        CHECK(nin == 1 && nout == 1, 41);
+        int insrc[1], outdst[1], iw[1], ow[1];
+        CHECK(MPI_Dist_graph_neighbors(dg, 1, insrc, iw, 1, outdst,
+                                       ow) == MPI_SUCCESS, 42);
+        CHECK(insrc[0] == left && outdst[0] == right, 43);
+        MPI_Comm_free(&dg);
+    }
+
+    /* ---- env / hardware info ---- */
+    MPI_Info ei;
+    CHECK(MPI_Info_create_env(argc, argv, &ei) == MPI_SUCCESS, 44);
+    MPI_Info_get(ei, "maxprocs", 63, val, &vflag);
+    CHECK(vflag && atoi(val) == 3, 45);
+    MPI_Info_free(&ei);
+    MPI_Info hw;
+    CHECK(MPI_Get_hw_resource_info(&hw) == MPI_SUCCESS, 46);
+    MPI_Info_get(hw, "num_cpus", 63, val, &vflag);
+    CHECK(vflag && atoi(val) >= 1, 47);
+    MPI_Info_free(&hw);
+
+    /* ---- session info queries ---- */
+    MPI_Session sess;
+    CHECK(MPI_Session_init(MPI_INFO_NULL, MPI_ERRORS_RETURN, &sess)
+          == MPI_SUCCESS, 48);
+    MPI_Info si;
+    CHECK(MPI_Session_get_info(sess, &si) == MPI_SUCCESS, 49);
+    MPI_Info_get(si, "thread_level", 63, val, &vflag);
+    CHECK(vflag, 50);
+    MPI_Info_free(&si);
+    int np;
+    MPI_Session_get_num_psets(sess, MPI_INFO_NULL, &np);
+    char pset[128];
+    int plen = 127;
+    MPI_Session_get_nth_pset(sess, MPI_INFO_NULL, 0, &plen, pset);
+    MPI_Info pi;
+    CHECK(MPI_Session_get_pset_info(sess, pset, &pi) == MPI_SUCCESS,
+          51);
+    MPI_Info_get(pi, "mpi_size", 63, val, &vflag);
+    CHECK(vflag && atoi(val) >= 1, 52);
+    MPI_Info_free(&pi);
+    MPI_Session_finalize(&sess);
+
+    /* ---- PSCW Win_test: target polls instead of blocking ---- */
+    {
+        int *base2;
+        MPI_Win w2;
+        CHECK(MPI_Win_allocate(4 * sizeof(int), sizeof(int),
+                               MPI_INFO_NULL, MPI_COMM_WORLD, &base2,
+                               &w2) == MPI_SUCCESS, 53);
+        memset(base2, 0, 4 * sizeof(int));
+        MPI_Group world_g, og, tg;
+        MPI_Comm_group(MPI_COMM_WORLD, &world_g);
+        int r0[1] = {0}, r12[2] = {1, 2};
+        MPI_Group_incl(world_g, 2, r12, &og);   /* origins */
+        MPI_Group_incl(world_g, 1, r0, &tg);    /* target */
+        if (rank == 0) {
+            MPI_Win_post(og, 0, w2);
+            int done = 0, spins = 0;
+            while (!done) {
+                CHECK(MPI_Win_test(w2, &done) == MPI_SUCCESS, 54);
+                spins++;
+            }
+            CHECK(base2[1] == 11 && base2[2] == 22, 55);
+            (void)spins;
+        } else {
+            MPI_Win_start(tg, 0, w2);
+            int v = rank == 1 ? 11 : 22;
+            MPI_Put(&v, 1, MPI_INT, 0, rank, 1, MPI_INT, w2);
+            MPI_Win_complete(w2);
+        }
+        MPI_Group_free(&world_g);
+        MPI_Group_free(&og);
+        MPI_Group_free(&tg);
+        MPI_Barrier(MPI_COMM_WORLD);
+        MPI_Win_free(&w2);
+    }
+
+    /* ---- intercomm from groups: evens vs odds, no peer comm ---- */
+    {
+        MPI_Group wg2, evens, odds;
+        MPI_Comm_group(MPI_COMM_WORLD, &wg2);
+        int ev[2] = {0, 2}, od[1] = {1};
+        MPI_Group_incl(wg2, 2, ev, &evens);
+        MPI_Group_incl(wg2, 1, od, &odds);
+        MPI_Group local = (rank % 2 == 0) ? evens : odds;
+        MPI_Group remote = (rank % 2 == 0) ? odds : evens;
+        MPI_Comm inter;
+        CHECK(MPI_Intercomm_create_from_groups(
+                  local, 0, remote, 0, "c34-icfg", MPI_INFO_NULL,
+                  MPI_ERRORS_ARE_FATAL, &inter) == MPI_SUCCESS, 56);
+        int rsz;
+        MPI_Comm_remote_size(inter, &rsz);
+        CHECK(rsz == (rank % 2 == 0 ? 1 : 2), 57);
+        /* leaders exchange one token across the bridge */
+        if (rank == 0) {
+            int tok = 777, back = -1;
+            MPI_Send(&tok, 1, MPI_INT, 0, 3, inter);
+            MPI_Recv(&back, 1, MPI_INT, 0, 3, inter,
+                     MPI_STATUS_IGNORE);
+            CHECK(back == 888, 58);
+        } else if (rank == 1) {
+            int tok = 888, back = -1;
+            MPI_Recv(&back, 1, MPI_INT, 0, 3, inter,
+                     MPI_STATUS_IGNORE);
+            MPI_Send(&tok, 1, MPI_INT, 0, 3, inter);
+            CHECK(back == 777, 59);
+        }
+        MPI_Comm_free(&inter);
+        MPI_Group_free(&wg2);
+        MPI_Group_free(&evens);
+        MPI_Group_free(&odds);
+    }
+
+    MPI_Barrier(MPI_COMM_WORLD);
+    printf("OK c34_misc2\n");
+    MPI_Finalize();
+    return 0;
+}

@@ -1,0 +1,145 @@
+/* Wave 9 closers needing real process machinery: MPI_Comm_join (two
+ * ranks bridge over a raw TCP socket they set up themselves) and
+ * MPI_Comm_spawn_multiple (ONE child world running two DIFFERENT
+ * argv roles via the MPMD dispatch shim).  Runs with -n 2.
+ * References: ompi/mpi/c/comm_join.c.in, comm_spawn_multiple.c.in,
+ * ompi/dpm/dpm.c (dpm_dyn_init MPMD path). */
+#include <arpa/inet.h>
+#include <mpi.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+static int rank, size;
+
+#define CHECK(cond, code)                                            \
+    do {                                                             \
+        if (!(cond)) {                                               \
+            fprintf(stderr, "rank %d: check failed at line %d\n",    \
+                    rank, __LINE__);                                 \
+            MPI_Abort(MPI_COMM_WORLD, code);                         \
+        }                                                            \
+    } while (0)
+
+static int child_main(const char *role)
+{
+    MPI_Comm parent = MPI_COMM_NULL;
+    CHECK(MPI_Comm_get_parent(&parent) == MPI_SUCCESS, 40);
+    CHECK(parent != MPI_COMM_NULL, 41);
+    /* BOTH roles live in ONE child world: size 2, role by rank */
+    CHECK(size == 2, 42);
+    int expect_a = (rank == 0);
+    CHECK(!strcmp(role, expect_a ? "roleA" : "roleB"), 43);
+    if (rank == 0) {
+        int token = 0;
+        MPI_Recv(&token, 1, MPI_INT, 0, 5, parent,
+                 MPI_STATUS_IGNORE);
+        token += 1;
+        MPI_Send(&token, 1, MPI_INT, 0, 6, parent);
+    }
+    MPI_Barrier(MPI_COMM_WORLD);
+    printf("OK mpmd-child %s rank=%d\n", role, rank);
+    MPI_Comm_disconnect(&parent);
+    MPI_Finalize();
+    return 0;
+}
+
+int main(int argc, char **argv)
+{
+    MPI_Init(&argc, &argv);
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &size);
+    if (argc > 1)
+        return child_main(argv[1]);
+    CHECK(size == 2, 1);
+
+    /* ---- MPI_Comm_join: rank 0 listens, rank 1 connects; the two
+     * processes then join into a 1x1 intercomm over that fd ---- */
+    int fd = -1;
+    if (rank == 0) {
+        int ls = socket(AF_INET, SOCK_STREAM, 0);
+        CHECK(ls >= 0, 2);
+        struct sockaddr_in a;
+        memset(&a, 0, sizeof a);
+        a.sin_family = AF_INET;
+        a.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+        a.sin_port = 0;
+        CHECK(bind(ls, (struct sockaddr *)&a, sizeof a) == 0, 3);
+        CHECK(listen(ls, 1) == 0, 4);
+        socklen_t alen = sizeof a;
+        CHECK(getsockname(ls, (struct sockaddr *)&a, &alen) == 0, 5);
+        int port = ntohs(a.sin_port);
+        MPI_Send(&port, 1, MPI_INT, 1, 1, MPI_COMM_WORLD);
+        fd = accept(ls, NULL, NULL);
+        CHECK(fd >= 0, 6);
+        close(ls);
+    } else {
+        int port = 0;
+        MPI_Recv(&port, 1, MPI_INT, 0, 1, MPI_COMM_WORLD,
+                 MPI_STATUS_IGNORE);
+        fd = socket(AF_INET, SOCK_STREAM, 0);
+        CHECK(fd >= 0, 7);
+        struct sockaddr_in a;
+        memset(&a, 0, sizeof a);
+        a.sin_family = AF_INET;
+        a.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+        a.sin_port = htons((unsigned short)port);
+        CHECK(connect(fd, (struct sockaddr *)&a, sizeof a) == 0, 8);
+    }
+    MPI_Comm joined;
+    CHECK(MPI_Comm_join(fd, &joined) == MPI_SUCCESS, 9);
+    close(fd);
+    int is_inter = 0, rsz = 0;
+    MPI_Comm_test_inter(joined, &is_inter);
+    MPI_Comm_remote_size(joined, &rsz);
+    CHECK(is_inter && rsz == 1, 10);
+    int tok = 4000 + rank, back = -1;
+    /* each side talks to remote rank 0 (1x1) */
+    if (rank == 0) {
+        MPI_Send(&tok, 1, MPI_INT, 0, 2, joined);
+        MPI_Recv(&back, 1, MPI_INT, 0, 2, joined, MPI_STATUS_IGNORE);
+        CHECK(back == 4001, 11);
+    } else {
+        MPI_Recv(&back, 1, MPI_INT, 0, 2, joined, MPI_STATUS_IGNORE);
+        MPI_Send(&tok, 1, MPI_INT, 0, 2, joined);
+        CHECK(back == 4000, 12);
+    }
+    MPI_Comm_disconnect(&joined);
+    MPI_Barrier(MPI_COMM_WORLD);
+
+    /* ---- MPI_Comm_spawn_multiple: one child world, two roles ---- */
+    char exe[4096];
+    ssize_t n = readlink("/proc/self/exe", exe, sizeof exe - 1);
+    CHECK(n > 0, 13);
+    exe[n] = '\0';
+    char *cmds[2] = {exe, exe};
+    char *argA[] = {"roleA", NULL}, *argB[] = {"roleB", NULL};
+    char **argvs[2] = {argA, argB};
+    int maxprocs[2] = {1, 1};
+    MPI_Info infos[2] = {MPI_INFO_NULL, MPI_INFO_NULL};
+    int errcodes[2] = {-1, -1};
+    MPI_Comm kids;
+    CHECK(MPI_Comm_spawn_multiple(2, cmds, argvs, maxprocs, infos, 0,
+                                  MPI_COMM_WORLD, &kids, errcodes)
+          == MPI_SUCCESS, 14);
+    CHECK(errcodes[0] == MPI_SUCCESS && errcodes[1] == MPI_SUCCESS,
+          15);
+    int krs = 0;
+    MPI_Comm_remote_size(kids, &krs);
+    CHECK(krs == 2, 16);
+    if (rank == 0) {
+        int token = 9000;
+        MPI_Send(&token, 1, MPI_INT, 0, 5, kids);
+        MPI_Recv(&token, 1, MPI_INT, 0, 6, kids, MPI_STATUS_IGNORE);
+        CHECK(token == 9001, 17);
+    }
+    MPI_Barrier(MPI_COMM_WORLD);
+    MPI_Comm_disconnect(&kids);
+
+    printf("OK c35_join_mpmd\n");
+    MPI_Finalize();
+    return 0;
+}

@@ -60,6 +60,8 @@ CASES = [
     ("c31_attrs_errh.c", 2),
     ("c32_convert_status.c", 2),
     ("c33_io2.c", 3),
+    ("c34_misc2.c", 3),
+    ("c35_join_mpmd.c", 2),
 ]
 
 # per-program argv (c13 runs 4M floats = 16 MB in CI — above the 1 MB
@@ -69,7 +71,8 @@ PROG_ARGS = {"c13_staged.c": ["4194304"]}
 # c23 moves a REAL >INT_MAX-element (2^31 + 4096 chars, ~2.1 GB)
 # payload through MPI_Send_c — ~90 s alone on this 1-core host, longer
 # when the suite stacks
-PROG_TIMEOUT = {"c23_bigcount.c": 450, "c25_spawn.c": 300}
+PROG_TIMEOUT = {"c23_bigcount.c": 450, "c25_spawn.c": 300,
+                "c35_join_mpmd.c": 300}
 
 
 @pytest.fixture(scope="module")
