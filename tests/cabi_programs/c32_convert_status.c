@@ -103,13 +103,18 @@ int main(int argc, char **argv)
     int indices[2];
     MPI_Status sts[2];
     int total = 0;
-    while (total < 1) {                  /* drain at least tag 21 */
+    /* only tag-21 messages exist until the barrier below, so the
+     * first drain can only ever complete duo[0] — completion order
+     * across DIFFERENT receives is otherwise unordered (the
+     * non-overtaking rule binds messages matching the SAME recv) */
+    while (total < 1) {                  /* drain tag 21 */
         CHECK(MPI_Testsome(2, duo, &out, indices, sts)
               == MPI_SUCCESS, 28);
         CHECK(out != MPI_UNDEFINED, 29);
         total += out;
     }
     CHECK(a == 60 + (1 - rank), 30);
+    MPI_Barrier(MPI_COMM_WORLD);         /* no tag 22 before this */
     int w = 80 + rank;
     MPI_Send(&w, 1, MPI_INT, 1 - rank, 22, MPI_COMM_WORLD);
     while (total < 2) {                  /* tag 22 via Testsome too */
