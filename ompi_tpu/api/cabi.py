@@ -24,6 +24,7 @@ returns the code — `ompi/errhandler/errhandler.h` behavior).
 from __future__ import annotations
 
 import itertools
+import queue
 import threading
 from typing import Any, Dict, Optional, Tuple
 
@@ -31,9 +32,9 @@ import numpy as np
 
 from ompi_tpu.core import op as op_mod
 from ompi_tpu.core.errhandler import (ERR_ARG, ERR_COMM, ERR_GROUP,
-                                      ERR_OP, ERR_RANK, ERR_REQUEST,
-                                      ERR_TOPOLOGY, ERR_TYPE, MPIError,
-                                      error_string)
+                                      ERR_OP, ERR_PENDING, ERR_RANK,
+                                      ERR_REQUEST, ERR_TOPOLOGY,
+                                      ERR_TYPE, MPIError, error_string)
 
 # ---------------------------------------------------------------------
 # handle tables (mpi.h constants must match these values)
@@ -1367,14 +1368,22 @@ def dpm_comm_connect(port: str, h: int, root: int) -> int:
 
 
 def comm_disconnect(h: int) -> None:
-    with _lock:
-        c = _comms.pop(h, None)
+    c = _claim_teardown(_comms, h, h)
     if c is None:
         raise MPIError(ERR_COMM, f"invalid communicator handle {h}")
-    if hasattr(c, "disconnect"):
-        c.disconnect()
-    elif hasattr(c, "free"):
-        c.free()
+    try:
+        _icoll_worker_shutdown(h)        # drain BEFORE disconnect
+        if hasattr(c, "disconnect"):
+            c.disconnect()
+        elif hasattr(c, "free"):
+            c.free()
+    except BaseException:
+        with _lock:
+            _closing.discard(h)          # handle stays valid on error
+        raise
+    with _lock:
+        _comms.pop(h, None)
+        _closing.discard(h)
 
 
 def group_translate_ranks(a: int, ranks_view, b: int) -> bytes:
@@ -1757,21 +1766,42 @@ def comm_compare(a: int, b: int) -> int:
     return 3
 
 
+def _claim_teardown(table: Dict, key, ckey):
+    """Atomically claim a handle for teardown: returns the object, or
+    None when the handle is unknown OR another thread already claimed
+    it (the loser reports a clean invalid-handle error, never a
+    double free). The caller must _closing.discard(ckey) when done."""
+    with _lock:
+        obj = table.get(key)
+        if obj is None or ckey in _closing:
+            return None
+        _closing.add(ckey)
+        return obj
+
+
 def comm_free(h: int) -> None:
     if h in (COMM_WORLD, COMM_SELF):
         raise MPIError(ERR_COMM, "cannot free a predefined communicator")
-    with _lock:
-        c = _comms.get(h)
+    c = _claim_teardown(_comms, h, h)
     if c is None:
         raise MPIError(ERR_COMM, f"invalid communicator handle {h}")
-    # free FIRST, pop after: user delete callbacks fire inside free()
-    # and must still resolve this comm's handle (_handle_of); their
-    # errors propagate — MPI_Comm_free reports callback failure
-    # (MPI-3.1 6.7.2), it does not swallow it
-    if hasattr(c, "free"):
-        c.free()
+    try:
+        _icoll_worker_shutdown(h)        # drain BEFORE free: pending
+        # nonblocking collectives must complete against a live comm
+        # free FIRST, pop after: user delete callbacks fire inside
+        # free() and must still resolve this comm's handle
+        # (_handle_of); their errors propagate — MPI_Comm_free reports
+        # callback failure (MPI-3.1 6.7.2), it does not swallow it
+        if hasattr(c, "free"):
+            c.free()
+    except BaseException:
+        with _lock:
+            _closing.discard(h)          # a failed delete callback
+        raise                            # leaves the comm VALID
+        # (MPI-3.1 6.7.2 reference behavior: free did not happen)
     with _lock:
         _comms.pop(h, None)
+        _closing.discard(h)
 
 
 # ---------------------------------------------------------------------
@@ -1937,9 +1967,16 @@ def _icoll_handle(req, dt: int, snap: bytes = b"") -> int:
     return rh
 
 
+def _is_perrank(c) -> bool:
+    from ompi_tpu.core.rankcomm import RankCommunicator
+    return isinstance(c, RankCommunicator)
+
+
 def ibarrier(h: int) -> int:
     """MPI_Ibarrier -> a request handle the existing wait/test paths
-    complete (payload empty)."""
+    complete (payload empty). Per-rank comms serialize the deferred
+    barrier on their collective worker (RankCommunicator._nb), which
+    preserves tag-draw order against every other collective entry."""
     return _icoll_handle(_comm(h).ibarrier(), 4)   # BYTE: no payload
 
 
@@ -1953,10 +1990,10 @@ def ibcast(h: int, view, dt: int, root: int) -> int:
 
 
 class _DoneReq:
-    """Immediately-complete request: on single-controller communicators
-    (no per-rank worker machinery) the 'nonblocking' collective runs
-    synchronously at the i-call — legal MPI behavior (completion at
-    MPI_Wait is a lower bound, not a mandate)."""
+    """Immediately-complete request: on communicator-like objects with
+    no worker machinery the 'nonblocking' collective runs synchronously
+    at the i-call — legal MPI behavior (completion at MPI_Wait is a
+    lower bound, not a mandate)."""
 
     _complete = True
 
@@ -1973,14 +2010,191 @@ class _DoneReq:
         return self._data
 
 
+class _AsyncBytesReq:
+    """Marshalled nonblocking collective running on the communicator's
+    serial worker thread. The GIL drops during XLA compute and the
+    device->host copy inside the job, so the C caller genuinely
+    overlaps its own compute with the collective (the libnbc progress
+    role, reference ompi/mca/coll/libnbc). Errors surface at
+    wait/test as RankRequest's do — but this is deliberately NOT
+    RankRequest (see wait() on the timeout contract): per-rank
+    requests gamble on remote peers and need a bounded default;
+    these jobs are local compute sharing one serial worker."""
+
+    __slots__ = ("_event", "_data", "_error")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._data = None
+        self._error: Optional[BaseException] = None
+
+    def _run(self, job) -> None:
+        try:
+            self._data = job()
+        except BaseException as e:
+            self._error = e
+        finally:
+            self._event.set()
+
+    def wait(self, timeout=None):
+        # UNBOUNDED by default — deliberately unlike RankRequest's
+        # 600 s budget: jobs here are local compute (no peer can hold
+        # them hostage) but they SHARE one serial worker, so a fixed
+        # budget would compound across queued jobs and a false
+        # ERR_PENDING frees the request while the worker still holds
+        # a zero-copy view of the C caller's buffer (use-after-free
+        # once the caller reclaims it). An explicit timeout still
+        # errors rather than silently faking completion.
+        if not self._event.wait(timeout):
+            raise MPIError(ERR_PENDING,
+                           "nonblocking operation did not complete "
+                           "within the wait timeout")
+        if self._error is not None:
+            raise self._error
+        return None
+
+    def test(self):
+        if not self._event.is_set():
+            return False, None
+        if self._error is not None:
+            raise self._error
+        return True, None
+
+    def get(self):
+        return self._data
+
+
+# one serial worker per communicator/file handle: issue order is
+# preserved (MPI requires same-order collective calls per comm, and
+# shared-file-pointer claims must happen in i-call order; on a single
+# process matching is local, but serialization also keeps interposition
+# counters and SPC increments race-free against each other). Comm
+# workers key by the int handle, file workers by ("file", fh).
+_icoll_workers: Dict[Any, Tuple["queue.Queue", threading.Thread]] = {}
+# handles mid-teardown: the closing thread claims the handle here so a
+# concurrent free/disconnect/close loses cleanly with ERR instead of
+# double-freeing the underlying object
+_closing: set = set()
+
+
+def _icoll_drain(q: "queue.Queue") -> None:
+    while True:
+        item = q.get()
+        if item is None:
+            q.task_done()
+            return
+        req, job = item
+        req._run(job)
+        q.task_done()                    # keeps unfinished_tasks (the
+        # _maybe_funnel busy signal) = queued + in-flight jobs
+
+
+def _icoll_submit(key, job) -> _AsyncBytesReq:
+    req = _AsyncBytesReq()
+    with _lock:
+        # re-validate under _lock: the caller's handle lookup happened
+        # outside it, so a concurrent free/close may have completed in
+        # between — submitting then would resurrect a worker no
+        # shutdown will ever retire and run the job against a freed
+        # object
+        if isinstance(key, tuple):       # ("file", fh)
+            if key in _closing or key[1] not in _files:
+                raise MPIError(ERR_ARG,
+                               f"invalid file handle {key[1]}")
+        elif key in _closing or (key not in _comms
+                                 and key not in (COMM_WORLD,
+                                                 COMM_SELF)):
+            raise MPIError(ERR_COMM,
+                           f"invalid communicator handle {key}")
+        ent = _icoll_workers.get(key)
+        if ent is None:
+            q = queue.Queue()
+            t = threading.Thread(target=_icoll_drain, args=(q,),
+                                 daemon=True,
+                                 name=f"icoll-worker-{key}")
+            _icoll_workers[key] = (q, t)
+            t.start()
+        else:
+            q, _t = ent
+        # enqueue under _lock: a concurrent shutdown's sentinel must
+        # not overtake this job (a job behind the sentinel would never
+        # complete — its waiter hangs silently)
+        q.put((req, job))
+    return req
+
+
+def _icoll_worker_shutdown(key) -> None:
+    """Retire a handle's worker, draining pending jobs first: MPI
+    deallocation happens only after pending operations complete
+    (MPI-3.1 6.4.3) — callers run this BEFORE freeing the object so
+    the deferred jobs can still resolve its handle."""
+    with _lock:
+        ent = _icoll_workers.pop(key, None)
+        if ent is None:
+            return
+        q, t = ent
+        q.put(None)                      # queues behind pending jobs
+    t.join()                             # outside _lock: jobs take it
+
+
+def _file_nb_req(fh: int, job):
+    """Deferred file op on the file's OWN serial worker, both tiers:
+    no deferred file job draws the comm's collective sequence tag
+    (individual ops pre-resolve their position at the i-call, shared
+    ops claim through RMA), so the file is its own ordering domain —
+    draining or funneling it never forces unrelated comm collectives
+    to complete, and file_close on one domain cannot deadlock a
+    program correct on the other."""
+    if hasattr(_file(fh).comm, "_nb"):   # either tier's worker model
+        return _icoll_submit(("file", fh), job)
+    return _DoneReq(job())
+
+
+def _file_blocking_serial(fh: int, fn, *a, **kw):
+    """Blocking shared-pointer/ordered file op: must queue BEHIND any
+    pending nonblocking ops on the same file, or its pointer claim
+    (made at execution time) overtakes an earlier-issued i-op's claim
+    and records land at swapped offsets. Funnels against the
+    ("file", fh) worker, inline when it is idle."""
+    key = ("file", fh)
+    with _lock:
+        ent = _icoll_workers.get(key)
+        busy = (ent is not None
+                and ent[0].unfinished_tasks > 0
+                and threading.current_thread() is not ent[1])
+        if busy:
+            req = _AsyncBytesReq()
+            ent[0].put((req, lambda: fn(*a, **kw)))
+    if not busy:
+        return fn(*a, **kw)
+    req.wait()
+    return req.get()
+
+
+def _nb_job(c, key, job):
+    """Dispatch a deferred byte-producing job on the handle's serial
+    worker — the i-call returns before the job materializes (deferring
+    the buffer read is legal: MPI forbids the caller from touching
+    buffers until completion). Per-rank jobs ride the comm's own
+    collective worker (RankCommunicator._nb), the chokepoint every
+    collective entry shares, so tag draws stay in issue order;
+    single-controller jobs ride the handle's serial worker here.
+    Objects with no worker machinery run synchronously (_DoneReq,
+    legal: completion at MPI_Wait is a lower bound)."""
+    if _is_perrank(c):
+        return c._nb(job)
+    if hasattr(c, "_nb"):                # stacked single-controller
+        return _icoll_submit(key, job)
+    return _DoneReq(job())
+
+
 def _icoll_bytes(h: int, job) -> int:
-    """Generic nonblocking collective: run ``job`` — a closure over the
-    blocking glue marshaller, returning the final C-buffer bytes — on
-    the communicator's nonblocking worker (the libnbc progress role).
-    The request entry's dt==0 marks the payload as pre-marshalled
-    bytes: wait/test deliver it verbatim, no unpack."""
-    c = _comm(h)
-    req = c._nb(job) if hasattr(c, "_nb") else _DoneReq(job())
+    """Generic nonblocking collective: run ``job`` — a closure over
+    the blocking glue marshaller, returning the final C-buffer bytes —
+    asynchronously (see _nb_job). The request entry's dt==0 marks the
+    payload as pre-marshalled bytes: wait/test deliver it verbatim,
+    no unpack."""
+    req = _nb_job(_comm(h), h, job)
     return _icoll_handle(req, 0)
 
 
@@ -2595,15 +2809,25 @@ def file_open(h: int, path: str, amode: int) -> int:
 
 
 def file_close(fh: int) -> None:
+    key = ("file", fh)
+    f = _claim_teardown(_files, fh, key)
+    if f is None:
+        raise MPIError(ERR_ARG, f"invalid file handle {fh}")
+    try:
+        _icoll_worker_shutdown(key)      # drain pending i-ops first:
+        # their deferred jobs still resolve this file's handle
+        f.close()
+    except BaseException:
+        with _lock:
+            _closing.discard(key)        # handle stays valid on error
+        raise
     with _lock:
-        f = _files.pop(fh, None)
+        _files.pop(fh, None)
         _file_amodes.pop(fh, None)
         _file_views.pop(fh, None)
         _file_pos.pop(fh, None)
         _file_atomicity.pop(fh, None)
-    if f is None:
-        raise MPIError(ERR_ARG, f"invalid file handle {fh}")
-    f.close()
+        _closing.discard(key)
 
 
 def file_delete(path: str) -> None:
@@ -2658,7 +2882,9 @@ def file_write_at_all(fh: int, offset: int, view, dt: int) -> int:
 
 
 def file_write_shared(fh: int, view, dt: int) -> int:
-    return _file_write(fh, view, dt, False, None)
+    # shared-pointer claim orders behind pending i-ops on this file
+    return _file_blocking_serial(fh, _file_write, fh, view, dt,
+                                 False, None)
 
 
 def file_read_at(fh: int, offset: int, nbytes: int, dt: int, curview
@@ -2673,7 +2899,9 @@ def file_read_at_all(fh: int, offset: int, nbytes: int, dt: int,
 
 def file_read_shared(fh: int, nbytes: int, dt: int, curview
                      ) -> Tuple[bytes, int]:
-    return _file_read(fh, nbytes, dt, curview, False, None)
+    # shared-pointer claim orders behind pending i-ops on this file
+    return _file_blocking_serial(fh, _file_read, fh, nbytes, dt,
+                                 curview, False, None)
 
 
 def file_get_size(fh: int) -> int:
@@ -3280,7 +3508,7 @@ def file_preallocate(fh: int, nbytes: int) -> None:
     _file(fh).preallocate(int(nbytes))
 
 
-def file_seek_shared(fh: int, offset: int, whence: int) -> None:
+def _file_seek_shared_impl(fh: int, offset: int, whence: int) -> None:
     f = _file(fh)
     disp, et, ft, _rep = _view_of(fh)
     esz = type_size_bytes(et)
@@ -3294,14 +3522,20 @@ def file_seek_shared(fh: int, offset: int, whence: int) -> None:
         raise MPIError(ERR_ARG, f"bad whence {whence}")
 
 
+def file_seek_shared(fh: int, offset: int, whence: int) -> None:
+    # the pointer write orders behind pending i-ops on this file
+    return _file_blocking_serial(fh, _file_seek_shared_impl, fh,
+                                 offset, whence)
+
+
 def file_get_position_shared(fh: int) -> int:
     f = _file(fh)
     _disp, et, _ft, _rep = _view_of(fh)
     return int(f.get_position_shared()) // type_size_bytes(et)
 
 
-def file_read_ordered(fh: int, offset: int, nbytes: int, dt: int,
-                      curview) -> Tuple[bytes, int]:
+def _file_read_ordered_impl(fh: int, offset: int, nbytes: int,
+                            dt: int, curview) -> Tuple[bytes, int]:
     f = _file(fh)
     disp, et, ft, _rep = _view_of(fh)
     if type_size_bytes(ft) != type_extent_bytes(ft) or disp:
@@ -3315,7 +3549,14 @@ def file_read_ordered(fh: int, offset: int, nbytes: int, dt: int,
     return _unpack(flat, dt, cnt, bytes(curview))[0], int(flat.nbytes)
 
 
-def file_write_ordered(fh: int, offset: int, view, dt: int) -> int:
+def file_read_ordered(fh: int, offset: int, nbytes: int, dt: int,
+                      curview) -> Tuple[bytes, int]:
+    return _file_blocking_serial(fh, _file_read_ordered_impl, fh,
+                                 offset, nbytes, dt, curview)
+
+
+def _file_write_ordered_impl(fh: int, offset: int, view,
+                             dt: int) -> int:
     f = _file(fh)
     disp, et, ft, _rep = _view_of(fh)
     if type_size_bytes(ft) != type_extent_bytes(ft) or disp:
@@ -3323,6 +3564,11 @@ def file_write_ordered(fh: int, offset: int, view, dt: int) -> int:
     a = _pack(view, dt, _count_of(view, dt))
     f.write_ordered(a.view(np.uint8))
     return int(a.nbytes)
+
+
+def file_write_ordered(fh: int, offset: int, view, dt: int) -> int:
+    return _file_blocking_serial(fh, _file_write_ordered_impl, fh,
+                                 offset, view, dt)
 
 
 class _FileReadReq:
@@ -3350,13 +3596,13 @@ class _FileReadReq:
 
 def file_iread(fh: int, offset: int, nbytes: int, dt: int,
                curview) -> int:
-    c = _file(fh).comm
     snap = bytes(curview)
     # resolve the individual pointer NOW (i-ops are ordered at call)
     _disp, et, _ft, _rep = _view_of(fh)
     esz = type_size_bytes(et)
     pos = _ind_offset(fh, offset, int(nbytes) // esz, et)
-    req = c._nb(lambda: _vis_read(fh, pos * esz, int(nbytes)))
+    req = _file_nb_req(fh,
+                       lambda: _vis_read(fh, pos * esz, int(nbytes)))
     with _lock:
         rh = next(_next_req)
         _requests[rh] = (_FileReadReq(req, dt), dt, snap)
@@ -3364,13 +3610,12 @@ def file_iread(fh: int, offset: int, nbytes: int, dt: int,
 
 
 def file_iwrite(fh: int, offset: int, view, dt: int) -> int:
-    c = _file(fh).comm
     a = _pack(view, dt, _count_of(view, dt))
     data = a.view(np.uint8).tobytes()
     disp, et, ft, _rep = _view_of(fh)
     esz = type_size_bytes(et)
     pos = _ind_offset(fh, offset, len(data) // esz, et)
-    req = c._nb(lambda: _vis_write(fh, pos * esz, data))
+    req = _file_nb_req(fh, lambda: _vis_write(fh, pos * esz, data))
     with _lock:
         rh = next(_next_req)
         _requests[rh] = (req, 0, b"")
@@ -4385,10 +4630,10 @@ def file_get_group(fh: int) -> int:
 
 
 def _file_nb(fh: int, job) -> int:
-    """Nonblocking file op on the communicator's worker; the request
-    entry's dt==0 delivers the job's byte image verbatim at Wait."""
-    c = _file(fh).comm
-    req = c._nb(job) if hasattr(c, "_nb") else _DoneReq(job())
+    """Nonblocking file op on the file's serial worker (shared-pointer
+    claims happen in i-call order); the request entry's dt==0 delivers
+    the job's byte image verbatim at Wait."""
+    req = _file_nb_req(fh, job)
     with _lock:
         rh = next(_next_req)
         _requests[rh] = (req, 0, b"")
@@ -4739,3 +4984,26 @@ def session_get_pset_info(sh: int, name: str) -> int:
 # activate the constructor-envelope recorders (must run after every
 # constructor definition; see _record_env_wrappers)
 _record_env_wrappers()
+
+
+def _capture_op_ctx():
+    """The in-flight reduction's datatype handle must travel with a
+    funneled collective body (rankcomm._coll_serial): the glue sets
+    _op_ctx.dt on the CALLER thread before c.reduce/allreduce/scan,
+    and a C user op's combiner reads it on whichever thread runs the
+    fold — without propagation the worker-side fallback reverse-maps
+    the numpy dtype, which cannot distinguish aliased handles
+    (INT64_T vs LONG)."""
+    dt = getattr(_op_ctx, "dt", 0)
+
+    def apply():
+        _op_ctx.dt = dt
+
+    def reset():
+        _op_ctx.dt = 0
+    return (apply, reset)
+
+
+from ompi_tpu.core import rankcomm as _rankcomm_mod  # noqa: E402
+
+_rankcomm_mod.register_tls_propagator(_capture_op_ctx)

@@ -38,7 +38,9 @@ the reference's iterative CID allreduce establishes
 """
 from __future__ import annotations
 
+import functools
 import itertools
+import queue
 import threading
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -98,6 +100,41 @@ def hidden_engine(comm: "RankCommunicator", prefix: str):
     return eng
 
 
+# thread-local CALL CONTEXT that must travel with a funneled body:
+# layers above (the C ABI sets a reduction-datatype context on the
+# caller thread before invoking blocking reductions) register a
+# capture hook; _coll_serial snapshots every registered context at
+# funnel time and applies/resets it around the body on the worker.
+_TLS_PROPAGATORS: List[Callable[[], Tuple[Callable, Callable]]] = []
+
+
+def register_tls_propagator(
+        capture: Callable[[], Tuple[Callable, Callable]]) -> None:
+    """``capture()`` runs on the funneling caller and returns
+    ``(apply, reset)`` closures run on the worker around the body."""
+    _TLS_PROPAGATORS.append(capture)
+
+
+def _serialized(fn):
+    """Collective-execution serializer — applied to every public
+    collective entry that (transitively) draws the comm's sequence
+    tag. ``_tag()`` draws at EXECUTION time and its cross-rank
+    agreement rests on one invariant: each rank executes the comm's
+    collectives in issue order on a single thread at a time.
+    Deferred i-collectives run on the comm's serial worker, so a
+    blocking collective issued while any are pending must queue
+    BEHIND them (two concurrent draws would order differently on
+    different ranks and cross-match payloads — e.g. a barrier's
+    round messages consumed as a scan's partial). With an idle
+    worker the call runs inline: no thread hop on the latency path.
+    This is the chokepoint the C ABI, the Python API, and internal
+    collective users (window creation, file IO, dpm) all share."""
+    @functools.wraps(fn)
+    def entry(self, *a, **kw):
+        return self._coll_serial(fn, self, *a, **kw)
+    return entry
+
+
 class RankCommunicator:
     """A communicator whose caller is exactly one rank."""
 
@@ -145,6 +182,10 @@ class RankCommunicator:
         self._dev_fns: Dict[Any, Callable] = {}
         self._mesh_cache = None
         self._lock = threading.Lock()
+        self._cq: Optional["queue.Queue"] = None   # serial collective
+        self._cworker: Optional[threading.Thread] = None  # executor
+        self._cclosed = False            # set by _coll_drain: no new
+        # jobs may spawn a worker after teardown began
 
     # ------------------------------------------------------------------
     @property
@@ -315,6 +356,7 @@ class RankCommunicator:
                 return False             # C fn pointers cannot trace
         return self._mesh() is not None
 
+    @_serialized
     def barrier(self) -> None:
         """Dissemination barrier: ceil(log2 n) rounds
         (coll_base_barrier.c bruck/dissemination)."""
@@ -327,6 +369,7 @@ class RankCommunicator:
             self._crecv((r - k) % n, t)
             k <<= 1
 
+    @_serialized
     def bcast(self, data: Any = None, root: int = 0) -> Any:
         """Binomial-tree bcast (coll_base_bcast.c binomial): non-root
         callers pass nothing and receive the root's value.
@@ -383,6 +426,7 @@ class RankCommunicator:
             mask >>= 1
         return data
 
+    @_serialized
     def reduce(self, data: Any, op: op_mod.Op = op_mod.SUM,
                root: int = 0) -> Any:
         """Binomial reduce for commutative ops; linear ordered fold at
@@ -457,6 +501,7 @@ class RankCommunicator:
             return data.nbytes <= max_bytes
         return isinstance(data, (int, float, complex, np.generic))
 
+    @_serialized
     def allreduce(self, data: Any, op: op_mod.Op = op_mod.SUM) -> Any:
         self._check()
         self._validate_op(op)
@@ -477,6 +522,7 @@ class RankCommunicator:
         r = self.reduce(data, op, 0)
         return self.bcast(r, 0)
 
+    @_serialized
     def gather(self, data: Any, root: int = 0) -> Optional[List[Any]]:
         """Linear gather (coll/basic): returns the rank-ordered list at
         root, None elsewhere."""
@@ -494,6 +540,7 @@ class RankCommunicator:
                 out[s] = self._crecv(s, t)
         return out
 
+    @_serialized
     def scatter(self, chunks: Optional[Sequence[Any]] = None,
                 root: int = 0) -> Any:
         """Linear scatter: root passes one chunk per rank; every caller
@@ -511,6 +558,7 @@ class RankCommunicator:
             return chunks[root]
         return self._crecv(root, t)
 
+    @_serialized
     def allgather(self, data: Any, *, uniform: bool = False) -> List[Any]:
         """Ring allgather (coll_base_allgather ring): n-1 rounds, each
         forwarding the chunk received last round.
@@ -541,6 +589,7 @@ class RankCommunicator:
             out[(r - 1 - s) % n] = cur
         return out
 
+    @_serialized
     def alltoall(self, chunks: Sequence[Any], *,
                  uniform: bool = False) -> List[Any]:
         """Pairwise-exchange alltoall (coll_base_alltoall pairwise).
@@ -578,6 +627,7 @@ class RankCommunicator:
             out[src] = req.get()
         return out
 
+    @_serialized
     def scan(self, data: Any, op: op_mod.Op = op_mod.SUM) -> Any:
         """Linear scan: inclusive prefix over ranks 0..r."""
         self._check()
@@ -591,6 +641,7 @@ class RankCommunicator:
             self._csend(r + 1, t, acc)
         return acc
 
+    @_serialized
     def exscan(self, data: Any, op: op_mod.Op = op_mod.SUM) -> Any:
         """Exclusive prefix: rank 0 gets None."""
         self._check()
@@ -619,6 +670,112 @@ class RankCommunicator:
         return acc
 
     # -- nonblocking collectives (async over a worker thread) ----------
+    def _coll_worker_loop(self, q: "queue.Queue") -> None:
+        # ONE worker per comm runs every deferred collective and any
+        # funneled blocking body. It must never fire the coll
+        # interposition hooks: blocking entries fire them on the
+        # CALLER thread before funneling, i-slots are interposition-
+        # exempt by contract (like the stacked coll/sync component),
+        # and a fresh thread-local depth would let sync's op counter
+        # race across threads and desynchronize injected barriers
+        # between ranks.
+        from ompi_tpu.coll.interpose_perrank import _tls as _itls
+        _itls.sync_depth = 1
+        _itls.mon_depth = 1
+        while True:
+            item = q.get()
+            if item is None:
+                q.task_done()
+                return
+            runner = item
+            runner()
+            q.task_done()                # unfinished_tasks is the
+            # _coll_serial busy signal: queued + in-flight jobs
+
+    def _coll_submit(self, runner: Callable) -> None:
+        with self._lock:
+            if self._cclosed:
+                raise MPIError(ERR_COMM,
+                               "communicator has been freed")
+            q = self._cq
+            if q is None:
+                q = self._cq = queue.Queue()
+                self._cworker = threading.Thread(
+                    target=self._coll_worker_loop, args=(q,),
+                    daemon=True, name=f"coll-worker-{self.name}")
+                self._cworker.start()
+            # enqueue under the lock: a concurrent drain's sentinel
+            # must not overtake this job
+            q.put(runner)
+
+    def _coll_serial(self, fn: Callable, *a, **kw):
+        """Execute a collective body on the comm's single collective-
+        execution context (see _serialized). Reentrant: a body already
+        on the worker runs directly."""
+        w = self._cworker
+        if w is not None and threading.current_thread() is w:
+            return fn(*a, **kw)
+        box: Dict[str, Any] = {}
+        ev: Optional[threading.Event] = None
+        with self._lock:
+            q = self._cq
+            if q is not None and q.unfinished_tasks > 0:
+                ev = threading.Event()
+                # a funneled body must see the caller's interposition
+                # depths (a collective entry arrives with its hook
+                # already fired and depth incremented — nested calls
+                # stay uncounted; a file/window op arrives at depth 0
+                # — its nested collectives count as app ops), exactly
+                # as an inline run would: a rank whose worker happens
+                # to be idle runs inline, and hook counts must not
+                # depend on that race or coll/sync's injected
+                # barriers desync across ranks
+                from ompi_tpu.coll.interpose_perrank import \
+                    _tls as _itls
+                sd = getattr(_itls, "sync_depth", 0)
+                md = getattr(_itls, "mon_depth", 0)
+                props = [cap() for cap in _TLS_PROPAGATORS]
+
+                def runner():
+                    _itls.sync_depth = sd
+                    _itls.mon_depth = md
+                    for apply, _reset in props:
+                        apply()
+                    try:
+                        box["res"] = fn(*a, **kw)
+                    except BaseException as e:  # noqa: BLE001
+                        box["err"] = e
+                    finally:
+                        for _apply, reset in props:
+                            reset()
+                        _itls.sync_depth = 1    # the worker default:
+                        _itls.mon_depth = 1     # i-jobs are exempt
+                        ev.set()
+                q.put(runner)
+        if ev is None:                   # worker idle: inline
+            return fn(*a, **kw)
+        ev.wait()
+        if "err" in box:
+            raise box["err"]
+        return box["res"]
+
+    def _coll_drain(self) -> None:
+        """Retire the comm's worker, draining pending jobs first
+        (MPI-3.1 6.4.3: deallocation only after pending operations
+        complete). _cclosed is set under the same lock hold as the
+        sentinel, so no concurrent submit can spawn a SECOND worker
+        while the old one still runs queued jobs (two executors would
+        break the single-tag-draw-thread invariant); late submits get
+        a clean freed-comm error instead."""
+        with self._lock:
+            q, t = self._cq, self._cworker
+            self._cq = self._cworker = None
+            self._cclosed = True
+            if q is not None:
+                q.put(None)              # queues behind pending jobs
+        if t is not None:
+            t.join()
+
     def _nb(self, fn: Callable, *args) -> Request:
         req = RankRequest(ANY_SOURCE, ANY_TAG)
         req._error: Optional[BaseException] = None
@@ -632,16 +789,6 @@ class RankCommunicator:
         req.wait = wait
 
         def run():
-            # Worker threads must never fire the coll interposition
-            # hooks: the class-level collective bodies still reach
-            # wrapped instance methods (self.reduce/self.bcast), and a
-            # fresh thread-local depth would let sync's op counter
-            # race across threads and desynchronize injected barriers
-            # between ranks (i-slots are interposition-exempt, like
-            # the stacked coll/sync component).
-            from ompi_tpu.coll.interpose_perrank import _tls as _itls
-            _itls.sync_depth = 1
-            _itls.mon_depth = 1
             from ompi_tpu.pml.perrank import _Msg
             try:
                 req._deliver(_Msg(self._rank, 0, fn(*args)))
@@ -649,7 +796,7 @@ class RankCommunicator:
                 req._error = e
                 req._complete = True
                 req._event.set()
-        threading.Thread(target=run, daemon=True).start()
+        self._coll_submit(run)
         return req
 
     # The i-variants run the CLASS-level implementations, bypassing any
@@ -915,6 +1062,7 @@ class RankCommunicator:
         """MPI_Cart_shift for THIS rank: (source, dest)."""
         return self._cart().shift(self._rank, direction, disp)
 
+    @_serialized
     def neighbor_allgather(self, data: Any) -> List[Any]:
         """MPI_Neighbor_allgather, textbook: exchange ``data`` with each
         topology neighbor; returns received buffers in neighbor order
@@ -950,6 +1098,7 @@ class RankCommunicator:
                 out.append(q.get())
         return out
 
+    @_serialized
     def neighbor_alltoall(self, chunks: Sequence[Any]) -> List[Any]:
         """MPI_Neighbor_alltoall, textbook: chunk j goes to my j-th
         neighbor; returns one buffer per neighbor slot (None at invalid
@@ -1071,9 +1220,16 @@ class RankCommunicator:
             errhandler=self.errhandler)
 
     def free(self) -> None:
-        # delete callbacks fire at free (attribute.c free path)
+        # delete callbacks fire FIRST (attribute.c free path): a
+        # failing callback aborts the free with the comm fully intact
+        # — worker alive, engines open — so the caller's "free did
+        # not happen, comm stays valid" contract holds (MPI-3.1
+        # 6.7.2)
         from ompi_tpu.core.communicator import fire_delete_attrs
         fire_delete_attrs(self)
+        self._coll_drain()               # pending deferred collectives
+        # complete against the live comm before teardown (MPI-3.1
+        # 6.4.3)
         self._pml.close()
         self._coll_pml.close()
         for eng in self._aux_pmls.values():   # hidden channels too —

@@ -1,0 +1,140 @@
+/* Overlap a pending nonblocking collective with LATER blocking
+ * collectives on the same communicator — legal MPI (the whole point
+ * of icolls) and the acid test for collective tag agreement: every
+ * rank must execute the comm's collectives in issue order even
+ * though the icoll runs on a worker thread (reference semantics:
+ * ompi/mca/coll/libnbc schedules vs coll/tuned blocking calls on one
+ * comm). A racing tag draw cross-matches a barrier/bcast payload
+ * into the scan and corrupts values. Runs with -n 3. */
+#include <mpi.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+#define CHECK(cond, code)                                            \
+    do {                                                             \
+        if (!(cond)) {                                               \
+            fprintf(stderr, "rank %d: check failed at line %d\n",    \
+                    rank, __LINE__);                                 \
+            MPI_Abort(MPI_COMM_WORLD, code);                         \
+        }                                                            \
+    } while (0)
+
+/* the datatype handle the user combiner observes: a funneled
+ * reduction must deliver the EXACT handle passed at the call (the
+ * worker-side fallback reverse-maps the numpy dtype and cannot
+ * distinguish aliased handles like MPI_LONG vs MPI_INT64_T) */
+static MPI_Datatype g_seen_dt = MPI_DATATYPE_NULL;
+
+static void longsum(void *in, void *inout, int *len,
+                    MPI_Datatype *dt)
+{
+    g_seen_dt = *dt;
+    long *a = (long *)in, *b = (long *)inout;
+    for (int i = 0; i < *len; i++)
+        b[i] += a[i];
+}
+
+int main(int argc, char **argv)
+{
+    MPI_Init(&argc, &argv);
+    int rank, size;
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &size);
+
+    for (int iter = 0; iter < 5; iter++) {
+        /* pending iscan + iexscan, then blocking bcast + allreduce
+         * BEFORE waiting: the blocking calls must queue behind the
+         * deferred ones on every rank */
+        double s = (double)(rank + 1), pre = -1.0, epre = -7.0;
+        MPI_Request reqs[2];
+        MPI_Iscan(&s, &pre, 1, MPI_DOUBLE, MPI_SUM, MPI_COMM_WORLD,
+                  &reqs[0]);
+        MPI_Iexscan(&s, &epre, 1, MPI_DOUBLE, MPI_SUM,
+                    MPI_COMM_WORLD, &reqs[1]);
+        int root_val = (rank == 0) ? 4200 + iter : -1;
+        MPI_Bcast(&root_val, 1, MPI_INT, 0, MPI_COMM_WORLD);
+        CHECK(root_val == 4200 + iter, 2);
+        int one = 1, tot = 0;
+        MPI_Allreduce(&one, &tot, 1, MPI_INT, MPI_SUM,
+                      MPI_COMM_WORLD);
+        CHECK(tot == size, 3);
+        MPI_Waitall(2, reqs, MPI_STATUSES_IGNORE);
+        CHECK(pre == (double)(rank + 1) * (rank + 2) / 2, 4);
+        if (rank > 0)
+            CHECK(epre == (double)rank * (rank + 1) / 2, 5);
+
+        /* pending ibarrier + ibcast, then a blocking barrier */
+        double bv[4];
+        for (int i = 0; i < 4; i++)
+            bv[i] = (rank == 1) ? 10.0 * iter + i : -1.0;
+        MPI_Request r2[2];
+        MPI_Ibarrier(MPI_COMM_WORLD, &r2[0]);
+        MPI_Ibcast(bv, 4, MPI_DOUBLE, 1, MPI_COMM_WORLD, &r2[1]);
+        MPI_Barrier(MPI_COMM_WORLD);
+        MPI_Waitall(2, r2, MPI_STATUSES_IGNORE);
+        for (int i = 0; i < 4; i++)
+            CHECK(bv[i] == 10.0 * iter + i, 6);
+
+        /* pending ibarrier, then window creation — win_allocate's
+         * INTERNAL collectives (size exchange) must also queue behind
+         * the deferred barrier on every rank */
+        MPI_Request r3;
+        MPI_Ibarrier(MPI_COMM_WORLD, &r3);
+        MPI_Win win;
+        int *wbase = NULL;
+        MPI_Win_allocate((MPI_Aint)sizeof(int), sizeof(int),
+                         MPI_INFO_NULL, MPI_COMM_WORLD, &wbase, &win);
+        *wbase = 500 + rank;
+        MPI_Win_fence(0, win);
+        int got = -1;
+        MPI_Get(&got, 1, MPI_INT, (rank + 1) % size, 0, 1, MPI_INT,
+                win);
+        MPI_Win_fence(0, win);
+        CHECK(got == 500 + (rank + 1) % size, 7);
+        MPI_Wait(&r3, MPI_STATUS_IGNORE);
+        MPI_Win_free(&win);
+    }
+
+    /* user-op blocking reduction funneled behind a pending icoll:
+     * the combiner must see MPI_LONG, not a reverse-mapped alias */
+    MPI_Op myop;
+    CHECK(MPI_Op_create(longsum, 1, &myop) == MPI_SUCCESS, 13);
+    MPI_Request ur;
+    MPI_Ibarrier(MPI_COMM_WORLD, &ur);
+    long lv = 7 + rank, lt = 0;
+    CHECK(MPI_Allreduce(&lv, &lt, 1, MPI_LONG, myop,
+                        MPI_COMM_WORLD) == MPI_SUCCESS, 14);
+    CHECK(lt == (long)size * 7 + (long)size * (size - 1) / 2, 15);
+    CHECK(g_seen_dt == MPI_LONG, 16);
+    MPI_Wait(&ur, MPI_STATUS_IGNORE);
+    MPI_Op_free(&myop);
+
+    /* shared file pointer: a pending nonblocking shared write must
+     * claim the pointer BEFORE a later blocking shared write (issue
+     * order), or records land at swapped offsets */
+    MPI_File fhandle;
+    char path[64];
+    snprintf(path, sizeof path, "/tmp/c36_shared_%d.bin", rank);
+    CHECK(MPI_File_open(MPI_COMM_SELF, path,
+                        MPI_MODE_CREATE | MPI_MODE_RDWR,
+                        MPI_INFO_NULL, &fhandle) == MPI_SUCCESS, 8);
+    int first[2] = {1111, 1112}, second[2] = {2221, 2222};
+    MPI_Request fr;
+    CHECK(MPI_File_iwrite_shared(fhandle, first, 2, MPI_INT, &fr)
+          == MPI_SUCCESS, 9);
+    MPI_Status fst;
+    CHECK(MPI_File_write_shared(fhandle, second, 2, MPI_INT, &fst)
+          == MPI_SUCCESS, 10);
+    MPI_Wait(&fr, MPI_STATUS_IGNORE);
+    int back[4] = {0, 0, 0, 0};
+    CHECK(MPI_File_read_at(fhandle, 0, back, 4, MPI_INT, &fst)
+          == MPI_SUCCESS, 11);
+    CHECK(back[0] == 1111 && back[1] == 1112
+          && back[2] == 2221 && back[3] == 2222, 12);
+    MPI_File_close(&fhandle);
+    MPI_File_delete(path, MPI_INFO_NULL);
+
+    MPI_Finalize();
+    printf("OK c36_icoll_blocking_mix\n");
+    return 0;
+}
