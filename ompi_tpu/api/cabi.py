@@ -5004,6 +5004,9 @@ def _capture_op_ctx():
     return (apply, reset)
 
 
-from ompi_tpu.core import rankcomm as _rankcomm_mod  # noqa: E402
+def _register_op_ctx_propagator() -> None:
+    from ompi_tpu.core import rankcomm as _rankcomm_mod
+    _rankcomm_mod.register_tls_propagator(_capture_op_ctx)
 
-_rankcomm_mod.register_tls_propagator(_capture_op_ctx)
+
+_register_op_ctx_propagator()

@@ -21,6 +21,7 @@ of the BTL active-message callback into ob1's ``recv_frag_match``.
 from __future__ import annotations
 
 import pickle
+import queue
 import socket
 import struct
 import threading
@@ -79,6 +80,20 @@ class TcpEndpoint:
         self._peer_locks: Dict[int, threading.Lock] = {}
         self._lock = threading.Lock()
         self._closed = False
+        # reader threads must NEVER block sending (acks, RMA replies):
+        # a reader stuck in sendall behind a full socket stops
+        # recv()ing, and two ranks doing bidirectional bulk sends then
+        # deadlock permanently (each app thread fills the socket, each
+        # reader waits to ack). Reader-originated frames queue here
+        # and a dedicated sender thread drains them — readers always
+        # keep reading, so kernel buffers always drain and every
+        # sendall eventually progresses.
+        self._reader_tls = threading.local()
+        self._ctl_q: "queue.Queue" = queue.Queue()
+        self._ctl_thread = threading.Thread(
+            target=self._ctl_send_loop, daemon=True,
+            name=f"btl-tcp-ctl-{rank}")
+        self._ctl_thread.start()
 
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -107,6 +122,8 @@ class TcpEndpoint:
 
     def _read_loop(self, conn: socket.socket) -> None:
         peer = -1                            # set by the hello frame
+        self._reader_tls.active = True       # sends from this thread
+        # divert to the ctl sender (see __init__: readers never block)
         try:
             while not self._closed:
                 head = self._read_exact(conn, _LEN.size)
@@ -168,6 +185,14 @@ class TcpEndpoint:
         addr = self._kv_get(f"ompi_tpu/btl/{peer}")
         host, port = addr.rsplit(":", 1)
         s = socket.create_connection((host, int(port)), timeout=60)
+        # the 60 s budget is for the CONNECT only: data sends must
+        # never carry it — a multi-GB sendall on a loaded host can
+        # legitimately take minutes (observed: a 2.1 GB bigcount
+        # frame spuriously timing out mid-transfer), and peer DEATH
+        # is detected by the reader's EOF machinery, not send
+        # timeouts (sendall fails fast with ECONNRESET when the
+        # peer really dies)
+        s.settimeout(None)
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         with self._lock:
             # lost race: keep the first connection
@@ -184,12 +209,34 @@ class TcpEndpoint:
             s.sendall(_LEN.pack(MAGIC, len(hraw), 0) + hraw)
         return s
 
+    def _ctl_send_loop(self) -> None:
+        while True:
+            item = self._ctl_q.get()
+            if item is None:
+                return
+            peer, header, payload = item
+            try:
+                self._send_frame_blocking(peer, header, payload)
+            except Exception:                # noqa: BLE001 — a dead
+                pass                         # peer's ack is moot; the
+            # failure detector reports the death through its own path
+
     def send_frame(self, peer: int, header: dict,
                    payload: bytes = b"") -> None:
         """Self-sends loop back without touching a socket (btl/self)."""
         if peer == self.rank:
             self.sink(header, payload)
             return
+        if getattr(self._reader_tls, "active", False):
+            # reader thread: never block on a socket send (deadlock
+            # cycle with a peer whose reader is equally stuck) — hand
+            # the frame to the ctl sender and return to recv()
+            self._ctl_q.put((peer, header, payload))
+            return
+        self._send_frame_blocking(peer, header, payload)
+
+    def _send_frame_blocking(self, peer: int, header: dict,
+                             payload: bytes = b"") -> None:
         s = self._connect(peer)
         hraw = pickle.dumps(header)
         msg = _LEN.pack(MAGIC, len(hraw), len(payload)) + hraw + payload
@@ -198,6 +245,7 @@ class TcpEndpoint:
 
     def close(self) -> None:
         self._closed = True
+        self._ctl_q.put(None)                # retire the ctl sender
         try:
             self._listener.close()
         except OSError:

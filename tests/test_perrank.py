@@ -47,6 +47,7 @@ CASES = [
     ("p27_staged_coll.py", 3),
     ("p28_devxfer.py", 3),
     ("p29_stage_probe.py", 3),
+    ("p30_bidir_bulk.py", 2),
 ]
 
 
