@@ -205,15 +205,16 @@ def _overlap_pct(world, MPI, elems: int = 1 << 20) -> dict:
         # toward 100%. Record the ceiling so the number is read
         # honestly.
         out["iallreduce_overlap_capped_by_host_cores"] = cores
-    if cores <= 2 and out["iallreduce_overlap_pct"] > bound:
-        # the core-free ceiling assumes COOPERATIVE overlap (comm
-        # offloaded while the host computes); process_time counts CPU
-        # across ALL threads, so with the CPU backend's own compute
-        # threads saturating the core the ceiling reads ~0 while the
-        # OS still timeslices the busy-loop against the mesh's
-        # backend threads — measured overlap above the ceiling is
-        # preemptive interleaving credit, not offload
-        out["iallreduce_overlap_model"] = "timeslice_interleaving"
+        if overlap > bound:              # raw value: rounding must not
+            # flip the classification at the boundary
+            # the core-free ceiling assumes COOPERATIVE overlap (comm
+            # offloaded while the host computes); process_time counts
+            # CPU across ALL threads, so with the CPU backend's own
+            # compute threads saturating the core the ceiling reads
+            # ~0 while the OS still timeslices the busy-loop against
+            # the mesh's backend threads — measured overlap above the
+            # ceiling is preemptive interleaving credit, not offload
+            out["iallreduce_overlap_model"] = "timeslice_interleaving"
     return out
 
 

@@ -983,17 +983,9 @@ def _status(st, payload: Optional[bytes] = None) -> Tuple[int, int, int]:
 def init(required: int) -> int:
     """MPI_Init / MPI_Init_thread from a C main(): same env-driven
     bring-up the Python per-rank programs get (mpirun --per-rank sets
-    OMPI_TPU_MCA_* + coordination-service vars)."""
-    import os
-    # A sitecustomize may pin jax_platforms to a TPU plugin, overriding
-    # the JAX_PLATFORMS env var the launcher set; re-assert it.
-    plat = os.environ.get("JAX_PLATFORMS")
-    if plat:
-        import jax
-        try:
-            jax.config.update("jax_platforms", plat)
-        except Exception:               # noqa: BLE001 — older jax
-            pass
+    OMPI_TPU_MCA_* + coordination-service vars). The JAX_PLATFORMS
+    re-assert against sitecustomize pins lives in runtime.init for
+    every entry tier."""
     from ompi_tpu.runtime import init as rt
     return rt.init(required)
 

@@ -24,6 +24,7 @@ import pickle
 import queue
 import socket
 import struct
+import time
 import threading
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -84,16 +85,16 @@ class TcpEndpoint:
         # a reader stuck in sendall behind a full socket stops
         # recv()ing, and two ranks doing bidirectional bulk sends then
         # deadlock permanently (each app thread fills the socket, each
-        # reader waits to ack). Reader-originated frames queue here
-        # and a dedicated sender thread drains them — readers always
-        # keep reading, so kernel buffers always drain and every
-        # sendall eventually progresses.
+        # reader waits to ack). Reader-originated frames divert to a
+        # PER-PEER ctl sender thread — readers always keep reading, so
+        # kernel buffers always drain and every sendall eventually
+        # progresses; per-peer queues keep one slow destination from
+        # head-of-line-blocking acks to every other peer. The bound
+        # gives backpressure against pathological reply floods (RMA
+        # get storms) without reintroducing the reader-block cycle in
+        # any realistic regime.
         self._reader_tls = threading.local()
-        self._ctl_q: "queue.Queue" = queue.Queue()
-        self._ctl_thread = threading.Thread(
-            target=self._ctl_send_loop, daemon=True,
-            name=f"btl-tcp-ctl-{rank}")
-        self._ctl_thread.start()
+        self._ctl_qs: Dict[int, "queue.Queue"] = {}
 
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -209,17 +210,45 @@ class TcpEndpoint:
             s.sendall(_LEN.pack(MAGIC, len(hraw), 0) + hraw)
         return s
 
-    def _ctl_send_loop(self) -> None:
+    def _ctl_send_loop(self, q: "queue.Queue", peer: int) -> None:
         while True:
-            item = self._ctl_q.get()
+            item = q.get()
             if item is None:
                 return
-            peer, header, payload = item
-            try:
-                self._send_frame_blocking(peer, header, payload)
-            except Exception:                # noqa: BLE001 — a dead
-                pass                         # peer's ack is moot; the
-            # failure detector reports the death through its own path
+            header, payload = item
+            # frames carry the bml's per-sender sequence number drawn
+            # at enqueue: silently dropping one would park EVERY
+            # later frame from this rank in the receiver's reorder
+            # buffer forever. Retry transient failures; a persistent
+            # failure is a dead link — report it to the failure
+            # detector (same contract as a reader-side EOF) rather
+            # than wedge the stream silently.
+            for attempt in range(3):
+                try:
+                    self._send_frame_blocking(peer, header, payload)
+                    break
+                except Exception:            # noqa: BLE001
+                    if self._closed:
+                        return
+                    time.sleep(0.05 * (attempt + 1))
+            else:
+                if not self._closed and self.on_peer_lost:
+                    try:
+                        self.on_peer_lost(peer)
+                    except Exception:        # noqa: BLE001
+                        pass
+
+    def _ctl_submit(self, peer: int, header: dict,
+                    payload: bytes) -> None:
+        with self._lock:
+            q = self._ctl_qs.get(peer)
+            if q is None:
+                q = self._ctl_qs[peer] = queue.Queue(maxsize=1024)
+                threading.Thread(
+                    target=self._ctl_send_loop, args=(q, peer),
+                    daemon=True,
+                    name=f"btl-tcp-ctl-{self.rank}-{peer}").start()
+        q.put((header, payload))
 
     def send_frame(self, peer: int, header: dict,
                    payload: bytes = b"") -> None:
@@ -230,8 +259,8 @@ class TcpEndpoint:
         if getattr(self._reader_tls, "active", False):
             # reader thread: never block on a socket send (deadlock
             # cycle with a peer whose reader is equally stuck) — hand
-            # the frame to the ctl sender and return to recv()
-            self._ctl_q.put((peer, header, payload))
+            # the frame to the peer's ctl sender and return to recv()
+            self._ctl_submit(peer, header, payload)
             return
         self._send_frame_blocking(peer, header, payload)
 
@@ -245,7 +274,10 @@ class TcpEndpoint:
 
     def close(self) -> None:
         self._closed = True
-        self._ctl_q.put(None)                # retire the ctl sender
+        with self._lock:
+            ctl_qs = list(self._ctl_qs.values())
+        for q in ctl_qs:                     # retire the ctl senders
+            q.put(None)
         try:
             self._listener.close()
         except OSError:
