@@ -95,6 +95,8 @@ class TcpEndpoint:
         # any realistic regime.
         self._reader_tls = threading.local()
         self._ctl_qs: Dict[int, "queue.Queue"] = {}
+        self._ctl_failed: set = set()    # peers whose ctl link died:
+        # reported to the failure detector ONCE, further frames dropped
 
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -210,37 +212,73 @@ class TcpEndpoint:
             s.sendall(_LEN.pack(MAGIC, len(hraw), 0) + hraw)
         return s
 
+    def _evict_peer_socket(self, peer: int) -> None:
+        """Drop a broken cached connection so the next send
+        reconnects (a retry against the same dead socket can never
+        succeed)."""
+        with self._lock:
+            s = self._peers.pop(peer, None)
+        if s is not None:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def _ctl_peer_down(self, peer: int) -> None:
+        """The peer's ctl link is dead or wedged: report ONCE to the
+        failure detector (same contract as a reader-side EOF), drain
+        and discard its queued frames (every later frame from this
+        rank is undeliverable anyway), and drop future ones."""
+        with self._lock:
+            if peer in self._ctl_failed:
+                return
+            self._ctl_failed.add(peer)
+            q = self._ctl_qs.get(peer)
+        if q is not None:
+            while True:
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+        if not self._closed and self.on_peer_lost:
+            try:
+                self.on_peer_lost(peer)
+            except Exception:                # noqa: BLE001
+                pass
+
     def _ctl_send_loop(self, q: "queue.Queue", peer: int) -> None:
         while True:
             item = q.get()
-            if item is None:
+            if item is None or self._closed:
                 return
             header, payload = item
             # frames carry the bml's per-sender sequence number drawn
             # at enqueue: silently dropping one would park EVERY
             # later frame from this rank in the receiver's reorder
-            # buffer forever. Retry transient failures; a persistent
-            # failure is a dead link — report it to the failure
-            # detector (same contract as a reader-side EOF) rather
-            # than wedge the stream silently.
+            # buffer forever. Retry transient failures (evicting the
+            # cached socket so the retry actually reconnects); a
+            # persistent failure is a dead link — fail the peer once
+            # and stop, rather than wedge or thrash.
+            sent = False
             for attempt in range(3):
                 try:
                     self._send_frame_blocking(peer, header, payload)
+                    sent = True
                     break
                 except Exception:            # noqa: BLE001
                     if self._closed:
                         return
+                    self._evict_peer_socket(peer)
                     time.sleep(0.05 * (attempt + 1))
-            else:
-                if not self._closed and self.on_peer_lost:
-                    try:
-                        self.on_peer_lost(peer)
-                    except Exception:        # noqa: BLE001
-                        pass
+            if not sent:
+                self._ctl_peer_down(peer)
+                return
 
     def _ctl_submit(self, peer: int, header: dict,
                     payload: bytes) -> None:
         with self._lock:
+            if self._closed or peer in self._ctl_failed:
+                return                       # undeliverable: drop
             q = self._ctl_qs.get(peer)
             if q is None:
                 q = self._ctl_qs[peer] = queue.Queue(maxsize=1024)
@@ -248,7 +286,16 @@ class TcpEndpoint:
                     target=self._ctl_send_loop, args=(q, peer),
                     daemon=True,
                     name=f"btl-tcp-ctl-{self.rank}-{peer}").start()
-        q.put((header, payload))
+        try:
+            # NEVER block the reader — not even on a full ctl queue
+            # (a blocking put here would reintroduce the exact
+            # reader-block deadlock this path exists to prevent). A
+            # full queue means the peer's ctl sender is wedged behind
+            # an unbounded sendall: that link is dead for practical
+            # purposes — fail it explicitly instead of wedging.
+            q.put_nowait((header, payload))
+        except queue.Full:
+            self._ctl_peer_down(peer)
 
     def send_frame(self, peer: int, header: dict,
                    payload: bytes = b"") -> None:
@@ -276,8 +323,12 @@ class TcpEndpoint:
         self._closed = True
         with self._lock:
             ctl_qs = list(self._ctl_qs.values())
-        for q in ctl_qs:                     # retire the ctl senders
-            q.put(None)
+        for q in ctl_qs:                     # retire the ctl senders:
+            try:                             # never block close() on a
+                q.put_nowait(None)           # full queue — the sender
+            except queue.Full:               # also exits on _closed,
+                pass                         # unstuck by the socket
+            # closes below
         try:
             self._listener.close()
         except OSError:

@@ -66,18 +66,15 @@ def _register_base_vars() -> None:
                           "textbook p2p algorithms")
 
 
-def init(requested: int = THREAD_SINGLE,
-         devices: Optional[List] = None) -> int:
-    """MPI_Init / MPI_Init_thread. Returns the provided thread level."""
-    if _state["initialized"]:
-        raise MPIError(ERR_OTHER, "MPI already initialized")
-    # A sitecustomize may pin jax_platforms to a hardware plugin at
-    # interpreter startup, silently overriding the JAX_PLATFORMS env
-    # the launcher set — the rank would then wire up against the
-    # plugin's (shared, persistent) coordination plane instead of the
-    # job's own, failing with stale-key ALREADY_EXISTS / barrier
-    # timeouts. Re-assert the env pin before any backend use (the C
-    # ABI's init has always done this; every entry tier gets it here).
+def assert_platform_pin() -> None:
+    """A sitecustomize may pin jax_platforms to a hardware plugin at
+    interpreter startup, silently overriding the JAX_PLATFORMS env
+    the launcher set — the rank would then wire up against the
+    plugin's (shared, persistent) coordination plane instead of the
+    job's own, failing with stale-key ALREADY_EXISTS / barrier
+    timeouts. Re-assert the env pin before any backend use; called by
+    EVERY init tier (world init here, the Init-free Sessions model in
+    runtime/session.py, and the C ABI through both)."""
     plat = os.environ.get("JAX_PLATFORMS")
     if plat:
         import jax as _jax
@@ -85,6 +82,14 @@ def init(requested: int = THREAD_SINGLE,
             _jax.config.update("jax_platforms", plat)
         except Exception:                  # noqa: BLE001 — older jax
             pass
+
+
+def init(requested: int = THREAD_SINGLE,
+         devices: Optional[List] = None) -> int:
+    """MPI_Init / MPI_Init_thread. Returns the provided thread level."""
+    if _state["initialized"]:
+        raise MPIError(ERR_OTHER, "MPI already initialized")
+    assert_platform_pin()
     _register_base_vars()
     from ompi_tpu.pml import stacked as _pml_stacked  # noqa: F401
     # (imports register the pml MCA vars — components register at open,

@@ -127,6 +127,10 @@ _session_names = itertools.count(0)
 class Session:
     def __init__(self, info: Optional[Info] = None,
                  errhandler=None):
+        # the Init-free tier (MPI-4 Sessions) touches the backend
+        # first here — same sitecustomize defense as world init
+        from ompi_tpu.runtime.init import assert_platform_pin
+        assert_platform_pin()
         import jax
         self.info = info or Info()
         self.errhandler = errhandler
