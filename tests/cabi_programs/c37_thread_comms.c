@@ -1,0 +1,74 @@
+/* MPI_THREAD_MULTIPLE with collectives: two threads drive DISTINCT
+ * communicators concurrently — legal concurrency the standard
+ * guarantees (collective ordering constraints are per-comm, MPI-3.1
+ * 12.4.3). Validates that the per-comm serial collective execution
+ * (one tag-draw thread per comm) neither cross-serializes unrelated
+ * comms into a deadlock nor cross-matches their traffic, with
+ * blocking and nonblocking collectives interleaved on each comm.
+ * Runs with -n 2. */
+#include <mpi.h>
+#include <pthread.h>
+#include <stdio.h>
+
+static int rank, size;
+
+#define CHECK(cond, code)                                            \
+    do {                                                             \
+        if (!(cond)) {                                               \
+            fprintf(stderr, "rank %d: check failed at line %d\n",    \
+                    rank, __LINE__);                                 \
+            MPI_Abort(MPI_COMM_WORLD, code);                         \
+        }                                                            \
+    } while (0)
+
+struct arg { MPI_Comm comm; int base; int iters; };
+
+static void *drive(void *vp)
+{
+    struct arg *a = (struct arg *)vp;
+    for (int i = 0; i < a->iters; i++) {
+        double v = (double)(a->base + rank + i), tot = -1.0;
+        MPI_Request r;
+        MPI_Iallreduce(&v, &tot, 1, MPI_DOUBLE, MPI_SUM, a->comm, &r);
+        int bv = (rank == 0) ? a->base * 100 + i : -1;
+        MPI_Bcast(&bv, 1, MPI_INT, 0, a->comm);
+        CHECK(bv == a->base * 100 + i, 3);
+        MPI_Wait(&r, MPI_STATUS_IGNORE);
+        /* sum over ranks of (base + rank + i) */
+        CHECK(tot == (double)(size * (a->base + i))
+                     + (double)size * (size - 1) / 2, 4);
+        MPI_Barrier(a->comm);
+    }
+    return NULL;
+}
+
+int main(int argc, char **argv)
+{
+    int prov = -1;
+    MPI_Init_thread(&argc, &argv, MPI_THREAD_MULTIPLE, &prov);
+    CHECK(prov == MPI_THREAD_MULTIPLE, 1);
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &size);
+
+    MPI_Comm c1, c2;
+    MPI_Comm_dup(MPI_COMM_WORLD, &c1);
+    MPI_Comm_dup(MPI_COMM_WORLD, &c2);
+
+    struct arg a1 = {c1, 10, 6}, a2 = {c2, 77, 6};
+    pthread_t t1, t2;
+    CHECK(pthread_create(&t1, NULL, drive, &a1) == 0, 5);
+    CHECK(pthread_create(&t2, NULL, drive, &a2) == 0, 6);
+    pthread_join(t1, NULL);
+    pthread_join(t2, NULL);
+
+    /* world still coherent after the concurrent phase */
+    int one = 1, tot = 0;
+    MPI_Allreduce(&one, &tot, 1, MPI_INT, MPI_SUM, MPI_COMM_WORLD);
+    CHECK(tot == size, 7);
+
+    MPI_Comm_free(&c1);
+    MPI_Comm_free(&c2);
+    MPI_Finalize();
+    printf("OK c37_thread_comms\n");
+    return 0;
+}

@@ -63,6 +63,7 @@ CASES = [
     ("c34_misc2.c", 3),
     ("c35_join_mpmd.c", 2),
     ("c36_icoll_blocking_mix.c", 3),
+    ("c37_thread_comms.c", 2),
 ]
 
 # per-program argv (c13 runs 4M floats = 16 MB in CI — above the 1 MB
