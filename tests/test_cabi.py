@@ -74,7 +74,11 @@ PROG_ARGS = {"c13_staged.c": ["4194304"]}
 # payload through MPI_Send_c — ~90 s alone on this 1-core host, longer
 # when the suite stacks
 PROG_TIMEOUT = {"c23_bigcount.c": 450, "c25_spawn.c": 300,
-                "c35_join_mpmd.c": 300}
+                "c35_join_mpmd.c": 300,
+                # sessions + dynamic-process rendezvous: same
+                # multi-job class as spawn/join — needs headroom when
+                # the full suite stacks load on the 1-core host
+                "c18_sessions_dpm.c": 300}
 
 
 @pytest.fixture(scope="module")
