@@ -30,6 +30,7 @@ from typing import Callable, Dict, Optional
 from ompi_tpu.btl.sm import SmEndpoint
 from ompi_tpu.btl.tcp import TcpEndpoint
 from ompi_tpu.mca import var
+from ompi_tpu.runtime import progress as _progress
 
 _BOOT_ID: Optional[str] = None
 
@@ -234,12 +235,18 @@ class BmlEndpoint:
         if header.get("ctl") == "_smpoke":
             # transport doorbell: the peer parked payload-bearing
             # records in our shared-memory rings; drain them on this
-            # (blocking, already-awake) reader thread
+            # (blocking, already-awake) reader thread — one wake batch
+            # for the whole ring drain, however many records it pops
             if self.sm is not None:
-                self.sm.drain(header.get("peer"))
+                _progress.wake_begin()
+                try:
+                    self.sm.drain(header.get("peer"))
+                finally:
+                    _progress.wake_end()
             return
         sq = header.pop("_sq", None)
         if sq is None:                   # unsequenced (foreign) frame
+            _progress.wake_note_frame()
             self.sink(header, payload)
             return
         src, seq = sq
@@ -260,21 +267,30 @@ class BmlEndpoint:
             if self._draining.get(src):
                 return                   # the active drainer takes it
             self._draining[src] = True
-        while True:
-            with self._order_lock:
-                if not ready:
-                    self._draining[src] = False
-                    return
-                h, p = ready.popleft()
-            try:
-                self.sink(h, p)
-            except Exception:            # noqa: BLE001
-                # one bad frame must drop only itself — an escaping
-                # exception would leave _draining stuck True and wedge
-                # this sender's stream forever (the tcp read loop makes
-                # the same promise)
-                import traceback
-                traceback.print_exc()
+        # wakeup coalescing: ONE flush at drain end services every
+        # match this batch of frames completes, instead of one cross-
+        # thread wake per frame racing the still-draining reader for
+        # the core (runtime/progress.py wake batch)
+        _progress.wake_begin()
+        try:
+            while True:
+                with self._order_lock:
+                    if not ready:
+                        self._draining[src] = False
+                        return
+                    h, p = ready.popleft()
+                _progress.wake_note_frame()
+                try:
+                    self.sink(h, p)
+                except Exception:        # noqa: BLE001
+                    # one bad frame must drop only itself — an escaping
+                    # exception would leave _draining stuck True and
+                    # wedge this sender's stream forever (the tcp read
+                    # loop makes the same promise)
+                    import traceback
+                    traceback.print_exc()
+        finally:
+            _progress.wake_end()
 
     def send_frame(self, peer: int, header: dict,
                    payload: bytes = b"") -> None:
@@ -288,9 +304,16 @@ class BmlEndpoint:
                 and self._is_same_host(peer)):
             from ompi_tpu.runtime import ft
             pushed = False
+            # a reader thread must never park behind a full peer ring
+            # (up to the full 60 s producer window): try-push once and
+            # let tcp carry the frame instead — the sequence number
+            # keeps ordering regardless of which plane delivers
+            timeout = 0.0 if getattr(self.tcp._reader_tls, "active",
+                                     False) else 60.0
             try:
                 pushed = not ft.is_failed(peer) and \
-                    self.sm.try_send(peer, header, payload)
+                    self.sm.try_send(peer, header, payload,
+                                     timeout=timeout)
             except Exception:            # noqa: BLE001 — ring closed
                 pushed = False           # mid-shutdown: tcp carries it
             if pushed:

@@ -129,18 +129,25 @@ class Ring:
 
     def push(self, record: bytes, timeout: float = 60.0) -> bool:
         """Producer: append one length-prefixed record, waiting for the
-        consumer to drain space if needed. False on timeout."""
+        consumer to drain space if needed. False on timeout.
+        ``timeout=0`` is a strict try-push: one space check, no wait —
+        the form reader-originated sends use (inbound progress must
+        never park behind a full peer ring)."""
         need = _REC.size + len(record)
         if need > self.capacity:
             return False
-        deadline = time.monotonic() + timeout
-        spins = 0
-        while self.capacity - (self._tail() - self._head()) < need:
-            spins += 1
-            if spins > 200:
-                if time.monotonic() > deadline:
-                    return False
-                time.sleep(0.00005)
+        if timeout <= 0:
+            if self.capacity - (self._tail() - self._head()) < need:
+                return False
+        else:
+            deadline = time.monotonic() + timeout
+            spins = 0
+            while self.capacity - (self._tail() - self._head()) < need:
+                spins += 1
+                if spins > 200:
+                    if time.monotonic() > deadline:
+                        return False
+                    time.sleep(0.00005)
         tail = self._tail()
         self._write(tail, _REC.pack(len(record)))
         self._write(tail + _REC.size, record)
@@ -284,9 +291,13 @@ class SmEndpoint:
         with self._out_lock:
             return self._out.setdefault(peer, ring)
 
-    def try_send(self, peer: int, header: dict, payload: bytes) -> bool:
+    def try_send(self, peer: int, header: dict, payload: bytes,
+                 timeout: float = 60.0) -> bool:
         """Send one frame if it fits the ring (the eager path); False
-        tells the caller (bml) to route via another btl."""
+        tells the caller (bml) to route via another btl. Reader-thread
+        callers pass ``timeout=0``: a full peer ring must divert the
+        frame to tcp immediately, not stall inbound progress for up to
+        the full producer window."""
         hraw = pickle.dumps(header)
         rec = _LEN.pack(MAGIC, len(hraw), len(payload)) + hraw + payload
         ring = self._attach(peer)
@@ -294,8 +305,15 @@ class SmEndpoint:
             return False
         with self._out_lock:
             lock = self._push_locks.setdefault(peer, threading.Lock())
+        if timeout <= 0:
+            if not lock.acquire(blocking=False):
+                return False             # a busy producer IS a wait
+            try:
+                return ring.push(rec, timeout=0)
+            finally:
+                lock.release()
         with lock:
-            return ring.push(rec)
+            return ring.push(rec, timeout=timeout)
 
     def close(self) -> None:
         self._closed = True

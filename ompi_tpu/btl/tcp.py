@@ -33,6 +33,11 @@ import numpy as np
 MAGIC = 0x7f4d5049          # "\x7fMPI"
 _LEN = struct.Struct("!IQQ")  # magic, header_len, payload_len
 
+# ctl-queue backpressure bound in BYTES (see _ctl_submit): far above
+# anything a live link queues, far below address-space trouble
+_CTL_MAX_BYTES = 256 << 20
+_CTL_FRAME_OVERHEAD = 256   # accounting estimate per queued frame
+
 
 def encode_payload(data: Any) -> Tuple[dict, bytes]:
     """(descriptor, raw bytes). Arrays go as raw buffers; anything else
@@ -97,6 +102,15 @@ class TcpEndpoint:
         self._ctl_qs: Dict[int, "queue.Queue"] = {}
         self._ctl_failed: set = set()    # peers whose ctl link died:
         # reported to the failure detector ONCE, further frames dropped
+        # ctl backpressure is BY BYTES, not frame count: a burst of
+        # >1024 tiny acks is normal traffic on the sub-eager fast path
+        # and must never read as a dead peer (the round-5 false-peer-
+        # down); only a pathological flood — queued bytes past a bound
+        # no healthy link accumulates — fails the link
+        self._ctl_q_bytes: Dict[int, int] = {}
+        # ctl-frame batching observability (the flush-window win):
+        # frames in == sendall batches out + pokes deduplicated
+        self.ctl_stats = {"frames": 0, "batches": 0, "poke_dedup": 0}
 
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -233,6 +247,7 @@ class TcpEndpoint:
             if peer in self._ctl_failed:
                 return
             self._ctl_failed.add(peer)
+            self._ctl_q_bytes[peer] = 0
             q = self._ctl_qs.get(peer)
         if q is not None:
             while True:
@@ -251,7 +266,41 @@ class TcpEndpoint:
             item = q.get()
             if item is None or self._closed:
                 return
-            header, payload = item
+            # adaptive flush window: everything already queued behind
+            # this frame coalesces into ONE sendall (pokes, acks, and
+            # small payload frames to the same peer batch naturally
+            # under load); an isolated frame sees an empty queue and
+            # goes out immediately — the bypass that keeps single-call
+            # latency. Duplicate _smpoke doorbells inside one window
+            # collapse to one: every poke in the window is pre-send,
+            # so the ring records each announced are all published
+            # before the surviving poke's drain runs at the peer.
+            batch = [item]
+            retire = False
+            while True:
+                try:
+                    nxt = q.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    retire = True            # close(): flush, then exit
+                    break
+                batch.append(nxt)
+            cost = sum(len(p) + _CTL_FRAME_OVERHEAD for _, p in batch)
+            if len(batch) > 1:
+                seen_poke = False
+                deduped = []
+                for header, payload in batch:
+                    if header.get("ctl") == "_smpoke":
+                        if seen_poke:
+                            self.ctl_stats["poke_dedup"] += 1
+                            continue
+                        seen_poke = True
+                    deduped.append((header, payload))
+                batch = deduped
+            with self._lock:
+                self._ctl_q_bytes[peer] = max(
+                    0, self._ctl_q_bytes.get(peer, 0) - cost)
             # frames carry the bml's per-sender sequence number drawn
             # at enqueue: silently dropping one would park EVERY
             # later frame from this rank in the receiver's reorder
@@ -262,7 +311,7 @@ class TcpEndpoint:
             sent = False
             for attempt in range(3):
                 try:
-                    self._send_frame_blocking(peer, header, payload)
+                    self._send_batch_blocking(peer, batch)
                     sent = True
                     break
                 except Exception:            # noqa: BLE001
@@ -273,29 +322,46 @@ class TcpEndpoint:
             if not sent:
                 self._ctl_peer_down(peer)
                 return
+            self.ctl_stats["frames"] += len(batch)
+            self.ctl_stats["batches"] += 1
+            if retire:
+                return
 
     def _ctl_submit(self, peer: int, header: dict,
                     payload: bytes) -> None:
         with self._lock:
             if self._closed or peer in self._ctl_failed:
                 return                       # undeliverable: drop
-            q = self._ctl_qs.get(peer)
-            if q is None:
-                q = self._ctl_qs[peer] = queue.Queue(maxsize=1024)
-                threading.Thread(
-                    target=self._ctl_send_loop, args=(q, peer),
-                    daemon=True,
-                    name=f"btl-tcp-ctl-{self.rank}-{peer}").start()
-        try:
-            # NEVER block the reader — not even on a full ctl queue
-            # (a blocking put here would reintroduce the exact
-            # reader-block deadlock this path exists to prevent). A
-            # full queue means the peer's ctl sender is wedged behind
-            # an unbounded sendall: that link is dead for practical
-            # purposes — fail it explicitly instead of wedging.
-            q.put_nowait((header, payload))
-        except queue.Full:
+            # backpressure by BYTES with a large bound: a frame-count
+            # cap read normal ack bursts as a dead peer (the round-5
+            # false-peer-down at 1024 frames). The queue itself is
+            # unbounded; only queued bytes no live link accumulates
+            # (the ctl sender wedged behind an unbounded sendall for
+            # the whole window) fail it.
+            pending = self._ctl_q_bytes.get(peer, 0) \
+                + len(payload) + _CTL_FRAME_OVERHEAD
+            if pending > _CTL_MAX_BYTES:
+                over = True
+            else:
+                over = False
+                self._ctl_q_bytes[peer] = pending
+                q = self._ctl_qs.get(peer)
+                if q is None:
+                    q = self._ctl_qs[peer] = queue.Queue()
+                    threading.Thread(
+                        target=self._ctl_send_loop, args=(q, peer),
+                        daemon=True,
+                        name=f"btl-tcp-ctl-{self.rank}-{peer}").start()
+        if over:
             self._ctl_peer_down(peer)
+            return
+        try:
+            # NEVER block the reader — not even on a wedged queue (a
+            # blocking put here would reintroduce the exact
+            # reader-block deadlock this path exists to prevent).
+            q.put_nowait((header, payload))
+        except queue.Full:                   # foreign bounded queue
+            self._ctl_peer_down(peer)        # (tests): same contract
 
     def send_frame(self, peer: int, header: dict,
                    payload: bytes = b"") -> None:
@@ -316,6 +382,27 @@ class TcpEndpoint:
         s = self._connect(peer)
         hraw = pickle.dumps(header)
         msg = _LEN.pack(MAGIC, len(hraw), len(payload)) + hraw + payload
+        with self._peer_locks[peer]:
+            s.sendall(msg)
+
+    def _send_batch_blocking(self, peer: int, frames) -> None:
+        """One sendall for a whole flush window. Encoding happens
+        outside the peer lock; the single syscall keeps the frames
+        contiguous on the wire, so receive-side framing (and the
+        bml's sequence ordering) is untouched."""
+        if len(frames) == 1:
+            header, payload = frames[0]
+            self._send_frame_blocking(peer, header, payload)
+            return
+        s = self._connect(peer)
+        parts = []
+        for header, payload in frames:
+            hraw = pickle.dumps(header)
+            parts.append(_LEN.pack(MAGIC, len(hraw), len(payload)))
+            parts.append(hraw)
+            if payload:
+                parts.append(payload)
+        msg = b"".join(parts)
         with self._peer_locks[peer]:
             s.sendall(msg)
 

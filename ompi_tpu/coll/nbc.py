@@ -126,6 +126,13 @@ class NbcModule:
         self._active: List[ScheduleRequest] = []
         self._cb_registered = False
         self._jit: Dict[Tuple, Callable] = {}
+        # schedule dispatch cache (the small-message control-plane
+        # overhaul): round lists and finalizers are pure functions of
+        # (collective, nranks, shape, dtype, op/root) — rebuilding the
+        # closure lists per call put O(n) Python allocation on every
+        # sub-eager i-collective dispatch. ScheduleRequest copies the
+        # list into its own deque, so cached lists are never mutated.
+        self._sched: Dict[Tuple, tuple] = {}
 
     # -- component progress callback (coll_libnbc_component.c:555) -----
     def _ensure_progress_cb(self) -> None:
@@ -185,6 +192,12 @@ class NbcModule:
         fused = self._fused("allreduce", x)
         if fused is not None:
             return ScheduleRequest(self, x, [lambda b: fused(b, op)])
+        skey = ("iar", n, x.shape, str(x.dtype), op.uid)
+        hit = self._sched.get(skey)
+        if hit is not None:
+            rounds, finalize = hit
+            chunks, _, _ = self._chunked(x)
+            return ScheduleRequest(self, chunks, rounds, finalize)
         chunks, length, shape = self._chunked(x)
         fn = op.fn
 
@@ -216,6 +229,7 @@ class NbcModule:
         def finalize(acc):
             return acc.reshape(n, -1)[:, :length].reshape(shape)
 
+        self._sched[skey] = (rounds, finalize)
         return ScheduleRequest(self, chunks, rounds, finalize)
 
     def ibcast(self, x, root: int = 0) -> ScheduleRequest:
@@ -228,6 +242,10 @@ class NbcModule:
         fused = self._fused("bcast", x)
         if fused is not None:
             return ScheduleRequest(self, x, [lambda b: fused(b, root)])
+        skey = ("ibc", n, x.shape, str(x.dtype), root)
+        hit = self._sched.get(skey)
+        if hit is not None:
+            return ScheduleRequest(self, x, hit[0])
         rows = np.arange(n)
         vr = (rows - root) % n
         nrounds = max(1, math.ceil(math.log2(n)))
@@ -244,8 +262,9 @@ class NbcModule:
             return step
         step = self._compiled(("bcast", n, x.shape, str(x.dtype), root),
                               build)
-        return ScheduleRequest(
-            self, x, [lambda b, k=k: step(b, k) for k in range(nrounds)])
+        rounds = [lambda b, k=k: step(b, k) for k in range(nrounds)]
+        self._sched[skey] = (rounds,)
+        return ScheduleRequest(self, x, rounds)
 
     def iallgather(self, x) -> ScheduleRequest:
         """Ring allgather: N-1 rounds; round s moves the chunk each
@@ -270,8 +289,12 @@ class NbcModule:
             return step
         step = self._compiled(("iag", n, out0.shape, str(out0.dtype)),
                               build)
-        return ScheduleRequest(
-            self, out0, [lambda o, s=s: step(o, s) for s in range(n - 1)])
+        skey = ("iag2", n, out0.shape, str(out0.dtype))
+        rounds = self._sched.get(skey)
+        if rounds is None:
+            rounds = [lambda o, s=s: step(o, s) for s in range(n - 1)]
+            self._sched[skey] = rounds
+        return ScheduleRequest(self, out0, rounds)
 
     def ibarrier(self) -> ScheduleRequest:
         """Dissemination barrier: ceil(log2 N) host rounds (no data

@@ -81,7 +81,10 @@ class _LruCache(OrderedDict):
         self.move_to_end(key)
         cap = max(1, int(var.var_get("coll_xla_cache_max_entries", 256)))
         while len(self) > cap:
-            self.popitem(last=False)
+            # evict via __delitem__, NOT popitem: popitem re-enters
+            # the overridden __getitem__ mid-unlink on current
+            # CPythons and its move_to_end raises KeyError
+            del self[next(iter(self))]
 
 
 class XlaCollModule:

@@ -97,6 +97,12 @@ class Communicator:
         self.mesh = Mesh(np.array(self.devices, dtype=object), (AXIS,))
         self.sharding = NamedSharding(self.mesh, P(AXIS))
         self.c_coll: Dict[str, Any] = {}
+        # sub-eager dispatch cache: per-(shape, dtype, op) resolution
+        # of the hottest allreduce call shape straight to the selected
+        # module's entry point — validation and wire-form decisions are
+        # pure functions of the key and run once (the small-message
+        # control-plane overhaul's single-controller leg)
+        self._subeager: Dict[tuple, Any] = {}
         self._select_coll()
 
     def _alloc_cid(self) -> int:
@@ -275,6 +281,28 @@ class Communicator:
         in_place = sendbuf is IN_PLACE
         if in_place:
             sendbuf = recvbuf       # MPI_IN_PLACE (allreduce.c.in:54,78-79)
+        # sub-eager fast path: contiguous device buffer, no recvbuf —
+        # shape/dtype/op were validated when the key was filled
+        # (validity is a pure function of the key), so a repeat call
+        # is one dict probe plus the selected module's own memo. The
+        # freed-op and ft checks stay per-call; the module re-checks
+        # the var epoch itself.
+        if (datatype is None and recvbuf is None
+                and getattr(op, "fn", None) is not None
+                and check_addr(sendbuf) == LOCUS_DEVICE):
+            key = (sendbuf.shape, sendbuf.dtype.name, op.uid)
+            fn = self._subeager.get(key)
+            if fn is None:
+                self._validate_stacked(sendbuf)
+                self._validate_op(op)
+                fn = self._subeager[key] = getattr(
+                    self._coll("allreduce"), "allreduce")
+                return fn(sendbuf, op)
+            self._check()
+            self._check_ft_coll()
+            spc.record("coll_allreduce", 1)
+            hooks.fire("coll_allreduce", self, {})
+            return fn(sendbuf, op)
         self._validate_stacked(sendbuf)
         self._validate_op(op)
         # Fused derived-datatype fast path (VERDICT r4 weak #6): one

@@ -180,6 +180,7 @@ class RankCommunicator:
         self._seq = itertools.count(1)          # collective sequence
         self._create_seq = itertools.count(1)   # comm-creation sequence
         self._dev_fns: Dict[Any, Callable] = {}
+        self._small_fold: Dict[Any, Callable] = {}  # op.uid -> combiner
         self._mesh_cache = None
         self._lock = threading.Lock()
         self._cq: Optional["queue.Queue"] = None   # serial collective
@@ -474,23 +475,47 @@ class RankCommunicator:
         the reduce-then-bcast chain's log(n) serialized round trips —
         the path that held 8 B latency at ~2.2 ms for two rounds.
         Rank-ordered folding keeps non-commutative ops and float
-        reproducibility exact (same canonical order on every rank)."""
+        reproducibility exact (same canonical order on every rank).
+
+        Sub-eager dispatch cache (round 6): the fold combiner resolves
+        ONCE per op to the dtype-preserving numpy kernel — the generic
+        ``_apply`` boxed scalar contributions through the jnp combiner
+        on the reader thread, a per-fold JAX dispatch that made the
+        scalar 8 B row 8x the ndarray row on the round-5 record — and
+        the outbound side multicasts one marshalled frame through the
+        engine's cached header templates (``send_small``)."""
         n, r, t = self.size, self._rank, self._tag()
         eng = self._coll_pml
-
-        def fold(vals):
-            acc = vals[0]
-            for v in vals[1:]:
-                acc = _apply(op, acc, v)
-            return acc
+        fold = self._small_fold.get(op.uid)
+        if fold is None:
+            npfn = (op_mod.NP_COMBINERS.get(op.name)
+                    if op.predefined and not op.is_loc else None)
+            if npfn is not None:
+                def fold(vals, _fn=npfn):
+                    acc = vals[0]
+                    for v in vals[1:]:
+                        acc = _fn(acc, v)
+                    return acc
+            else:
+                def fold(vals):
+                    acc = vals[0]
+                    for v in vals[1:]:
+                        acc = _apply(op, acc, v)
+                    return acc
+            self._small_fold[op.uid] = fold
 
         slot = eng.post_combine(t, n, n - 1, fold, own=(r, data))
         try:
-            for off in range(1, n):
-                self._csend((r + off) % n, t, data)
-            return slot.wait()
+            eng.send_small(data, [(r + off) % n for off in range(1, n)],
+                           t)
+            out = slot.wait()
         finally:
             eng.end_combine(t)
+        if not isinstance(data, np.ndarray) and (
+                isinstance(out, np.generic)
+                or (isinstance(out, np.ndarray) and out.ndim == 0)):
+            out = out.item()             # scalar in, python scalar out
+        return out
 
     def _small_allreduce_ok(self, data: Any, op: op_mod.Op) -> bool:
         from ompi_tpu.coll.tuned import small_allreduce_limits
@@ -687,10 +712,19 @@ class RankCommunicator:
             if item is None:
                 q.task_done()
                 return
-            runner = item
-            runner()
-            q.task_done()                # unfinished_tasks is the
-            # _coll_serial busy signal: queued + in-flight jobs
+            try:
+                item()
+            except BaseException:        # noqa: BLE001
+                # runners report their own errors through their
+                # completion boxes; anything escaping here (a broken
+                # propagator, an OOM in the plumbing) must not kill
+                # the worker — that would wedge every later collective
+                # on this comm behind a queue nobody drains
+                import traceback
+                traceback.print_exc()
+            finally:
+                q.task_done()            # unfinished_tasks is the
+                # _coll_serial busy signal: queued + in-flight jobs
 
     def _coll_submit(self, runner: Callable) -> None:
         with self._lock:
@@ -739,15 +773,24 @@ class RankCommunicator:
                 def runner():
                     _itls.sync_depth = sd
                     _itls.mon_depth = md
-                    for apply, _reset in props:
-                        apply()
+                    applied = []
+                    # apply() runs INSIDE the try: a raising propagator
+                    # must surface at the caller's wait like any body
+                    # error — not escape the runner, leave ev unset,
+                    # and hang the funneling caller forever
                     try:
+                        for apply, reset in props:
+                            apply()
+                            applied.append(reset)
                         box["res"] = fn(*a, **kw)
                     except BaseException as e:  # noqa: BLE001
                         box["err"] = e
                     finally:
-                        for _apply, reset in props:
-                            reset()
+                        for reset in applied:
+                            try:
+                                reset()
+                            except BaseException:  # noqa: BLE001
+                                pass
                         _itls.sync_depth = 1    # the worker default:
                         _itls.mon_depth = 1     # i-jobs are exempt
                         ev.set()
@@ -881,7 +924,7 @@ class RankCommunicator:
                     return jax.lax.pmin(s, AXIS)
                 g = jax.lax.all_gather(s, AXIS, axis=0, tiled=True)
                 return op.reduce_tree(g, axis=0)[None]
-            return jax.jit(jax.shard_map(
+            return jax.jit(_shard_map()(
                 inner, mesh=mesh, in_specs=P(AXIS), out_specs=P(AXIS)))
         fn = self._dev_fn(("ar", op.uid), build)
         return self._local(fn(self._global(x)))
@@ -895,7 +938,7 @@ class RankCommunicator:
             def inner(s):
                 g = jax.lax.all_gather(s, AXIS, axis=0, tiled=True)
                 return jax.lax.dynamic_slice_in_dim(g, root, 1, 0)
-            return jax.jit(jax.shard_map(
+            return jax.jit(_shard_map()(
                 inner, mesh=mesh, in_specs=P(AXIS), out_specs=P(AXIS)))
         fn = self._dev_fn(("bc", root), build)
         return self._local(fn(self._global(x)))
@@ -908,7 +951,7 @@ class RankCommunicator:
         def build():
             def inner(s):
                 return jax.lax.all_gather(s, AXIS, axis=0, tiled=True)[None]
-            return jax.jit(jax.shard_map(
+            return jax.jit(_shard_map()(
                 inner, mesh=mesh, in_specs=P(AXIS), out_specs=P(AXIS)))
         fn = self._dev_fn(("ag",), build)
         g = self._local(fn(self._global(x)))           # (n, *local)
@@ -927,7 +970,7 @@ class RankCommunicator:
                 return jnp.moveaxis(
                     jax.lax.all_to_all(s, AXIS, split_axis=1,
                                        concat_axis=0), 0, 1)
-            return jax.jit(jax.shard_map(
+            return jax.jit(_shard_map()(
                 inner, mesh=mesh, in_specs=P(AXIS), out_specs=P(AXIS)))
         fn = self._dev_fn(("a2a",), build)
         x = jnp.stack(list(chunks))                    # (n, *c)
@@ -1295,6 +1338,15 @@ def _apply(op: op_mod.Op, a: Any, b: Any) -> Any:
             if npfn is not None:
                 return np.asarray(npfn(an, bn))
         return np.asarray(op.fn(a, b))
+    if (op.predefined and not op.is_loc
+            and isinstance(a, np.generic) and isinstance(b, np.generic)):
+        # scalar fast path: the numpy kernel both preserves 64-bit
+        # dtypes (the jnp combiner below silently downcasts without
+        # x64) and skips a per-call JAX dispatch — this fold runs on
+        # btl reader threads inside the sub-eager collective path
+        npfn = op_mod.NP_COMBINERS.get(op.name)
+        if npfn is not None:
+            return npfn(a, b).item()
     try:
         import jax
         if isinstance(a, jax.Array):
@@ -1309,3 +1361,15 @@ def _apply(op: op_mod.Op, a: Any, b: Any) -> Any:
 def _dev_array_type():
     import jax
     return jax.Array
+
+
+def _shard_map():
+    """The shard_map entry point across jax versions (jax >= 0.4.35
+    exposes it at top level; older releases keep it experimental) —
+    the same shim coll/xla.py carries."""
+    import jax
+    try:
+        return jax.shard_map
+    except AttributeError:              # pragma: no cover
+        from jax.experimental.shard_map import shard_map
+        return shard_map

@@ -43,6 +43,22 @@ def pvar_write(name: str, value: Any) -> None:
     wf(value)
 
 
+def pvar_register_dict(prefix: str, stats: Dict[str, Any], *,
+                       help_prefix: str = "") -> None:
+    """Register one pvar per key of a live counter dict (the btl/bml
+    stats-dict idiom): reads always reflect the dict's CURRENT values,
+    so hot paths keep their plain ``dict[k] += 1`` increments and the
+    MPI_T surface still observes them. Re-registration (a new endpoint
+    in the same process) rebinds the names to the newest dict."""
+    def make_reader(d, k):
+        return lambda: d.get(k, 0)
+
+    for key in list(stats):
+        pvar_register(f"{prefix}_{key}", make_reader(stats, key),
+                      help=(f"{help_prefix}{key}" if help_prefix
+                            else f"{prefix} counter {key}"))
+
+
 def pvar_list() -> List[Dict[str, Any]]:
     with _lock:
         items = list(_pvars.items())

@@ -29,9 +29,12 @@ import threading
 from collections import deque
 from typing import Any, Deque, Dict, List, Optional, Tuple
 
+import numpy as np
+
 from ompi_tpu.btl.tcp import decode_payload, encode_payload
 from ompi_tpu.core.errhandler import ERR_PENDING, ERR_RANK, ERR_TAG, MPIError
 from ompi_tpu.core.request import Request, Status
+from ompi_tpu.runtime import progress as _progress
 
 ANY_SOURCE = -1
 ANY_TAG = -1
@@ -65,6 +68,12 @@ class Router:
         self.endpoint = BmlEndpoint(rank, nprocs, kv_set, kv_get,
                                     self._deliver,
                                     on_peer_lost=self._peer_lost)
+        # the ctl flush-window counters ride the MPI_T pvar plumbing
+        # next to the wakeup-coalescing pvars (docs/SMALLMSG.md)
+        from ompi_tpu.mca import pvar
+        pvar.pvar_register_dict(
+            "btl_ctl", self.endpoint.tcp.ctl_stats,
+            help_prefix="ctl flush window: ")
 
     def wire_up(self) -> None:
         """Eagerly connect to every peer (the reference's add_procs
@@ -160,7 +169,7 @@ class Router:
             if ent is not None:
                 if "desc" in header:
                     ent[1] = decode_payload(header["desc"], raw)
-                ent[0].set()
+                _progress.wake(ent[0])   # coalesces under a drain batch
             return
         if "rma" in header:
             with self._lock:
@@ -229,14 +238,14 @@ class RankRequest(Request):
         self.status.count = int(getattr(msg.data, "size", 1) or 1)
         self.status.nbytes = int(getattr(msg.data, "nbytes", -1))
         self._complete = True
-        self._event.set()
+        _progress.wake(self._event)      # coalesced under drain batches
 
     def _fail(self, err: BaseException) -> None:
         """ULFM (req_ft.c): complete the pending request in error —
         the matching send can never arrive from a dead peer."""
         self._error = err
         self._complete = True
-        self._event.set()
+        _progress.wake(self._event)
 
     def test(self):
         if self._complete and self._error is not None:
@@ -314,7 +323,7 @@ class CombineSlot:
                 self.result = self._fold(self._vals)
             except BaseException as e:    # noqa: BLE001
                 self._error = e
-            self._event.set()
+            _progress.wake(self._event)  # one coalesced consumer wake
 
     def put_own(self, rank: int, value: Any) -> None:
         """The caller's own contribution (never counted in _need)."""
@@ -324,7 +333,7 @@ class CombineSlot:
         with self._lock:
             self._need = -1
         self._error = err
-        self._event.set()
+        _progress.wake(self._event)
 
     def wait(self, timeout: float = 600):
         if not self._event.wait(timeout):
@@ -350,6 +359,11 @@ class PerRankEngine:
         self._arrival: Deque[int] = deque()            # src arrival order
         self.posted: List[Tuple[int, int, RankRequest]] = []
         self._combine: Dict[int, CombineSlot] = {}     # tag -> slot
+        # sub-eager dispatch cache: per-(dtype, shape) marshalled
+        # descriptor templates for the small-message multicast path —
+        # the control plane stops re-boxing the same 8 B shape on
+        # every collective call (see send_small)
+        self._small_desc: Dict[Tuple[str, tuple], dict] = {}
         # per-peer traffic accounting (the pml/monitoring role): THIS
         # rank's sends/receives by comm-local peer, consumed by
         # tools/profile's matrix (each rank holds its own rows in a
@@ -502,6 +516,53 @@ class PerRankEngine:
             raise MPIError(ERR_PENDING,
                            "ssend timed out waiting for the receive")
         return Request.completed()
+
+    def send_small(self, data: Any, dests, tag: int) -> None:
+        """Sub-eager multicast fast path (the combined small-message
+        collectives): marshal the payload ONCE, reuse a cached
+        per-(dtype, shape) descriptor, and push one frame per
+        destination with none of the per-call protocol work the
+        general ``send`` must do (devxfer registration, sync-ack
+        plumbing, per-dest re-encoding). ``dests`` are comm-local
+        ranks, validated by the collective's own construction; the
+        caller's rank must not appear in ``dests`` (self-contributions
+        go through ``CombineSlot.put_own``)."""
+        if isinstance(data, np.generic):
+            # numpy scalars ride the raw nd encoding as 0-d arrays —
+            # a pickle round trip costs 4x the marshal of the whole
+            # frame (the residual in the round-6 scalar 8 B row); the
+            # collective's epilogue restores the scalar type
+            data = np.asarray(data)
+        if isinstance(data, np.ndarray):
+            arr = data if data.flags.c_contiguous \
+                else np.ascontiguousarray(data)
+            key = (arr.dtype.str, arr.shape)
+            desc = self._small_desc.get(key)
+            if desc is None:
+                desc = self._small_desc[key] = {
+                    "kind": "nd", "dtype": arr.dtype.str,
+                    "shape": arr.shape}
+            raw = arr.tobytes()
+        else:
+            desc, raw = encode_payload(data)
+        me = self.comm.rank()
+        header = {"cid": self.comm.cid, "src": me, "tag": tag,
+                  "desc": desc}
+        nraw = len(raw)
+        endpoint = self.router.endpoint
+        world_of = self.comm.world_rank_of
+        from ompi_tpu.runtime import ft
+        from ompi_tpu.core.errhandler import ERR_PROC_FAILED
+        for dest in dests:
+            if ft.is_failed(world_of(dest)):
+                raise MPIError(ERR_PROC_FAILED,
+                               f"send peer rank {dest} has failed")
+            t = self.traffic.setdefault((me, dest), [0, 0])
+            t[0] += 1
+            t[1] += nraw
+            # the bml copies the header before stamping its sequence
+            # number, so one template serves every destination
+            endpoint.send_frame(world_of(dest), header, raw)
 
     # -- receive side --------------------------------------------------
     def _cancel_posted(self, req: RankRequest) -> None:

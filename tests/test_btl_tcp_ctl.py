@@ -113,3 +113,80 @@ def test_close_never_blocks_on_full_ctl_queue():
     t0 = time.monotonic()
     a.close()
     assert time.monotonic() - t0 < 1.0, "close blocked on full queue"
+
+
+def test_ctl_flood_no_false_peer_down():
+    """Satellite regression (round 6): >1024 reader-originated frames
+    to a LIVE peer must all arrive, in order, with no peer-down — the
+    old 1024-frame queue bound read a normal ack burst as a dead link
+    and discarded its queue (parking every later sequenced frame in
+    the receiver's reorder buffer). Backpressure is by bytes now."""
+    kv = {}
+    lost = []
+    got = []
+    done = threading.Event()
+    N = 2000
+
+    def sink_b(header, payload):
+        got.append(header["i"])
+        if len(got) == N:
+            done.set()
+
+    a = _pair(kv, 0, lambda h, p: None, on_peer_lost=lost.append)
+    b = _pair(kv, 1, sink_b)
+    try:
+        a._reader_tls.active = True      # reader-originated: ctl path
+        for i in range(N):
+            a.send_frame(1, {"i": i})
+        assert done.wait(30), f"only {len(got)}/{N} frames arrived"
+        assert lost == [], "flood of live-peer ctl frames reported " \
+                           "a false peer-down"
+        assert got == list(range(N)), "ctl batching broke ordering"
+        # the flush window actually coalesced: frames went out in
+        # fewer sendalls than frames (the whole point of the window)
+        st = a.ctl_stats
+        assert st["frames"] == N, st
+        assert 0 < st["batches"] < N, st
+    finally:
+        a._reader_tls.active = False
+        a.close()
+        b.close()
+
+
+def test_ctl_batch_flush_window_dedupes_pokes():
+    """Frames queued behind one in-flight send flush as ONE sendall,
+    and duplicate _smpoke doorbells inside the window collapse to one
+    (every poke in the window is pre-send, so ring records announced
+    by any of them are published before the survivor's drain)."""
+    kv = {}
+    got = []
+    done = threading.Event()
+
+    def sink_b(header, payload):
+        got.append(header)
+        if len(got) == 3:
+            done.set()
+
+    a = _pair(kv, 0, lambda h, p: None)
+    b = _pair(kv, 1, sink_b)
+    try:
+        q = queue.Queue()
+        q.put(({"ctl": "_smpoke", "peer": 0}, b""))
+        q.put(({"ctl": "_smpoke", "peer": 0}, b""))
+        q.put(({"k": 1}, b""))
+        q.put(({"ctl": "_smpoke", "peer": 0}, b""))
+        q.put(({"k": 2}, b""))
+        t = threading.Thread(target=a._ctl_send_loop, args=(q, 1),
+                             daemon=True)
+        t.start()
+        assert done.wait(10), f"got {len(got)} frames"
+        time.sleep(0.2)                  # no extra frames trail in
+        assert [h.get("ctl") or h.get("k") for h in got] == \
+            ["_smpoke", 1, 2], got
+        assert a.ctl_stats["poke_dedup"] == 2, a.ctl_stats
+        assert a.ctl_stats["batches"] == 1, a.ctl_stats
+        q.put(None)                      # retire the sender
+        t.join(5)
+    finally:
+        a.close()
+        b.close()

@@ -1,0 +1,192 @@
+"""The sub-eager dispatch cache and funneled-worker hardening (round
+6 control-plane overhaul): scalar folds stay on dtype-preserving numpy
+kernels, the small-message multicast reuses marshalled headers, a full
+peer ring never stalls a reader-originated push, and a raising TLS
+propagator cannot wedge a comm's collective worker."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ompi_tpu.core import op as op_mod
+from ompi_tpu.core.rankcomm import _apply
+
+
+def test_apply_scalar_fast_path_preserves_float64():
+    """np.generic scalars with predefined ops take the numpy kernel:
+    no per-fold JAX dispatch (the 8x row on the round-5 record) and no
+    silent 64->32-bit downcast when jax runs without x64."""
+    a = np.float64(1.0 + 2**-40)         # lost in float32
+    b = np.float64(2.0 + 2**-40)
+    out = _apply(op_mod.SUM, a, b)
+    assert isinstance(out, float)
+    assert out == float(a) + float(b)    # exact in float64
+    assert out != float(np.float32(a) + np.float32(b))
+
+
+def test_apply_scalar_fast_path_other_ops():
+    assert _apply(op_mod.MAX, np.float64(1.5), np.float64(2.5)) == 2.5
+    assert _apply(op_mod.PROD, np.int64(3), np.int64(4)) == 12
+    assert _apply(op_mod.BXOR, np.int32(0b101), np.int32(0b011)) == 0b110
+
+
+def test_apply_ndarray_unchanged():
+    out = _apply(op_mod.SUM, np.full(4, 1.5), np.full(4, 2.0))
+    assert isinstance(out, np.ndarray)
+    np.testing.assert_allclose(out, 3.5)
+
+
+def _loopback_engine(cid, size=2):
+    from ompi_tpu.pml.perrank import PerRankEngine, Router
+
+    kv = {}
+    router = Router(0, 1, kv.__setitem__, kv.__getitem__)
+
+    class _C:
+        def __init__(self):
+            self.cid = cid
+            self.size = size
+
+        def rank(self):
+            return 0
+
+        def world_rank_of(self, r):
+            return 0                     # loopback: every dest is me
+    return PerRankEngine(_C(), router), router
+
+
+def test_send_small_multicast_and_descriptor_cache():
+    """send_small marshals once, reuses the cached per-(dtype, shape)
+    descriptor template, and the frames still match ordinary
+    receives."""
+    eng, router = _loopback_engine("smallsend")
+    try:
+        payload = np.full(2, 1.5, np.float32)
+        eng.send_small(payload, [1], tag=3)
+        eng.send_small(payload, [1], tag=4)
+        assert len(eng._small_desc) == 1, eng._small_desc
+        d1, _ = eng.recv(source=0, tag=3, timeout=10)
+        d2, _ = eng.recv(source=0, tag=4, timeout=10)
+        np.testing.assert_array_equal(d1, payload)
+        np.testing.assert_array_equal(d2, payload)
+        # numpy scalars ride the raw nd encoding as 0-d arrays (no
+        # pickle round trip) through their own cached template
+        eng.send_small(np.float64(2.5), [1], tag=5)
+        d3, _ = eng.recv(source=0, tag=5, timeout=10)
+        assert d3 == np.float64(2.5) and d3.dtype == np.float64
+        assert len(eng._small_desc) == 2
+        # a second array shape earns its own template
+        eng.send_small(np.zeros(4, np.float32), [1], tag=6)
+        eng.recv(source=0, tag=6, timeout=10)
+        assert len(eng._small_desc) == 3
+    finally:
+        router.close()
+
+
+def test_ring_zero_timeout_push_returns_immediately():
+    """Satellite (round 6): a reader-originated sm push on a full peer
+    ring must fail fast (the frame falls back to tcp), not park
+    inbound progress for up to the 60 s producer window."""
+    from ompi_tpu.btl.sm import Ring
+
+    ring = Ring(None, capacity=1 << 12, create=True)
+    try:
+        while ring.push(b"x" * 512, timeout=0):
+            pass                         # fill it
+        t0 = time.monotonic()
+        ok = ring.push(b"x" * 512, timeout=0)
+        assert not ok
+        assert time.monotonic() - t0 < 0.5, "zero-timeout push waited"
+    finally:
+        ring.close()
+
+
+def _world_comm():
+    """A size-1 per-rank communicator over a loopback router — enough
+    to drive the funneled collective worker for real."""
+    from ompi_tpu.core.group import Group
+    from ompi_tpu.core.rankcomm import RankCommunicator
+    from ompi_tpu.pml.perrank import Router
+
+    kv = {}
+    router = Router(0, 1, kv.__setitem__, kv.__getitem__)
+    return RankCommunicator(Group([0]), 0, router, cid="fp-test"), router
+
+
+def test_raising_propagator_cannot_wedge_the_worker():
+    """Satellite (round 6): a TLS propagator whose apply() raises must
+    surface at the funneling caller's wait — not escape the runner,
+    kill the worker, and wedge every later collective on the comm."""
+    from ompi_tpu.core import rankcomm as rc
+
+    comm, router = _world_comm()
+    boom = RuntimeError("propagator exploded")
+
+    def capture():
+        def apply():
+            raise boom
+
+        def reset():
+            pass
+        return (apply, reset)
+
+    rc.register_tls_propagator(capture)
+    try:
+        # make the worker busy so the next blocking call FUNNELS
+        comm._coll_submit(lambda: time.sleep(0.4))
+        with pytest.raises(RuntimeError, match="propagator exploded"):
+            comm.barrier()
+    finally:
+        rc._TLS_PROPAGATORS.remove(capture)
+    # the worker survived: later collectives still run (both funneled
+    # while it drains and inline once idle)
+    comm._coll_submit(lambda: time.sleep(0.2))
+    comm.barrier()
+    comm.barrier()
+    comm.free()
+    router.close()
+
+
+def test_raising_runner_does_not_stall_task_done():
+    """A directly-submitted job that raises must not leave
+    unfinished_tasks pinned (the busy signal every later blocking
+    collective funnels behind) or kill the worker."""
+    comm, router = _world_comm()
+    comm._coll_submit(lambda: (_ for _ in ()).throw(ValueError("x")))
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        with comm._lock:
+            q = comm._cq
+        if q is not None and q.unfinished_tasks == 0:
+            break
+        time.sleep(0.01)
+    else:
+        raise AssertionError("raising runner wedged unfinished_tasks")
+    comm.barrier()                       # worker alive and draining
+    comm.free()
+    router.close()
+
+
+def test_staging_probe_confirms_at_crossover():
+    """Satellite (round 6): when the two-point fit proposes a finite
+    crossover, the probe CONFIRMS by measurement at that size and the
+    adopted threshold carries a 1.5x hysteresis band — or staging is
+    rejected outright with the rejection recorded. (The r5 record
+    routed 8 MB to a tier its own A/B measured 1.3x slower because the
+    extrapolated fit was trusted unmeasured.)"""
+    from ompi_tpu.coll.tuned import _NEVER_STAGE, staging_probe
+
+    # a very slow transport inflates the host side's per-byte model,
+    # forcing a finite fitted crossover so the confirm loop runs
+    cross, basis = staging_probe(transport_bps=1e6, nranks=2)
+    assert basis.get("confirm_bytes"), basis
+    assert basis.get("confirm_staged_ms") is not None
+    assert basis.get("confirm_host_ms") is not None
+    if cross < _NEVER_STAGE:
+        # adopted from a measured win, padded by the hysteresis band
+        assert basis.get("hysteresis") == 1.5
+        assert basis["stage_min_bytes"] == cross
+    else:
+        assert basis.get("confirm_rejected_staging") is True
+        assert basis["stage_min_bytes"] == -1

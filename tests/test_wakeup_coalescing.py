@@ -1,0 +1,122 @@
+"""Wakeup coalescing — one reader-thread wake services every completed
+match in a drain batch (``runtime/progress.py`` wake batches, consumed
+by ``btl/bml.py``'s ordered drain and the combining collectives).
+Counters are exported through the MPI_T pvar plumbing."""
+import threading
+
+import numpy as np
+
+from ompi_tpu.btl.tcp import encode_payload
+from ompi_tpu.mca import pvar
+from ompi_tpu.runtime import progress
+
+
+def test_batch_defers_and_dedupes_wakes():
+    """Inside a batch, wakes are deferred; duplicates of one Event
+    collapse; the flush at batch end sets each exactly once."""
+    s0 = progress.wake_stats()
+    e1, e2 = threading.Event(), threading.Event()
+    progress.wake_begin()
+    try:
+        progress.wake(e1)
+        progress.wake(e1)                # duplicate: same Event
+        progress.wake(e2)
+        progress.wake_note_frame(4)
+        assert not e1.is_set() and not e2.is_set(), \
+            "wakes must defer to batch end"
+    finally:
+        progress.wake_end()
+    assert e1.is_set() and e2.is_set()
+    s1 = progress.wake_stats()
+    assert s1["wakeups"] - s0["wakeups"] == 2        # deduped
+    assert s1["completions"] - s0["completions"] == 3
+    assert s1["frames"] - s0["frames"] == 4
+    assert s1["batches"] - s0["batches"] == 1
+
+
+def test_nested_batches_flush_once_at_outermost():
+    e = threading.Event()
+    progress.wake_begin()
+    progress.wake_begin()                # the sm drain inside the bml
+    progress.wake(e)                     # drain nests like this
+    progress.wake_end()
+    assert not e.is_set(), "inner end must not flush"
+    progress.wake_end()
+    assert e.is_set()
+
+
+def test_wake_outside_batch_is_immediate():
+    s0 = progress.wake_stats()
+    e = threading.Event()
+    progress.wake(e)
+    assert e.is_set()
+    s1 = progress.wake_stats()
+    assert s1["wakeups"] - s0["wakeups"] == 1
+
+
+def test_counters_ride_the_pvar_plumbing():
+    for name in ("pml_wakeups", "pml_completions",
+                 "pml_frames_delivered", "pml_frames_per_wakeup"):
+        assert isinstance(pvar.pvar_read(name), (int, float)), name
+    info = pvar.pvar_info("pml_frames_per_wakeup")
+    assert info["unit"] == "ratio"
+
+
+def test_combine_slot_one_wake_many_frames():
+    """The sub-eager collective schedule the counters prove: n-1
+    contributions delivered inside one drain batch complete the
+    combining slot with exactly ONE flushed wakeup."""
+    from ompi_tpu.pml.perrank import PerRankEngine, Router
+
+    kv = {}
+    router = Router(0, 1, kv.__setitem__, kv.__getitem__)
+
+    class _C:
+        cid = "wake-test"
+        size = 4
+
+        def rank(self):
+            return 0
+
+        def world_rank_of(self, r):
+            return 0
+    eng = PerRankEngine(_C(), router)
+    try:
+        slot = eng.post_combine(
+            9, 4, 3, lambda vals: sum(float(v[0]) for v in vals),
+            own=(0, np.array([1.0])))
+        s0 = progress.wake_stats()
+        progress.wake_begin()            # the bml drain's batch
+        try:
+            for src in (1, 2, 3):
+                desc, raw = encode_payload(np.array([float(src)]))
+                eng._incoming({"cid": "wake-test", "src": src,
+                               "tag": 9, "desc": desc}, raw)
+                progress.wake_note_frame()
+        finally:
+            progress.wake_end()
+        assert slot.wait(5) == 1.0 + 1.0 + 2.0 + 3.0
+        s1 = progress.wake_stats()
+        assert s1["wakeups"] - s0["wakeups"] == 1, \
+            "3 frames completing one slot must flush ONE wake"
+        assert s1["frames"] - s0["frames"] == 3
+        eng.end_combine(9)
+    finally:
+        router.close()
+
+
+def test_ctl_stats_ride_the_pvar_plumbing():
+    """Router construction binds the tcp ctl flush-window counters to
+    pvars through pvar_register_dict."""
+    from ompi_tpu.pml.perrank import Router
+
+    kv = {}
+    router = Router(0, 1, kv.__setitem__, kv.__getitem__)
+    try:
+        for name in ("btl_ctl_frames", "btl_ctl_batches",
+                     "btl_ctl_poke_dedup"):
+            assert pvar.pvar_read(name) == 0, name
+        router.endpoint.tcp.ctl_stats["frames"] += 7
+        assert pvar.pvar_read("btl_ctl_frames") == 7
+    finally:
+        router.close()
