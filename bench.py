@@ -857,6 +857,19 @@ def _ab_matrix_child() -> None:
     MPI.Finalize()
 
 
+def _trace_summary() -> dict:
+    """Trace summary for the committed BENCH record, proven
+    machine-readable: the summary must round-trip through JSON
+    bit-identically (the archive's consumers parse these records —
+    a float NaN or tuple key here would silently rot the record)."""
+    from ompi_tpu import trace
+    from ompi_tpu.trace import attribution
+    summary = attribution.summarize(trace.spans(), trace.stats())
+    rt = json.loads(json.dumps(summary))
+    assert rt == summary, "trace summary does not round-trip JSON"
+    return summary
+
+
 def main() -> None:
     import argparse
     ap = argparse.ArgumentParser()
@@ -872,6 +885,10 @@ def main() -> None:
     ap.add_argument("--ab-child", action="store_true")
     ap.add_argument("--perrank-child", action="store_true")
     ap.add_argument("--tpu-child", action="store_true")
+    ap.add_argument("--trace", action="store_true",
+                    help="record collective/pt2pt spans "
+                         "(ompi_tpu.trace) and attach the trace "
+                         "summary to the committed BENCH record")
     args = ap.parse_args()
 
     if args.perrank_child:
@@ -908,6 +925,12 @@ def main() -> None:
         jax.config.update("jax_platforms", "cpu")
     import ompi_tpu as MPI
     from ompi_tpu.accelerator import to_device, to_host
+
+    if args.trace:
+        # before Init: the coll composer wraps vtables at communicator
+        # construction, so enabling later would miss collective spans
+        from ompi_tpu import trace as _trace_mod
+        _trace_mod.enable()
 
     MPI.Init()
     world = MPI.get_comm_world()
@@ -1094,6 +1117,9 @@ def main() -> None:
                    "algorithm A/B come from the 8-rank CPU-mesh child"
                    if n == 1 else ""),
     }
+
+    if args.trace:
+        result["trace"] = _trace_summary()
 
     # ---- hardware evidence (VERDICT r4 next #2) ---------------------
     # Re-probe the tunnel at bench END — the sections above run for

@@ -65,10 +65,17 @@ def pvar_read(name: str) -> Any:
 class _EventHandle:
     def __init__(self, name: str, cb):
         self.name = name
-        self.dropped = 0
+        self.dropped = 0                 # MPI_T_event dropped-data count
+
         def _shim(event, comm, info):
             if event == name:
-                cb(event, comm, info)
+                try:
+                    cb(event, comm, info)
+                except Exception:
+                    # count against THIS handle, then let fire()'s
+                    # chain-level accounting log + count globally
+                    self.dropped += 1
+                    raise
         self._shim = _hooks.register_profiler(_shim)
 
     def free(self) -> None:

@@ -42,6 +42,7 @@ import time
 from typing import Callable, Dict, Optional
 
 from ompi_tpu.btl.tcp import MAGIC, _LEN
+from ompi_tpu.trace import core as _trace
 
 _HDR = struct.Struct("<QQ")          # head, tail (bytes consumed/produced)
 _REC = struct.Struct("<Q")           # per-record length prefix
@@ -257,13 +258,20 @@ class SmEndpoint:
         rings = ([self._in[src]] if src is not None and src in self._in
                  else list(self._in.values()))
         n = 0
-        with self._drain_lock:
-            for ring in rings:
-                rec = ring.pop()
-                while rec is not None:
-                    n += 1
-                    self._deliver(rec)
+        tok = (_trace.begin("btl_sm_drain", src=src)
+               if _trace.active else None)
+        try:
+            with self._drain_lock:
+                for ring in rings:
                     rec = ring.pop()
+                    while rec is not None:
+                        n += 1
+                        self._deliver(rec)
+                        rec = ring.pop()
+        finally:
+            if tok is not None:
+                if n:                    # empty polls would swamp the
+                    _trace.end(tok, frames=n)    # ring with noise
         return n
 
     def _deliver(self, rec: bytes) -> None:

@@ -30,6 +30,8 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 import numpy as np
 
+from ompi_tpu.trace import core as _trace
+
 MAGIC = 0x7f4d5049          # "\x7fMPI"
 _LEN = struct.Struct("!IQQ")  # magic, header_len, payload_len
 
@@ -308,17 +310,28 @@ class TcpEndpoint:
             # cached socket so the retry actually reconnects); a
             # persistent failure is a dead link — fail the peer once
             # and stop, rather than wedge or thrash.
+            # trace the flush window (span "btl_ctl_flush"): when the
+            # timeline shows a collective blocked, this is where "the
+            # ctl sender was wedged behind a big sendall" becomes
+            # visible; free when tracing is off (one attribute read)
+            tok = (_trace.begin("btl_ctl_flush", peer=peer,
+                                frames=len(batch), bytes=cost)
+                   if _trace.active else None)
             sent = False
-            for attempt in range(3):
-                try:
-                    self._send_batch_blocking(peer, batch)
-                    sent = True
-                    break
-                except Exception:            # noqa: BLE001
-                    if self._closed:
-                        return
-                    self._evict_peer_socket(peer)
-                    time.sleep(0.05 * (attempt + 1))
+            try:
+                for attempt in range(3):
+                    try:
+                        self._send_batch_blocking(peer, batch)
+                        sent = True
+                        break
+                    except Exception:        # noqa: BLE001
+                        if self._closed:
+                            return
+                        self._evict_peer_socket(peer)
+                        time.sleep(0.05 * (attempt + 1))
+            finally:
+                if tok is not None:
+                    _trace.end(tok, sent=sent)
             if not sent:
                 self._ctl_peer_down(peer)
                 return

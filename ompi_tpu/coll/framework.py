@@ -74,4 +74,10 @@ def comm_select_coll(comm) -> Dict[str, Any]:
     from ompi_tpu.coll import monitoring
     if vtable and monitoring.enabled():
         vtable = monitoring.wrap_vtable(comm, vtable)
+    # tracing wraps OUTERMOST (after monitoring): spans measure the
+    # app-visible call, monitoring's counters ride inside them; off by
+    # default, so the composed vtable is byte-identical when disabled
+    from ompi_tpu import trace
+    if vtable and trace.tracing_enabled():
+        vtable = trace.wrap_coll_vtable(comm, vtable)
     return vtable

@@ -65,6 +65,35 @@ def _wrap(comm, funcs, depth_attr: str, on_outermost) -> None:
         setattr(comm, f, make(f, getattr(comm, f)))
 
 
+def _wrap_span(comm, funcs) -> None:
+    """Rebind each collective with a begin/end span shim (the tracing
+    tier). Only the outermost frame of this layer records — rankcomm
+    collectives COMPOSE (allreduce = reduce + bcast), and the span must
+    describe the application operation, not its internal schedule. The
+    live ``trace.core.active`` gate is re-read per call, so disabling
+    tracing mid-run drops the overhead back to one attribute read."""
+    from ompi_tpu.trace import core as _trace
+    cid = comm.cid
+
+    def make(func, inner):
+        event = f"coll_{func}"
+
+        def call(*args, **kw):
+            if not _trace.active or getattr(_tls, "trace_depth", 0):
+                return inner(*args, **kw)
+            tok = _trace.begin(event, cid=cid)
+            _tls.trace_depth = 1
+            try:
+                return inner(*args, **kw)
+            finally:
+                _tls.trace_depth = 0
+                _trace.end(tok)
+        call.__name__ = func
+        return call
+    for f in funcs:
+        setattr(comm, f, make(f, getattr(comm, f)))
+
+
 def _payload_nbytes(args, kw) -> int:
     """Bytes of the call's first buffer-ish argument: arrays directly,
     chunk lists by summation, keyword buffers included."""
@@ -96,8 +125,10 @@ def interpose(comm) -> None:
     if every < 0:
         every = 0                        # stacked semantics: <=0 is off
     mon = bool(var.var_get("coll_monitoring_enable", False))
+    from ompi_tpu import trace as _trace_pkg
+    traced = _trace_pkg.tracing_enabled()
     comm._coll_interposers = []
-    if not every and not mon:
+    if not every and not mon and not traced:
         return
 
     base_barrier = comm.barrier          # unwrapped: sync's injections
@@ -124,3 +155,9 @@ def interpose(comm) -> None:
         # CLASS implementations, so nothing here re-fires
         _wrap(comm, PERRANK_ICOLL_FUNCS, "mon_depth", mon_hook)
         comm._coll_interposers.append("monitoring")
+
+    if traced:
+        # outermost, mirroring the stacked composer: spans measure the
+        # app-visible call, monitoring/sync overhead rides inside
+        _wrap_span(comm, PERRANK_COLL_FUNCS + PERRANK_ICOLL_FUNCS)
+        comm._coll_interposers.append("trace")

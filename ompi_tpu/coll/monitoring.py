@@ -54,7 +54,11 @@ class MonitoringCollModule:
         self.vtable["barrier"].barrier()
 
     def ibarrier(self):
-        record(self.comm.cid, "barrier", 0)
+        # its own key: conflating blocking and nonblocking counts hid
+        # the i-surface from the monitoring tables (the stacked table
+        # has separate i-slots, and the per-rank interposer already
+        # records i-collectives under their own names)
+        record(self.comm.cid, "ibarrier", 0)
         m = self.vtable.get("ibarrier")
         if m is not None:
             return m.ibarrier()

@@ -106,12 +106,24 @@ class XlaCollModule:
         compiled calling convention is stable)."""
         fn = self._cache.get(key)
         if fn is None:
-            fn = build()
-            if lower_args:
-                try:
-                    fn = fn.lower(*lower_args).compile()
-                except Exception:       # fall back to the jit wrapper
-                    pass
+            # compile misses dominate first-call latency; trace them as
+            # their own spans so a timeline distinguishes "the
+            # collective was slow" from "the collective compiled"
+            from ompi_tpu.trace import core as _trace
+            tok = (_trace.begin("xla_compile",
+                                cid=getattr(self.comm, "cid", None),
+                                key=str(key[0]))
+                   if _trace.active else None)
+            try:
+                fn = build()
+                if lower_args:
+                    try:
+                        fn = fn.lower(*lower_args).compile()
+                    except Exception:   # fall back to the jit wrapper
+                        pass
+            finally:
+                if tok is not None:
+                    _trace.end(tok)
             self._cache[key] = fn
         return fn
 

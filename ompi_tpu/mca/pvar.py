@@ -69,7 +69,11 @@ def pvar_list() -> List[Dict[str, Any]]:
 
 def _install_spc_pvars() -> None:
     """Surface every SPC counter as a pvar (the reference surfaces its
-    ~110 SPC counters as MPI_T pvars, ompi_spc.c)."""
+    ~110 SPC counters as MPI_T pvars, ompi_spc.c). The membership
+    check and the registration happen under ONE ``_lock`` hold:
+    concurrent ``refresh()`` calls (tool thread + app thread both
+    enumerating pvars) used to race the unlocked check against
+    writers, re-registering entries mid-mutation."""
     from ompi_tpu.runtime import spc
 
     def make_reader(key):
@@ -79,11 +83,14 @@ def _install_spc_pvars() -> None:
         return lambda value: spc.write(key, int(value))
 
     for key in spc.snapshot():
-        if f"spc_{key}" not in _pvars:
-            pvar_register(f"spc_{key}", make_reader(key),
-                          help=f"SPC counter {key}")
-            with _lock:
-                _pvars[f"spc_{key}"]["write"] = make_writer(key)
+        full = f"spc_{key}"
+        with _lock:
+            if full in _pvars:
+                continue
+            _pvars[full] = {"read": make_reader(key), "unit": "count",
+                            "help": f"SPC counter {key}",
+                            "class": "counter",
+                            "write": make_writer(key)}
 
 
 def refresh() -> None:

@@ -35,6 +35,7 @@ from ompi_tpu.btl.tcp import decode_payload, encode_payload
 from ompi_tpu.core.errhandler import ERR_PENDING, ERR_RANK, ERR_TAG, MPIError
 from ompi_tpu.core.request import Request, Status
 from ompi_tpu.runtime import progress as _progress
+from ompi_tpu.trace import core as _trace
 
 ANY_SOURCE = -1
 ANY_TAG = -1
@@ -472,6 +473,21 @@ class PerRankEngine:
     # -- send side -----------------------------------------------------
     def send(self, data: Any, dest: int, tag: int = 0,
              synchronous: bool = False) -> Request:
+        # tracing gate: one attribute read when off (hooks event name
+        # "pml_send" — the PERUSE/MPI_T stream and the trace agree);
+        # cid rides in args so pt2pt spans stay out of the collective
+        # sequence space the attribution layer groups on
+        if _trace.active:
+            tok = _trace.begin("pml_send", cid=None,
+                               cc=str(self.comm.cid), dest=dest, tag=tag)
+            try:
+                return self._send_impl(data, dest, tag, synchronous)
+            finally:
+                _trace.end(tok)
+        return self._send_impl(data, dest, tag, synchronous)
+
+    def _send_impl(self, data: Any, dest: int, tag: int = 0,
+                   synchronous: bool = False) -> Request:
         if dest == PROC_NULL:
             return Request.completed()
         if not (0 <= dest < self.comm.size):
@@ -527,6 +543,19 @@ class PerRankEngine:
         ranks, validated by the collective's own construction; the
         caller's rank must not appear in ``dests`` (self-contributions
         go through ``CombineSlot.put_own``)."""
+        if _trace.active:
+            tok = _trace.begin("pml_send", cid=None,
+                               cc=str(self.comm.cid), tag=tag,
+                               ndest=(len(dests)
+                                      if hasattr(dests, "__len__")
+                                      else -1), small=True)
+            try:
+                return self._send_small_impl(data, dests, tag)
+            finally:
+                _trace.end(tok)
+        return self._send_small_impl(data, dests, tag)
+
+    def _send_small_impl(self, data: Any, dests, tag: int) -> None:
         if isinstance(data, np.generic):
             # numpy scalars ride the raw nd encoding as 0-d arrays —
             # a pickle round trip costs 4x the marshal of the whole
@@ -642,6 +671,18 @@ class PerRankEngine:
 
     def recv(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
              timeout: Optional[float] = None) -> Tuple[Any, Status]:
+        # the span covers post-to-completion: its duration IS the
+        # blocked-waiting time a late sender costs this rank
+        if _trace.active:
+            tok = _trace.begin("pml_recv", cid=None,
+                               cc=str(self.comm.cid), src=source,
+                               tag=tag)
+            try:
+                req = self.irecv(source, tag)
+                st = req.wait(timeout)
+                return req.get(), st
+            finally:
+                _trace.end(tok)
         req = self.irecv(source, tag)
         st = req.wait(timeout)
         return req.get(), st

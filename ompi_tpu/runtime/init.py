@@ -117,6 +117,12 @@ def init(requested: int = THREAD_SINGLE,
             pass
         jax.distributed.initialize(**kw)       # PMIx-equivalent wire-up
 
+    # arm the tracer when the MCA var (env/param-file) asks for it —
+    # BEFORE any communicator exists, so the coll composer sees it and
+    # wraps every vtable (docs/OBSERVABILITY.md)
+    from ompi_tpu import trace
+    trace.maybe_enable_from_var()
+
     if var.var_get("mpi_base_per_rank", False):
         return _init_per_rank(requested)
 
@@ -166,6 +172,10 @@ def _init_per_rank(requested: int) -> int:
     client = _kv_client()
     rank = jax.process_index()
     nprocs = jax.process_count()
+    # every span this process records carries its world rank — the
+    # exporter's pid and the attribution layer's participant identity
+    from ompi_tpu import trace
+    trace.set_process_rank(rank)
     router = Router(rank, nprocs, client.key_value_set,
                     lambda k: client.blocking_key_value_get(k, 120_000))
     world = RankCommunicator(Group(range(nprocs)), rank, router,

@@ -17,6 +17,8 @@ from __future__ import annotations
 import threading
 from typing import Callable, List
 
+from ompi_tpu.trace import core as _trace
+
 _callbacks: List[Callable[[], int]] = []
 _low_priority: List[Callable[[], int]] = []
 _low_tick = 0
@@ -130,6 +132,11 @@ def wake_end() -> None:
         _wake_stats["completions"] += completions
         _wake_stats["frames"] += frames
         _wake_stats["batches"] += 1
+    # timeline marker for the coalescing win: one instant per flushed
+    # batch; free when tracing is off (one attribute read)
+    if (events or frames) and _trace.active:
+        _trace.instant("pml_wakeup_flush", wakeups=len(events),
+                       completions=completions, frames=frames)
 
 
 def wake_stats() -> dict:

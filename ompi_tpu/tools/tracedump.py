@@ -1,0 +1,93 @@
+"""tracedump — merge per-rank trace dumps and render them.
+
+The mpirun-style companion to ``ompi_tpu.trace``: each rank persists
+its span ring with ``trace.dump(path, offset_s=...)`` (offset measured
+against rank 0 by ``tools/mpisync``); this tool merges the dumps onto
+one timebase and emits either a Perfetto-loadable JSON
+(``--format perfetto``, open at https://ui.perfetto.dev), the
+late-arrival attribution report (``--format report``), or the compact
+summary (``--format summary``).
+
+Without input files it renders the CURRENT process's ring — the
+in-process escape hatch (call ``ompi_tpu.tools.tracedump.main([...])``
+at the end of a traced program, or rely on ``bench.py --trace``).
+
+Usage::
+
+    python -m ompi_tpu.tools.tracedump [-o OUT] \
+        [--format perfetto|report|summary] [DUMP.json ...]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+from ompi_tpu import trace
+from ompi_tpu.trace import attribution, perfetto
+
+
+def _gather(files: List[str]) -> tuple:
+    """(spans, rank_offsets, live) merged from dump files, or the
+    live ring (live=True)."""
+    if not files:
+        return trace.span_dicts(), {}, True
+    spans: List[Dict[str, Any]] = []
+    offsets: Dict[int, float] = {}
+    for path in files:
+        d = trace.load_dump(path)
+        rank = int(d.get("rank", -1))
+        off = float(d.get("offset_s", 0.0))
+        for s in d["spans"]:
+            # a dump written before the world knew its rank (-1) keeps
+            # per-span ranks; otherwise the file's rank is authoritative
+            if rank >= 0 and int(s.get("rank", -1)) < 0:
+                s = dict(s, rank=rank)
+            spans.append(s)
+        if rank >= 0:
+            offsets[rank] = off
+    return spans, offsets, False
+
+
+def render(spans, offsets, fmt: str, live: bool = False
+           ) -> Dict[str, Any]:
+    if fmt == "perfetto":
+        return perfetto.export(spans, offsets)
+    if fmt == "report":
+        return {"late_arrival": attribution.late_arrival(spans, offsets),
+                "skew_watermarks": attribution.skew_watermarks()}
+    # file mode: span/drop totals come from the dumps themselves, not
+    # this (tool) process's empty live ring
+    return attribution.summarize(spans,
+                                 trace.stats() if live else None)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m ompi_tpu.tools.tracedump",
+        description="Merge per-rank trace dumps; emit Perfetto JSON, "
+                    "a late-arrival report, or a summary.")
+    ap.add_argument("files", nargs="*",
+                    help="trace dump files written by trace.dump(); "
+                         "empty = this process's live ring")
+    ap.add_argument("--format", "-f", default="perfetto",
+                    choices=("perfetto", "report", "summary"))
+    ap.add_argument("--out", "-o", default="-",
+                    help="output path (default: stdout)")
+    args = ap.parse_args(argv)
+
+    spans, offsets, live = _gather(args.files)
+    obj = render(spans, offsets, args.format, live)
+    text = json.dumps(obj, indent=None if args.format == "perfetto"
+                      else 1)
+    if args.out == "-":
+        sys.stdout.write(text + "\n")
+    else:
+        with open(args.out, "w") as f:
+            f.write(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -56,6 +56,47 @@ def unregister_profiler(handle) -> None:
             pass
 
 
+# Dropped-callback accounting: a raising profiler must not break
+# communication, but silently eating its exceptions made tool bugs
+# undiagnosable (and MPI_T's event-handle ``dropped`` count was never
+# incremented). Every swallowed exception is now counted globally
+# (pvar ``hooks_dropped``) and the FIRST failure of each hook logs its
+# traceback once — later failures of the same hook stay silent.
+_drop_lock = threading.Lock()
+_dropped_total = 0
+_logged_hooks: set = set()               # id(hook) already tracebacked
+
+
+def _count_drop(h, event: str) -> None:
+    global _dropped_total
+    with _drop_lock:
+        _dropped_total += 1
+        first = id(h) not in _logged_hooks
+        if first:
+            _logged_hooks.add(id(h))
+    if first:
+        import sys
+        import traceback
+        sys.stderr.write(
+            f"ompi_tpu: profiler hook "
+            f"{getattr(h, '__name__', repr(h))} raised on event "
+            f"{event!r}; dropping (counted in the hooks_dropped pvar; "
+            f"further failures of this hook are silent):\n")
+        traceback.print_exc(file=sys.stderr)
+
+
+def dropped() -> int:
+    with _drop_lock:
+        return _dropped_total
+
+
+def _reset_drops_for_tests() -> None:
+    global _dropped_total
+    with _drop_lock:
+        _dropped_total = 0
+        _logged_hooks.clear()
+
+
 def fire(event: str, comm, info: Dict[str, Any]) -> None:
     # Hot path (every collective and pt2pt entry): stay lock-free when
     # there is nothing to do — membership reads on builtins are safe.
@@ -69,4 +110,15 @@ def fire(event: str, comm, info: Dict[str, Any]) -> None:
         try:
             h(event, comm, info)
         except Exception:
-            pass          # profiler bugs must not break communication
+            _count_drop(h, event)
+
+
+def _register_pvar() -> None:
+    from ompi_tpu.mca import pvar
+    pvar.pvar_register(
+        "hooks_dropped", dropped,
+        help="Profiler-hook exceptions swallowed by utils.hooks.fire "
+             "(first failure per hook logged with traceback)")
+
+
+_register_pvar()

@@ -1,0 +1,184 @@
+"""Observability satellites: hook-drop accounting (utils/hooks +
+MPI_T event handles), the pvar install race, sharded SPC counters,
+and monitoring's distinct ibarrier key."""
+import threading
+
+import pytest
+
+from ompi_tpu.mca import pvar, var
+from ompi_tpu.utils import hooks
+
+
+# -- hooks.fire drop accounting --------------------------------------------
+def test_fire_counts_drops_and_logs_first_traceback_once(capsys):
+    hooks._reset_drops_for_tests()
+
+    def bad(event, comm, info):
+        raise RuntimeError("tool bug")
+
+    hooks.register_profiler(bad)
+    try:
+        before = hooks.dropped()
+        hooks.fire("coll_allreduce", None, {})
+        hooks.fire("coll_allreduce", None, {})
+        hooks.fire("coll_bcast", None, {})
+        assert hooks.dropped() - before == 3
+        assert pvar.pvar_read("hooks_dropped") == hooks.dropped()
+        err = capsys.readouterr().err
+        # the FIRST failure logged with its traceback — exactly once
+        assert err.count("RuntimeError: tool bug") == 1
+        assert "hooks_dropped" in err
+    finally:
+        hooks.unregister_profiler(bad)
+        hooks._reset_drops_for_tests()
+
+
+def test_fire_drop_does_not_break_later_hooks():
+    hooks._reset_drops_for_tests()
+    seen = []
+
+    def bad(event, comm, info):
+        raise ValueError("boom")
+
+    def good(event, comm, info):
+        seen.append(event)
+
+    hooks.register_profiler(bad)
+    hooks.register_profiler(good)
+    try:
+        hooks.fire("pml_send", None, {})
+        assert seen == ["pml_send"]
+        assert hooks.dropped() >= 1
+    finally:
+        hooks.unregister_profiler(bad)
+        hooks.unregister_profiler(good)
+        hooks._reset_drops_for_tests()
+
+
+def test_event_handle_dropped_increments_per_handle(capsys):
+    from ompi_tpu.api import tool
+    hooks._reset_drops_for_tests()
+
+    def bad_cb(event, comm, info):
+        raise RuntimeError("handler bug")
+
+    ok_events = []
+    h_bad = tool.event_handle_alloc("coll_reduce", bad_cb)
+    h_ok = tool.event_handle_alloc(
+        "coll_reduce", lambda e, c, i: ok_events.append(e))
+    try:
+        hooks.fire("coll_reduce", None, {})
+        hooks.fire("coll_reduce", None, {})
+        hooks.fire("coll_scatter", None, {})   # filtered: no drop
+        assert h_bad.dropped == 2
+        assert h_ok.dropped == 0
+        assert ok_events == ["coll_reduce"] * 2
+        assert hooks.dropped() == 2            # chain-level view agrees
+    finally:
+        tool.event_handle_free(h_bad)
+        tool.event_handle_free(h_ok)
+        hooks._reset_drops_for_tests()
+    capsys.readouterr()                        # swallow the one log
+
+
+# -- pvar install race ------------------------------------------------------
+def test_concurrent_refresh_registers_each_spc_pvar_once():
+    """The check-and-register in _install_spc_pvars runs under the
+    registry lock: concurrent refresh() calls (tool + app thread) must
+    neither raise nor double-register."""
+    from ompi_tpu.runtime import spc
+    spc.record("race_probe_counter", 7)
+    errs = []
+
+    def spin():
+        try:
+            for _ in range(50):
+                pvar.refresh()
+        except Exception as e:           # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=spin) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    assert pvar.pvar_read("spc_race_probe_counter") == 7
+    # writable (the SPC-backed pvar contract survived the rewrite)
+    pvar.pvar_write("spc_race_probe_counter", 0)
+    assert pvar.pvar_read("spc_race_probe_counter") == 0
+
+
+# -- sharded SPC counters ---------------------------------------------------
+def test_spc_sharded_increments_merge_across_threads():
+    from ompi_tpu.runtime import spc
+    key = "shard_merge_probe"
+    spc.write(key, 0)
+    nthreads, per = 8, 500
+
+    def work():
+        for _ in range(per):
+            spc.record(key, 1)
+
+    threads = [threading.Thread(target=work) for _ in range(nthreads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert spc.read(key) == nthreads * per
+    assert spc.snapshot()[key] == nthreads * per
+
+
+def test_spc_write_sets_absolute_value_and_record_resumes():
+    from ompi_tpu.runtime import spc
+    key = "shard_write_probe"
+    spc.record(key, 10)
+    spc.write(key, 3)                    # MPI_T_pvar_write reset idiom
+    assert spc.read(key) == 3
+    spc.record(key, 2)
+    assert spc.read(key) == 5
+
+
+def test_spc_record_takes_no_global_lock(monkeypatch):
+    """The tentpole's coexistence claim: tracing + SPC on one path must
+    not serialize reader and sender threads — a warmed-up record() may
+    not touch the module lock."""
+    from ompi_tpu.runtime import spc
+    spc.record("lock_probe", 1)          # warm this thread's shard
+
+    class Forbidden:
+        def __enter__(self):
+            raise AssertionError("record() took the global lock")
+
+        def __exit__(self, *a):
+            return False
+
+        def acquire(self, *a, **kw):
+            raise AssertionError("record() took the global lock")
+
+        def release(self):
+            pass
+    monkeypatch.setattr(spc, "_lock", Forbidden())
+    spc.record("lock_probe", 1)          # no lock on the hot path
+
+
+# -- monitoring: ibarrier under its own key --------------------------------
+def test_monitoring_counts_barrier_and_ibarrier_distinctly(mpi, world):
+    from ompi_tpu.coll import monitoring
+    var.var_set("coll_monitoring_enable", True)
+    comm = None
+    try:
+        monitoring.reset()
+        comm = world.dup()               # selection re-runs: wrapped
+        comm.barrier()
+        comm.barrier()
+        req = comm.ibarrier()
+        req.wait()
+        snap = monitoring.snapshot()
+        assert snap[(comm.cid, "barrier")][0] == 2
+        assert snap[(comm.cid, "ibarrier")][0] == 1
+    finally:
+        var.var_set("coll_monitoring_enable", False)
+        if comm is not None:
+            comm.free()
+        monitoring.reset()
