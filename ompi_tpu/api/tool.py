@@ -58,6 +58,41 @@ def pvar_read(name: str) -> Any:
     return _pvar.pvar_read(name)
 
 
+# -- decision tables --------------------------------------------------------
+def decision_table(comm_size: int = 0, multihost: bool = False,
+                   platform: str = "") -> Dict[str, Any]:
+    """The *effective* per-collective algorithm rules — fixed tables
+    after the per-func MCA pins, the tuned dynamic-rules file, the
+    multihost/platform branches, and (when ``mpi_base_compress`` is
+    on) the compression rows. Before this existed there was no way to
+    ask which algorithm a (func, size, nbytes) tuple picks without
+    calling the collective."""
+    from ompi_tpu.coll import decision as _decision
+    from ompi_tpu.coll.tuned import _load_rules
+    dyn = _load_rules(_var.var_get("coll_tuned_dynamic_rules", "") or "")
+    return _decision.decision_table(comm_size, multihost, dyn, platform)
+
+
+def decision_query(func: str, comm_size: int, nbytes: int,
+                   multihost: bool = False, platform: str = "",
+                   dtype: str = "float32", op=None) -> Dict[str, Any]:
+    """What would run: the algorithm the decision layer picks for one
+    (func, comm_size, nbytes) tuple plus whether the compressed path
+    would claim it first (same gates coll/compressed applies)."""
+    from ompi_tpu.coll import decision as _decision
+    from ompi_tpu.coll.tuned import _load_rules
+    dyn = _load_rules(_var.var_get("coll_tuned_dynamic_rules", "") or "")
+    alg = _decision.decide(func, comm_size, nbytes, multihost, dyn,
+                           platform)
+    compressed = _decision.compress_eligible(func, nbytes, dtype, op)
+    out: Dict[str, Any] = {"func": func, "algorithm": alg,
+                           "compressed": compressed}
+    if compressed:
+        from ompi_tpu import compress
+        out["codec"] = compress.codec_name()
+    return out
+
+
 # -- events (MPI_T_event_*, ompi/mpi/tool/events.c shape) -------------------
 # An event handle binds a callback to one event type; the backend is the
 # profiling hook chain (the PMPI/PERUSE instrumentation point), filtered

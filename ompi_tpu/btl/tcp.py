@@ -114,6 +114,22 @@ class TcpEndpoint:
         # frames in == sendall batches out + pokes deduplicated
         self.ctl_stats = {"frames": 0, "batches": 0, "poke_dedup": 0}
 
+        # optional paced-wire mode: btl_tcp_sim_gbps > 0 floors each
+        # frame's wall time at nbytes / rate — the slow-tier (DCN)
+        # simulator for algorithm and compression A/Bs on hosts whose
+        # loopback is far faster than any real cross-host fabric (the
+        # reference's btl latency/bandwidth params made the same
+        # tier-shape assumptions selectable). Off (0) by default:
+        # byte-identical behavior and no extra clock reads.
+        from ompi_tpu.mca import var as _var
+        _var.var_register(
+            "btl", "tcp", "sim_gbps", vtype="float", default=0.0,
+            help="When > 0, pace tcp frame sends to this many GB/s "
+                 "(wall-time floor per frame) — a simulated slow "
+                 "tier for algorithm/compression A/Bs; 0 disables")
+        self._sim_bps = float(_var.var_get("btl_tcp_sim_gbps", 0.0)) \
+            * 1e9
+
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind(("127.0.0.1", 0))
@@ -390,13 +406,26 @@ class TcpEndpoint:
             return
         self._send_frame_blocking(peer, header, payload)
 
+    def _pace(self, nbytes: int, t0: float) -> None:
+        """Paced-wire floor (btl_tcp_sim_gbps): hold the sender until
+        the frame's simulated wall time has elapsed."""
+        budget = nbytes / self._sim_bps
+        remain = budget - (time.perf_counter() - t0)
+        if remain > 0:
+            time.sleep(remain)
+
     def _send_frame_blocking(self, peer: int, header: dict,
                              payload: bytes = b"") -> None:
         s = self._connect(peer)
         hraw = pickle.dumps(header)
         msg = _LEN.pack(MAGIC, len(hraw), len(payload)) + hraw + payload
         with self._peer_locks[peer]:
-            s.sendall(msg)
+            if self._sim_bps > 0:
+                t0 = time.perf_counter()
+                s.sendall(msg)
+                self._pace(len(msg), t0)
+            else:
+                s.sendall(msg)
 
     def _send_batch_blocking(self, peer: int, frames) -> None:
         """One sendall for a whole flush window. Encoding happens
@@ -417,7 +446,12 @@ class TcpEndpoint:
                 parts.append(payload)
         msg = b"".join(parts)
         with self._peer_locks[peer]:
-            s.sendall(msg)
+            if self._sim_bps > 0:
+                t0 = time.perf_counter()
+                s.sendall(msg)
+                self._pace(len(msg), t0)
+            else:
+                s.sendall(msg)
 
     def close(self) -> None:
         self._closed = True

@@ -125,6 +125,48 @@ _SYMMETRIC_FALLBACK = {"reduce": "alias", "gather": "allgather",
                        "scatter": "direct"}
 
 
+def effective_rules(func: str, multihost: bool = False,
+                    dynamic: Dict[str, Dict] | None = None,
+                    platform: str = "") -> List[Sequence]:
+    """The rule list :func:`decide` actually scans for ``func`` after
+    every override source (dynamic file, multihost structure, measured
+    platform branches) — the single source both ``decide`` and the
+    introspection table read, so the two can't drift."""
+    rules = None
+    if dynamic:
+        rules = dynamic.get(func, {}).get("algorithm_rules")
+    if rules:
+        return rules
+    if multihost and func in ("allreduce", "bcast", "allgather",
+                              "reduce_scatter_block", "barrier"):
+        # Multi-host: the two-tier composition keeps bulk traffic on
+        # ICI and only chunk-sized exchanges on DCN (coll/han's role).
+        # The xla module demotes to 'direct' where hier doesn't apply
+        # (ragged groups, non-sum reduce_scatter).
+        return [[0, 0, "hier"]]
+    if func in _SYMMETRIC_FALLBACK:
+        if multihost:
+            # Cross-process ppermute chains serialize on the DCN tier;
+            # the fused symmetric ops let XLA schedule the slow tier.
+            return [[0, 0, _SYMMETRIC_FALLBACK[func]]]
+        if platform == "cpu":
+            # Measured (bench child, reduce_8MB_ab): on the shared-
+            # memory host backend "wire bytes saved" cost nothing and
+            # the log-round root-targeted schedules lose to one fused
+            # op at every size. The root-targeted defaults below are
+            # for ICI, where the traffic asymmetry is real.
+            return [[0, 0, _SYMMETRIC_FALLBACK[func]]]
+    if platform == "cpu" and func == "allreduce":
+        # Measured on the 8-rank host mesh (bench child allreduce_ab):
+        # rabenseifner <= direct at 1 MB and above; ring loses at every
+        # size. Keep the table consistent with those numbers.
+        return [[0, 0, "direct"], [0, 1 << 20, "rabenseifner"]]
+    rules = FIXED_RULES.get(func)
+    if not rules:
+        return [[0, 0, "direct"]]
+    return rules
+
+
 def decide(func: str, comm_size: int, nbytes: int, multihost: bool,
            dynamic: Dict[str, Dict] | None = None,
            platform: str = "") -> str:
@@ -133,37 +175,74 @@ def decide(func: str, comm_size: int, nbytes: int, multihost: bool,
     ``{func: {"algorithm_rules": [...]}}`` entry overrides the fixed
     table wholesale (the reference's dynamic file has the same
     override-don't-merge semantics)."""
-    rules = None
-    if dynamic:
-        rules = dynamic.get(func, {}).get("algorithm_rules")
-    if rules:
-        return _match(rules, comm_size, nbytes)
-    if multihost and func in ("allreduce", "bcast", "allgather",
-                              "reduce_scatter_block", "barrier"):
-        # Multi-host: the two-tier composition keeps bulk traffic on
-        # ICI and only chunk-sized exchanges on DCN (coll/han's role).
-        # The xla module demotes to 'direct' where hier doesn't apply
-        # (ragged groups, non-sum reduce_scatter).
-        return "hier"
-    if func in _SYMMETRIC_FALLBACK:
-        if multihost:
-            # Cross-process ppermute chains serialize on the DCN tier;
-            # the fused symmetric ops let XLA schedule the slow tier.
-            return _SYMMETRIC_FALLBACK[func]
-        if platform == "cpu":
-            # Measured (bench child, reduce_8MB_ab): on the shared-
-            # memory host backend "wire bytes saved" cost nothing and
-            # the log-round root-targeted schedules lose to one fused
-            # op at every size. The root-targeted defaults below are
-            # for ICI, where the traffic asymmetry is real.
-            return _SYMMETRIC_FALLBACK[func]
-    if platform == "cpu" and func == "allreduce":
-        # Measured on the 8-rank host mesh (bench child allreduce_ab):
-        # rabenseifner <= direct at 1 MB and above; ring loses at every
-        # size. Keep the table consistent with those numbers.
-        return _match([[0, 0, "direct"], [0, 1 << 20, "rabenseifner"]],
-                      comm_size, nbytes)
-    rules = FIXED_RULES.get(func)
-    if not rules:
-        return "direct"
-    return _match(rules, comm_size, nbytes)
+    return _match(effective_rules(func, multihost, dynamic, platform),
+                  comm_size, nbytes)
+
+
+# -- compression gating (ompi_tpu/compress; EQuARX-style) -------------------
+# Only these collectives have a compressed schedule, and only these
+# dtypes quantize meaningfully (integer payloads would need a lossless
+# codec; f16 is already half-width).
+COMPRESSIBLE = frozenset({"allreduce", "allgather",
+                          "reduce_scatter_block"})
+COMPRESS_DTYPES = frozenset({"float32", "float64", "bfloat16"})
+
+
+def compress_eligible(func: str, nbytes: int, dtype_name: str,
+                      op=None) -> bool:
+    """True when the (func, per-rank payload, dtype, op) tuple takes
+    the compressed path: the MCA var is on, the payload is a large
+    eligible float, and the reduction (if any) is a sum — MPI
+    reduction-op semantics for every other op fall back to the
+    uncompressed path (dequantized partial maxima, products etc. would
+    silently change the documented error model)."""
+    from ompi_tpu import compress
+    if not compress.enabled():
+        return False
+    if func not in COMPRESSIBLE:
+        return False
+    if str(dtype_name) not in COMPRESS_DTYPES:
+        return False
+    if nbytes < compress.min_bytes():
+        return False
+    if op is not None and func != "allgather" \
+            and getattr(op, "xla_prim", None) != "sum":
+        return False
+    return True
+
+
+def compression_rules() -> Dict[str, List[Sequence]]:
+    """Effective compression rows (after MCA overrides), in the same
+    [min_comm_size, min_bytes, algorithm] shape as the fixed tables;
+    empty when ``mpi_base_compress`` is off."""
+    from ompi_tpu import compress
+    if not compress.enabled():
+        return {}
+    alg = f"compressed:{compress.codec_name()}"
+    return {func: [[0, compress.min_bytes(), alg]]
+            for func in sorted(COMPRESSIBLE)}
+
+
+def decision_table(comm_size: int = 0, multihost: bool = False,
+                   dynamic: Dict[str, Dict] | None = None,
+                   platform: str = "") -> Dict[str, List[Sequence]]:
+    """The *effective* selection table, after every override source:
+    the per-func MCA algorithm pins (``coll_xla_<func>_algorithm``),
+    the dynamic-rules file, the multihost/platform branches, and the
+    compression rows (present only when ``mpi_base_compress`` is on).
+    This is the introspection surface ``api/tool.decision_table``
+    exposes — asking which algorithm a (func, size, nbytes) tuple picks
+    no longer requires calling the collective."""
+    from ompi_tpu.mca import var as _var
+    table: Dict[str, List[Sequence]] = {}
+    funcs = sorted(set(FIXED_RULES) | {"scan"})
+    for func in funcs:
+        pinned = _var.var_get(f"coll_xla_{func}_algorithm", "auto")
+        if pinned not in (None, "auto"):
+            table[func] = [[0, 0, str(pinned)]]
+        else:
+            table[func] = [list(r) for r in effective_rules(
+                func, multihost, dynamic, platform)]
+    for func, rows in compression_rules().items():
+        table[func] = table[func] + [list(r) for r in rows]
+    return table

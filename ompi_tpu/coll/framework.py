@@ -36,8 +36,8 @@ def _ensure_components() -> None:
         return
     # Importing registers each component with the framework.
     from ompi_tpu.coll import (acoll, adapt, basic,  # noqa: F401
-                               ftagree, han, monitoring, nbc, self_,
-                               sync, tuned, xhc, xla)
+                               compressed, ftagree, han, monitoring,
+                               nbc, self_, sync, tuned, xhc, xla)
     _components_loaded = True
 
 

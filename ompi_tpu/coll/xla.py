@@ -231,13 +231,25 @@ class XlaCollModule:
         high = [[gr[i] for gr in groups] for i in range(size)]
         return low, high
 
-    def _ring_allreduce_inner(self, op, n, shape):
+    def _ring_allreduce_inner(self, op, n, shape, codec=None):
         """Explicit segmented ring (2(n-1) ppermute steps). Operates on
         the flattened buffer padded to n chunks; supports any op (the
-        chunk combine is op.fn)."""
+        chunk combine is op.fn).
+
+        ``codec`` (a ``(Codec, block)`` pair, coll/compressed) turns
+        every hop quantized (EQuARX's reduction-hop structure): the
+        reduce-scatter phase quantizes the outgoing partial sum, moves
+        1-byte codes + per-block scales, and the receiver dequantizes
+        before the combine — dequant -> reduce -> requant at each hop.
+        The allgather phase quantizes each rank's finished chunk ONCE
+        and forwards the codes losslessly, so broadcast hops add no
+        further error; the owner's row is its own dequantized image so
+        every rank ends bitwise identical."""
         total = int(np.prod(shape))
         chunk = -(-total // n)           # ceil
         perm = [(i, (i + 1) % n) for i in range(n)]
+        if codec is not None:
+            cobj, cblock = codec
 
         def inner(b):                    # block (1, *s)
             x = b.reshape(-1)
@@ -249,7 +261,14 @@ class XlaCollModule:
                 send_idx = jnp.mod(r - t, n)
                 send = jax.lax.dynamic_index_in_dim(buf, send_idx, 0,
                                                     keepdims=False)
-                recvd = jax.lax.ppermute(send, AXIS, perm=perm)
+                if codec is not None:
+                    qc, qs = cobj.jnp_quant(send, cblock)
+                    qc = jax.lax.ppermute(qc, AXIS, perm=perm)
+                    qs = jax.lax.ppermute(qs, AXIS, perm=perm)
+                    recvd = cobj.jnp_dequant(qc, qs, chunk, buf.dtype,
+                                             cblock)
+                else:
+                    recvd = jax.lax.ppermute(send, AXIS, perm=perm)
                 tgt = jnp.mod(r - t - 1, n)
                 cur = jax.lax.dynamic_index_in_dim(buf, tgt, 0,
                                                    keepdims=False)
@@ -261,6 +280,28 @@ class XlaCollModule:
             # rank r now owns the fully reduced chunk (r+1) mod n
             own = jnp.mod(r + 1, n)
             cur = jax.lax.dynamic_index_in_dim(buf, own, 0, keepdims=False)
+
+            if codec is not None:
+                qc, qs = cobj.jnp_quant(cur, cblock)
+                # own row = own dequantized image: what the peers see
+                cur_dq = cobj.jnp_dequant(qc, qs, chunk, buf.dtype,
+                                          cblock)
+                buf = jax.lax.dynamic_update_index_in_dim(
+                    buf, cur_dq, own, 0)
+
+                def ag_step_q(carry, t):
+                    buf, qc, qs = carry
+                    qc = jax.lax.ppermute(qc, AXIS, perm=perm)
+                    qs = jax.lax.ppermute(qs, AXIS, perm=perm)
+                    idx = jnp.mod(r - t, n)
+                    buf = jax.lax.dynamic_update_index_in_dim(
+                        buf, cobj.jnp_dequant(qc, qs, chunk, buf.dtype,
+                                              cblock), idx, 0)
+                    return (buf, qc, qs), None
+
+                (buf, _, _), _ = jax.lax.scan(ag_step_q, (buf, qc, qs),
+                                              jnp.arange(n - 1))
+                return buf.reshape(-1)[:total].reshape(b.shape)
 
             def ag_step(carry, t):
                 buf, cur = carry
@@ -275,11 +316,49 @@ class XlaCollModule:
             return buf.reshape(-1)[:total].reshape(b.shape)
         return inner
 
-    def _hier_allreduce_inner(self, op, low, high):
+    def _hier_allreduce_inner(self, op, low, high, codec=None):
         """han-style two-level: rs(low) -> ar(high) -> ag(low). Only the
         sum path uses psum_scatter; other ops go through the generic
-        gather+fold on each tier."""
+        gather+fold on each tier.
+
+        ``codec`` ((Codec, block), sum ops only — coll/compressed
+        gates): the intra-group tiers stay full-width (ICI is the fast
+        tier), and ONLY the scattered chunk crossing the slow tier is
+        quantized — each position class all-gathers codes + scales over
+        the high groups and dequant->reduces in fixed group order, so
+        members of a class end bitwise identical and the DCN bytes drop
+        to ~codes+scales (HiCCL's compression-on-the-slow-tier
+        composition)."""
         glen = len(low[0])
+        if codec is not None:
+            cobj, cblock = codec
+            H = len(high[0])
+
+            def inner_q(b):              # block (1, *s); sum only
+                x = b[0]
+                shape = x.shape
+                total = x.size
+                chunk = -(-total // glen)
+                flat = jnp.pad(x.reshape(-1), (0, glen * chunk - total))
+                part = jax.lax.psum_scatter(
+                    flat.reshape(glen, chunk), AXIS, scatter_dimension=0,
+                    tiled=True, axis_index_groups=low)[0]
+                qc, qs = cobj.jnp_quant(part, cblock)
+                gc = jax.lax.all_gather(qc, AXIS, tiled=False,
+                                        axis_index_groups=high)
+                gs = jax.lax.all_gather(qs, AXIS, tiled=False,
+                                        axis_index_groups=high)
+                # fixed group order: every member of a position class
+                # folds the same dequantized contributions identically
+                acc = cobj.jnp_dequant(gc[0], gs[0], chunk, part.dtype,
+                                       cblock)
+                for h in range(1, H):
+                    acc = op.fn(acc, cobj.jnp_dequant(
+                        gc[h], gs[h], chunk, part.dtype, cblock))
+                out = jax.lax.all_gather(acc, AXIS, tiled=True,
+                                         axis_index_groups=low)
+                return out.reshape(-1)[:total].reshape(shape)[None]
+            return inner_q
 
         def inner(b):                    # block (1, *s)
             x = b[0]
@@ -422,7 +501,8 @@ class XlaCollModule:
             return t3[None]
         return inner
 
-    def _ring_segmented_allreduce_inner(self, op, n, shape, nseg):
+    def _ring_segmented_allreduce_inner(self, op, n, shape, nseg,
+                                        codec=None):
         """Segmented ring (``coll_base_allreduce.c:345-357,622``): the
         payload is split into ``nseg`` segments, each running its OWN
         complete ring chain — the chains share no values, so nothing in
@@ -438,10 +518,11 @@ class XlaCollModule:
         still lose to the fused psum / Rabenseifner there, so the
         decision tables keep preferring those; the segsize knob is the
         TPU tuning surface, where async collective-permute can overlap
-        the chains further."""
+        the chains further. ``codec`` quantizes every hop of every
+        segment chain (see _ring_allreduce_inner)."""
         total = int(np.prod(shape))
         seglen = -(-total // nseg)
-        ring = self._ring_allreduce_inner(op, n, (seglen,))
+        ring = self._ring_allreduce_inner(op, n, (seglen,), codec)
 
         def inner(b):                    # block (1, *s)
             x = b.reshape(1, -1)

@@ -1,0 +1,90 @@
+"""ompi_tpu.compress — quantized & compressed collectives (EQuARX-style).
+
+The subsystem behind the ``coll/compressed`` component: block-scaled
+quantization codecs (compress/codecs), the host/per-rank wire form
+(compress/wire), an error-feedback accumulator for iterative workloads
+(compress/feedback), and the observability plane (compress/stats:
+byte/ratio/error pvars + ``compress.*`` trace spans).
+
+Config (MCA vars, framework ``mpi``/``base`` — the subsystem gates
+collective behavior across components, like the tracer's vars):
+
+- ``mpi_base_compress`` (bool, off): master switch. Off means every
+  path is byte-identical to the uncompressed framework.
+- ``mpi_base_compress_codec``: ``int8_block`` (default), ``fp8_block``,
+  or ``null``.
+- ``mpi_base_compress_min_bytes`` (default 4 MiB): per-rank payload
+  floor below which compression never engages (quantization arithmetic
+  beats wire savings only for large messages).
+- ``mpi_base_compress_block`` (default 256): elements per scale block.
+- ``mpi_base_compress_error_feedback`` (bool, off): opt keyed wire
+  streams into the residual accumulator.
+
+See docs/COMPRESSION.md for formats, selection rules, and accuracy
+caveats.
+"""
+from __future__ import annotations
+
+from ompi_tpu.mca import var as _var
+
+from ompi_tpu.compress import stats  # noqa: F401  (registers pvars)
+from ompi_tpu.compress.codecs import (Codec, DEFAULT_BLOCK,  # noqa: F401
+                                      codec_names, get_codec)
+from ompi_tpu.compress.feedback import ErrorFeedback  # noqa: F401
+
+DEFAULT_MIN_BYTES = 4 << 20
+
+
+def _register_vars() -> None:
+    _var.var_register(
+        "mpi", "base", "compress", vtype="bool", default=False,
+        help="Enable block-scaled quantized collectives for large "
+             "f32/f64/bf16 sum reductions and gathers "
+             "(docs/COMPRESSION.md)")
+    _var.var_register(
+        "mpi", "base", "compress_codec", vtype="str",
+        default="int8_block",
+        help="Compression codec: int8_block (symmetric int8, "
+             "err <= block_max/254), fp8_block (e4m3, relative err "
+             "<= 2^-4), or null (identity; schedule A/B baseline)")
+    _var.var_register(
+        "mpi", "base", "compress_min_bytes", vtype="int",
+        default=DEFAULT_MIN_BYTES,
+        help="Per-rank payload floor for compressed collectives; "
+             "smaller payloads take the uncompressed path unchanged")
+    _var.var_register(
+        "mpi", "base", "compress_block", vtype="int", default=DEFAULT_BLOCK,
+        help="Elements per quantization block (one float32 scale per "
+             "block rides the wire next to the 1-byte codes)")
+    _var.var_register(
+        "mpi", "base", "compress_error_feedback", vtype="bool",
+        default=False,
+        help="Carry quantization residuals per wire stream and fold "
+             "them into the next payload (iterative workloads)")
+
+
+def enabled() -> bool:
+    _register_vars()
+    return bool(_var.var_get("mpi_base_compress", False))
+
+
+def codec_name() -> str:
+    _register_vars()
+    return str(_var.var_get("mpi_base_compress_codec", "int8_block"))
+
+
+def min_bytes() -> int:
+    _register_vars()
+    return int(_var.var_get("mpi_base_compress_min_bytes",
+                            DEFAULT_MIN_BYTES))
+
+
+def block_elems() -> int:
+    _register_vars()
+    return max(1, int(_var.var_get("mpi_base_compress_block",
+                                   DEFAULT_BLOCK)))
+
+
+def error_feedback() -> bool:
+    _register_vars()
+    return bool(_var.var_get("mpi_base_compress_error_feedback", False))

@@ -46,6 +46,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ompi_tpu.compress import wire as _cwire
 from ompi_tpu.core import op as op_mod
 from ompi_tpu.core.errhandler import (ERR_ARG, ERR_COMM, ERR_COUNT, ERR_OP,
                                       ERR_RANK, ERR_ROOT, ERRORS_ARE_FATAL,
@@ -59,6 +60,12 @@ from ompi_tpu.runtime import spc
 from ompi_tpu.utils import hooks as _hooks_mod
 
 AXIS = "mpi_r"
+
+# Compressed host-tier allreduce: worlds at or below this size use the
+# direct code-exchange schedule (one parallel round, single quant
+# error); larger worlds use the binomial reduce + code-forwarding
+# bcast, whose per-rank wire bytes stay O(1) (docs/COMPRESSION.md).
+_WIRE_DIRECT_MAX_RANKS = 4
 
 
 class _HiddenChannel:
@@ -394,6 +401,11 @@ class RankCommunicator:
             if self._rank == root:
                 if self._stageable(data, func="bcast"):
                     msg = ((tuple(data.shape), data.dtype.str), None)
+                elif _cwire.eligible(data):
+                    # quantize ONCE at the root; the binomial tree
+                    # forwards the codes losslessly (one quantization
+                    # error total, ~1/4 the bytes per hop)
+                    msg = (None, _cwire.encode(data))
                 else:
                     msg = (None, data)
             else:
@@ -408,8 +420,12 @@ class RankCommunicator:
                 # the root already holds the payload: participate in
                 # the collective but skip the redundant D2H copy
                 return data if self._rank == root else np.asarray(res)
-            return data if self._rank == root else payload
-        return self._host_bcast(data, root)
+            return data if self._rank == root \
+                else _cwire.maybe_decode(payload)
+        if self._rank == root and _cwire.eligible(data):
+            self._host_bcast(_cwire.encode(data), root)
+            return data
+        return _cwire.maybe_decode(self._host_bcast(data, root))
 
     def _host_bcast(self, data: Any, root: int) -> Any:
         n, t = self.size, self._tag()
@@ -453,15 +469,23 @@ class RankCommunicator:
             y = self._device_allreduce(np.ascontiguousarray(data), op)
             # only the root pays the D2H copy; others just participate
             return np.asarray(y) if self._rank == root else None
+        # compressed wire hops (docs/COMPRESSION.md): large float sum
+        # payloads quantize per hop — decode, fold, re-encode at every
+        # tree level (the EQuARX reduction-hop structure on the host
+        # tier). The decision is a pure function of (shape, dtype,
+        # nbytes, op), identical on every member by MPI semantics.
+        use_wire = _cwire.eligible(data, op)
         vr = (self._rank - root) % n
         acc = data
         k = 1
         while k < n:
             if vr & k:
-                self._csend(((vr - k) + root) % n, t, acc)
+                self._csend(((vr - k) + root) % n, t,
+                            _cwire.encode(acc) if use_wire else acc)
                 return None
             if vr + k < n:
-                acc = _apply(op, acc, self._crecv(((vr + k) + root) % n, t))
+                acc = _apply(op, acc, _cwire.maybe_decode(
+                    self._crecv(((vr + k) + root) % n, t)))
             k <<= 1
         return acc if self._rank == root else None
 
@@ -544,8 +568,45 @@ class RankCommunicator:
         if self._small_allreduce_ok(data, op):
             spc.record("coll_small_combine", 1)
             return self._small_allreduce(data, op)
+        if _cwire.eligible(data, op) \
+                and 1 < self.size <= _WIRE_DIRECT_MAX_RANKS:
+            return self._wire_allreduce_direct(data, op)
         r = self.reduce(data, op, 0)
+        if _cwire.eligible(data, op):
+            # allreduce must return the SAME value on every rank: the
+            # root broadcasts the wire form as an opaque payload and
+            # every member (root included) decodes the same image —
+            # root keeping its exact fold would diverge from the
+            # quantized copies the peers receive.
+            w = _cwire.encode(r) if self._rank == 0 else None
+            return _cwire.maybe_decode(self.bcast(w, 0))
         return self.bcast(r, 0)
+
+    def _wire_allreduce_direct(self, data, op):
+        """Direct-exchange compressed allreduce (small worlds): every
+        rank quantizes its contribution ONCE and multicasts the codes;
+        every rank decodes all n images and folds them in rank order —
+        one fully parallel round (no serialized tree levels), exactly
+        one quantization error per contribution (lossless code
+        forwarding), and bitwise-identical results everywhere (all
+        ranks fold the same images in the same order). Wire cost is
+        (n-1)*qbytes per rank vs the tree's ~2*qbytes, the winning
+        trade while n is small — the tree path above takes over past
+        _WIRE_DIRECT_MAX_RANKS."""
+        n, r, t = self.size, self._rank, self._tag()
+        spc.record("coll_compress_direct", 1)
+        w = _cwire.encode(data)
+        for off in range(1, n):
+            self._csend((r + off) % n, t, w)
+        parts: Dict[int, Any] = {r: w}
+        for _ in range(n - 1):
+            d, st = self._coll_pml.recv(ANY_SOURCE, t)
+            parts[st.source] = d
+        out = None
+        for i in range(n):
+            img = _cwire.maybe_decode(parts[i])
+            out = img if out is None else _apply(op, out, img)
+        return out
 
     @_serialized
     def gather(self, data: Any, root: int = 0) -> Optional[List[Any]]:

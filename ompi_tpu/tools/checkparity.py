@@ -1,0 +1,127 @@
+"""checkparity — CI audit for the compressed-collective test contract.
+
+Two invariants the compression subsystem must never lose
+(docs/COMPRESSION.md, docs/PARITY.md):
+
+1. **Parity coverage**: every collective the ``coll/compressed``
+   component wraps (``WRAPPED_FUNCS``) has a paired
+   uncompressed-equivalence test — a test named
+   ``test_compressed_<func>_matches_uncompressed`` somewhere under
+   ``tests/``. A compressed schedule without its equivalence test is
+   an unverified lossy path.
+2. **Tier-1 budget**: compression tests that spawn real OS processes
+   (``subprocess``-using test functions in ``tests/test_compress*``)
+   carry the ``slow`` marker, so the multi-process jobs stay out of
+   the ``-m 'not slow'`` tier-1 run and its 870 s wall budget.
+
+Usage::
+
+    python -m ompi_tpu.tools.checkparity [--tests DIR]
+
+Prints a JSON report; exit status 1 on any violation (the CI entry).
+The audit is also invoked in-process by tests/test_compress_tools.py,
+so tier-1 itself enforces the contract.
+"""
+from __future__ import annotations
+
+import ast
+import glob
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _test_functions(path: str):
+    """Yield (name, node) for every test function in a file (module
+    level and class level)."""
+    try:
+        with open(path) as f:
+            tree = ast.parse(f.read(), path)
+    except (OSError, SyntaxError):
+        return
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name.startswith("test"):
+            yield node.name, node
+
+
+def _uses_subprocess(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) \
+                and isinstance(sub.value, ast.Name) \
+                and sub.value.id == "subprocess":
+            return True
+        if isinstance(sub, ast.Name) and sub.id in ("Popen", "check_call",
+                                                    "check_output"):
+            return True
+    return False
+
+
+def _has_slow_mark(node: ast.AST) -> bool:
+    for dec in getattr(node, "decorator_list", []):
+        for sub in ast.walk(dec):
+            if isinstance(sub, ast.Attribute) and sub.attr == "slow":
+                return True
+    return False
+
+
+def _module_slow_pytestmark(path: str) -> bool:
+    try:
+        with open(path) as f:
+            tree = ast.parse(f.read(), path)
+    except (OSError, SyntaxError):
+        return False
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "pytestmark"
+                for t in node.targets):
+            return "slow" in ast.dump(node.value)
+    return False
+
+
+def audit(tests_dir: Optional[str] = None) -> Dict[str, Any]:
+    tests_dir = tests_dir or os.path.join(_REPO, "tests")
+    from ompi_tpu.coll.compressed import WRAPPED_FUNCS
+
+    wanted = {f"test_compressed_{func}_matches_uncompressed": func
+              for func in WRAPPED_FUNCS}
+    found: set = set()
+    unmarked: List[str] = []
+    for path in sorted(glob.glob(os.path.join(tests_dir, "**", "*.py"),
+                                 recursive=True)):
+        base = os.path.basename(path)
+        mod_slow = _module_slow_pytestmark(path)
+        for name, node in _test_functions(path) or ():
+            if name in wanted:
+                found.add(name)
+            if base.startswith("test_compress") \
+                    and _uses_subprocess(node) \
+                    and not (mod_slow or _has_slow_mark(node)):
+                unmarked.append(f"{base}::{name}")
+    missing = sorted(set(wanted) - found)
+    return {"ok": not missing and not unmarked,
+            "wrapped_funcs": list(WRAPPED_FUNCS),
+            "missing_parity": missing,
+            "unmarked_slow": sorted(unmarked)}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="python -m ompi_tpu.tools.checkparity",
+        description="Audit compressed-collective parity tests and "
+                    "slow-marker hygiene.")
+    ap.add_argument("--tests", default=None,
+                    help="tests directory (default: <repo>/tests)")
+    args = ap.parse_args(argv)
+    report = audit(args.tests)
+    print(json.dumps(report, indent=1))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -6,7 +6,9 @@ against rank 0 by ``tools/mpisync``); this tool merges the dumps onto
 one timebase and emits either a Perfetto-loadable JSON
 (``--format perfetto``, open at https://ui.perfetto.dev), the
 late-arrival attribution report (``--format report``), or the compact
-summary (``--format summary``).
+summary (``--format summary``; includes per-rank ``compress.quant`` /
+``compress.dequant`` time aggregation when compressed collectives ran
+— docs/COMPRESSION.md).
 
 Without input files it renders the CURRENT process's ring — the
 in-process escape hatch (call ``ompi_tpu.tools.tracedump.main([...])``

@@ -133,12 +133,39 @@ def late_arrival(spans: Iterable[SpanLike],
     return reports
 
 
+def compress_by_rank(spans: Iterable[SpanLike]) -> Dict[str, Any]:
+    """Aggregate ``compress.quant`` / ``compress.dequant`` span time
+    per rank (keys are strings for JSON round-tripping; rank -1 is the
+    single-controller world). Empty dict when no compression spans are
+    present — the summary omits the section entirely."""
+    agg: Dict[str, Dict[str, Any]] = {}
+    for s in spans:
+        name = str(_field(s, "name", "?"))
+        if name not in ("compress.quant", "compress.dequant"):
+            continue
+        rank = str(int(_field(s, "rank", -1)))
+        e = agg.setdefault(rank, {"quant_us": 0.0, "quant_n": 0,
+                                  "dequant_us": 0.0, "dequant_n": 0})
+        us = max(float(_field(s, "dur", 0.0)), 0.0) * 1e6
+        if name == "compress.quant":
+            e["quant_us"] += us
+            e["quant_n"] += 1
+        else:
+            e["dequant_us"] += us
+            e["dequant_n"] += 1
+    for e in agg.values():
+        e["quant_us"] = round(e["quant_us"], 2)
+        e["dequant_us"] = round(e["dequant_us"], 2)
+    return agg
+
+
 def summarize(spans: Iterable[SpanLike],
               stats: Optional[Mapping[str, int]] = None,
               top: int = 5) -> Dict[str, Any]:
     """The compact, JSON-round-trippable trace summary bench.py
     attaches to the committed BENCH record: span/drop totals, per-name
-    aggregates, and the worst late-arrival attributions."""
+    aggregates, per-rank quant/dequant time (when compression ran),
+    and the worst late-arrival attributions."""
     spans = list(spans)
     by_name: Dict[str, Dict[str, Any]] = {}
     for s in spans:
@@ -156,6 +183,9 @@ def summarize(spans: Iterable[SpanLike],
         "skew_watermarks": {k: round(v, 9)
                             for k, v in skew_watermarks().items()},
     }
+    comp = compress_by_rank(spans)
+    if comp:
+        out["compress"] = comp
     if reports:
         out["late_arrival_top"] = reports[:top]
     return out

@@ -42,3 +42,11 @@ def world(mpi):
 @pytest.fixture()
 def rng():
     return np.random.default_rng(1234)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-process / long-running jobs excluded from the "
+        "tier-1 '-m \"not slow\"' run (tools/checkparity audits that "
+        "subprocess-spawning compress tests carry this)")

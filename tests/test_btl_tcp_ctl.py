@@ -190,3 +190,38 @@ def test_ctl_batch_flush_window_dedupes_pokes():
     finally:
         a.close()
         b.close()
+
+
+def test_sim_gbps_paces_frame_sends():
+    """btl_tcp_sim_gbps floors a frame's send wall time at
+    nbytes/rate (the DCN-tier simulator the compression A/B rides,
+    docs/COMPRESSION.md); 0 (the default) adds nothing."""
+    from ompi_tpu.mca import var
+    kv = {}
+    got = threading.Event()
+    payload = b"x" * (1 << 20)                 # 1 MB
+
+    var.var_register("btl", "tcp", "sim_gbps", vtype="float",
+                     default=0.0)
+    var.var_set("btl_tcp_sim_gbps", 0.1)       # 100 MB/s -> >= 10 ms
+    try:
+        a = _pair(kv, 0, lambda h, p: None)
+        b = _pair(kv, 1, lambda h, p: got.set())
+        try:
+            assert a._sim_bps == 0.1e9
+            t0 = time.perf_counter()
+            a.send_frame(1, {"kind": "bulk"}, payload)
+            sent_s = time.perf_counter() - t0
+            assert got.wait(10)
+            assert sent_s >= len(payload) / 0.1e9 * 0.9, sent_s
+        finally:
+            a.close()
+            b.close()
+    finally:
+        var.var_set("btl_tcp_sim_gbps", 0.0)
+    # default-off endpoints carry no pacing state
+    c = _pair({}, 0, lambda h, p: None)
+    try:
+        assert c._sim_bps == 0.0
+    finally:
+        c.close()

@@ -1,0 +1,267 @@
+"""coll/compressed — the quantized collective component on the 8-rank
+CPU mesh: selection, uncompressed-equivalence (the checkparity-audited
+pairs), byte-pvar accounting (<= 0.3x on the wire), the off-path
+bit-identity contract, dtype/op/threshold gating, and the effective
+decision-table exposure (api/tool.decision_table)."""
+import numpy as np
+import pytest
+
+import ompi_tpu as MPI
+from ompi_tpu.mca import pvar, var
+
+MB4_ELEMS = 1 << 20                  # 4 MB of f32 per rank
+
+
+@pytest.fixture()
+def compress_world(world):
+    """A communicator whose vtable was selected with compression ON
+    (the han/xhc fixture idiom: enable, then dup so selection sees
+    it). The threshold is dropped to 256 KiB so the smaller equivalence
+    payloads engage too; the 4 MB acceptance test overrides nothing."""
+    var.var_set("mpi_base_compress", True)
+    var.var_set("mpi_base_compress_min_bytes", 256 << 10)
+    c = world.dup()
+    try:
+        yield c
+    finally:
+        c.free()
+        var.var_set("mpi_base_compress_min_bytes", 4 << 20)
+        var.var_set("mpi_base_compress", False)
+
+
+def _bytes():
+    return (pvar.pvar_read("compress_bytes_in"),
+            pvar.pvar_read("compress_bytes_out"))
+
+
+def test_compressed_component_selected_only_when_enabled(world):
+    assert world._coll_winners["allreduce"] != "compressed"
+    var.var_set("mpi_base_compress", True)
+    try:
+        c = world.dup()
+        assert c._coll_winners["allreduce"] == "compressed"
+        assert c._coll_winners["allgather"] == "compressed"
+        assert c._coll_winners["reduce_scatter_block"] == "compressed"
+        # everything else backfills from the next-priority providers
+        assert c._coll_winners["bcast"] != "compressed"
+        assert c._coll_winners["barrier"] != "compressed"
+        c.free()
+    finally:
+        var.var_set("mpi_base_compress", False)
+
+
+def test_compressed_allreduce_4mb_within_bound_and_wire_budget(
+        compress_world, rng):
+    """The acceptance row: a >= 4 MB fp32 allreduce through the
+    compressed path is correct within the documented error model,
+    moves <= 0.3x the baseline bytes (pvar-asserted), and returns the
+    SAME array on every rank."""
+    c = compress_world
+    n = c.size
+    host = rng.normal(size=(n, MB4_ELEMS)).astype(np.float32)
+    x = c.put(host)
+    ref = host.sum(axis=0, dtype=np.float64)
+
+    bi0, bo0 = _bytes()
+    y = np.asarray(c.allreduce(x, MPI.SUM))
+    bi1, bo1 = _bytes()
+    assert bi1 > bi0, "compressed path never engaged"
+    ratio = (bo1 - bo0) / (bi1 - bi0)
+    assert ratio <= 0.3, f"wire ratio {ratio}"
+
+    # error model: one int8 requant per reduce-scatter hop (n-1 hops
+    # of partial sums) + one for the broadcast codes. Bound per
+    # element by hops * blockmax/254 with blockmax <= max|partial|;
+    # assert the measured error against a loose 2% of the result scale
+    # (the documented envelope for n=8 gaussian payloads).
+    err = np.abs(y[0].astype(np.float64) - ref).max()
+    scale = np.abs(ref).max()
+    assert err <= 0.02 * scale, f"err {err} vs scale {scale}"
+    for r in range(1, n):
+        assert np.array_equal(y[0], y[r]), "ranks diverged"
+
+
+def test_compressed_allreduce_matches_uncompressed(compress_world,
+                                                   world, rng):
+    """Parity pair (tools/checkparity): same payload through the
+    compressed comm and the plain world agrees within the codec
+    bound."""
+    n = world.size
+    host = rng.normal(size=(n, 1 << 17)).astype(np.float32)  # 512 KiB
+    ref = np.asarray(world.allreduce(world.put(host), MPI.SUM))
+    out = np.asarray(compress_world.allreduce(
+        compress_world.put(host), MPI.SUM))
+    assert out.shape == ref.shape and out.dtype == ref.dtype
+    scale = np.abs(ref).max()
+    assert np.abs(out - ref).max() <= 0.02 * scale
+
+
+def test_compressed_allgather_matches_uncompressed(compress_world,
+                                                   world, rng):
+    n = world.size
+    host = rng.normal(size=(n, 1 << 17)).astype(np.float32)
+    ref = np.asarray(world.allgather(world.put(host)))
+    bi0, bo0 = _bytes()
+    out = np.asarray(compress_world.allgather(compress_world.put(host)))
+    bi1, bo1 = _bytes()
+    assert bi1 > bi0
+    assert (bo1 - bo0) / (bi1 - bi0) <= 0.3
+    assert out.shape == ref.shape
+    # allgather quantizes each contribution exactly once
+    scale = np.abs(host).max()
+    assert np.abs(out - ref).max() <= scale / 64
+    for r in range(1, n):
+        assert np.array_equal(out[0], out[r])
+
+
+def test_compressed_reduce_scatter_block_matches_uncompressed(
+        compress_world, world, rng):
+    n = world.size
+    host = rng.normal(size=(n, n, 1 << 16)).astype(np.float32)
+    ref = np.asarray(world.reduce_scatter_block(world.put(host),
+                                                MPI.SUM))
+    bi0, bo0 = _bytes()
+    out = np.asarray(compress_world.reduce_scatter_block(
+        compress_world.put(host), MPI.SUM))
+    bi1, bo1 = _bytes()
+    assert bi1 > bi0
+    assert (bo1 - bo0) / (bi1 - bi0) <= 0.3
+    assert out.shape == ref.shape
+    scale = np.abs(ref).max()
+    assert np.abs(out - ref).max() <= 0.02 * scale
+
+
+def test_disabled_var_is_bit_identical_and_moves_no_extra_bytes(
+        compress_world, world, rng):
+    """Toggling the var off on an already-compressed comm delegates
+    every call: results bit-identical to the plain path, zero new
+    compress bytes."""
+    n = world.size
+    host = rng.normal(size=(n, 1 << 17)).astype(np.float32)
+    ref = np.asarray(world.allreduce(world.put(host), MPI.SUM))
+    var.var_set("mpi_base_compress", False)
+    try:
+        bi0, bo0 = _bytes()
+        out = np.asarray(compress_world.allreduce(
+            compress_world.put(host), MPI.SUM))
+        bi1, bo1 = _bytes()
+        assert (bi1, bo1) == (bi0, bo0), "bytes moved while disabled"
+        assert np.array_equal(out, ref)
+    finally:
+        var.var_set("mpi_base_compress", True)
+
+
+def test_non_sum_ops_fall_back_exact(compress_world, world, rng):
+    """MPI reduction-op semantics: MAX (and every non-sum op) takes
+    the uncompressed path even above the threshold — exact result,
+    no compress bytes."""
+    n = world.size
+    host = rng.normal(size=(n, 1 << 17)).astype(np.float32)
+    ref = np.asarray(world.allreduce(world.put(host), MPI.MAX))
+    bi0, bo0 = _bytes()
+    out = np.asarray(compress_world.allreduce(
+        compress_world.put(host), MPI.MAX))
+    bi1, bo1 = _bytes()
+    assert (bi1, bo1) == (bi0, bo0)
+    assert np.array_equal(out, ref)
+
+
+def test_small_and_integer_payloads_fall_back_exact(compress_world,
+                                                    world, rng):
+    n = world.size
+    small = rng.normal(size=(n, 64)).astype(np.float32)   # < threshold
+    ref = np.asarray(world.allreduce(world.put(small), MPI.SUM))
+    ints = rng.integers(0, 100, size=(n, 1 << 17)).astype(np.int32)
+    refi = np.asarray(world.allreduce(world.put(ints), MPI.SUM))
+    bi0, bo0 = _bytes()
+    outs = np.asarray(compress_world.allreduce(
+        compress_world.put(small), MPI.SUM))
+    outi = np.asarray(compress_world.allreduce(
+        compress_world.put(ints), MPI.SUM))
+    assert _bytes() == (bi0, bo0)
+    assert np.array_equal(outs, ref)
+    assert np.array_equal(outi, refi)
+
+
+def test_compressed_hier_inner_two_tier(compress_world, rng):
+    """The hier schedule with the codec composed in (the multihost
+    path, exercised over _groups' synthetic split on this flat mesh):
+    only the slow-tier chunk quantizes; result within bound and
+    bitwise identical across ranks."""
+    from ompi_tpu.compress import codecs
+    dev = compress_world.c_coll["allreduce"]
+    while hasattr(dev, "_inner"):        # unwrap tracing shims if any
+        dev = dev._inner
+    dev = dev.device
+    low, high = dev._groups()
+    assert low is not None
+    codec = (codecs.get_codec("int8_block"), 128)
+    inner = dev._hier_allreduce_inner(MPI.SUM, low, high, codec)
+    n = compress_world.size
+    host = rng.normal(size=(n, 4096)).astype(np.float32)
+    fn = dev._smap(inner, 2, 2)
+    out = np.asarray(fn(compress_world.put(host)))
+    ref = host.sum(axis=0, dtype=np.float64)
+    assert np.abs(out[0].astype(np.float64) - ref).max() \
+        <= 0.02 * np.abs(ref).max()
+    for r in range(1, n):
+        assert np.array_equal(out[0], out[r])
+
+
+def test_allreduce_bind_routes_through_compressed(compress_world, rng):
+    """MPI-4 persistent handle on a compressed comm: eligible example
+    warms the compressed executable (bytes accounted per call);
+    ineligible example binds the plain fast path."""
+    n = compress_world.size
+    host = rng.normal(size=(n, 1 << 17)).astype(np.float32)
+    x = compress_world.put(host)
+    bound = compress_world.allreduce_bind(x, MPI.SUM)
+    bi0, _ = _bytes()
+    y = np.asarray(bound(x))
+    bi1, _ = _bytes()
+    assert bi1 > bi0
+    ref = host.sum(axis=0, dtype=np.float64)
+    assert np.abs(y[0].astype(np.float64) - ref).max() \
+        <= 0.02 * np.abs(ref).max()
+    small = compress_world.put(
+        rng.normal(size=(n, 2)).astype(np.float32))
+    bsmall = compress_world.allreduce_bind(small, MPI.SUM)
+    bi2, _ = _bytes()
+    np.asarray(bsmall(small))
+    assert _bytes()[0] == bi2            # plain path: no quant bytes
+
+
+def test_decision_table_compression_rows_follow_the_var(world):
+    """Satellite: the effective decision table (api/tool) shows
+    compression rows only while mpi_base_compress is on, and
+    decision_query answers without calling the collective."""
+    from ompi_tpu.api import tool
+    t_off = tool.decision_table(comm_size=world.size, platform="cpu")
+    assert not any("compressed" in str(rule[2])
+                   for rules in t_off.values() for rule in rules)
+    q = tool.decision_query("allreduce", world.size, 8 << 20,
+                            platform="cpu", op=MPI.SUM)
+    assert q["compressed"] is False and q["algorithm"]
+    var.var_set("mpi_base_compress", True)
+    try:
+        t_on = tool.decision_table(comm_size=world.size, platform="cpu")
+        for func in ("allreduce", "allgather", "reduce_scatter_block"):
+            rows = [r for r in t_on[func]
+                    if str(r[2]).startswith("compressed:")]
+            assert rows, f"no compression row for {func}"
+            assert rows[-1][1] == (4 << 20)      # effective threshold
+        assert not any(str(r[2]).startswith("compressed:")
+                       for r in t_on["bcast"])
+        q = tool.decision_query("allreduce", world.size, 8 << 20,
+                                platform="cpu", dtype="float32",
+                                op=MPI.SUM)
+        assert q["compressed"] is True and q["codec"] == "int8_block"
+        # non-sum op and ineligible dtype still answer uncompressed
+        assert not tool.decision_query(
+            "allreduce", world.size, 8 << 20, platform="cpu",
+            op=MPI.MAX)["compressed"]
+        assert not tool.decision_query(
+            "allreduce", world.size, 8 << 20, platform="cpu",
+            dtype="int32", op=MPI.SUM)["compressed"]
+    finally:
+        var.var_set("mpi_base_compress", False)

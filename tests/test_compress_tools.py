@@ -1,0 +1,94 @@
+"""Tooling contracts for the compression subsystem: the checkparity
+audit (every compressed collective has its uncompressed-equivalence
+pair; multi-process compress tests are slow-marked) and its CLI."""
+import json
+import os
+import textwrap
+
+from ompi_tpu.tools import checkparity
+
+_TESTS = os.path.dirname(os.path.abspath(__file__))
+
+
+def test_checkparity_audit_passes_on_this_tree():
+    """Tier-1 enforces the contract on itself: the real tests/ tree
+    has a parity pair for every wrapped collective and no unmarked
+    subprocess test in the compress modules."""
+    report = checkparity.audit(_TESTS)
+    assert report["ok"], report
+    assert set(report["wrapped_funcs"]) == {
+        "allreduce", "allgather", "reduce_scatter_block"}
+
+
+def test_checkparity_detects_missing_pair_and_unmarked_slow(tmp_path):
+    (tmp_path / "test_compress_x.py").write_text(textwrap.dedent("""
+        import subprocess
+
+        def test_compressed_allreduce_matches_uncompressed():
+            pass
+
+        def test_spawns_without_marker():
+            subprocess.run(["true"])
+    """))
+    report = checkparity.audit(str(tmp_path))
+    assert not report["ok"]
+    # allgather + reduce_scatter_block pairs are missing
+    assert "test_compressed_allgather_matches_uncompressed" \
+        in report["missing_parity"]
+    assert "test_compressed_reduce_scatter_block_matches_uncompressed" \
+        in report["missing_parity"]
+    assert report["unmarked_slow"] == \
+        ["test_compress_x.py::test_spawns_without_marker"]
+
+
+def test_checkparity_accepts_slow_marks(tmp_path):
+    (tmp_path / "test_compress_ok.py").write_text(textwrap.dedent("""
+        import subprocess
+        import pytest
+
+        def test_compressed_allreduce_matches_uncompressed():
+            pass
+
+        def test_compressed_allgather_matches_uncompressed():
+            pass
+
+        def test_compressed_reduce_scatter_block_matches_uncompressed():
+            pass
+
+        @pytest.mark.slow
+        def test_spawns_marked():
+            subprocess.run(["true"])
+    """))
+    report = checkparity.audit(str(tmp_path))
+    assert report["ok"], report
+
+
+def test_checkparity_module_pytestmark(tmp_path):
+    (tmp_path / "test_compress_mod.py").write_text(textwrap.dedent("""
+        import subprocess
+        import pytest
+
+        pytestmark = pytest.mark.slow
+
+        def test_compressed_allreduce_matches_uncompressed():
+            subprocess.run(["true"])
+
+        def test_compressed_allgather_matches_uncompressed():
+            pass
+
+        def test_compressed_reduce_scatter_block_matches_uncompressed():
+            pass
+    """))
+    report = checkparity.audit(str(tmp_path))
+    assert report["ok"], report
+
+
+def test_checkparity_cli(tmp_path, capsys):
+    rc = checkparity.main(["--tests", _TESTS])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0 and out["ok"]
+    (tmp_path / "test_compress_bad.py").write_text(
+        "def test_nothing():\n    pass\n")
+    rc = checkparity.main(["--tests", str(tmp_path)])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1 and out["missing_parity"]

@@ -182,3 +182,69 @@ def test_monitoring_counts_barrier_and_ibarrier_distinctly(mpi, world):
         if comm is not None:
             comm.free()
         monitoring.reset()
+
+
+# -- compress.* spans: namespace + tracedump summary aggregation ----------
+def test_compress_spans_aggregate_per_rank_in_summary():
+    """The compress.quant/compress.dequant spans land in the hooks
+    event namespace and `tracedump summary` aggregates quant/dequant
+    time per rank (docs/COMPRESSION.md)."""
+    from ompi_tpu.trace import attribution
+    from ompi_tpu.tools import tracedump
+
+    spans = [
+        {"name": "compress.quant", "ts": 0.0, "dur": 0.002,
+         "rank": 0, "kind": "span"},
+        {"name": "compress.quant", "ts": 0.1, "dur": 0.001,
+         "rank": 1, "kind": "span"},
+        {"name": "compress.dequant", "ts": 0.2, "dur": 0.0005,
+         "rank": 1, "kind": "span"},
+        {"name": "coll_allreduce", "ts": 0.3, "dur": 0.01,
+         "rank": 0, "kind": "span"},
+    ]
+    agg = attribution.compress_by_rank(spans)
+    assert agg["0"] == {"quant_us": 2000.0, "quant_n": 1,
+                        "dequant_us": 0.0, "dequant_n": 0}
+    assert agg["1"]["quant_n"] == 1 and agg["1"]["dequant_n"] == 1
+    assert agg["1"]["dequant_us"] == 500.0
+
+    summary = tracedump.render(spans, {}, "summary")
+    assert summary["compress"] == agg
+    # JSON-round-trippable (the bench record contract)
+    import json
+    assert json.loads(json.dumps(summary)) == summary
+    # no compression spans -> no section
+    assert "compress" not in tracedump.render(spans[3:], {}, "summary")
+
+
+def test_compress_span_recording_through_the_tracer():
+    """Live path: an enabled tracer sees the wire codec's spans with
+    the hooks-namespace names (they are declared MPI_T event types)."""
+    import numpy as np
+    from ompi_tpu import trace
+    from ompi_tpu.compress import wire
+    from ompi_tpu.utils import hooks
+
+    assert "compress.quant" in hooks.known_events()
+    assert "compress.dequant" in hooks.known_events()
+    import ompi_tpu.compress as compress
+    compress._register_vars()
+    trace.enable()
+    trace.reset()
+    var.var_set("mpi_base_compress", True)
+    var.var_set("mpi_base_compress_min_bytes", 1 << 10)
+    try:
+        x = np.random.default_rng(0).normal(size=4096) \
+            .astype(np.float32)
+        wire.decode(wire.encode(x))
+        from ompi_tpu.trace import attribution
+        agg = attribution.compress_by_rank(
+            [s.to_dict() for s in trace.spans()])
+        (rank_key,) = agg.keys()
+        assert agg[rank_key]["quant_n"] >= 1
+        assert agg[rank_key]["dequant_n"] >= 1
+    finally:
+        var.var_set("mpi_base_compress_min_bytes", 4 << 20)
+        var.var_set("mpi_base_compress", False)
+        trace.reset()
+        trace.disable()
