@@ -2023,6 +2023,74 @@ int PMPI_Start(MPI_Request *request)
 
 int PMPI_Startall(int count, MPI_Request array_of_requests[])
 {
+    /* Persistent COLLECTIVES batch through one glue call
+     * (pcoll_startall): the BucketFuser flushes on the startall
+     * boundary, so K bucketable allreduces ride
+     * ceil(K*bytes/bucket_bytes) wire collectives instead of K.
+     * Everything else (pt2pt persistents, partitioned) starts singly
+     * in order, as before. */
+    int npc = 0;
+    for (int i = 0; i < count; i++) {
+        if (!array_of_requests
+            || array_of_requests[i] == MPI_REQUEST_NULL)
+            return MPI_ERR_REQUEST;
+        req_entry *e = (req_entry *)(intptr_t)array_of_requests[i];
+        if (e->is_pcoll && e->persistent && e->pyh == 0)
+            npc++;
+    }
+    if (npc > 1) {
+        GIL_BEGIN;
+        int rc = MPI_SUCCESS;
+        int *idx = (int *)malloc(sizeof(int) * npc);
+        PyObject *lst = PyList_New(0);
+        int k = 0;
+        if (!idx || !lst)
+            rc = MPI_ERR_INTERN;
+        for (int i = 0; rc == MPI_SUCCESS && i < count; i++) {
+            req_entry *e = (req_entry *)(intptr_t)array_of_requests[i];
+            if (!(e->is_pcoll && e->persistent && e->pyh == 0))
+                continue;
+            PyObject *v = PyLong_FromLong(e->pcoll_h);
+            if (!v || PyList_Append(lst, v) < 0) {
+                Py_XDECREF(v);
+                rc = MPI_ERR_INTERN;
+                break;
+            }
+            Py_DECREF(v);
+            idx[k++] = i;
+        }
+        if (rc == MPI_SUCCESS) {
+            PyObject *r = PyObject_CallMethod(g_mod, "pcoll_startall",
+                                              "O", lst);
+            if (!r || !PyList_Check(r)
+                || PyList_GET_SIZE(r) != (Py_ssize_t)npc) {
+                rc = r ? MPI_ERR_INTERN
+                       : handle_error("MPI_Startall");
+                Py_XDECREF(r);
+            } else {
+                for (int j = 0; j < npc; j++) {
+                    req_entry *e = (req_entry *)(intptr_t)
+                        array_of_requests[idx[j]];
+                    e->pyh = PyLong_AsLong(PyList_GET_ITEM(r, j));
+                }
+                Py_DECREF(r);
+            }
+        }
+        Py_XDECREF(lst);
+        free(idx);
+        GIL_END;
+        if (rc != MPI_SUCCESS)
+            return rc;
+        for (int i = 0; i < count; i++) {
+            req_entry *e = (req_entry *)(intptr_t)array_of_requests[i];
+            if (e->is_pcoll)
+                continue;                /* already launched above */
+            int src = PMPI_Start(&array_of_requests[i]);
+            if (src != MPI_SUCCESS)
+                return src;
+        }
+        return MPI_SUCCESS;
+    }
     for (int i = 0; i < count; i++) {
         int rc = PMPI_Start(&array_of_requests[i]);
         if (rc != MPI_SUCCESS)

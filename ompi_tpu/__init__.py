@@ -57,8 +57,9 @@ from ompi_tpu.api.mpi import (  # noqa: F401
     Init, Init_thread, Finalize, Initialized, Finalized, Abort,
     Query_thread, Get_processor_name, Wtime, Wtick, Get_version,
     get_comm_world, get_comm_self, COMM_NULL,
-    # request completion
+    # request completion + persistent start
     Wait, Test, Waitall, Waitany, Waitsome, Testall, Testany, Testsome,
+    Start, Startall,
     # helpers
     op_create, create_keyval, free_keyval, error_string, from_numpy_dtype,
     Grequest, INFO_ENV, INFO_NULL,

@@ -4396,9 +4396,54 @@ def _pcoll_register(thunk) -> int:
     return ph
 
 
+def _pcoll_prebind(name: str, *args):
+    """Pre-bound persistent-collective thunk (coll/persistent's cabi
+    leg): the handle->comm resolution, op mapping, and element-count
+    arithmetic the one-shot marshaller re-derives at every MPI_Start
+    run ONCE here; Start re-reads only the C buffer bytes (persistent
+    semantics: the app refills the registered buffer between rounds)
+    and dispatches the comm's nonblocking entry — which rides the
+    BucketFuser when ``mpi_base_bucket`` is on. Returns None when the
+    collective has no prebound form (generic re-dispatch glue)."""
+    if name == "allreduce":
+        h, view, dt, o = args
+        c, op = _comm(h), _op(o)
+        cnt = _count_of(view, dt)
+
+        def thunk():
+            snap = bytes(view)
+            return _icoll_handle(
+                c.iallreduce(_pack(view, dt, cnt), op), dt, snap)
+        return thunk
+    if name == "bcast":
+        h, view, dt, root = args
+        c = _comm(h)
+        cnt = _count_of(view, dt)
+        is_root = c.rank() == root
+
+        def thunk():
+            data = _pack(view, dt, cnt) if is_root else None
+            return _icoll_handle(c.ibcast(data, root), dt, bytes(view))
+        return thunk
+    if name == "barrier":
+        (h,) = args
+        c = _comm(h)
+        return lambda: _icoll_handle(c.ibarrier(), 4)
+    return None
+
+
 def pcoll_init(name: str, *args) -> int:
-    fn = globals()["i" + name]
-    return _pcoll_register(lambda: fn(*args))
+    thunk = None
+    try:
+        thunk = _pcoll_prebind(name, *args)
+    except MPIError:
+        raise                            # arg validation stays loud
+    except Exception:                    # noqa: BLE001 — prebind is an
+        thunk = None                     # optimization, never a gate
+    if thunk is None:
+        fn = globals()["i" + name]
+        thunk = lambda: fn(*args)        # noqa: E731
+    return _pcoll_register(thunk)
 
 
 def pcoll_alltoallw_init(h: int, sview, sc_v, sd_v, st_v, rview, rc_v,
@@ -4429,7 +4474,23 @@ def pcoll_start(ph: int) -> int:
     if thunk is None:
         raise MPIError(ERR_REQUEST,
                        "stale persistent-collective handle")
+    from ompi_tpu.coll import persistent as _persistent
+    _persistent._count("coll_persistent_starts")
     return thunk()
+
+
+def pcoll_startall(phs) -> list:
+    """MPI_Startall over persistent collectives: dispatch every
+    captured thunk inside one startall window, so bucketable
+    allreduces accumulated by the fuser flush at the boundary — K
+    small allreduces issue ceil(K*bytes/bucket_bytes) wire collectives
+    instead of K. Returns the inner request handles in call order."""
+    from ompi_tpu.coll import persistent as _persistent
+    out = []
+    with _persistent.startall_window():
+        for ph in phs:
+            out.append(pcoll_start(int(ph)))
+    return out
 
 
 def pcoll_free(ph: int) -> None:

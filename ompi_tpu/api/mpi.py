@@ -39,8 +39,8 @@ from ompi_tpu.core.convertor import (  # noqa: F401
     mpi_pack as Pack, mpi_unpack as Unpack, pack_external as Pack_external,
     unpack_external as Unpack_external, pack_size as Pack_size)
 from ompi_tpu.core.request import (Grequest, Request, Status,  # noqa: F401
-                                   testall, testany, testsome, waitall,
-                                   waitany, waitsome)
+                                   startall, testall, testany, testsome,
+                                   waitall, waitany, waitsome)
 from ompi_tpu.runtime import init as _rt
 
 ANY_SOURCE = -1
@@ -127,6 +127,17 @@ def get_comm_self() -> Communicator:
 # request completion (MPI_Wait/Test families) -----------------------------
 def Wait(request: Request) -> Status:
     return request.wait()
+
+
+def Start(request: Request) -> Request:
+    return request.start()
+
+
+def Startall(requests) -> None:
+    """MPI_Startall: bucketable persistent collectives fuse — they
+    enqueue into their communicator's BucketFuser and flush once at
+    the startall boundary (coll/persistent, docs/PERSISTENT.md)."""
+    startall(requests)
 
 
 def Test(request: Request):

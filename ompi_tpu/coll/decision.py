@@ -223,6 +223,32 @@ def compression_rules() -> Dict[str, List[Sequence]]:
             for func in sorted(COMPRESSIBLE)}
 
 
+# -- persistent/bucket gating (ompi_tpu/coll/persistent) --------------------
+def persistent_rules() -> Dict[str, List[Sequence]]:
+    """The pre-bound persistent-plan rows (MPI-4 ``*_init`` family),
+    keyed ``<func>_init``: one row per collective whose init builds a
+    launch-only plan — algorithm decided, executable compiled, staging
+    bound at init (docs/PERSISTENT.md). Unconditional capability, so
+    the rows are always present."""
+    from ompi_tpu.coll import persistent as _p
+    return {f"{func}_init": [[0, 0, "persistent_prebound"]]
+            for func in _p.PERSISTENT_FUNCS}
+
+
+def bucket_rules() -> Dict[str, List[Sequence]]:
+    """Effective bucket-fusion rows in the fixed-table shape; empty
+    when ``mpi_base_bucket`` is off (off = byte-identical unfused
+    dispatch). The threshold is a CEILING — payloads above
+    ``mpi_base_bucket_bytes`` never bucket — encoded in the algorithm
+    label since the rule shape only carries floors."""
+    from ompi_tpu.coll import persistent as _p
+    if not _p.bucket_enabled():
+        return {}
+    b = _p.bucket_bytes()
+    return {func: [[0, 0, f"bucket_fuse:<={b}B"]]
+            for func in sorted(_p.FUSED_FUNCS)}
+
+
 def decision_table(comm_size: int = 0, multihost: bool = False,
                    dynamic: Dict[str, Dict] | None = None,
                    platform: str = "") -> Dict[str, List[Sequence]]:
@@ -245,4 +271,8 @@ def decision_table(comm_size: int = 0, multihost: bool = False,
                 func, multihost, dynamic, platform)]
     for func, rows in compression_rules().items():
         table[func] = table[func] + [list(r) for r in rows]
+    for func, rows in bucket_rules().items():
+        table[func] = table[func] + [list(r) for r in rows]
+    for func, rows in persistent_rules().items():
+        table[func] = [list(r) for r in rows]
     return table

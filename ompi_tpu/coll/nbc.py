@@ -30,6 +30,13 @@ structure at 30x the blocking cost, VERDICT weak #2):
   libnbc's progress callback exists to emulate. The switch point is an
   MCA var (``coll_nbc_fused_min_bytes``), mirroring how coll/tuned
   picks algorithms by message size.
+- Small payloads can skip the schedule in the OTHER direction: with
+  ``mpi_base_bucket`` on, concurrent small iallreduces coalesce into
+  one flattened fused collective BEFORE reaching this component (the
+  DDP-style BucketFuser, ``coll/persistent.py`` — the communicator's
+  i-entry consults it ahead of the schedule winner; this module sees
+  only the unfused residue). The fuser's idle-flush sweep rides the
+  same progress engine these schedules dispatch through.
 """
 from __future__ import annotations
 

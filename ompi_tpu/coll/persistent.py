@@ -1,0 +1,674 @@
+"""coll/persistent — pre-bound persistent collectives + bucket fusion.
+
+Two mechanisms behind the MPI-4 persistent-collective family
+(``MPI_Allreduce_init`` …) that the glue used to fake by re-dispatching
+the one-shot nonblocking marshaller on every ``MPI_Start``:
+
+1. **Plan pre-binding** (the MPI Advance "planned collectives" shape):
+   everything a one-shot dispatch re-derives per call — the decision
+   tables (coll/decision), the compiled XLA executable
+   (``XlaCollModule._compiled`` via the module's bind/warm path), the
+   per-rank wire schedule (fold combiner, multicast descriptor
+   template, destination map — ``pml/perrank.bind_small_multicast``),
+   the staged-device route, and the compression-codec gates — binds
+   ONCE at ``*_init``. ``MPI_Start`` is launch-only.
+
+2. **Bucket fusion** (DDP-style gradient bucketing, the HiCCL
+   composition argument): concurrent small (i)allreduces on the same
+   (comm, op, dtype) coalesce into ONE flattened fused collective.
+   Buckets flush on the bytes threshold (``mpi_base_bucket_bytes``),
+   on the ``MPI_Startall`` boundary, on an explicit ``flush()``, or
+   when the progress engine spins with the bucket idle. Off by
+   default: with ``mpi_base_bucket`` off every collective result is
+   byte-identical to the unfused path.
+
+Observability: pvars ``coll_persistent_starts`` /
+``coll_bucket_flushes`` / ``coll_bucket_occupancy`` (plus per-reason
+flush counters), ``coll.bucket_flush`` hooks-namespace trace spans
+with the flush reason attributed, aggregated per reason by
+``tools/tracedump summary``. See docs/PERSISTENT.md.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import weakref
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ompi_tpu.core import op as op_mod
+from ompi_tpu.core.request import Request
+from ompi_tpu.mca import pvar, var
+from ompi_tpu.runtime import progress as prog
+from ompi_tpu.runtime import spc
+from ompi_tpu.trace import core as _trace
+from ompi_tpu.utils import hooks
+
+# The funcs with a pre-bound plan (tools/checkparity requires a
+# test_persistent_<func>_matches_unfused pair for every entry, enforced
+# in tier-1 exactly like the compress parity pairs).
+PERSISTENT_FUNCS = ("allreduce", "bcast", "allgather",
+                    "reduce_scatter_block", "barrier")
+# The funcs the BucketFuser coalesces (tools/checkparity requires a
+# test_bucketed_<func>_matches_unfused pair for every entry).
+FUSED_FUNCS = ("allreduce",)
+
+DEFAULT_BUCKET_BYTES = 1 << 20
+
+
+# -- config (MCA vars) ------------------------------------------------------
+def _register_vars() -> None:
+    var.var_register(
+        "mpi", "base", "bucket", vtype="bool", default=False,
+        help="Coalesce concurrent small same-(comm, op, dtype) "
+             "(i)allreduces into one flattened fused collective "
+             "(DDP-style gradient bucketing; docs/PERSISTENT.md). Off "
+             "means every collective is byte-identical to the unfused "
+             "path")
+    var.var_register(
+        "mpi", "base", "bucket_bytes", vtype="int",
+        default=DEFAULT_BUCKET_BYTES,
+        help="Bucket flush threshold in bytes (per-rank payload): a "
+             "bucket whose accumulated payload reaches this flushes "
+             "as one wire collective; payloads above it never bucket")
+
+
+_register_vars()
+
+
+def bucket_enabled() -> bool:
+    return bool(var.var_get("mpi_base_bucket", False))
+
+
+def bucket_bytes() -> int:
+    return int(var.var_get("mpi_base_bucket_bytes", DEFAULT_BUCKET_BYTES))
+
+
+# -- counters (MPI_T pvars) -------------------------------------------------
+_counts: Dict[str, int] = {
+    "coll_persistent_starts": 0,
+    "coll_bucket_flushes": 0,
+    "coll_bucket_fused_members": 0,
+    "coll_bucket_flush_bytes": 0,
+    "coll_bucket_flush_startall": 0,
+    "coll_bucket_flush_idle": 0,
+    "coll_bucket_flush_explicit": 0,
+}
+_live_fusers: "weakref.WeakSet[BucketFuser]" = weakref.WeakSet()
+
+
+def _count(name: str, n: int = 1) -> None:
+    # lock-free on purpose: Start is the launch-only hot path and a
+    # GIL-atomic dict increment is the whole cost (the SPC sharding
+    # argument, applied to one counter)
+    _counts[name] = _counts.get(name, 0) + n
+
+
+def _occupancy_bytes() -> int:
+    return sum(f.pending_bytes() for f in list(_live_fusers))
+
+
+def _register_pvars() -> None:
+    def reader(key):
+        return lambda: _counts.get(key, 0)
+
+    pvar.pvar_register("coll_persistent_starts",
+                       reader("coll_persistent_starts"),
+                       help="Persistent-collective MPI_Start launches "
+                            "through the pre-bound plan path")
+    pvar.pvar_register("coll_bucket_flushes",
+                       reader("coll_bucket_flushes"),
+                       help="Fused wire collectives issued by the "
+                            "BucketFuser (one per bucket flush)")
+    pvar.pvar_register("coll_bucket_fused_members",
+                       reader("coll_bucket_fused_members"),
+                       help="Member collectives coalesced into fused "
+                            "bucket launches")
+    for reason in ("bytes", "startall", "idle", "explicit"):
+        pvar.pvar_register(f"coll_bucket_flush_{reason}",
+                           reader(f"coll_bucket_flush_{reason}"),
+                           help=f"Bucket flushes triggered by: {reason}")
+    pvar.pvar_register("coll_bucket_occupancy", _occupancy_bytes,
+                       unit="bytes", var_class="level",
+                       help="Bytes currently pending in unflushed "
+                            "buckets across live fusers")
+
+
+_register_pvars()
+hooks.declare_event("coll.bucket_flush")
+
+
+# -- plans ------------------------------------------------------------------
+class CollPlan:
+    """A pre-bound persistent-collective plan: algorithm decided,
+    executable compiled, wire schedule / staging / codec gates bound at
+    ``*_init`` — Start is launch-only. ``fn``/``buf`` is the DIRECT
+    form (stacked tier): Start invokes the compiled callable and parks
+    its output arrays straight on the outer request — no inner-request
+    allocation, no tree walk. ``launch()`` is the general form
+    (per-rank plans, datatype fallbacks). ``payload``/``epilogue`` are
+    the bucket-fusion adapters (None = not fusable)."""
+
+    __slots__ = ("comm", "func", "launch", "fn", "buf", "op", "nbytes",
+                 "algorithm", "codec", "bucket_key", "payload",
+                 "epilogue", "__weakref__")
+
+    def __init__(self, comm, func: str,
+                 launch: Optional[Callable[[], Request]] = None, *,
+                 fn: Optional[Callable] = None, buf: Any = None,
+                 op=None, nbytes: int = 0, algorithm: str = "",
+                 codec: Optional[str] = None,
+                 bucket_key: Optional[Tuple] = None,
+                 payload: Optional[Callable[[], Any]] = None,
+                 epilogue: Optional[Callable[[Any], Any]] = None):
+        self.comm = comm
+        self.func = func
+        self.fn = fn
+        self.buf = buf
+        self.launch = launch if launch is not None else self._direct
+        self.op = op
+        self.nbytes = int(nbytes)
+        self.algorithm = algorithm
+        self.codec = codec
+        self.bucket_key = bucket_key
+        self.payload = payload
+        self.epilogue = epilogue
+
+    def _direct(self) -> Request:
+        """General-machinery fallback for a direct plan (the override
+        path in ``PersistentCollRequest.start`` normally short-circuits
+        this)."""
+        y = self.fn(self.buf) if self.buf is not None else self.fn()
+        return Request(result=y, arrays=y if isinstance(y, list)
+                       else [y])
+
+
+class PersistentCollRequest(Request):
+    """The request a persistent-collective ``*_init`` returns: Start
+    launches the pre-bound plan (or enqueues into the comm's bucket
+    when fusion is on); completion delegates to the launched inner
+    request exactly as the base persistent machinery does."""
+
+    def __init__(self, plan: CollPlan):
+        super().__init__(persistent_start=plan.launch)
+        self.plan = plan
+
+    def start(self) -> "PersistentCollRequest":
+        self._check_startable()
+        _count("coll_persistent_starts")
+        p = self.plan
+        if (p.bucket_key is not None and bucket_enabled()
+                and 0 < p.nbytes <= bucket_bytes()):
+            self._inner_req = fuser_of(p.comm).enqueue(
+                p.bucket_key, p.payload, p.epilogue, p.nbytes, p.op)
+        elif p.fn is not None:
+            # direct plan: the compiled callable's output arrays ARE
+            # the completion state — no inner request, no tree walk
+            y = p.fn(p.buf) if p.buf is not None else p.fn()
+            self._result = y
+            self._arrays = y if type(y) is list else [y]
+            self._inner_req = None
+        else:
+            self._inner_req = self._persistent_start()
+        self._complete = False
+        self._active = True
+        return self
+
+
+def _preselect_codec(func: str, nbytes: int, dtype_name,
+                     op=None) -> Optional[str]:
+    """Compression gate evaluation, hoisted to init time: the plan
+    records the codec the compressed path would pick so Start never
+    re-reads the gate vars (the codec itself still rides the selected
+    module's compressed executable)."""
+    from ompi_tpu.coll import decision
+    try:
+        if decision.compress_eligible(func, nbytes, str(dtype_name), op):
+            from ompi_tpu import compress
+            return compress.codec_name()
+    except Exception:                    # noqa: BLE001 — metadata only
+        pass
+    return None
+
+
+def _decide(comm, func: str, nbytes: int, multihost: bool) -> str:
+    from ompi_tpu.coll import decision
+    try:
+        platform = getattr(
+            getattr(comm, "devices", (None,))[0], "platform", "")
+        return decision.decide(func, comm.size, nbytes, multihost,
+                               None, platform)
+    except Exception:                    # noqa: BLE001 — metadata only
+        return "direct"
+
+
+def _bucket_spec(comm, data, op) -> Optional[Tuple]:
+    """(key, payload_fn, epilogue, per_rank_nbytes) when (comm-tier,
+    buffer, op) is bucket-fusable, else None. Fusion is elementwise
+    (the fused collective applies the same reduction per element), so
+    any real non-pair reduction qualifies; pair (MINLOC/MAXLOC) and
+    callback-only ops keep the unfused path."""
+    if (op is None or getattr(op, "fn", None) is None
+            or getattr(op, "is_loc", False)):
+        return None
+    if getattr(comm, "is_per_rank", False):
+        if (not isinstance(data, np.ndarray) or data.ndim == 0
+                or data.dtype.kind not in "fiub"):
+            return None
+        shape, dt = data.shape, data.dtype
+        return ((op.uid, dt.str),
+                lambda: np.ascontiguousarray(data).reshape(-1),
+                lambda flat: np.asarray(flat).reshape(shape),
+                int(data.nbytes))
+    # stacked single-controller: leading axis is the rank
+    n = comm.size
+    if getattr(data, "ndim", 0) < 1 or data.shape[0] != n:
+        return None
+    if np.dtype(data.dtype).kind not in "fiub":
+        return None
+    shape = tuple(data.shape)
+
+    def payload():
+        import jax.numpy as jnp
+        return jnp.reshape(jnp.asarray(data), (n, -1))
+
+    def epilogue(flat):
+        import jax.numpy as jnp
+        return jnp.reshape(flat, shape)
+
+    return ((op.uid, str(data.dtype)), payload, epilogue,
+            int(data.nbytes) // max(n, 1))
+
+
+# -- plan builders: stacked single-controller tier --------------------------
+def _stacked_plan(comm, func: str, *args) -> CollPlan:
+    import jax
+
+    multihost = bool(getattr(comm, "spans_processes", False))
+    if func == "barrier":
+        mod = comm._coll("barrier")
+        fn = getattr(mod, "_ibarrier_arrays", None)
+        if fn is not None:
+            jax.block_until_ready(fn())   # warm: stage token + compile
+            return CollPlan(comm, "barrier", fn=fn,
+                            algorithm=_decide(comm, "barrier", 0,
+                                              multihost))
+        if hasattr(mod, "ibarrier"):
+            launch = mod.ibarrier
+        else:
+            def launch():
+                mod.barrier()
+                return Request.completed()
+        return CollPlan(comm, "barrier", launch,
+                        algorithm=_decide(comm, "barrier", 0, multihost))
+
+    if func == "allreduce":
+        sendbuf, op = args
+        comm._validate_stacked(sendbuf)
+        comm._validate_op(op)
+        mod = comm._coll("allreduce")
+        dev = getattr(mod, "device", mod)
+        bind = getattr(dev, "bind_allreduce", None)
+        if bind is not None:
+            fn = bind(sendbuf, op)       # warm: decide + compile + memo
+        else:
+            fn = lambda buf: mod.allreduce(buf, op)   # noqa: E731
+            jax.block_until_ready(fn(sendbuf))
+        per_rank = int(sendbuf.nbytes) // max(comm.size, 1)
+        key, payload, epilogue = None, None, None
+        spec = _bucket_spec(comm, sendbuf, op)
+        if spec is not None:
+            key, payload, epilogue, per_rank = spec
+        return CollPlan(
+            comm, "allreduce", fn=fn, buf=sendbuf, op=op,
+            nbytes=per_rank,
+            algorithm=_decide(comm, "allreduce", per_rank, multihost),
+            codec=_preselect_codec("allreduce", per_rank,
+                                   sendbuf.dtype, op),
+            bucket_key=key, payload=payload, epilogue=epilogue)
+
+    if func == "bcast":
+        buf, root = args
+        comm._validate_stacked(buf)
+        comm._validate_root(root)
+        mod = comm._coll("bcast")
+        fn = lambda: mod.bcast(buf, root)             # noqa: E731
+        jax.block_until_ready(fn())                   # warm
+        per_rank = int(buf.nbytes) // max(comm.size, 1)
+        return CollPlan(comm, "bcast", fn=fn, nbytes=per_rank,
+                        algorithm=_decide(comm, "bcast", per_rank,
+                                          multihost))
+
+    if func == "allgather":
+        (sendbuf,) = args
+        comm._validate_stacked(sendbuf)
+        mod = comm._coll("allgather")
+        fn = lambda: mod.allgather(sendbuf)           # noqa: E731
+        jax.block_until_ready(fn())                   # warm
+        per_rank = int(sendbuf.nbytes) // max(comm.size, 1)
+        return CollPlan(comm, "allgather", fn=fn, nbytes=per_rank,
+                        algorithm=_decide(comm, "allgather", per_rank,
+                                          multihost),
+                        codec=_preselect_codec("allgather", per_rank,
+                                               sendbuf.dtype))
+
+    if func == "reduce_scatter_block":
+        sendbuf, op = args
+        comm._validate_stacked(sendbuf)
+        comm._validate_op(op)
+        mod = comm._coll("reduce_scatter_block")
+        fn = lambda: mod.reduce_scatter_block(sendbuf, op)  # noqa: E731
+        jax.block_until_ready(fn())                   # warm
+        per_rank = int(sendbuf.nbytes) // max(comm.size, 1)
+        return CollPlan(
+            comm, "reduce_scatter_block", fn=fn, op=op,
+            nbytes=per_rank,
+            algorithm=_decide(comm, "reduce_scatter_block", per_rank,
+                              multihost),
+            codec=_preselect_codec("reduce_scatter_block", per_rank,
+                                   sendbuf.dtype, op))
+
+    raise ValueError(f"no persistent plan for collective {func!r}")
+
+
+# -- plan builders: per-rank (multi-controller) tier ------------------------
+def _perrank_plan(comm, func: str, *args) -> CollPlan:
+    from ompi_tpu.core.rankcomm import RankCommunicator as RC
+    comm._check()
+
+    if func == "allreduce":
+        data, op = args
+        comm._validate_op(op)
+        nbytes = int(getattr(data, "nbytes", 0) or 0)
+        launch = None
+        algorithm = "generic"
+        if comm._stageable(data, op):
+            # staged-device route, bound once; the registered numpy
+            # buffer is re-read (and re-staged) at every Start
+            algorithm = "staged_device"
+
+            def body(_c=comm, _d=data, _op=op):
+                spc.record("coll_allreduce", 1)
+                spc.record("coll_staged_device", 1)
+                return np.asarray(_c._device_allreduce(
+                    np.ascontiguousarray(_d), _op))
+        elif comm._small_allreduce_ok(data, op):
+            # Start-only launcher: posts the slot + multicast inline;
+            # N outstanding starts pipeline on the wire
+            algorithm = "small_combine"
+            launch = comm.bind_small_allreduce(data, op)
+        else:
+            def body(_c=comm, _d=data, _op=op):
+                return RC.allreduce(_c, _d, _op)
+        if launch is None:
+            launch = lambda: comm._nb(body)          # noqa: E731
+        spec = _bucket_spec(comm, data, op)
+        key, payload, epilogue = spec[:3] if spec else (None, None, None)
+        return CollPlan(
+            comm, "allreduce", launch, op=op,
+            nbytes=nbytes, algorithm=algorithm,
+            codec=_preselect_codec("allreduce", nbytes,
+                                   getattr(data, "dtype", ""), op),
+            bucket_key=key, payload=payload, epilogue=epilogue)
+
+    body = getattr(RC, func, None)
+    if body is None:
+        raise ValueError(f"no persistent plan for collective {func!r}")
+    if func == "bcast":
+        comm._validate_root(args[1] if len(args) > 1 else 0)
+    if func in ("reduce_scatter_block",):
+        comm._validate_op(args[1] if len(args) > 1 else op_mod.SUM)
+    return CollPlan(comm, func,
+                    lambda: comm._nb(body, comm, *args),
+                    nbytes=int(getattr(args[0], "nbytes", 0) or 0)
+                    if args else 0,
+                    algorithm="host")
+
+
+def coll_init(comm, func: str, *args) -> PersistentCollRequest:
+    """Build the pre-bound plan for ``func`` on ``comm`` and return the
+    persistent request. Collective: every member calls the ``*_init``
+    together (the warm-up executes one collective on the spot, which
+    the MPI-4 init contract permits and the plan cache requires)."""
+    if getattr(comm, "is_per_rank", False):
+        plan = _perrank_plan(comm, func, *args)
+    else:
+        plan = _stacked_plan(comm, func, *args)
+    return PersistentCollRequest(plan)
+
+
+# -- bucket fusion ----------------------------------------------------------
+class _BucketMemberReq(Request):
+    """One member of a fused bucket: completed by the flush with its
+    slice of the fused result. ``wait`` force-flushes its own bucket
+    (reason ``idle``) so a member can never deadlock on an unreached
+    threshold."""
+
+    def __init__(self, fuser: "BucketFuser", key):
+        super().__init__(arrays=[])
+        self._complete = False
+        self._event = threading.Event()
+        self._fuser = fuser
+        self._key = key
+        self._error: Optional[BaseException] = None
+
+    def _deliver(self, result) -> None:
+        self._result = result
+        self._complete = True
+        self._event.set()
+
+    def _fail(self, err: BaseException) -> None:
+        self._error = err
+        self._complete = True
+        self._event.set()
+
+    def test(self):
+        if self._complete:
+            if self._error is not None:
+                raise self._error
+            return True, self.status
+        return False, None
+
+    def wait(self, timeout: Optional[float] = None):
+        if not self._complete:
+            self._fuser.flush_key(self._key, "idle")
+            self._event.wait(timeout if timeout is not None else 600)
+        if self._error is not None:
+            raise self._error
+        return self.status
+
+    def get(self):
+        self.wait()
+        return self._result
+
+
+class BucketFuser:
+    """Per-communicator small-collective fuser (DDP-style gradient
+    bucketing): members on the same (op, dtype) key accumulate until a
+    flush trigger, then ride ONE flattened fused allreduce. Flush
+    triggers and correctness contract are documented in
+    docs/PERSISTENT.md — on per-rank comms every flush trigger is a
+    deterministic point of the program order (enqueue count, startall,
+    wait), so all ranks fuse identical buckets; the progress-idle
+    sweep is single-controller-only for exactly that reason."""
+
+    def __init__(self, comm):
+        self.comm = comm
+        self._per_rank = bool(getattr(comm, "is_per_rank", False))
+        self._lock = threading.RLock()
+        # key -> [(member_req, payload_fn, epilogue, nbytes)]
+        self._items: Dict[Tuple, List[Tuple]] = {}
+        self._bytes: Dict[Tuple, int] = {}
+        self._ops: Dict[Tuple, Any] = {}
+        self._cb_registered = False
+        _live_fusers.add(self)
+
+    def pending_bytes(self) -> int:
+        with self._lock:
+            return sum(self._bytes.values())
+
+    def enqueue(self, key, payload_fn, epilogue, nbytes,
+                op) -> _BucketMemberReq:
+        req = _BucketMemberReq(self, key)
+        with self._lock:
+            self._items.setdefault(key, []).append(
+                (req, payload_fn, epilogue, int(nbytes)))
+            self._bytes[key] = self._bytes.get(key, 0) + int(nbytes)
+            self._ops[key] = op
+            full = self._bytes[key] >= bucket_bytes()
+            if not self._per_rank and not self._cb_registered:
+                # single-controller only: the progress engine's spin is
+                # rank-local, so an idle sweep there would fuse
+                # different buckets on different ranks of a per-rank
+                # world (per-rank worlds flush at deterministic
+                # program points instead: bytes / startall / wait)
+                prog.register(self._progress_cb, low_priority=True)
+                self._cb_registered = True
+        if full:
+            self.flush_key(key, "bytes")
+        return req
+
+    def _progress_cb(self) -> int:
+        n = self.flush("idle")
+        with self._lock:
+            if not any(self._items.values()) and self._cb_registered:
+                prog.unregister(self._progress_cb)
+                self._cb_registered = False
+        return n
+
+    def flush(self, reason: str = "explicit") -> int:
+        with self._lock:
+            keys = [k for k, v in self._items.items() if v]
+        return sum(self.flush_key(k, reason) for k in keys)
+
+    def flush_key(self, key, reason: str) -> int:
+        """Flush one bucket as ONE fused wire collective; returns the
+        number of wire collectives issued (0 when already empty)."""
+        with self._lock:
+            items = self._items.pop(key, None)
+            total = self._bytes.pop(key, 0)
+            op = self._ops.get(key)
+        if not items:
+            return 0
+        _count("coll_bucket_flushes")
+        _count(f"coll_bucket_flush_{reason}",)
+        _count("coll_bucket_fused_members", len(items))
+        hooks.fire("coll.bucket_flush", self.comm,
+                   {"reason": reason, "members": len(items),
+                    "bytes": total})
+
+        def run():
+            tok = (_trace.begin("coll.bucket_flush",
+                                cid=getattr(self.comm, "cid", None),
+                                reason=reason, members=len(items),
+                                nbytes=total)
+                   if _trace.active else None)
+            try:
+                self._launch_fused(items, op)
+            except BaseException as e:   # noqa: BLE001 — members raise
+                for req, _pf, _ep, _nb in items:
+                    req._fail(e)
+            finally:
+                if tok is not None:
+                    _trace.end(tok)
+
+        if self._per_rank:
+            # on the comm's serial collective worker: the fused op
+            # draws its sequence tag in issue order against every
+            # other collective on this comm
+            self.comm._coll_submit(run)
+        else:
+            run()
+        return 1
+
+    def _launch_fused(self, items: List[Tuple], op) -> None:
+        if self._per_rank:
+            from ompi_tpu.core.rankcomm import RankCommunicator as RC
+            flats = [np.ascontiguousarray(pf()).reshape(-1)
+                     for _req, pf, _ep, _nb in items]
+            fused = RC.allreduce(
+                self.comm,
+                flats[0] if len(flats) == 1 else np.concatenate(flats),
+                op)
+            fused = np.asarray(fused)
+            off = 0
+            for (req, _pf, ep, _nb), flat in zip(items, flats):
+                ln = flat.shape[0]
+                req._deliver(ep(fused[off:off + ln]))
+                off += ln
+            return
+        import jax.numpy as jnp
+        parts = [pf() for _req, pf, _ep, _nb in items]   # (n, w_i)
+        fused = self.comm._coll("allreduce").allreduce(
+            parts[0] if len(parts) == 1
+            else jnp.concatenate(parts, axis=1), op)
+        off = 0
+        for (req, _pf, ep, _nb), part in zip(items, parts):
+            w = part.shape[1]
+            req._deliver(ep(fused[:, off:off + w]))
+            off += w
+
+
+def fuser_of(comm) -> BucketFuser:
+    f = getattr(comm, "_bucket_fuser", None)
+    if f is None:
+        f = comm._bucket_fuser = BucketFuser(comm)
+    return f
+
+
+def maybe_bucket_iallreduce(comm, data, op) -> Optional[Request]:
+    """One-shot iallreduce bucketing: when ``mpi_base_bucket`` is on
+    and the payload fuses, enqueue into the comm's fuser and return
+    the member request; None keeps the unfused path. The caller has
+    already validated (comm, data, op)."""
+    if not bucket_enabled():
+        return None
+    spec = _bucket_spec(comm, data, op)
+    if spec is None or not (0 < spec[3] <= bucket_bytes()):
+        return None
+    key, payload, epilogue, nbytes = spec
+    return fuser_of(comm).enqueue(key, payload, epilogue, nbytes, op)
+
+
+def startall(requests) -> Any:
+    """MPI_Startall: start every request in order; bucketable
+    persistent collectives enqueue (flushing on the bytes threshold as
+    they accumulate) and any remainder flushes once at the startall
+    boundary — K bucketable allreduces of b bytes issue
+    ceil(K*b/bucket_bytes) wire collectives."""
+    touched: List[BucketFuser] = []
+    for r in requests:
+        r.start()
+        inner = getattr(r, "_inner_req", None)
+        if isinstance(inner, _BucketMemberReq) and not inner._complete:
+            touched.append(inner._fuser)
+    seen: set = set()
+    for f in touched:
+        if id(f) not in seen:
+            seen.add(id(f))
+            f.flush("startall")
+    return requests
+
+
+def flush_all(reason: str = "explicit") -> int:
+    """Flush every live fuser's pending buckets (the explicit-flush
+    entry and the cabi Startall window boundary)."""
+    return sum(f.flush(reason) for f in list(_live_fusers))
+
+
+@contextlib.contextmanager
+def startall_window():
+    """Bundle a burst of persistent starts (the cabi MPI_Startall
+    path): buckets accumulated inside the window flush once at its
+    boundary with reason ``startall``."""
+    try:
+        yield
+    finally:
+        flush_all("startall")
+
+
+def counters() -> Dict[str, int]:
+    """Snapshot of the persistent/bucket counters (tests, tools)."""
+    with _count_lock:
+        return dict(_counts)

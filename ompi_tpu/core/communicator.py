@@ -706,6 +706,16 @@ class Communicator:
 
     def iallreduce(self, sendbuf, op=op_mod.SUM, **kw) -> Request:
         if not kw:
+            from ompi_tpu.coll import persistent as _pcoll
+            if _pcoll.bucket_enabled():
+                # DDP-style bucket fusion: concurrent small
+                # iallreduces on the same (op, dtype) coalesce into
+                # one flattened wire collective (docs/PERSISTENT.md)
+                self._validate_stacked(sendbuf)
+                self._validate_op(op)
+                r = _pcoll.maybe_bucket_iallreduce(self, sendbuf, op)
+                if r is not None:
+                    return r
             m = self._isched("iallreduce")
             if m is not None:
                 self._validate_stacked(sendbuf)
@@ -779,7 +789,14 @@ class Communicator:
         return Request.completed()
 
     # -- persistent collectives (MPI-4 MPI_Allreduce_init etc.) --------
+    # Contiguous-buffer inits build a pre-bound plan (coll/persistent:
+    # algorithm decided, executable compiled, codec gates evaluated at
+    # init; Start is launch-only, and bucketable starts fuse). The
+    # datatype/count forms keep the generic re-dispatch marshaller.
     def allreduce_init(self, sendbuf, op=op_mod.SUM, **kw) -> Request:
+        if not kw:
+            from ompi_tpu.coll import persistent as _pcoll
+            return _pcoll.coll_init(self, "allreduce", sendbuf, op)
         return Request(persistent_start=lambda: self.iallreduce(
             sendbuf, op, **kw))
 
@@ -803,7 +820,23 @@ class Communicator:
         return bind(example, op)
 
     def bcast_init(self, buf, root: int = 0, **kw) -> Request:
+        if not kw:
+            from ompi_tpu.coll import persistent as _pcoll
+            return _pcoll.coll_init(self, "bcast", buf, root)
         return Request(persistent_start=lambda: self.ibcast(buf, root, **kw))
+
+    def allgather_init(self, sendbuf) -> Request:
+        from ompi_tpu.coll import persistent as _pcoll
+        return _pcoll.coll_init(self, "allgather", sendbuf)
+
+    def reduce_scatter_block_init(self, sendbuf,
+                                  op=op_mod.SUM) -> Request:
+        from ompi_tpu.coll import persistent as _pcoll
+        return _pcoll.coll_init(self, "reduce_scatter_block", sendbuf, op)
+
+    def barrier_init(self) -> Request:
+        from ompi_tpu.coll import persistent as _pcoll
+        return _pcoll.coll_init(self, "barrier")
 
     # ==================================================================
     # Point-to-point (pml framework; matching spec pml_ob1_recvfrag.c)

@@ -122,6 +122,52 @@ def register_tls_propagator(
     _TLS_PROPAGATORS.append(capture)
 
 
+class _SlotRequest(Request):
+    """A request completed by a posted CombineSlot (the persistent
+    small-allreduce's Start residue): wait blocks on the slot's event,
+    collects the rank-ordered fold, and retires the slot's tag."""
+
+    __slots__ = ("_eng", "_tag_", "_slot", "_epilogue")
+
+    def __init__(self, eng, tag: int, slot, epilogue):
+        super().__init__(arrays=[])
+        self._complete = False
+        self._eng = eng
+        self._tag_ = tag
+        self._slot = slot
+        self._epilogue = epilogue
+
+    def _collect(self) -> None:
+        try:
+            out = self._slot.wait()      # set already: returns/raises
+        finally:
+            self._eng.end_combine(self._tag_)
+            self._complete = True
+        self._result = self._epilogue(out)
+
+    def test(self):
+        if not self._complete:
+            if not self._slot._event.is_set():
+                return False, None
+            self._collect()
+        return True, self.status
+
+    def wait(self, timeout: Optional[float] = None):
+        if not self._complete:
+            try:
+                out = self._slot.wait(
+                    timeout if timeout is not None else 600)
+            finally:
+                self._eng.end_combine(self._tag_)
+                self._complete = True
+            self._result = self._epilogue(out)
+        return self.status
+
+    def get(self):
+        self.wait()
+        return self._result
+
+
 def _serialized(fn):
     """Collective-execution serializer — applied to every public
     collective entry that (transitively) draws the comm's sequence
@@ -510,6 +556,24 @@ class RankCommunicator:
         engine's cached header templates (``send_small``)."""
         n, r, t = self.size, self._rank, self._tag()
         eng = self._coll_pml
+        fold = self._small_fold_for(op)
+        slot = eng.post_combine(t, n, n - 1, fold, own=(r, data))
+        try:
+            eng.send_small(data, [(r + off) % n for off in range(1, n)],
+                           t)
+            out = slot.wait()
+        finally:
+            eng.end_combine(t)
+        if not isinstance(data, np.ndarray) and (
+                isinstance(out, np.generic)
+                or (isinstance(out, np.ndarray) and out.ndim == 0)):
+            out = out.item()             # scalar in, python scalar out
+        return out
+
+    def _small_fold_for(self, op: op_mod.Op) -> Callable:
+        """The memoized deterministic rank-order fold for ``op`` (the
+        sub-eager dispatch cache's combiner leg, shared by the one-shot
+        small path and the persistent plan prebinding)."""
         fold = self._small_fold.get(op.uid)
         if fold is None:
             npfn = (op_mod.NP_COMBINERS.get(op.name)
@@ -527,19 +591,47 @@ class RankCommunicator:
                         acc = _apply(op, acc, v)
                     return acc
             self._small_fold[op.uid] = fold
+        return fold
 
-        slot = eng.post_combine(t, n, n - 1, fold, own=(r, data))
-        try:
-            eng.send_small(data, [(r + off) % n for off in range(1, n)],
-                           t)
-            out = slot.wait()
-        finally:
-            eng.end_combine(t)
-        if not isinstance(data, np.ndarray) and (
-                isinstance(out, np.generic)
-                or (isinstance(out, np.ndarray) and out.ndim == 0)):
-            out = out.item()             # scalar in, python scalar out
-        return out
+    def bind_small_allreduce(self, data: Any, op: op_mod.Op) -> Callable:
+        """Pre-bound persistent small-allreduce launcher
+        (coll/persistent): the fold combiner, destination ring, and the
+        engine's multicast template resolve ONCE here. The returned
+        launcher is Start-only — it draws the sequence tag (through
+        the serialized chokepoint so tag order can never race deferred
+        i-collectives), posts the combining slot, and multicasts this
+        rank's contribution; completion rides the slot through the
+        returned request. N outstanding starts therefore PIPELINE:
+        every contribution is on the wire before the first wait, and
+        reader threads feed all N slots concurrently. ``data`` (the
+        registered buffer, refilled by the app between rounds) is
+        re-read at every Start."""
+        n, r = self.size, self._rank
+        fold = self._small_fold_for(op)
+        dests = [(r + off) % n for off in range(1, n)]
+        eng = self._coll_pml
+        send = eng.bind_small_multicast(data, dests)
+        scalar_in = not isinstance(data, np.ndarray)
+
+        def epilogue(out):
+            if scalar_in and (isinstance(out, np.generic)
+                              or (isinstance(out, np.ndarray)
+                                  and out.ndim == 0)):
+                out = out.item()
+            return out
+
+        def post():
+            spc.record("coll_allreduce", 1)
+            spc.record("coll_small_combine", 1)
+            t = self._tag()
+            slot = eng.post_combine(t, n, n - 1, fold, own=(r, data))
+            send(data, t)
+            return t, slot
+
+        def launch() -> Request:
+            t, slot = self._coll_serial(post)
+            return _SlotRequest(eng, t, slot, epilogue)
+        return launch
 
     def _small_allreduce_ok(self, data: Any, op: op_mod.Op) -> bool:
         from ompi_tpu.coll.tuned import small_allreduce_limits
@@ -915,6 +1007,15 @@ class RankCommunicator:
         return self._nb(RankCommunicator.bcast, self, data, root)
 
     def iallreduce(self, data: Any, op: op_mod.Op = op_mod.SUM) -> Request:
+        from ompi_tpu.coll import persistent as _pcoll
+        if _pcoll.bucket_enabled():
+            # DDP-style bucket fusion (docs/PERSISTENT.md): concurrent
+            # small iallreduces on one (op, dtype) ride a single fused
+            # wire collective; flush points are deterministic program
+            # points so every rank fuses the identical bucket
+            r = _pcoll.maybe_bucket_iallreduce(self, data, op)
+            if r is not None:
+                return r
         return self._nb(RankCommunicator.allreduce, self, data, op)
 
     def iallgather(self, data: Any) -> Request:
@@ -923,6 +1024,37 @@ class RankCommunicator:
     def ireduce(self, data: Any, op: op_mod.Op = op_mod.SUM,
                 root: int = 0) -> Request:
         return self._nb(RankCommunicator.reduce, self, data, op, root)
+
+    # -- persistent collectives (MPI-4 *_init; coll/persistent) --------
+    # The plan — route decision, fold combiner, multicast template,
+    # staged-device executable, codec gates — binds once at init;
+    # Start is launch-only and bucketable starts fuse (Startall).
+    def allreduce_init(self, data: Any,
+                       op: op_mod.Op = op_mod.SUM) -> Request:
+        self._check()
+        from ompi_tpu.coll import persistent as _pcoll
+        return _pcoll.coll_init(self, "allreduce", data, op)
+
+    def bcast_init(self, data: Any = None, root: int = 0) -> Request:
+        self._check()
+        from ompi_tpu.coll import persistent as _pcoll
+        return _pcoll.coll_init(self, "bcast", data, root)
+
+    def allgather_init(self, data: Any) -> Request:
+        self._check()
+        from ompi_tpu.coll import persistent as _pcoll
+        return _pcoll.coll_init(self, "allgather", data)
+
+    def reduce_scatter_block_init(self, chunks: Sequence[Any],
+                                  op: op_mod.Op = op_mod.SUM) -> Request:
+        self._check()
+        from ompi_tpu.coll import persistent as _pcoll
+        return _pcoll.coll_init(self, "reduce_scatter_block", chunks, op)
+
+    def barrier_init(self) -> Request:
+        self._check()
+        from ompi_tpu.coll import persistent as _pcoll
+        return _pcoll.coll_init(self, "barrier")
 
     # ==================================================================
     # Collectives — device tier (XLA over the global mesh)

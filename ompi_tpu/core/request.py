@@ -17,6 +17,8 @@ from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import jax
 
+from ompi_tpu.core.errhandler import ERR_REQUEST, MPIError
+
 
 class Status:
     """MPI_Status: source, tag, error, element count."""
@@ -72,6 +74,7 @@ class Request:
         self._on_complete = on_complete
         self._complete = arrays is None
         self._freed = False
+        self._free_pending = False
         self.status = status or Status()
         self._persistent_start = persistent_start
         self._active = persistent_start is None
@@ -83,6 +86,12 @@ class Request:
             cb, self._on_complete = self._on_complete, None
             self._result = cb(self._result)
         self._complete = True
+        if self._free_pending:
+            # MPI_Request_free was called while the operation was in
+            # flight: the deallocation completes with the operation
+            # (request_free.c.in deferred-free semantics)
+            self._free_pending = False
+            self._freed = True
 
     def test(self) -> Tuple[bool, Optional[Status]]:
         """MPI_Test: non-blocking completion check."""
@@ -131,12 +140,35 @@ class Request:
             self.status.cancelled = False
 
     def free(self) -> None:
+        """MPI_Request_free. On an ACTIVE request (started, not yet
+        completed) the free is DEFERRED: the operation runs to
+        completion and the handle is released then — but it is
+        unusable (un-startable) from this call on, exactly the
+        standard's contract."""
+        if self._active and not self._complete:
+            self._free_pending = True
+            return
         self._freed = True
 
     # -- persistent requests (MPI_Send_init / MPI_Start) -------------------
-    def start(self) -> "Request":
+    def _check_startable(self) -> None:
+        """MPI_Start argument checks (start.c.in:56-70): the request
+        must be persistent, not freed (or free-pending), and INACTIVE —
+        starting an already-active persistent request is
+        MPI_ERR_REQUEST, not a silent second dispatch."""
         if self._persistent_start is None:
-            raise ValueError("not a persistent request")
+            raise MPIError(ERR_REQUEST,
+                           "MPI_Start on a non-persistent request")
+        if self._freed or self._free_pending:
+            raise MPIError(ERR_REQUEST,
+                           "MPI_Start on a freed request")
+        if self._active and not self._complete:
+            raise MPIError(ERR_REQUEST,
+                           "MPI_Start on an active persistent request "
+                           "(complete it with MPI_Wait/MPI_Test first)")
+
+    def start(self) -> "Request":
+        self._check_startable()
         self._inner_req = self._persistent_start()
         self._complete = False
         self._active = True
@@ -176,6 +208,17 @@ class Grequest(Request):
 # -- wait/test families (request.h:311-430) --------------------------------
 def waitall(requests: Sequence[Request]) -> List[Status]:
     return [r.wait() for r in requests]
+
+
+def startall(requests: Sequence[Request]) -> Sequence[Request]:
+    """MPI_Startall. Persistent COLLECTIVES on the same communicator
+    coalesce: bucketable ones enqueue into the comm's BucketFuser and
+    flush once at the startall boundary — K small allreduces ride
+    ceil(K*bytes/bucket_bytes) wire collectives instead of K
+    (coll/persistent, docs/PERSISTENT.md). Everything else starts
+    singly, in order."""
+    from ompi_tpu.coll import persistent as _pcoll
+    return _pcoll.startall(requests)
 
 
 UNDEFINED = -32766

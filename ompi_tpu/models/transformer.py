@@ -369,18 +369,82 @@ def pp_train_step(params, batch, cfg: Config, lr: float, *,
 def sgd_train_step(params, batch, cfg: Config, lr: float,
                    dp_comm: Optional[InGraphComm] = None,
                    tp_comm: Optional[InGraphComm] = None,
-                   sp_comm: Optional[InGraphComm] = None):
+                   sp_comm: Optional[InGraphComm] = None,
+                   grad_sync: Optional["BucketedGradSync"] = None):
     """One DP x TP x SP training step. Gradient synchronization follows
     the strategy table (SURVEY.md §2.6): grads allreduced (mean) over dp
     and over sp (each sp rank saw 1/n of the sequence); tp correctness
     comes from the Megatron f/g operators inside ``forward``.
-    ``batch`` = (inputs, targets), pre-shifted."""
+    ``batch`` = (inputs, targets), pre-shifted.
+
+    ``grad_sync`` replaces the in-graph dp pmean with DDP-style
+    bucketed persistent allreduces over the framework's communicator
+    tier (one fused wire collective per gradient bucket instead of one
+    collective per tensor — docs/PERSISTENT.md)."""
     inputs, targets = batch
     loss, grads = jax.value_and_grad(loss_fn)(params, inputs, targets,
                                               cfg, tp_comm, sp_comm)
-    for comm in (sp_comm, dp_comm):
+    for comm in (sp_comm, dp_comm if grad_sync is None else None):
         if comm is not None:
             grads = jax.tree_util.tree_map(lambda g: comm.pmean(g), grads)
             loss = comm.pmean(loss)
+    if grad_sync is not None:
+        grads = grad_sync(grads)
+        loss = grad_sync.mean_scalar(loss)
     params = jax.tree_util.tree_map(lambda p, g: p - lr * g, params, grads)
     return params, loss
+
+
+class BucketedGradSync:
+    """DDP-style gradient synchronization over bucketed persistent
+    allreduces (coll/persistent, docs/PERSISTENT.md).
+
+    Built once per (comm, gradient tree shape): each leaf gets a
+    pinned numpy staging buffer and a persistent allreduce plan
+    (``comm.allreduce_init``), so every step is copy-in -> one
+    ``Startall`` (buckets fuse into ceil(total/bucket_bytes) wire
+    collectives when ``mpi_base_bucket`` is on; byte-identical
+    per-leaf collectives when off) -> copy-out. Works on both
+    communicator tiers: on a per-rank comm each leaf is this rank's
+    local gradient; on the stacked single-controller comm each leaf
+    carries the leading rank axis."""
+
+    def __init__(self, comm, grads_example):
+        import numpy as np
+        from ompi_tpu.core import op as _op
+        self.comm = comm
+        self.n = comm.size
+        leaves, self._treedef = jax.tree_util.tree_flatten(grads_example)
+        self._stages = [np.zeros(tuple(g.shape),
+                                 np.dtype(jnp.asarray(g).dtype))
+                        for g in leaves]
+        self._reqs = [comm.allreduce_init(s, _op.SUM)
+                      for s in self._stages]
+        self._scalar_req = None
+
+    def __call__(self, grads):
+        import numpy as np
+        from ompi_tpu.core.request import startall
+        leaves = jax.tree_util.tree_leaves(grads)
+        for stage, g in zip(self._stages, leaves):
+            np.copyto(stage, np.asarray(g))
+        startall(self._reqs)
+        out = [np.asarray(r.get()) / self.n for r in self._reqs]
+        return jax.tree_util.tree_unflatten(self._treedef, out)
+
+    def mean_scalar(self, value):
+        """Mean one scalar (the loss) over the comm — rides the same
+        persistent machinery through a lazily-built 1-elem plan."""
+        import numpy as np
+        from ompi_tpu.core import op as _op
+        if self._scalar_req is None:
+            shape = tuple(np.shape(value)) or ()
+            self._scalar_stage = np.zeros(
+                (self.n,) + shape if not getattr(
+                    self.comm, "is_per_rank", False) else shape,
+                np.float64)
+            self._scalar_req = self.comm.allreduce_init(
+                self._scalar_stage, _op.SUM)
+        np.copyto(self._scalar_stage, np.asarray(value, np.float64))
+        self._scalar_req.start()
+        return np.asarray(self._scalar_req.get()) / self.n

@@ -1,18 +1,26 @@
-"""checkparity — CI audit for the compressed-collective test contract.
+"""checkparity — CI audit for the collective test-parity contracts.
 
-Two invariants the compression subsystem must never lose
-(docs/COMPRESSION.md, docs/PARITY.md):
+Invariants the lossy/fused subsystems must never lose
+(docs/COMPRESSION.md, docs/PERSISTENT.md, docs/PARITY.md):
 
-1. **Parity coverage**: every collective the ``coll/compressed``
+1. **Compression parity**: every collective the ``coll/compressed``
    component wraps (``WRAPPED_FUNCS``) has a paired
    uncompressed-equivalence test — a test named
    ``test_compressed_<func>_matches_uncompressed`` somewhere under
    ``tests/``. A compressed schedule without its equivalence test is
    an unverified lossy path.
-2. **Tier-1 budget**: compression tests that spawn real OS processes
-   (``subprocess``-using test functions in ``tests/test_compress*``)
-   carry the ``slow`` marker, so the multi-process jobs stay out of
-   the ``-m 'not slow'`` tier-1 run and its 870 s wall budget.
+2. **Persistent/fused parity**: every collective with a pre-bound
+   persistent plan (``coll/persistent.PERSISTENT_FUNCS``) has a
+   ``test_persistent_<func>_matches_unfused`` pair, and every
+   bucket-fused collective (``FUSED_FUNCS``) has a
+   ``test_bucketed_<func>_matches_unfused`` pair — a fused wire path
+   without its equivalence test is an unverified rewrite of the
+   collective's result.
+3. **Tier-1 budget**: compression/persistent tests that spawn real OS
+   processes (``subprocess``-using test functions in
+   ``tests/test_compress*`` / ``tests/test_persistent*``) carry the
+   ``slow`` marker, so the multi-process jobs stay out of the
+   ``-m 'not slow'`` tier-1 run and its 870 s wall budget.
 
 Usage::
 
@@ -86,10 +94,16 @@ def _module_slow_pytestmark(path: str) -> bool:
 def audit(tests_dir: Optional[str] = None) -> Dict[str, Any]:
     tests_dir = tests_dir or os.path.join(_REPO, "tests")
     from ompi_tpu.coll.compressed import WRAPPED_FUNCS
+    from ompi_tpu.coll.persistent import FUSED_FUNCS, PERSISTENT_FUNCS
 
     wanted = {f"test_compressed_{func}_matches_uncompressed": func
               for func in WRAPPED_FUNCS}
+    wanted_pers = {f"test_persistent_{func}_matches_unfused": func
+                   for func in PERSISTENT_FUNCS}
+    wanted_pers.update({f"test_bucketed_{func}_matches_unfused": func
+                        for func in FUSED_FUNCS})
     found: set = set()
+    found_pers: set = set()
     unmarked: List[str] = []
     for path in sorted(glob.glob(os.path.join(tests_dir, "**", "*.py"),
                                  recursive=True)):
@@ -98,14 +112,20 @@ def audit(tests_dir: Optional[str] = None) -> Dict[str, Any]:
         for name, node in _test_functions(path) or ():
             if name in wanted:
                 found.add(name)
-            if base.startswith("test_compress") \
+            if name in wanted_pers:
+                found_pers.add(name)
+            if base.startswith(("test_compress", "test_persistent")) \
                     and _uses_subprocess(node) \
                     and not (mod_slow or _has_slow_mark(node)):
                 unmarked.append(f"{base}::{name}")
     missing = sorted(set(wanted) - found)
-    return {"ok": not missing and not unmarked,
+    missing_pers = sorted(set(wanted_pers) - found_pers)
+    return {"ok": not missing and not missing_pers and not unmarked,
             "wrapped_funcs": list(WRAPPED_FUNCS),
+            "persistent_funcs": list(PERSISTENT_FUNCS),
+            "fused_funcs": list(FUSED_FUNCS),
             "missing_parity": missing,
+            "missing_persistent_parity": missing_pers,
             "unmarked_slow": sorted(unmarked)}
 
 

@@ -159,6 +159,30 @@ def compress_by_rank(spans: Iterable[SpanLike]) -> Dict[str, Any]:
     return agg
 
 
+def bucket_flushes_by_reason(spans: Iterable[SpanLike]
+                             ) -> Dict[str, Any]:
+    """Aggregate ``coll.bucket_flush`` spans by flush reason (bytes /
+    startall / idle / explicit — coll/persistent's BucketFuser):
+    count, fused member collectives, fused bytes, and span time per
+    reason. Empty dict when no bucket fusion ran — the summary omits
+    the section entirely."""
+    agg: Dict[str, Dict[str, Any]] = {}
+    for s in spans:
+        if str(_field(s, "name", "?")) != "coll.bucket_flush":
+            continue
+        args = _field(s, "args", None) or {}
+        reason = str(args.get("reason", "?"))
+        e = agg.setdefault(reason, {"flushes": 0, "members": 0,
+                                    "bytes": 0, "total_us": 0.0})
+        e["flushes"] += 1
+        e["members"] += int(args.get("members", 0) or 0)
+        e["bytes"] += int(args.get("nbytes", 0) or 0)
+        e["total_us"] += max(float(_field(s, "dur", 0.0)), 0.0) * 1e6
+    for e in agg.values():
+        e["total_us"] = round(e["total_us"], 2)
+    return agg
+
+
 def summarize(spans: Iterable[SpanLike],
               stats: Optional[Mapping[str, int]] = None,
               top: int = 5) -> Dict[str, Any]:
@@ -186,6 +210,9 @@ def summarize(spans: Iterable[SpanLike],
     comp = compress_by_rank(spans)
     if comp:
         out["compress"] = comp
+    buck = bucket_flushes_by_reason(spans)
+    if buck:
+        out["bucket_flush"] = buck
     if reports:
         out["late_arrival_top"] = reports[:top]
     return out

@@ -1,0 +1,261 @@
+"""BucketFuser (coll/persistent): small-collective fusion semantics —
+off = byte-identical unfused dispatch, on = fused wire collectives with
+exact results, Startall's wire-collective budget (pvar-asserted),
+flush-reason trace spans aggregated by tracedump summary, compression
+composition, decision-table gate rows, and the DDP gradient sync."""
+import math
+
+import numpy as np
+import pytest
+
+import ompi_tpu as MPI
+from ompi_tpu.coll import persistent
+from ompi_tpu.mca import pvar, var
+
+
+@pytest.fixture()
+def bucket(world):
+    """Bucketing ON with a small threshold; always restored (and the
+    world's fuser drained) so no other test sees fusion."""
+    var.var_set("mpi_base_bucket", True)
+    var.var_set("mpi_base_bucket_bytes", 1 << 14)
+    try:
+        yield world
+    finally:
+        persistent.flush_all("explicit")
+        var.var_set("mpi_base_bucket_bytes", persistent.DEFAULT_BUCKET_BYTES)
+        var.var_set("mpi_base_bucket", False)
+
+
+def _bufs(world, k, elems, seed=0):
+    rng = np.random.default_rng(seed)
+    xs = [rng.integers(-8, 8, size=(world.size, elems)).astype(np.float32)
+          for _ in range(k)]
+    return [world.stack(list(x)) for x in xs]
+
+
+# -- parity (tools/checkparity contract) -----------------------------------
+def test_bucketed_allreduce_matches_unfused(world):
+    bufs = _bufs(world, 6, 256)
+    refs = [np.asarray(world.allreduce(b, MPI.SUM)) for b in bufs]
+
+    var.var_set("mpi_base_bucket", True)
+    var.var_set("mpi_base_bucket_bytes", 1 << 20)
+    try:
+        reqs = [world.allreduce_init(b, MPI.SUM) for b in bufs]
+        MPI.Startall(reqs)
+        for rq, ref in zip(reqs, refs):
+            rq.wait()
+            got = np.asarray(rq.get())
+            # integer-valued f32: the fused elementwise combine is
+            # exact, so fused == unfused bit-for-bit
+            assert got.tobytes() == ref.tobytes()
+    finally:
+        persistent.flush_all("explicit")
+        var.var_set("mpi_base_bucket_bytes", persistent.DEFAULT_BUCKET_BYTES)
+        var.var_set("mpi_base_bucket", False)
+
+
+def test_bucket_off_is_byte_identical(world):
+    """The acceptance contract: with mpi_base_bucket off (the default)
+    every path — blocking, one-shot nonblocking, persistent — returns
+    the byte-identical unfused result."""
+    assert not persistent.bucket_enabled()
+    (buf,) = _bufs(world, 1, 128, seed=1)
+    ref = np.asarray(world.allreduce(buf, MPI.SUM))
+    i_res = np.asarray(world.iallreduce(buf, MPI.SUM).get())
+    req = world.allreduce_init(buf, MPI.SUM)
+    req.start()
+    req.wait()
+    p_res = np.asarray(req.get())
+    assert i_res.tobytes() == ref.tobytes()
+    assert p_res.tobytes() == ref.tobytes()
+
+
+# -- Startall wire-collective budget (pvar-asserted) -----------------------
+def test_startall_wire_collective_budget(bucket):
+    world = bucket
+    k, elems = 8, 1024                  # 4 KiB per rank per member
+    member_bytes = elems * 4
+    bucket_bytes = persistent.bucket_bytes()
+    assert bucket_bytes == 1 << 14      # 4 members per bucket
+    bufs = _bufs(world, k, elems, seed=2)
+    refs = []
+    var.var_set("mpi_base_bucket", False)
+    for b in bufs:
+        refs.append(np.asarray(world.allreduce(b, MPI.SUM)))
+    var.var_set("mpi_base_bucket", True)
+
+    f0 = pvar.pvar_read("coll_bucket_flushes")
+    m0 = pvar.pvar_read("coll_bucket_fused_members")
+    reqs = [world.allreduce_init(b, MPI.SUM) for b in bufs]
+    MPI.Startall(reqs)
+    for rq, ref in zip(reqs, refs):
+        rq.wait()
+        assert np.asarray(rq.get()).tobytes() == ref.tobytes()
+    flushes = pvar.pvar_read("coll_bucket_flushes") - f0
+    budget = math.ceil(k * member_bytes / bucket_bytes)
+    assert flushes <= budget, (flushes, budget)
+    assert pvar.pvar_read("coll_bucket_fused_members") - m0 == k
+    # reason attribution: threshold flushes + at most one startall tail
+    assert pvar.pvar_read("coll_bucket_flush_bytes") >= 1
+
+
+def test_oneshot_iallreduce_fuses(bucket):
+    world = bucket
+    bufs = _bufs(world, 3, 64, seed=3)
+    var.var_set("mpi_base_bucket", False)
+    refs = [np.asarray(world.allreduce(b, MPI.SUM)) for b in bufs]
+    var.var_set("mpi_base_bucket", True)
+    f0 = pvar.pvar_read("coll_bucket_flushes")
+    reqs = [world.iallreduce(b, MPI.SUM) for b in bufs]
+    outs = [np.asarray(r.get()) for r in reqs]
+    for got, ref in zip(outs, refs):
+        assert got.tobytes() == ref.tobytes()
+    # all three rode fused launches, not three separate wire colls
+    assert pvar.pvar_read("coll_bucket_flushes") - f0 <= 2
+
+
+def test_bucket_occupancy_level_pvar(bucket):
+    world = bucket
+    (buf,) = _bufs(world, 1, 64, seed=4)
+    req = world.allreduce_init(buf, MPI.SUM)
+    req.start()
+    occ = pvar.pvar_read("coll_bucket_occupancy")
+    assert occ >= buf.nbytes // world.size or req._inner_req._complete
+    req.wait()
+    assert pvar.pvar_read("coll_bucket_occupancy") == 0
+
+
+# -- trace spans + tracedump summary aggregation ---------------------------
+def test_bucket_flush_spans_and_summary(bucket):
+    from ompi_tpu import trace
+    from ompi_tpu.tools import tracedump
+    world = bucket
+    bufs = _bufs(world, 4, 64, seed=5)
+    trace.enable()
+    trace.reset()
+    try:
+        reqs = [world.allreduce_init(b, MPI.SUM) for b in bufs]
+        MPI.Startall(reqs)
+        for rq in reqs:
+            rq.wait()
+        spans = [s for s in trace.span_dicts()
+                 if s["name"] == "coll.bucket_flush"]
+        assert spans, "no bucket_flush span recorded"
+        reasons = {s["args"]["reason"] for s in spans}
+        assert reasons <= {"bytes", "startall", "idle", "explicit"}
+        assert "startall" in reasons or "bytes" in reasons
+        assert all(s["args"]["members"] >= 1 for s in spans)
+        summary = tracedump.render(trace.span_dicts(), {}, "summary")
+        agg = summary.get("bucket_flush")
+        assert agg, summary
+        assert sum(e["flushes"] for e in agg.values()) == len(spans)
+        assert sum(e["members"] for e in agg.values()) == 4
+    finally:
+        trace.reset()
+        trace.disable()
+
+
+# -- composition with compress/ (satellite) --------------------------------
+def test_bucketed_compressed_parity_and_ratio(world, rng):
+    """Buckets crossing mpi_base_compress_min_bytes ride the codec:
+    members individually below the floor, fused payload above it —
+    quant bytes move (ratio pvar-asserted) and every member's result
+    stays within the codec's documented error model."""
+    n = world.size
+    k, elems = 16, 8192                 # 32 KiB/rank each, 512 KiB fused
+    xs = [rng.normal(size=(n, elems)).astype(np.float32)
+          for _ in range(k)]
+    var.var_set("mpi_base_compress", True)
+    var.var_set("mpi_base_compress_min_bytes", 256 << 10)
+    var.var_set("mpi_base_bucket", True)
+    var.var_set("mpi_base_bucket_bytes", 1 << 20)
+    try:
+        c = world.dup()                 # vtable selected with compress on
+        bufs = [c.stack(list(x)) for x in xs]
+        bi0 = pvar.pvar_read("compress_bytes_in")
+        bo0 = pvar.pvar_read("compress_bytes_out")
+        reqs = [c.allreduce_init(b, MPI.SUM) for b in bufs]
+        MPI.Startall(reqs)
+        outs = [np.asarray(r.get()) for r in reqs]
+        bi1 = pvar.pvar_read("compress_bytes_in")
+        bo1 = pvar.pvar_read("compress_bytes_out")
+        assert bi1 > bi0, "fused bucket never engaged the codec"
+        assert (bo1 - bo0) / (bi1 - bi0) <= 0.5, "no wire savings"
+        for x, got in zip(xs, outs):
+            ref = x.sum(axis=0, dtype=np.float64)
+            err = np.abs(got[0].astype(np.float64) - ref).max()
+            assert err <= 0.02 * np.abs(ref).max() + 1e-6
+            for r in range(1, n):       # same value everywhere
+                assert np.array_equal(got[0], got[r])
+        c.free()
+    finally:
+        persistent.flush_all("explicit")
+        var.var_set("mpi_base_bucket_bytes", persistent.DEFAULT_BUCKET_BYTES)
+        var.var_set("mpi_base_bucket", False)
+        var.var_set("mpi_base_compress_min_bytes", 4 << 20)
+        var.var_set("mpi_base_compress", False)
+
+
+# -- decision-table gate rows (satellite) ----------------------------------
+def test_decision_table_persistent_and_bucket_rows(world):
+    from ompi_tpu.api import tool
+    t_off = tool.decision_table(comm_size=world.size, platform="cpu")
+    # persistent prebound rows: always present, one per *_init func
+    for func in persistent.PERSISTENT_FUNCS:
+        assert t_off[f"{func}_init"] == [[0, 0, "persistent_prebound"]]
+    # bucket rows: only while the var is on (the compression-row idiom)
+    assert not any("bucket_fuse" in str(r[2])
+                   for rules in t_off.values() for r in rules)
+    var.var_set("mpi_base_bucket", True)
+    try:
+        t_on = tool.decision_table(comm_size=world.size, platform="cpu")
+        rows = [r for r in t_on["allreduce"]
+                if str(r[2]).startswith("bucket_fuse:")]
+        assert rows and str(persistent.bucket_bytes()) in rows[-1][2]
+    finally:
+        var.var_set("mpi_base_bucket", False)
+
+
+def test_checkparity_requires_persistent_pairs(tmp_path):
+    """A tree with compress pairs but no persistent/fused pairs fails
+    the audit with the missing names listed."""
+    from ompi_tpu.tools import checkparity
+    (tmp_path / "test_x.py").write_text(
+        "def test_compressed_allreduce_matches_uncompressed():\n"
+        "    pass\n"
+        "def test_compressed_allgather_matches_uncompressed():\n"
+        "    pass\n"
+        "def test_compressed_reduce_scatter_block_matches_uncompressed"
+        "():\n    pass\n")
+    report = checkparity.audit(str(tmp_path))
+    assert not report["ok"]
+    assert "test_persistent_allreduce_matches_unfused" \
+        in report["missing_persistent_parity"]
+    assert "test_bucketed_allreduce_matches_unfused" \
+        in report["missing_persistent_parity"]
+
+
+# -- DDP gradient sync (models/transformer) --------------------------------
+def test_bucketed_grad_sync_matches_per_leaf_allreduce(world):
+    from ompi_tpu.models.transformer import BucketedGradSync
+    n = world.size
+    rng = np.random.default_rng(7)
+    tree = {"w": world.stack(list(
+                rng.integers(-4, 4, size=(n, 8, 8)).astype(np.float32))),
+            "b": world.stack(list(
+                rng.integers(-4, 4, size=(n, 8)).astype(np.float32)))}
+    refs = {k: np.asarray(world.allreduce(v, MPI.SUM)) / n
+            for k, v in tree.items()}
+    var.var_set("mpi_base_bucket", True)
+    try:
+        sync = BucketedGradSync(world, tree)
+        out = sync(tree)
+        for k in tree:
+            assert np.allclose(np.asarray(out[k]), refs[k])
+        loss = sync.mean_scalar(2.5)
+        assert np.allclose(np.asarray(loss), 2.5)
+    finally:
+        persistent.flush_all("explicit")
+        var.var_set("mpi_base_bucket", False)

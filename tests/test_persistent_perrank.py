@@ -1,0 +1,38 @@
+"""Persistent collectives on the real per-rank execution model (slow —
+tier-1 budget): 2 OS processes over btl sm/tcp running
+perrank_programs/p32_persistent.py, which asserts plan parity,
+persistent refill semantics, and the Startall wire-collective budget."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_MPIRUN = os.path.join(_REPO, "ompi_tpu", "tools", "mpirun.py")
+
+
+def _run(extra):
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("JAX_", "XLA_"))}
+    n = 2
+    res = subprocess.run(
+        [sys.executable, _MPIRUN, "--per-rank", "-n", str(n),
+         "--timeout", "150", *extra,
+         os.path.join(_REPO, "tests", "perrank_programs",
+                      "p32_persistent.py")],
+        env=env, capture_output=True, text=True, timeout=200,
+        cwd=_REPO)
+    assert res.returncode == 0, \
+        f"rc={res.returncode}\n{res.stdout}\n{res.stderr[-4000:]}"
+    assert res.stdout.count("OK p32_persistent") == n, res.stdout
+
+
+def test_persistent_perrank_sm():
+    _run([])
+
+
+def test_persistent_perrank_tcp_only():
+    _run(["--mca", "btl_sm_enable", "0"])
