@@ -47,7 +47,8 @@ import time
 if "--ab-child" in sys.argv or "--perrank-child" in sys.argv \
         or "--compress-child" in sys.argv \
         or "--compress-device-child" in sys.argv \
-        or "--pcoll-child" in sys.argv:
+        or "--pcoll-child" in sys.argv \
+        or "--largemsg-child" in sys.argv:
     os.environ["JAX_PLATFORMS"] = "cpu"
 if "--tpu-child" in sys.argv:
     # the one-chip hardware child must NOT inherit a cpu pin the parent
@@ -1118,6 +1119,112 @@ def _pcoll_rows() -> dict:
     return out
 
 
+def _largemsg_child() -> None:
+    """One rank of the 2-process large-message A/B job
+    (docs/LARGEMSG.md): a 64 MB f32 allreduce riding the segment-
+    pipelined ring (chunk hops through the pml's pipelined rendezvous,
+    striped over ``mpi_base_btl_rails``) against the serial
+    reduce+bcast schedule, plus the chain-vs-binomial bcast pair —
+    with the pipeline pvars read so the speedup row is EVIDENCED
+    (segments actually flowed, overlap actually measured, rail bytes
+    actually balanced), not inferred. Rank 0 prints one JSON line."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import ompi_tpu as MPI
+    from ompi_tpu.mca import pvar as _pvar
+    from ompi_tpu.mca import var as _var
+
+    MPI.Init()
+    w = MPI.get_comm_world()
+    r = w.rank()
+    # host tier only: the staging shim would swallow the payload
+    _var.var_set("coll_tuned_stage_min_bytes", 1 << 62)
+    mb = int(os.environ.get("OMPI_TPU_BENCH_LARGEMSG_MB", "64"))
+    x = np.full((mb << 20) // 4, float(r + 1), np.float32)
+
+    def timed(fn, reps=3):
+        fn()                             # warm
+        ts = []
+        for _ in range(reps):
+            w.barrier()
+            t0 = time.perf_counter()
+            fn()
+            ts.append(time.perf_counter() - t0)
+        return float(np.median(ts))
+
+    s0 = _pvar.pvar_read("pml_pipeline_segments")
+    t_pipe = timed(lambda: w.allreduce(x, MPI.SUM))
+    segments = int(_pvar.pvar_read("pml_pipeline_segments") - s0)
+    overlap = float(_pvar.pvar_read("pml_overlap_ratio"))
+    y = np.asarray(w.allreduce(x, MPI.SUM))
+    correct = bool(y[0] == 3.0)          # (r=0)+1 + (r=1)+1
+    _var.var_set("mpi_base_pipeline_enable", False)
+    t_serial = timed(lambda: w.allreduce(x, MPI.SUM))
+    _var.var_set("mpi_base_pipeline_enable", True)
+
+    t_bchain = timed(lambda: w.bcast(x if r == 0 else None, 0))
+    _var.var_set("mpi_base_pipeline_enable", False)
+    t_bserial = timed(lambda: w.bcast(x if r == 0 else None, 0))
+    _var.var_set("mpi_base_pipeline_enable", True)
+
+    rails = int(_var.var_get("mpi_base_btl_rails", 1))
+    rail_bytes = [int(_pvar.pvar_read(f"btl_rail_bytes_c{c}"))
+                  for c in range(rails)]
+    balanced = None
+    if rails > 1:
+        even = sum(rail_bytes) / rails
+        balanced = bool(even > 0 and all(
+            abs(b - even) <= 0.2 * even for b in rail_bytes))
+
+    w.barrier()
+    MPI.Finalize()
+    if r == 0:
+        print(json.dumps({
+            "payload_mb": mb,
+            "rails": rails,
+            "allreduce_pipelined_ms": round(t_pipe * 1e3, 1),
+            "allreduce_serial_ms": round(t_serial * 1e3, 1),
+            "allreduce_speedup": round(t_serial / t_pipe, 2),
+            "bcast_chain_ms": round(t_bchain * 1e3, 1),
+            "bcast_serial_ms": round(t_bserial * 1e3, 1),
+            "bcast_speedup": round(t_bserial / t_bchain, 2),
+            "pipeline_segments": segments,
+            "overlap_ratio": round(overlap, 3),
+            "rail_bytes": rail_bytes,
+            "rail_bytes_balanced": balanced,
+            "correct": correct,
+        }), flush=True)
+
+
+def _largemsg_rows() -> dict:
+    """The --largemsg section: pipelined-vs-serial A/B at 64 MB on
+    the three transports (sm rings, raw tcp loopback, and tcp paced
+    to 0.2 GB/s — the DCN-like tier where overlap actually pays), and
+    rails 1-vs-2 on the tcp tiers (rail count binds at Init, so each
+    rail count is its own job). The paced rails=2 job carries the
+    acceptance contract: pipeline_speedup_paced >= 1.5 with
+    pml_pipeline_segments > 1, and rail bytes within 20% of even."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    mpirun = os.path.join(here, "ompi_tpu", "tools", "mpirun.py")
+    out = {}
+    for label, extra in (
+            ("sm", []),
+            ("tcp", ["--mca", "btl_sm_enable", "0"]),
+            ("tcp_rails2", ["--mca", "btl_sm_enable", "0",
+                            "--mca", "mpi_base_btl_rails", "2"]),
+            ("paced", ["--mca", "btl_sm_enable", "0",
+                       "--mca", "btl_tcp_sim_gbps", "0.2"]),
+            ("paced_rails2", ["--mca", "btl_sm_enable", "0",
+                              "--mca", "btl_tcp_sim_gbps", "0.2",
+                              "--mca", "mpi_base_btl_rails", "2"])):
+        out[label] = _child_json(
+            [sys.executable, mpirun, "--per-rank", "-n", "2",
+             "--timeout", "300", *extra,
+             sys.executable, os.path.abspath(__file__),
+             "--largemsg-child"], 360, _child_env())
+    return out
+
+
 def _trace_summary() -> dict:
     """Trace summary for the committed BENCH record, proven
     machine-readable: the summary must round-trip through JSON
@@ -1158,6 +1265,12 @@ def main() -> None:
                          "A/B on sm and tcp per-rank jobs "
                          "(docs/PERSISTENT.md)")
     ap.add_argument("--pcoll-child", action="store_true")
+    ap.add_argument("--largemsg", action="store_true",
+                    help="measure the large-message data-plane rows: "
+                         "the 64 MB pipelined-vs-serial allreduce/"
+                         "bcast A/B with rails 1 vs 2 on sm, tcp, and "
+                         "the paced tier (docs/LARGEMSG.md)")
+    ap.add_argument("--largemsg-child", action="store_true")
     ap.add_argument("--trace", action="store_true",
                     help="record collective/pt2pt spans "
                          "(ompi_tpu.trace) and attach the trace "
@@ -1181,6 +1294,9 @@ def main() -> None:
         return
     if args.pcoll_child:
         _pcoll_child()
+        return
+    if args.largemsg_child:
+        _largemsg_child()
         return
 
     # The TPU is reached through a tunnel that can be down for hours
@@ -1403,6 +1519,10 @@ def main() -> None:
     pcoll_rows = _pcoll_rows() if (args.pcoll and n == 1
                                    and not args.no_ab) else None
 
+    # ---- large-message pipeline/rail rows (--largemsg) --------------
+    largemsg_rows = _largemsg_rows() if (args.largemsg and n == 1
+                                         and not args.no_ab) else None
+
     result = {
         # throughput-derived: amortized pipelined dispatch minus the
         # observation RTT (the OSU loop), NOT a single-shot latency —
@@ -1449,6 +1569,8 @@ def main() -> None:
         **({"compress": compress_rows}
            if compress_rows is not None else {}),
         **({"pcoll": pcoll_rows} if pcoll_rows is not None else {}),
+        **({"largemsg": largemsg_rows}
+           if largemsg_rows is not None else {}),
         "caveat": ("size-1 world: large-message path is identity-aliased "
                    "by XLA (algbw is an upper bound); >1-rank rows and "
                    "algorithm A/B come from the 8-rank CPU-mesh child"
@@ -1534,6 +1656,26 @@ def main() -> None:
         "correct": result["correct"],
     }
     contract = _contract_rows(ab, perrank)
+    if largemsg_rows is not None:
+        # the large-message acceptance rows (docs/LARGEMSG.md): the
+        # paced-tier pipelined-vs-serial speedup with its pvar
+        # evidence, and the rails=2 byte balance
+        pj = largemsg_rows.get("paced") or {}
+        pr2 = largemsg_rows.get("paced_rails2") or {}
+        if isinstance(pj, dict) and "error" not in pj:
+            contract["pipeline_speedup_paced"] = pj.get(
+                "allreduce_speedup")
+            contract["pipeline_segments"] = pj.get("pipeline_segments")
+        if isinstance(pr2, dict) and "error" not in pr2:
+            contract["rail_bytes_balanced"] = pr2.get(
+                "rail_bytes_balanced")
+    prev_algbw = _prev_headline_algbw()
+    if prev_algbw is not None:
+        # regression gate: this round's single-process large-message
+        # algbw must not fall below the newest committed headline's
+        contract["algbw_vs_prev"] = {
+            "now": result["large_algbw_gbps"], "prev": prev_algbw,
+            "ok": bool(result["large_algbw_gbps"] >= 0.9 * prev_algbw)}
     if contract:
         headline["contract"] = contract
     if pcoll_rows is not None:
@@ -1593,6 +1735,28 @@ def main() -> None:
                            if k in headline})
     print(line)
     MPI.Finalize()
+
+
+def _prev_headline_algbw():
+    """large_algbw_gbps from the newest committed BENCH_rNN.json — the
+    regression-gate baseline (r08: 0.75). None when no prior round has
+    the row (the gate is advisory, never run-killing)."""
+    import glob
+    import re
+    here = os.path.dirname(os.path.abspath(__file__))
+    rounds = sorted(
+        ((int(m.group(1)), f) for f in glob.glob(
+            os.path.join(here, "BENCH_r*.json"))
+         if (m := re.search(r"BENCH_r(\d+)\.json$", f))), reverse=True)
+    for _, f in rounds:
+        try:
+            with open(f) as fh:
+                v = (json.load(fh) or {}).get("large_algbw_gbps")
+            if v is not None:
+                return float(v)
+        except (OSError, ValueError, json.JSONDecodeError):
+            continue
+    return None
 
 
 def _bench_round() -> int:

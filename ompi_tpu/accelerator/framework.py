@@ -125,6 +125,21 @@ class TpuAccelComponent(Component):
     def mem_copy_d2h(self, dev_buf):
         return np.asarray(dev_buf)
 
+    def mem_copy_d2h_async(self, dev_buf):
+        """Begin the device-to-host copy WITHOUT forcing completion
+        (the async memcpy of ``accelerator.h:280``): the caller
+        finishes it later with ``mem_copy_d2h``. Backed by
+        ``jax.Array.copy_to_host_async`` where the runtime offers it;
+        degrades to a no-op start elsewhere — correctness never
+        depends on the copy actually being in flight."""
+        start = getattr(dev_buf, "copy_to_host_async", None)
+        if start is not None:
+            try:
+                start()
+            except Exception:            # noqa: BLE001 — deleted /
+                pass                     # donated buffer: sync path
+        return dev_buf
+
     # -- alloc (accelerator.h:364) -------------------------------------
     def mem_alloc(self, shape, dtype=np.float32, device=None):
         z = jax.numpy.zeros(shape, dtype)
@@ -268,6 +283,13 @@ def to_device(buf: Any, sharding=None):
 
 def to_host(buf: Any):
     return _mod().mem_copy_d2h(buf)
+
+
+def to_host_async(buf: Any):
+    """Start a D2H copy (returns the in-flight buffer); finish with
+    ``to_host``. The double-buffering primitive behind
+    ``btl/devxfer.SegmentStager``."""
+    return _mod().mem_copy_d2h_async(buf)
 
 
 def _reset_for_tests():

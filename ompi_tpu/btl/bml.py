@@ -22,15 +22,21 @@ job would route cross-host peers over tcp only.
 """
 from __future__ import annotations
 
+import itertools
 import os
+import queue
 import socket
+import threading
+import time
 import uuid
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, Optional, Tuple
 
 from ompi_tpu.btl.sm import SmEndpoint
 from ompi_tpu.btl.tcp import TcpEndpoint
+from ompi_tpu.mca import pvar as _pvar
 from ompi_tpu.mca import var
 from ompi_tpu.runtime import progress as _progress
+from ompi_tpu.trace import core as _trace
 
 _BOOT_ID: Optional[str] = None
 
@@ -81,33 +87,49 @@ def register_params() -> None:
                      help="Device-array payloads at or above this ride "
                           "the transfer plane (the rndv eager limit, "
                           "pml_ob1_sendreq.h:389-460 role)")
+    var.var_register("mpi", "base", "btl_rails", vtype="int", default=1,
+                     help="Channels per peer for large-message segment "
+                          "striping (extra tcp connections with "
+                          "independent send locks and sender threads); "
+                          "1 = the single-rail byte-identical default "
+                          "(docs/LARGEMSG.md)")
 
 
-def _probe_stream(chunk: int = 64 << 10, reps: int = 8
-                  ) -> "tuple[float, float]":
+def _probe_stream(chunk: int = 64 << 10, reps: int = 8,
+                  probe_sm: bool = True) -> "tuple[float, float]":
     """~1 ms micro-probe of the two planes' stream mechanics on THIS
     host: bytes/sec pushing+popping records through a loopback
     /dev/shm ring (the sm bulk path's two memcpys and bookkeeping) vs
     writing+reading a local socketpair (the tcp path's kernel
-    copies). Returns (sm_bps, tcp_bps)."""
+    copies). Returns (sm_bps, tcp_bps); sm_bps is 0.0 when the ring
+    probe is skipped (``probe_sm=False``) or fails — the tcp half
+    always runs, because its number doubles as the per-rail bandwidth
+    estimate the rail/segment decision rows reuse
+    (coll/decision.pipeline_plan) instead of re-probing."""
     import socket
     import time
 
-    from ompi_tpu.btl.sm import Ring
     payload = b"\x5a" * chunk
 
-    ring = Ring(None, capacity=max(2 * chunk + (1 << 12), 1 << 20),
-                create=True)
-    try:
-        ring.push(payload)               # warm the mapping
-        ring.pop()
-        t0 = time.perf_counter()
-        for _ in range(reps):
-            ring.push(payload)
-            ring.pop()
-        sm_s = time.perf_counter() - t0
-    finally:
-        ring.close()
+    sm_s = 0.0
+    if probe_sm:
+        try:
+            from ompi_tpu.btl.sm import Ring
+            ring = Ring(None,
+                        capacity=max(2 * chunk + (1 << 12), 1 << 20),
+                        create=True)
+            try:
+                ring.push(payload)       # warm the mapping
+                ring.pop()
+                t0 = time.perf_counter()
+                for _ in range(reps):
+                    ring.push(payload)
+                    ring.pop()
+                sm_s = time.perf_counter() - t0
+            finally:
+                ring.close()
+        except Exception:                # noqa: BLE001 — no /dev/shm:
+            sm_s = 0.0                   # the tcp half still matters
     a, b = socket.socketpair()
     try:
         a.sendall(payload)               # warm the buffers
@@ -121,7 +143,8 @@ def _probe_stream(chunk: int = 64 << 10, reps: int = 8
         a.close()
         b.close()
     total = float(reps * chunk)
-    return total / max(sm_s, 1e-9), total / max(tcp_s, 1e-9)
+    sm_bps = total / max(sm_s, 1e-9) if sm_s > 0 else 0.0
+    return sm_bps, total / max(tcp_s, 1e-9)
 
 
 def _drain_sock(sock, n: int) -> None:
@@ -151,8 +174,6 @@ class BmlEndpoint:
         self.nprocs = nprocs
         self._kv_get = kv_get
         self.sink = sink
-        import itertools
-        import threading
         self._send_seq: Dict[int, "itertools.count"] = {
             p: itertools.count(1) for p in range(nprocs)}
         self._expect: Dict[int, int] = {}
@@ -180,29 +201,61 @@ class BmlEndpoint:
         # per-transport frame counts (the hook/comm_method selection
         # table's data source)
         self.stats = {"sm": 0, "tcp": 0, "self": 0}
+        # -- multi-rail striping state (send_segment) ------------------
+        self.rails = max(1, int(var.var_get("mpi_base_btl_rails", 1)))
+        self._rail_lock = threading.Lock()
+        self._rail_rr: Dict[int, "itertools.count"] = {}   # peer -> rr
+        self._rail_seq: Dict[Tuple[int, int], "itertools.count"] = {}
+        self._rail_expect: Dict[Tuple[int, int], int] = {}
+        self._rail_qs: Dict[Tuple[int, int], "queue.Queue"] = {}
+        # per-rail byte counters (send + receive on this endpoint),
+        # surfaced as btl_rail_bytes_c<r> pvars — the bench's
+        # rail_bytes_balanced contract row reads these
+        self.rail_bytes: Dict[int, int] = {r: 0
+                                           for r in range(self.rails)}
+        self.rail_stats = {"ooo": 0, "fallback": 0, "recv_frames": 0}
+        for r in range(self.rails):
+            _pvar.pvar_register(
+                f"btl_rail_bytes_c{r}",
+                (lambda rr=r, ep=self: ep.rail_bytes.get(rr, 0)),
+                unit="bytes",
+                help=f"Segment payload bytes carried on rail {r} by "
+                     f"this endpoint, send + receive "
+                     f"(docs/LARGEMSG.md)")
         # routing earns its defaults from DATA (round-3 postmortem:
         # the sm "bandwidth plane" measurably lost to tcp on the CI
         # host and the decision layer still routed bulk to it). A ~1ms
         # local micro-probe measures both planes' stream mechanics; sm
         # is demoted for bulk unless it actually wins. A user-set
-        # btl_sm_min_bytes (env/file/CLI) overrides the probe.
+        # btl_sm_min_bytes (env/file/CLI) overrides the probe. The tcp
+        # half always runs: its number doubles as the per-rail
+        # bandwidth estimate (``rail_gbps``) the rail/segment decision
+        # rows reuse instead of re-probing.
         self.probe_basis: Dict[str, object] = {"ran": False}
-        if (self.sm is not None
-                and var.var_source("btl_sm_min_bytes")
-                in (None, var.SOURCE_DEFAULT)):
-            try:
-                sm_bps, tcp_bps = _probe_stream()
-                demote = sm_bps <= tcp_bps * 1.1
-                if demote:
-                    self._sm_min = 1 << 62   # bulk stays on tcp
-                self.probe_basis = {
+        user_min = var.var_source("btl_sm_min_bytes") \
+            not in (None, var.SOURCE_DEFAULT)
+        try:
+            # a user-set btl_sm_min_bytes suppresses the ROUTING probe
+            # (their threshold stands, "ran" stays False) — but the
+            # tcp half still runs: its number doubles as the per-rail
+            # bandwidth estimate (rail_gbps) regardless of routing
+            probe_sm = self.sm is not None and not user_min
+            sm_bps, tcp_bps = _probe_stream(probe_sm=probe_sm)
+            self.probe_basis["rail_gbps"] = round(tcp_bps / 1e9, 3)
+            if not user_min:
+                self.probe_basis.update({
                     "ran": True,
-                    "sm_gbps": round(sm_bps / 1e9, 3),
+                    "sm_gbps": round(sm_bps / 1e9, 3) if sm_bps else None,
                     "tcp_gbps": round(tcp_bps / 1e9, 3),
-                    "sm_demoted": bool(demote),
-                }
-            except Exception:            # noqa: BLE001 — probe is
-                pass                     # advisory, never fatal
+                    "sm_demoted": False,
+                })
+                if self.sm is not None and sm_bps > 0:
+                    demote = sm_bps <= tcp_bps * 1.1
+                    if demote:
+                        self._sm_min = 1 << 62   # bulk stays on tcp
+                    self.probe_basis["sm_demoted"] = bool(demote)
+        except Exception:                # noqa: BLE001 — probe is
+            pass                         # advisory, never fatal
 
     # -- the TcpEndpoint surface the Router binds ----------------------
     @property
@@ -243,6 +296,30 @@ class BmlEndpoint:
                     self.sm.drain(header.get("peer"))
                 finally:
                     _progress.wake_end()
+            return
+        rq = header.pop("_rq", None)
+        if rq is not None:
+            # rail-striped segment (send_segment): per-rail FIFO is
+            # TRACKED (a gap means cross-rail overtaking or a dropped-
+            # rail detour — counted, never held back) but delivery is
+            # immediate: the pml reassembles by segment index, and MPI
+            # matching order was already fixed by the train's init
+            # frame on the ordered _sq stream. This generalizes the
+            # ordered sink: rails trade total order for concurrency,
+            # the index-keyed PipeStore buys it back.
+            src, rail, rseq = rq
+            with self._order_lock:
+                key = (src, rail)
+                exp = self._rail_expect.get(key, 1)
+                if rseq != exp:
+                    self.rail_stats["ooo"] += 1
+                self._rail_expect[key] = max(exp, rseq + 1)
+                self.rail_stats["recv_frames"] += 1
+            with self._rail_lock:        # rail_bytes shares the send-
+                self.rail_bytes[rail] = (self.rail_bytes.get(rail, 0)
+                                         + len(payload))  # side lock
+            _progress.wake_note_frame()
+            self.sink(header, payload)
             return
         sq = header.pop("_sq", None)
         if sq is None:                   # unsequenced (foreign) frame
@@ -334,7 +411,123 @@ class BmlEndpoint:
         self.stats["tcp"] += 1
         self.tcp.send_frame(peer, header, payload)
 
+    # -- rail-striped segments (the pipelined rendezvous data plane) ---
+    def send_segment(self, peer: int, header: dict, payload: bytes,
+                     on_done=None) -> None:
+        """Enqueue one unordered large-message segment, striped
+        round-robin over ``mpi_base_btl_rails`` rails. Segments carry
+        a per-(sender, rail) sequence stamp ``_rq`` instead of the
+        ordered ``_sq`` — MPI ordering rides the train's init frame
+        (pml/pipeline); segments reassemble by index, so rails may
+        deliver in any order. Each (peer, rail) pair owns a dedicated
+        sender thread: the caller returns immediately, so segment
+        s+1's pack/stage/compress overlaps segment s's wire time, and
+        rails overlap each other (under btl_tcp_sim_gbps each rail
+        paces on its OWN lock — N rails aggregate like N NICs).
+        ``on_done(wire_seconds)`` fires on the sender thread after the
+        segment leaves (0.0 for loopback) — the pml's flow-control
+        window and overlap accounting hang off it."""
+        if peer == self.rank:            # btl/self loopback
+            self.stats["self"] += 1
+            with self._rail_lock:
+                self.rail_bytes[0] = self.rail_bytes.get(0, 0) \
+                    + len(payload)
+            self.sink(dict(header), payload)
+            if on_done is not None:
+                on_done(0.0)
+            return
+        with self._rail_lock:
+            rr = self._rail_rr.get(peer)
+            if rr is None:
+                rr = self._rail_rr[peer] = itertools.count()
+            rail = next(rr) % self.rails
+            key = (peer, rail)
+            seq = self._rail_seq.get(key)
+            if seq is None:
+                seq = self._rail_seq[key] = itertools.count(1)
+            rseq = next(seq)
+            q = self._rail_qs.get(key)
+            if q is None:
+                q = self._rail_qs[key] = queue.Queue()
+                threading.Thread(
+                    target=self._rail_send_loop, args=(q, peer, rail),
+                    daemon=True,
+                    name=f"btl-rail-{self.rank}-{peer}-{rail}").start()
+            self.rail_bytes[rail] = self.rail_bytes.get(rail, 0) \
+                + len(payload)
+        header = dict(header)
+        header["_rq"] = (self.rank, rail, rseq)
+        q.put((header, payload, on_done))
+
+    def _rail_send_loop(self, q: "queue.Queue", peer: int,
+                        rail: int) -> None:
+        from ompi_tpu.runtime import ft
+        while True:
+            item = q.get()
+            if item is None:
+                return                   # close(): retire
+            header, payload, on_done = item
+            t0 = time.perf_counter()
+            tok = (_trace.begin("btl.rail", rail=rail, peer=peer,
+                                bytes=len(payload))
+                   if _trace.active else None)
+            sent = False
+            try:
+                if not ft.is_failed(peer):
+                    if (self.sm is not None
+                            and len(payload) >= self._sm_min
+                            and self._is_same_host(peer)):
+                        # same-host segments ride the ONE existing sm
+                        # ring per peer (rails stripe the tcp plane;
+                        # the ring's push lock serializes multi-rail
+                        # pushes and index reassembly absorbs the
+                        # interleaving) — this thread may block, it is
+                        # not a reader
+                        try:
+                            sent = self.sm.try_send(peer, header,
+                                                    payload,
+                                                    timeout=60.0)
+                        except Exception:    # noqa: BLE001
+                            sent = False
+                        if sent:
+                            self.stats["sm"] += 1
+                            try:
+                                self.tcp.send_frame(
+                                    peer, {"ctl": "_smpoke",
+                                           "peer": self.rank})
+                            except Exception:  # noqa: BLE001
+                                pass
+                    if not sent:
+                        try:
+                            self.tcp.send_frame_rail(peer, header,
+                                                     payload, rail)
+                            sent = True
+                            self.stats["tcp"] += 1
+                        except Exception:    # noqa: BLE001
+                            # dropped rail: detour over the primary
+                            # rail-0 socket — index reassembly makes
+                            # the re-route invisible to the pml
+                            try:
+                                self.tcp.send_frame(peer, header,
+                                                    payload)
+                                sent = True
+                                self.stats["tcp"] += 1
+                                with self._rail_lock:
+                                    self.rail_stats["fallback"] += 1
+                            except Exception:  # noqa: BLE001
+                                pass         # peer death: the failure
+                                #              detector owns reporting
+            finally:
+                if tok is not None:
+                    _trace.end(tok, sent=sent)
+                if on_done is not None:
+                    on_done(time.perf_counter() - t0)
+
     def close(self) -> None:
+        with self._rail_lock:
+            rail_qs = list(self._rail_qs.values())
+        for q in rail_qs:                # retire the rail senders
+            q.put(None)
         if self.sm is not None:
             self.sm.close()
         self.tcp.close()

@@ -167,6 +167,39 @@ def maybe_resolve(data):
     return data
 
 
+class SegmentStager:
+    """Double-buffered device-to-host staging for the pipelined
+    rendezvous (pml/pipeline): the async D2H copy of segment s+1 is
+    issued when segment s is fetched, so staging overlaps the wire —
+    the ``accelerator.h:280`` async-memcpy pattern over
+    ``accelerator/framework.to_host_async``. Segments are element
+    ranges of the flattened array; slicing stays on-device (a lazy
+    JAX op), only the staged copy crosses to host."""
+
+    def __init__(self, arr, elems_per_seg: int):
+        from ompi_tpu.accelerator import framework as _fw
+        self._mod = _fw.current_module()
+        self._flat = arr.reshape(-1)
+        self._eps = max(1, int(elems_per_seg))
+        self._n = -(-int(self._flat.shape[0]) // self._eps)
+        self._ahead: Dict[int, Any] = {}     # idx -> in-flight buffer
+
+    @property
+    def nseg(self) -> int:
+        return self._n
+
+    def _start(self, i: int) -> None:
+        if 0 <= i < self._n and i not in self._ahead:
+            seg = self._flat[i * self._eps:(i + 1) * self._eps]
+            self._ahead[i] = self._mod.mem_copy_d2h_async(seg)
+
+    def get(self, i: int) -> np.ndarray:
+        self._start(i)                   # miss (first / out-of-order
+        self._start(i + 1)               # consumer): issue now; then
+        #                                  prefetch the NEXT segment
+        return np.asarray(self._mod.mem_copy_d2h(self._ahead.pop(i)))
+
+
 def reset() -> None:
     """Finalize: drop connections and the server (new jobs re-modex)."""
     with _lock:

@@ -40,6 +40,28 @@ _LEN = struct.Struct("!IQQ")  # magic, header_len, payload_len
 _CTL_MAX_BYTES = 256 << 20
 _CTL_FRAME_OVERHEAD = 256   # accounting estimate per queued frame
 
+# Bulk data-plane thresholds (the large-message path, docs/LARGEMSG.md):
+# payloads at least this big skip the header+payload concatenation on
+# send (two sendalls under the same lock — the frame stays contiguous
+# on the wire) and the bytearray->bytes copy on receive. A pipelined
+# segment crosses this at every supported segment size (>= 64 KiB).
+_BULK_MIN = 64 << 10
+# Kernel socket buffers for peer/rail connections: one full pipeline
+# segment (<= 4 MiB) must fit IN FLIGHT, so a sender's sendall returns
+# and paces on its own clock instead of blocking on the moment the
+# peer's reader thread gets scheduled — on a small host two ranks doing
+# a bidirectional chunk exchange otherwise serialize on each other's
+# reader wakeups (each sendall waits out the other side's drain).
+_SOCK_BUF = 8 << 20
+# Paced-wire catch-up credit: a pace sleep can wake a scheduler
+# quantum late (tens of ms on a busy single-CPU host); the per-socket
+# pacing clock lets a late frame start its wire slot where the
+# previous slot ended, bounded by this much wall time, so the
+# simulated rate holds in AGGREGATE instead of losing one quantum per
+# frame (which punished many-small-frame senders — exactly the
+# pipelined data plane — relative to one-big-frame senders).
+_PACE_CREDIT = 0.05
+
 
 def encode_payload(data: Any) -> Tuple[dict, bytes]:
     """(descriptor, raw bytes). Arrays go as raw buffers; anything else
@@ -86,6 +108,12 @@ class TcpEndpoint:
         self.on_peer_lost = on_peer_lost
         self._peers: Dict[int, socket.socket] = {}
         self._peer_locks: Dict[int, threading.Lock] = {}
+        # multi-rail striping (bml.send_segment): rails >= 1 are EXTRA
+        # connections to the same peer listener, each with its own send
+        # lock so bulk/paced sends on different rails genuinely overlap
+        # — rail 0 is the ordinary _peers socket
+        self._rail_peers: Dict[Tuple[int, int], socket.socket] = {}
+        self._rail_locks: Dict[Tuple[int, int], threading.Lock] = {}
         self._lock = threading.Lock()
         self._closed = False
         # reader threads must NEVER block sending (acks, RMA replies):
@@ -129,6 +157,9 @@ class TcpEndpoint:
                  "tier for algorithm/compression A/Bs; 0 disables")
         self._sim_bps = float(_var.var_get("btl_tcp_sim_gbps", 0.0)) \
             * 1e9
+        # per-socket pacing clocks (keyed by send-lock identity; each
+        # entry is only touched under that lock — see _pace)
+        self._pace_clock: Dict[int, float] = {}
 
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -150,6 +181,11 @@ class TcpEndpoint:
             except OSError:
                 return                       # listener closed
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            try:
+                conn.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF,
+                                _SOCK_BUF)
+            except OSError:
+                pass
             t = threading.Thread(target=self._read_loop, args=(conn,),
                                  daemon=True,
                                  name=f"btl-tcp-read-{self.rank}")
@@ -157,8 +193,17 @@ class TcpEndpoint:
 
     def _read_loop(self, conn: socket.socket) -> None:
         peer = -1                            # set by the hello frame
+        rail = 0                             # ditto (extra-rail conns)
         self._reader_tls.active = True       # sends from this thread
         # divert to the ctl sender (see __init__: readers never block)
+        # reusable bulk scratch: offset-addressed pipeline segments
+        # ("off" in the header) are copied into their train's assembly
+        # buffer synchronously inside sink() (pml/pipeline PipeStore),
+        # so their payload can land in one per-connection buffer reused
+        # across segments — the allocator churn of a fresh multi-MB
+        # buffer per segment (and the glibc arena growth it causes on
+        # long runs) disappears from the hot receive path
+        scratch = bytearray()
         try:
             while not self._closed:
                 head = self._read_exact(conn, _LEN.size)
@@ -169,13 +214,36 @@ class TcpEndpoint:
                     peer = -1                # corrupt stream: drop the
                     break                    # conn, NOT a death report
                 hraw = self._read_exact(conn, hlen)
-                praw = self._read_exact(conn, plen) if plen else b""
-                if hraw is None or praw is None:
+                if hraw is None:
                     break
                 try:
                     header = pickle.loads(hraw)
+                except Exception:            # noqa: BLE001
+                    header = None            # malformed: consume the
+                #                              payload, stay framed
+                if (header is not None and "pipeseg" in header
+                        and "off" in header and plen >= _BULK_MIN):
+                    if len(scratch) < plen:
+                        scratch = bytearray(plen)
+                    view = memoryview(scratch)
+                    got = 0
+                    while got < plen:
+                        n = conn.recv_into(view[got:plen])
+                        if not n:
+                            got = -1
+                            break
+                        got += n
+                    praw = view[:plen] if got == plen else None
+                else:
+                    praw = self._read_exact(conn, plen) if plen else b""
+                if praw is None:
+                    break
+                if header is None:
+                    continue
+                try:
                     if header.get("ctl") == "hello":
                         peer = header["peer"]   # identify the sender
+                        rail = int(header.get("rail", 0))
                         continue
                     self.sink(header, praw)
                 except Exception:            # noqa: BLE001
@@ -194,8 +262,12 @@ class TcpEndpoint:
                 pass
             # EOF/error on an identified inbound connection while the
             # endpoint is alive == the peer process died (graceful
-            # shutdown closes AFTER the fini fence, with _closed set)
-            if peer >= 0 and not self._closed and self.on_peer_lost:
+            # shutdown closes AFTER the fini fence, with _closed set).
+            # Extra-rail connections (rail > 0) are exempt: a dropped
+            # rail is degraded mode — segments detour to rail 0 (bml
+            # fallback) — and real death still shows as rail 0's EOF.
+            if peer >= 0 and rail == 0 and not self._closed \
+                    and self.on_peer_lost:
                 try:
                     self.on_peer_lost(peer)
                 except Exception:            # noqa: BLE001
@@ -203,6 +275,20 @@ class TcpEndpoint:
 
     @staticmethod
     def _read_exact(conn: socket.socket, n: int) -> Optional[bytes]:
+        if n >= _BULK_MIN:
+            # bulk payloads: recv straight into the final buffer and
+            # hand it out as-is — the recv-chunk concatenation AND the
+            # bytes() copy both disappear (each was a full extra pass
+            # over every large-message segment)
+            buf = bytearray(n)
+            view = memoryview(buf)
+            got = 0
+            while got < n:
+                r = conn.recv_into(view[got:])
+                if not r:
+                    return None
+                got += r
+            return buf
         buf = bytearray()
         while len(buf) < n:
             chunk = conn.recv(n - len(buf))
@@ -229,6 +315,10 @@ class TcpEndpoint:
         # peer really dies)
         s.settimeout(None)
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, _SOCK_BUF)
+        except OSError:
+            pass
         with self._lock:
             # lost race: keep the first connection
             cur = self._peers.setdefault(peer, s)
@@ -243,6 +333,71 @@ class TcpEndpoint:
         with self._peer_locks[peer]:
             s.sendall(_LEN.pack(MAGIC, len(hraw), 0) + hraw)
         return s
+
+    def _connect_rail(self, peer: int, rail: int) -> socket.socket:
+        """An extra per-peer channel (multi-rail striping): rails >= 1
+        open additional connections to the same published listener.
+        The hello carries the rail index so the peer's reader knows
+        this connection's EOF is a dropped RAIL, not a dead PROCESS —
+        rail 0 remains the failure detector's wire."""
+        key = (peer, rail)
+        with self._lock:
+            s = self._rail_peers.get(key)
+            if s is not None:
+                return s
+        addr = self._kv_get(f"ompi_tpu/btl/{peer}")
+        host, port = addr.rsplit(":", 1)
+        s = socket.create_connection((host, int(port)), timeout=60)
+        s.settimeout(None)                   # same contract as _connect:
+        s.setsockopt(socket.IPPROTO_TCP,     # death is the reader's EOF
+                     socket.TCP_NODELAY, 1)  # business, never a timeout
+        try:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, _SOCK_BUF)
+        except OSError:
+            pass
+        with self._lock:
+            cur = self._rail_peers.setdefault(key, s)
+            won = cur is s
+            self._rail_locks.setdefault(key, threading.Lock())
+        if not won:
+            s.close()                        # lost race, never sent
+            return cur
+        hraw = pickle.dumps({"ctl": "hello", "peer": self.rank,
+                             "rail": rail})
+        with self._rail_locks[key]:
+            s.sendall(_LEN.pack(MAGIC, len(hraw), 0) + hraw)
+        return s
+
+    def evict_rail_socket(self, peer: int, rail: int) -> None:
+        """Drop a broken rail connection; the next segment on this
+        rail reconnects (the caller meanwhile detours via rail 0)."""
+        with self._lock:
+            s = self._rail_peers.pop((peer, rail), None)
+        if s is not None:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def send_frame_rail(self, peer: int, header: dict, payload: bytes,
+                        rail: int) -> None:
+        """Blocking send over one rail's dedicated socket (rail <= 0 ==
+        the ordinary path). Each rail holds its OWN lock, so the paced
+        wall-time floor (btl_tcp_sim_gbps) applies per rail — N rails
+        aggregate simulated bandwidth exactly as N NICs would."""
+        if rail <= 0 or peer == self.rank:
+            self.send_frame(peer, header, payload)
+            return
+        try:
+            s = self._connect_rail(peer, rail)
+            self._sendmsg(s, self._rail_locks[(peer, rail)], header,
+                          payload)
+        except Exception:
+            # broken rail: evict so the next attempt reconnects; the
+            # caller (bml's rail sender) detours this segment to the
+            # rail-0 socket
+            self.evict_rail_socket(peer, rail)
+            raise
 
     def _evict_peer_socket(self, peer: int) -> None:
         """Drop a broken cached connection so the next send
@@ -406,26 +561,50 @@ class TcpEndpoint:
             return
         self._send_frame_blocking(peer, header, payload)
 
-    def _pace(self, nbytes: int, t0: float) -> None:
+    def _pace(self, key: int, nbytes: int, t0: float) -> None:
         """Paced-wire floor (btl_tcp_sim_gbps): hold the sender until
-        the frame's simulated wall time has elapsed."""
+        the frame's simulated wire slot has elapsed. Slots are issued
+        from a per-socket clock — a frame's slot begins where the
+        previous frame's slot ended (with at most _PACE_CREDIT of
+        catch-up), so sleep-wakeup overshoot doesn't compound and a
+        segment train paces at the same aggregate rate as one large
+        frame. Callers hold the socket's send lock, which is what
+        serializes access to this key's clock entry."""
         budget = nbytes / self._sim_bps
-        remain = budget - (time.perf_counter() - t0)
+        clock = self._pace_clock.get(key)
+        start = t0 if clock is None else max(clock, t0 - _PACE_CREDIT)
+        deadline = start + budget
+        self._pace_clock[key] = deadline
+        remain = deadline - time.perf_counter()
         if remain > 0:
             time.sleep(remain)
 
     def _send_frame_blocking(self, peer: int, header: dict,
                              payload: bytes = b"") -> None:
         s = self._connect(peer)
+        self._sendmsg(s, self._peer_locks[peer], header, payload)
+
+    def _sendmsg(self, s: socket.socket, lock: threading.Lock,
+                 header: dict, payload) -> None:
+        """Frame a header+payload pair onto one socket under its send
+        lock. Bulk payloads go as a second sendall instead of being
+        concatenated into the prefix (the concat copied every large
+        segment once more); both sendalls sit under the same lock, so
+        the frame stays contiguous on the wire and receive-side
+        framing is untouched. Accepts any buffer (bytes, bytearray,
+        memoryview) as payload."""
         hraw = pickle.dumps(header)
-        msg = _LEN.pack(MAGIC, len(hraw), len(payload)) + hraw + payload
-        with self._peer_locks[peer]:
-            if self._sim_bps > 0:
-                t0 = time.perf_counter()
-                s.sendall(msg)
-                self._pace(len(msg), t0)
+        nbytes = len(payload)
+        head = _LEN.pack(MAGIC, len(hraw), nbytes) + hraw
+        with lock:
+            t0 = time.perf_counter() if self._sim_bps > 0 else 0.0
+            if nbytes >= _BULK_MIN:
+                s.sendall(head)
+                s.sendall(payload)
             else:
-                s.sendall(msg)
+                s.sendall(head + payload if nbytes else head)
+            if self._sim_bps > 0:
+                self._pace(id(lock), len(head) + nbytes, t0)
 
     def _send_batch_blocking(self, peer: int, frames) -> None:
         """One sendall for a whole flush window. Encoding happens
@@ -445,11 +624,12 @@ class TcpEndpoint:
             if payload:
                 parts.append(payload)
         msg = b"".join(parts)
-        with self._peer_locks[peer]:
+        lock = self._peer_locks[peer]
+        with lock:
             if self._sim_bps > 0:
                 t0 = time.perf_counter()
                 s.sendall(msg)
-                self._pace(len(msg), t0)
+                self._pace(id(lock), len(msg), t0)
             else:
                 s.sendall(msg)
 
@@ -474,3 +654,9 @@ class TcpEndpoint:
                 except OSError:
                     pass
             self._peers.clear()
+            for s in self._rail_peers.values():
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            self._rail_peers.clear()

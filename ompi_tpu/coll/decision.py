@@ -223,6 +223,50 @@ def compression_rules() -> Dict[str, List[Sequence]]:
             for func in sorted(COMPRESSIBLE)}
 
 
+# -- large-message pipeline gating (ompi_tpu/pml/pipeline) ------------------
+# Host-tier collectives with a segment-pipelined schedule
+# (core/rankcomm): the ring allreduce and chain bcast whose chunk hops
+# ride the pml's pipelined rendezvous (docs/LARGEMSG.md).
+PIPELINED: Dict[str, str] = {"allreduce": "pipelined_ring",
+                             "bcast": "pipelined_chain"}
+
+
+def pipeline_rules() -> Dict[str, List[Sequence]]:
+    """Effective segment-pipeline rows in the fixed-table shape; empty
+    when ``mpi_base_pipeline_enable`` is off (off = byte-identical
+    serial dispatch). Two ranks minimum: a 1-rank 'ring' is a copy."""
+    from ompi_tpu.pml import pipeline as _pl
+    if not _pl.enabled():
+        return {}
+    mb = _pl.min_bytes()
+    return {func: [[2, mb, alg]]
+            for func, alg in sorted(PIPELINED.items())}
+
+
+def pipeline_plan(nbytes: int, rails: int = 1,
+                  rail_gbps: "float | None" = None) -> Dict[str, int]:
+    """Segment size and rail count for one ``nbytes`` pipelined
+    transfer: segments sized to carry ~2 ms of wire time at the probed
+    per-rail bandwidth (``btl/bml._probe_stream``'s tcp estimate,
+    recorded once in ``probe_basis['rail_gbps']`` and reused here
+    instead of re-probing), clamped to [256 KiB, 8 MiB] — grown toward
+    ``pipeline_depth`` segments per train (up to the ceiling), and
+    never fewer than ~4. The segment-count floor exists because the
+    window must fill before any overlap exists; the growth rule
+    because each segment costs a fixed slice of host CPU (header,
+    syscall, rail-thread wake), and past a full window extra segments
+    only add that overhead — measured on the paced tier, 4x8 MiB
+    beats 8x4 MiB by ~15% end to end."""
+    seg = 1 << 20
+    if rail_gbps:
+        seg = int(float(rail_gbps) * 1e9 * 0.002)
+    seg = max(256 << 10, min(8 << 20, seg))
+    from ompi_tpu.pml import pipeline as _pl
+    seg = max(seg, min(8 << 20, int(nbytes) // max(1, _pl.depth())))
+    seg = min(seg, max(64 << 10, int(nbytes) // 4))
+    return {"segment_bytes": int(seg), "rails": max(1, int(rails))}
+
+
 # -- persistent/bucket gating (ompi_tpu/coll/persistent) --------------------
 def persistent_rules() -> Dict[str, List[Sequence]]:
     """The pre-bound persistent-plan rows (MPI-4 ``*_init`` family),
@@ -272,6 +316,8 @@ def decision_table(comm_size: int = 0, multihost: bool = False,
     for func, rows in compression_rules().items():
         table[func] = table[func] + [list(r) for r in rows]
     for func, rows in bucket_rules().items():
+        table[func] = table[func] + [list(r) for r in rows]
+    for func, rows in pipeline_rules().items():
         table[func] = table[func] + [list(r) for r in rows]
     for func, rows in persistent_rules().items():
         table[func] = [list(r) for r in rows]

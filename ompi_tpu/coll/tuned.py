@@ -114,11 +114,18 @@ def staging_probe(transport_bps: Optional[float] = None,
     a_s = staged[0] - b_s * n1
     b_h = (host[1] - host[0]) / (n2 - n1)
     a_h = host[0] - b_h * n1
+    # host-tier wire volume per member: 2 serial payloads for the
+    # reduce+bcast schedule, 2(n-1)/n with full-duplex overlap once
+    # the segment-pipelined ring handles the large sizes this probe
+    # is deciding for (core/rankcomm, docs/LARGEMSG.md)
+    from ompi_tpu.pml import pipeline as _pl
+    wire_factor = (2.0 * (nranks - 1) / nranks
+                   if nranks > 1 and _pl.enabled() else 2.0)
     if transport_bps and transport_bps > 0 and nranks > 1:
-        # host-tier collectives shuffle ~2 full payloads per member
-        # through the byte transport (ring/recursive-doubling volume);
-        # the staged tier's device dispatch replaces that entirely
-        b_h += 2.0 / transport_bps
+        # host-tier collectives shuffle the payload volume above
+        # through the byte transport; the staged tier's device
+        # dispatch replaces that entirely
+        b_h += wire_factor / transport_bps
     basis: Dict[str, object] = {
         "ran": True,
         "staged_per_mb_ms": round(b_s * (1 << 20) * 1e3, 3),
@@ -146,7 +153,7 @@ def staging_probe(transport_bps: Optional[float] = None,
     # adopted winner then gets a 1.5x hysteresis band — payloads near
     # the boundary, where all the fit error lives, keep the host path.
     if cross < _NEVER_STAGE:
-        tx_per_byte = (2.0 / transport_bps
+        tx_per_byte = (wire_factor / transport_bps
                        if transport_bps and transport_bps > 0
                        and nranks > 1 else 0.0)
         confirm: Dict[str, object] = {}

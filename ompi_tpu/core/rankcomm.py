@@ -446,7 +446,10 @@ class RankCommunicator:
             # not staged -> (None, data), the payload already arrived.
             if self._rank == root:
                 if self._stageable(data, func="bcast"):
-                    msg = ((tuple(data.shape), data.dtype.str), None)
+                    msg = (("stage", tuple(data.shape), data.dtype.str),
+                           None)
+                elif self._pipeline_bcast_ok(data):
+                    msg = (("chain",), None)
                 elif _cwire.eligible(data):
                     # quantize ONCE at the root; the binomial tree
                     # forwards the codes losslessly (one quantization
@@ -457,8 +460,8 @@ class RankCommunicator:
             else:
                 msg = None
             meta, payload = self._host_bcast(msg, root)
-            if meta is not None:
-                shape, dtstr = meta
+            if meta is not None and meta[0] == "stage":
+                shape, dtstr = meta[1], meta[2]
                 local = (np.ascontiguousarray(data) if self._rank == root
                          else np.empty(shape, np.dtype(dtstr)))
                 spc.record("coll_staged_device", 1)
@@ -466,6 +469,8 @@ class RankCommunicator:
                 # the root already holds the payload: participate in
                 # the collective but skip the redundant D2H copy
                 return data if self._rank == root else np.asarray(res)
+            if meta is not None and meta[0] == "chain":
+                return self._pipelined_chain_bcast(data, root)
             return data if self._rank == root \
                 else _cwire.maybe_decode(payload)
         if self._rank == root and _cwire.eligible(data):
@@ -663,6 +668,8 @@ class RankCommunicator:
         if _cwire.eligible(data, op) \
                 and 1 < self.size <= _WIRE_DIRECT_MAX_RANKS:
             return self._wire_allreduce_direct(data, op)
+        if self._pipeline_ring_ok(data, op):
+            return self._pipelined_ring_allreduce(data, op)
         r = self.reduce(data, op, 0)
         if _cwire.eligible(data, op):
             # allreduce must return the SAME value on every rank: the
@@ -699,6 +706,138 @@ class RankCommunicator:
             img = _cwire.maybe_decode(parts[i])
             out = img if out is None else _apply(op, out, img)
         return out
+
+    # -- segment-pipelined host tier (docs/LARGEMSG.md) ----------------
+    def _pipeline_ring_ok(self, data: Any, op: op_mod.Op) -> bool:
+        """Rank-symmetric gate for the pipelined ring: the decision
+        rows (coll/decision.pipeline_rules) select by size and bytes,
+        and the fold must be a commutative predefined op with a numpy
+        kernel — the ring reassociates chunk folds exactly like the
+        other REORDERING schedules."""
+        if self.size < 2 or not isinstance(data, np.ndarray):
+            return False
+        if data.dtype.kind not in "fiu" or data.ndim == 0:
+            return False
+        if not op.commute or op.is_loc or not op.predefined:
+            return False
+        if op_mod.NP_COMBINERS.get(op.name) is None:
+            return False
+        from ompi_tpu.coll import decision
+        rules = decision.pipeline_rules().get("allreduce")
+        if not rules:
+            return False
+        return decision._match(rules, self.size,
+                               int(data.nbytes)) == "pipelined_ring"
+
+    def _pipelined_ring_allreduce(self, data: np.ndarray,
+                                  op: op_mod.Op) -> np.ndarray:
+        """Segment-pipelined ring allreduce for the host tier — the
+        device ``_ring_segmented_allreduce_inner``'s analogue over the
+        byte transport (coll_base_allreduce.c ring: reduce-scatter
+        ring then allgather ring). Each rank ends up computing ONE
+        chunk's full fold and circulating it, so results are bitwise
+        identical everywhere; every chunk hop is a large pt2pt send
+        that rides the pml's segment-pipelined rendezvous (striped
+        over mpi_base_btl_rails rails), and since all ranks send and
+        receive concurrently the wire time per step is one chunk, not
+        two. Wire bytes per rank: 2(n-1)/n payloads with overlap — vs
+        the serial reduce-then-bcast fallback's 2 payloads with none."""
+        n, r, t = self.size, self._rank, self._tag()
+        spc.record("coll_pipelined_ring", 1)
+        arr = np.ascontiguousarray(data)
+        shape, flat = arr.shape, arr.reshape(-1)
+        bounds = [(flat.size * i) // n for i in range(n + 1)]
+        # views, not copies: sends pack straight from the source buffer
+        # (pml/pipeline's zero-copy segments); the fold below replaces
+        # each entry with a fresh array, so the input is never mutated
+        chunks = [flat[bounds[i]:bounds[i + 1]] for i in range(n)]
+        right, left = (r + 1) % n, (r - 1) % n
+        npfn = op_mod.NP_COMBINERS[op.name]
+        # reduce-scatter ring: at step s, send chunk (r-s), fold the
+        # incoming chunk (r-s-1); after n-1 steps this rank holds the
+        # complete fold of chunk (r+1) % n
+        for s in range(n - 1):
+            si = (r - s) % n
+            ri = (r - s - 1) % n
+            req = self._coll_pml.irecv(left, t)
+            self._csend(right, t, chunks[si])
+            req.wait()
+            inc = req.get()
+            chunks[ri] = npfn(chunks[ri],
+                              np.asarray(inc).reshape(chunks[ri].shape))
+        # allgather ring: circulate the n fully-folded chunks
+        own = (r + 1) % n
+        cur = chunks[own]
+        for s in range(n - 1):
+            req = self._coll_pml.irecv(left, t)
+            self._csend(right, t, cur)
+            req.wait()
+            cur = np.asarray(req.get())
+            idx = (own - 1 - s) % n
+            chunks[idx] = cur.reshape(chunks[idx].shape)
+        out = chunks[0] if n == 1 else np.concatenate(
+            [np.asarray(c).reshape(-1) for c in chunks])
+        return out.reshape(shape).astype(arr.dtype, copy=False)
+
+    def _pipeline_bcast_ok(self, data: Any) -> bool:
+        """Root-side gate for the pipelined chain bcast; the decision
+        travels to the other ranks in the metadata round (bcast's args
+        are asymmetric, so only the root can decide)."""
+        if self.size < 2 or not isinstance(data, np.ndarray):
+            return False
+        if data.dtype.kind not in "fiub" or data.ndim == 0:
+            return False
+        from ompi_tpu.coll import decision
+        rules = decision.pipeline_rules().get("bcast")
+        if not rules:
+            return False
+        return decision._match(rules, self.size,
+                               int(data.nbytes)) == "pipelined_chain"
+
+    def _pipelined_chain_bcast(self, data: Any, root: int) -> Any:
+        """Segment-pipelined chain bcast (coll_base_bcast.c
+        pipeline/chain): ranks form a chain from the root; the payload
+        moves as a train of chunks, and every intermediate rank
+        forwards chunk c while its predecessor is already sending
+        chunk c+1 — after the chain fills, every link streams
+        concurrently, so wall time approaches one payload's wire time
+        plus chain-depth chunk latencies instead of depth full
+        payloads. Chunks large enough also ride the pml's segmented
+        rendezvous inside each hop."""
+        n, t = self.size, self._tag()
+        vr = (self._rank - root) % n
+        succ = ((vr + 1) + root) % n if vr + 1 < n else None
+        pred = ((vr - 1) + root) % n
+        spc.record("coll_pipelined_chain", 1)
+        if vr == 0:
+            arr = np.ascontiguousarray(data)
+            flat = arr.reshape(-1)
+            from ompi_tpu.pml import pipeline as _pl
+            seg = _pl.segment_bytes_for(int(arr.nbytes),
+                                        self.router.endpoint)
+            # chunk = a few segments: big enough to pipeline inside
+            # the hop, small enough that the chain fills quickly
+            per = max(1, (seg * 4) // max(arr.dtype.itemsize, 1))
+            k = max(1, -(-flat.size // per))
+            if succ is not None:
+                self._csend(succ, t, (k, tuple(arr.shape),
+                                      arr.dtype.str))
+                for c in range(k):
+                    self._csend(succ, t, flat[c * per:(c + 1) * per])
+            return data
+        k, shape, dtstr = self._crecv(pred, t)
+        if succ is not None:
+            self._csend(succ, t, (k, shape, dtstr))
+        parts: List[Any] = []
+        for c in range(k):
+            part = self._crecv(pred, t)
+            if succ is not None:
+                self._csend(succ, t, part)   # forward c while pred
+            parts.append(part)               # streams c+1 behind it
+        flat = np.asarray(parts[0]).reshape(-1) if k == 1 \
+            else np.concatenate([np.asarray(p).reshape(-1)
+                                 for p in parts])
+        return flat.reshape(shape).astype(np.dtype(dtstr), copy=False)
 
     @_serialized
     def gather(self, data: Any, root: int = 0) -> Optional[List[Any]]:

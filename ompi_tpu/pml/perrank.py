@@ -63,6 +63,12 @@ class Router:
         self._rma: Dict[Any, Any] = {}
         self._closing = False
         self._departed: set = set()      # peers that said goodbye
+        # segment-train reassembly for the pipelined rendezvous
+        # (pml/pipeline): keyed (source world rank, pipe id), fed by
+        # rail reader threads BELOW the matching layer — created before
+        # the endpoint so no reader thread can race it
+        from ompi_tpu.pml.pipeline import PipeStore
+        self.pipes = PipeStore()
         # the bml/r2 multiplexer: sm rings for same-host eager frames,
         # tcp for the rest (and as the failure detector's wire)
         from ompi_tpu.btl.bml import BmlEndpoint
@@ -115,6 +121,9 @@ class Router:
             return                       # graceful exit, not death
         from ompi_tpu.runtime import ft
         ft.fail_rank(world_rank, "peer connection lost")
+        # unfinished segment trains from the dead sender can never
+        # complete — fail their waiters now (pml/pipeline)
+        self.pipes.fail_peer(world_rank)
         with self._lock:
             engines = list(self._engines.values())
         for eng in engines:
@@ -178,6 +187,12 @@ class Router:
             if h is not None:
                 h(header, raw)
             return
+        if "pipeseg" in header:
+            # a rail-striped segment of a pipelined rendezvous train:
+            # reassembled by index below the matching layer — only the
+            # train's ordered init frame participates in matching
+            self.pipes.deliver(header, raw)
+            return
         cid = header["cid"]
         with self._lock:
             eng = self._engines.get(cid)
@@ -239,6 +254,11 @@ class RankRequest(Request):
         self.status.count = int(getattr(msg.data, "size", 1) or 1)
         self.status.nbytes = int(getattr(msg.data, "nbytes", -1))
         self._complete = True
+        # completion is a cancellation point (cancel() becomes a no-op)
+        # — drop the closure NOW: it captures this request, and the
+        # request → closure → cell → request cycle pins the payload
+        # (up to a whole segment train) until a full gen-2 gc pass
+        self._cancel_fn = None
         _progress.wake(self._event)      # coalesced under drain batches
 
     def _fail(self, err: BaseException) -> None:
@@ -246,6 +266,7 @@ class RankRequest(Request):
         the matching send can never arrive from a dead peer."""
         self._error = err
         self._complete = True
+        self._cancel_fn = None           # break the cancel-closure cycle
         _progress.wake(self._event)
 
     def test(self):
@@ -259,6 +280,13 @@ class RankRequest(Request):
                            "recv timed out waiting for a matching send")
         if self._error is not None:
             raise self._error
+        from ompi_tpu.pml.pipeline import PipePayload
+        if isinstance(self._result, PipePayload):
+            # MPI completion means the data is PLACED: assemble the
+            # segment train now so the store's multi-MB buffer is
+            # released even if the caller never calls get()
+            from ompi_tpu.pml.pipeline import maybe_resolve as _pr
+            self._result = _pr(self._result)
         return self.status
 
     def get(self):
@@ -267,7 +295,8 @@ class RankRequest(Request):
         thread — the pull must never run on a btl reader thread."""
         self.wait()
         from ompi_tpu.btl.devxfer import maybe_resolve
-        self._result = maybe_resolve(self._result)
+        from ompi_tpu.pml.pipeline import maybe_resolve as _pipe_resolve
+        self._result = _pipe_resolve(maybe_resolve(self._result))
         return self._result
 
 
@@ -380,6 +409,12 @@ class PerRankEngine:
             # lazily on the consumer thread (btl/devxfer)
             from ompi_tpu.btl.devxfer import DevPayload
             payload = DevPayload(self.router, d)
+        elif d.get("kind") == "pipe":
+            # pipelined-rendezvous init frame (pml/pipeline): matches
+            # NOW with the right counts; the segment train assembles
+            # on the consumer thread at resolve time
+            from ompi_tpu.pml.pipeline import PipePayload
+            payload = PipePayload(self.router, d)
         else:
             payload = decode_payload(d, raw)
             # inline-combining fast path: a posted CombineSlot for this
@@ -512,6 +547,15 @@ class PerRankEngine:
             desc, raw = dev_desc, b""
             wire_bytes = int(data.nbytes)   # moved out-of-band (D2D)
         else:
+            # host byte path: large payloads take the segment-
+            # pipelined rendezvous (pml/pipeline, docs/LARGEMSG.md);
+            # None means nothing touched the wire — fall through to
+            # the unchanged eager path
+            from ompi_tpu.pml import pipeline as _pipeline
+            preq = _pipeline.maybe_send_pipelined(self, data, dest,
+                                                  tag, synchronous)
+            if preq is not None:
+                return preq
             desc, raw = encode_payload(data)
             wire_bytes = len(raw)
         me = self.comm.rank()
@@ -775,7 +819,8 @@ class PerRankEngine:
     @staticmethod
     def mrecv(msg: _Msg) -> Tuple[Any, Status]:
         from ompi_tpu.btl.devxfer import maybe_resolve
-        data = maybe_resolve(msg.data)
+        from ompi_tpu.pml.pipeline import maybe_resolve as _pipe_resolve
+        data = _pipe_resolve(maybe_resolve(msg.data))
         return data, Status(source=msg.src, tag=msg.tag,
                             count=int(getattr(data, "size", 1) or 1),
                             nbytes=int(getattr(data, "nbytes", -1)))

@@ -1,0 +1,466 @@
+"""pml/pipeline — segment-pipelined rendezvous for large host payloads.
+
+Behavioral spec: ob1's pipelined rendezvous protocol
+(``pml_ob1_sendreq.h:389-460``) — above the rendezvous threshold a
+payload leaves the single-copy eager path and moves as a train of
+fragments, so pack work overlaps the wire, and the send scheduler
+(``mca_pml_ob1_send_request_schedule``) round-robins fragments over
+every eligible BTL.
+
+TPU-native re-design: host-tier payloads at or above
+``mpi_base_pipeline_min_bytes`` are cut into segments (size from the
+``coll/decision`` pipeline rows, fed by the bml probe's per-rail
+bandwidth estimate; ``mpi_base_pipeline_segment_bytes`` overrides) with
+``mpi_base_pipeline_depth`` segments in flight. A small *init* frame
+rides the ordered bml stream — it is what MATCHES, so MPI's
+non-overtaking rule is untouched — while the segments travel unordered,
+striped round-robin over ``mpi_base_btl_rails`` rails
+(``btl/bml.send_segment``), each independently packed (the convertor
+role), staged D2H (``btl/devxfer.SegmentStager`` double-buffering), and
+compressed (``compress/wire`` per segment, whole-message gated), so all
+of that work overlaps the wire. The receive side reassembles by segment
+index (:class:`PipeStore`), so out-of-order rail delivery is harmless.
+
+Observability: ``pml_pipeline_segments`` / ``pml_pipeline_inits`` /
+``pml_overlap_ratio`` pvars and ``pml.segment`` trace spans
+(docs/LARGEMSG.md).
+"""
+from __future__ import annotations
+
+import itertools
+import pickle
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from ompi_tpu.btl.tcp import decode_payload
+from ompi_tpu.compress import wire as _cwire
+from ompi_tpu.core.errhandler import ERR_PENDING, ERR_PROC_FAILED, MPIError
+from ompi_tpu.mca import pvar as _pvar
+from ompi_tpu.mca import var as _var
+from ompi_tpu.runtime import progress as _progress
+from ompi_tpu.trace import core as _trace
+
+# single source of truth for the tuning defaults (the bml convention)
+_DEF_MIN_BYTES = 4 << 20
+_DEF_SEG_BYTES = 1 << 20
+_DEF_DEPTH = 4
+
+_uids = itertools.count(1)
+
+
+def register_params() -> None:
+    _var.var_register(
+        "mpi", "base", "pipeline_enable", vtype="bool", default=True,
+        help="Segment-pipelined rendezvous for large host-path pt2pt "
+             "payloads (docs/LARGEMSG.md); off restores the serial "
+             "eager path byte-for-byte")
+    _var.var_register(
+        "mpi", "base", "pipeline_min_bytes", vtype="int",
+        default=_DEF_MIN_BYTES,
+        help="Host payloads at or above this take the pipelined "
+             "rendezvous (ordered init frame + unordered striped "
+             "segment train)")
+    _var.var_register(
+        "mpi", "base", "pipeline_segment_bytes", vtype="int",
+        default=_DEF_SEG_BYTES,
+        help="Segment size for the pipelined rendezvous; when left at "
+             "the default the effective size comes from the decision "
+             "rows (coll/decision.pipeline_plan, fed by the bml "
+             "probe's per-rail bandwidth)")
+    _var.var_register(
+        "mpi", "base", "pipeline_depth", vtype="int", default=_DEF_DEPTH,
+        help="Segments in flight per pipelined send (the rendezvous "
+             "scheduler window; prep of segment s+depth waits for "
+             "segment s's wire slot)")
+
+
+def enabled() -> bool:
+    register_params()
+    return bool(_var.var_get("mpi_base_pipeline_enable", True))
+
+
+def min_bytes() -> int:
+    register_params()
+    return int(_var.var_get("mpi_base_pipeline_min_bytes",
+                            _DEF_MIN_BYTES))
+
+
+def depth() -> int:
+    register_params()
+    return max(1, int(_var.var_get("mpi_base_pipeline_depth",
+                                   _DEF_DEPTH)))
+
+
+def segment_bytes_for(total: int, endpoint=None) -> int:
+    """Effective segment size for one ``total``-byte transfer: a
+    user-set ``mpi_base_pipeline_segment_bytes`` wins; otherwise the
+    decision row picks by message size and the probed per-rail
+    bandwidth (``btl/bml._probe_stream``'s estimate, reused instead of
+    re-probing)."""
+    register_params()
+    if _var.var_overridden("mpi_base_pipeline_segment_bytes"):
+        return max(64 << 10, int(_var.var_get(
+            "mpi_base_pipeline_segment_bytes", _DEF_SEG_BYTES)))
+    from ompi_tpu.coll import decision
+    basis = getattr(endpoint, "probe_basis", None) or {}
+    plan = decision.pipeline_plan(
+        total, rails=int(getattr(endpoint, "rails", 1) or 1),
+        rail_gbps=basis.get("rail_gbps"))
+    return int(plan["segment_bytes"])
+
+
+# -- pvars ------------------------------------------------------------------
+stats = {"segments": 0, "inits": 0}
+_gauges = {"overlap_ratio": 0.0}
+
+
+def _register_pvars() -> None:
+    _pvar.pvar_register(
+        "pml_pipeline_segments", lambda: stats["segments"],
+        help="Segments sent by the pipelined rendezvous "
+             "(docs/LARGEMSG.md)")
+    _pvar.pvar_register(
+        "pml_pipeline_inits", lambda: stats["inits"],
+        help="Pipelined rendezvous trains initiated by this process")
+    _pvar.pvar_register(
+        "pml_overlap_ratio", lambda: _gauges["overlap_ratio"],
+        unit="ratio", var_class="level",
+        help="Fraction of the serial cost (segment prep + summed "
+             "per-rail wire time) hidden by overlap on the most "
+             "recent pipelined send")
+
+
+# -- receive-side reassembly ------------------------------------------------
+class _PipeBuf:
+    __slots__ = ("lock", "segs", "nseg", "event", "error", "buf",
+                 "have")
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.segs: Dict[int, bytes] = {}
+        self.nseg: Optional[int] = None
+        self.event = threading.Event()
+        self.error: Optional[BaseException] = None
+        # offset-addressed trains (uncompressed): ONE payload-sized
+        # buffer assembled in place — no per-segment allocations, no
+        # join pass, and resolve() hands the buffer to numpy zero-copy
+        self.buf: Optional[bytearray] = None
+        self.have = 0
+
+
+class PipeStore:
+    """Segment-train reassembly, keyed (source world rank, pipe id).
+
+    Segments arrive unordered from any rail's reader thread; the
+    matching init frame may land before, between, or after them (it
+    rides the ordered stream, they do not), so both sides get-or-create
+    the buffer. One store per :class:`~ompi_tpu.pml.perrank.Router`.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._bufs: Dict[Tuple[int, int], _PipeBuf] = {}
+
+    def _buf(self, key: Tuple[int, int]) -> _PipeBuf:
+        with self._lock:
+            b = self._bufs.get(key)
+            if b is None:
+                b = self._bufs[key] = _PipeBuf()
+        return b
+
+    def deliver(self, header: dict, raw: bytes) -> None:
+        """One segment frame, called from a btl reader thread.
+
+        Segments carrying a byte offset (``off``/``tb``, the
+        uncompressed fast path) are copied straight into ONE
+        payload-sized assembly buffer — ``raw`` may be a transient
+        view (the btl reader's reusable scratch, or the sender's own
+        buffer on loopback), since nothing is retained past this call.
+        Compressed segments have irregular wire lengths and decode
+        later on the consumer thread, so they keep the classic
+        per-segment stash (their ``raw`` is always an owned buffer)."""
+        b = self._buf((int(header["psrc"]), int(header["pipe"])))
+        off = header.get("off")
+        with b.lock:
+            if b.nseg is None:
+                b.nseg = int(header["n"])
+            if off is not None:
+                if b.buf is None:
+                    b.buf = bytearray(int(header["tb"]))
+                b.buf[off:off + len(raw)] = raw
+                b.have += 1
+                done = b.have >= b.nseg
+            else:
+                b.segs[int(header["idx"])] = raw
+                done = len(b.segs) >= b.nseg
+        if done:
+            _progress.wake(b.event)      # coalesced consumer wake
+
+    def claim(self, psrc: int, uid: int, nseg: int) -> _PipeBuf:
+        """The init frame's side: bind the expected train length."""
+        b = self._buf((int(psrc), int(uid)))
+        with b.lock:
+            b.nseg = int(nseg)
+            done = (b.have if b.buf is not None
+                    else len(b.segs)) >= b.nseg
+        if done:
+            b.event.set()                # whole train raced the init
+        return b
+
+    def forget(self, psrc: int, uid: int) -> None:
+        with self._lock:
+            self._bufs.pop((int(psrc), int(uid)), None)
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._bufs)
+
+    def fail_peer(self, world_rank: int) -> None:
+        """ULFM: a dead sender's unfinished trains can never complete —
+        fail their waiters instead of letting them ride the timeout."""
+        with self._lock:
+            bufs = [b for (src, _), b in self._bufs.items()
+                    if src == world_rank]
+        err = MPIError(ERR_PROC_FAILED,
+                       f"pipelined payload source rank {world_rank} "
+                       f"died mid-train")
+        for b in bufs:
+            b.error = err
+            _progress.wake(b.event)
+
+
+class PipePayload:
+    """Descriptor of an in-flight segmented payload — the object that
+    MATCHES (probe/status see the right counts) while segments are
+    still landing. ``resolve()`` blocks until the train completes and
+    assembles on the CONSUMER thread (the DevPayload contract: never
+    on a btl reader thread)."""
+
+    def __init__(self, router, desc: dict):
+        self._desc = desc
+        self._store: PipeStore = router.pipes
+        self._buf = self._store.claim(desc["psrc"], desc["pipe"],
+                                      desc["nseg"])
+        self._result: Any = None
+        self._done = False
+        self._rlock = threading.Lock()
+        inner = desc["inner"]
+        self.nbytes = int(desc["nbytes"])
+        if inner.get("kind") == "nd":
+            self.shape = tuple(inner["shape"])
+            self.dtype = np.dtype(inner["dtype"])
+            self.size = int(np.prod(self.shape)) if self.shape else 1
+        else:
+            self.size = 1
+
+    def resolve(self):
+        with self._rlock:                # exactly-once, thread-safe
+            if self._done:
+                return self._result
+            b = self._buf
+            if not b.event.wait(600):
+                raise MPIError(ERR_PENDING,
+                               "pipelined payload timed out waiting "
+                               "for its segment train")
+            if b.error is not None:
+                raise b.error
+            desc = self._desc
+            inner = desc["inner"]
+            n = int(desc["nseg"])
+            with b.lock:
+                buf = b.buf
+                segs = None if buf is not None \
+                    else [b.segs[i] for i in range(n)]
+                b.buf = None
+                b.segs = {}
+            if buf is not None:
+                # offset-assembled train: the assembly buffer IS the
+                # payload — numpy adopts it without a copy
+                out = np.frombuffer(buf, dtype=self.dtype) \
+                    .reshape(self.shape)
+            elif inner.get("comp"):
+                # per-segment codec: each segment is an independently
+                # quantized slice of the flattened payload
+                parts = [_cwire.decode(pickle.loads(s)) for s in segs]
+                flat = parts[0] if len(parts) == 1 \
+                    else np.concatenate([p.reshape(-1) for p in parts])
+                out = flat.reshape(self.shape)
+            else:
+                out = decode_payload(inner, b"".join(segs))
+            self._store.forget(desc["psrc"], desc["pipe"])
+            self._result = out
+            self._done = True
+            return out
+
+
+def maybe_resolve(data):
+    """Consumer-side hook: assemble a pipelined payload; anything else
+    passes through untouched (composes after devxfer's hook)."""
+    if isinstance(data, PipePayload):
+        return data.resolve()
+    return data
+
+
+# -- send side --------------------------------------------------------------
+def _comp_codec(dtype_name: str, total: int) -> Optional[str]:
+    """Per-segment compression gate: the codec gates of
+    ``compress/wire.eligible`` applied to the WHOLE message (segments
+    individually may sit under the threshold — the nbytes override
+    exists for exactly this composition)."""
+    from ompi_tpu import compress as _c
+    if not _c.enabled():
+        return None
+    if dtype_name not in ("float32", "float64"):
+        return None
+    if total < _c.min_bytes():
+        return None
+    return _c.codec_name()
+
+
+def maybe_send_pipelined(engine, data: Any, dest: int, tag: int,
+                         synchronous: bool):
+    """The pml's host-path protocol switch: returns a completed Request
+    when the payload took the pipelined rendezvous, or None to fall
+    through to the serial eager path. When it returns None, NOTHING
+    here has touched the wire — the fallback stays byte-identical."""
+    if not enabled():
+        return None
+    stager = None
+    is_dev = False
+    try:
+        import jax
+        is_dev = isinstance(data, jax.Array)
+    except Exception:                    # noqa: BLE001
+        is_dev = False
+    if isinstance(data, np.ndarray) and not is_dev:
+        if data.dtype.hasobject or data.ndim == 0:
+            return None
+        total = int(data.nbytes)
+        np_dtype = data.dtype
+        shape = tuple(data.shape)
+    elif is_dev:
+        if data.ndim == 0:
+            return None
+        try:                             # non-numpy dtypes (bfloat16)
+            np_dtype = np.dtype(str(data.dtype))
+        except TypeError:
+            return None                  # keep the eager encoding
+        total = int(data.nbytes)
+        shape = tuple(data.shape)
+    else:
+        return None                     # generic objects stay eager
+    if total < min_bytes():
+        return None
+    router = engine.router
+    ep = router.endpoint
+    seg_bytes = segment_bytes_for(total, ep)
+    epseg = max(1, seg_bytes // max(np_dtype.itemsize, 1))
+    size = int(np.prod(shape)) if shape else 1
+    nseg = -(-size // epseg)
+    if nseg < 2:
+        return None                      # nothing to overlap
+    if is_dev:
+        from ompi_tpu.btl.devxfer import SegmentStager
+        stager = SegmentStager(data, epseg)
+        flat = None
+    else:
+        arr = np.ascontiguousarray(data)
+        flat = arr.reshape(-1)
+    codec = _comp_codec(np_dtype.name, total)
+    inner: Dict[str, Any] = {"kind": "nd", "dtype": np_dtype.str,
+                             "shape": shape}
+    if codec:
+        inner["comp"] = codec
+    uid = next(_uids)
+    me = engine.comm.rank()
+    wdest = engine.comm.world_rank_of(dest)
+    t = engine.traffic.setdefault((me, dest), [0, 0])
+    t[0] += 1
+    t[1] += total
+    header = {"cid": engine.comm.cid, "src": me, "tag": tag,
+              "desc": {"kind": "pipe", "pipe": uid, "psrc": router.rank,
+                       "nseg": nseg, "nbytes": total, "inner": inner}}
+    ent = aid = None
+    if synchronous:
+        aid, ent = router.new_ack()
+        header["ack_id"] = aid
+        header["wsrc"] = engine.comm.world_rank_of(me)
+    # the init frame rides the ORDERED stream: it is what matches, so
+    # two sends to one peer can never overtake each other even though
+    # their segment trains interleave freely on the rails
+    ep.send_frame(wdest, header, b"")
+
+    window = threading.Semaphore(depth())
+    lock = threading.Lock()
+    state = {"pending": nseg, "wire_s": 0.0}
+    done_evt = threading.Event()
+
+    def on_done(dt: float) -> None:      # runs on a rail sender thread
+        window.release()
+        with lock:
+            state["wire_s"] += dt
+            state["pending"] -= 1
+            if state["pending"] == 0:
+                done_evt.set()
+
+    t_start = time.perf_counter()
+    prep_s = 0.0
+    send_segment = ep.send_segment
+    for i in range(nseg):
+        window.acquire()                 # N segments in flight
+        tok = (_trace.begin("pml.segment", idx=i, pipe=uid, dest=dest)
+               if _trace.active else None)
+        t0 = time.perf_counter()
+        if stager is not None:
+            seg = stager.get(i)          # staged D2H, next copy already
+        else:                            # in flight (double buffer)
+            seg = flat[i * epseg:(i + 1) * epseg]
+        seg_header = {"pipeseg": 1, "pipe": uid, "psrc": router.rank,
+                      "idx": i, "n": nseg}
+        if codec:
+            w = _cwire.encode(np.ascontiguousarray(seg))
+            raw = pickle.dumps(w, protocol=pickle.HIGHEST_PROTOCOL)
+        else:                            # zero-copy pack: the segment
+            raw = memoryview(seg).cast("B")   # rides the source buffer
+            # straight to sendall (tcp._sendmsg) — tobytes() here cost
+            # one full extra pass over every large message. The byte
+            # offset lets the receiver assemble in place (PipeStore).
+            seg_header["off"] = i * epseg * np_dtype.itemsize
+            seg_header["tb"] = total
+        dt = time.perf_counter() - t0
+        prep_s += dt
+        if tok is not None:
+            _trace.end(tok, bytes=len(raw))
+        send_segment(wdest, seg_header, raw, on_done)
+    if not done_evt.wait(600):
+        raise MPIError(ERR_PENDING,
+                       "pipelined send timed out draining its "
+                       "segment train")
+    wall = time.perf_counter() - t_start
+    with lock:
+        serial = prep_s + state["wire_s"]
+    stats["segments"] += nseg
+    stats["inits"] += 1
+    if serial > 1e-9:
+        _gauges["overlap_ratio"] = round(
+            max(0.0, min(1.0, (serial - wall) / serial)), 4)
+    if ent is not None and not ent[0].wait(600):
+        router.cancel_ack(aid)
+        raise MPIError(ERR_PENDING,
+                       "ssend timed out waiting for the receive")
+    from ompi_tpu.core.request import Request
+    return Request.completed()
+
+
+def reset_stats() -> None:
+    """Tests / a new measurement window."""
+    stats["segments"] = 0
+    stats["inits"] = 0
+    _gauges["overlap_ratio"] = 0.0
+
+
+register_params()
+_register_pvars()

@@ -203,11 +203,18 @@ def _init_per_rank(requested: int) -> int:
             try:
                 pb = dict(getattr(router.endpoint, "probe_basis",
                                   {}) or {})
-                bps = None
+                g = None
                 if pb.get("ran"):
                     g = (pb.get("sm_gbps") if not pb.get("sm_demoted")
                          else pb.get("tcp_gbps"))
-                    bps = g * 1e9 if g else None
+                if not g:
+                    # routing probe suppressed (sm disabled or user-set
+                    # btl_sm_min_bytes) — the tcp half still measured
+                    # the wire, and a host tier modeled with NO
+                    # transport cost routed 8 MB against its own A/B
+                    # (the r08 tcp route_ok break)
+                    g = pb.get("rail_gbps")
+                bps = g * 1e9 if g else None
                 value, basis = _tuned.staging_probe(
                     transport_bps=bps, nranks=nprocs)
             except Exception:            # noqa: BLE001 — advisory

@@ -16,9 +16,16 @@ Invariants the lossy/fused subsystems must never lose
    ``test_bucketed_<func>_matches_unfused`` pair — a fused wire path
    without its equivalence test is an unverified rewrite of the
    collective's result.
-3. **Tier-1 budget**: compression/persistent tests that spawn real OS
-   processes (``subprocess``-using test functions in
-   ``tests/test_compress*`` / ``tests/test_persistent*``) carry the
+3. **Pipeline parity**: every collective with a segment-pipelined
+   host-tier schedule (``coll/decision.PIPELINED``) has a
+   ``test_pipelined_<func>_matches_unpipelined`` pair — a pipelined
+   rewrite of the wire schedule without its equivalence test is an
+   unverified reordering of the collective's result
+   (docs/LARGEMSG.md).
+4. **Tier-1 budget**: compression/persistent/large-message tests that
+   spawn real OS processes (``subprocess``-using test functions in
+   ``tests/test_compress*`` / ``tests/test_persistent*`` /
+   ``tests/test_largemsg*`` / ``tests/test_btl_rails*``) carry the
    ``slow`` marker, so the multi-process jobs stay out of the
    ``-m 'not slow'`` tier-1 run and its 870 s wall budget.
 
@@ -94,6 +101,7 @@ def _module_slow_pytestmark(path: str) -> bool:
 def audit(tests_dir: Optional[str] = None) -> Dict[str, Any]:
     tests_dir = tests_dir or os.path.join(_REPO, "tests")
     from ompi_tpu.coll.compressed import WRAPPED_FUNCS
+    from ompi_tpu.coll.decision import PIPELINED
     from ompi_tpu.coll.persistent import FUSED_FUNCS, PERSISTENT_FUNCS
 
     wanted = {f"test_compressed_{func}_matches_uncompressed": func
@@ -102,8 +110,11 @@ def audit(tests_dir: Optional[str] = None) -> Dict[str, Any]:
                    for func in PERSISTENT_FUNCS}
     wanted_pers.update({f"test_bucketed_{func}_matches_unfused": func
                         for func in FUSED_FUNCS})
+    wanted_pipe = {f"test_pipelined_{func}_matches_unpipelined": func
+                   for func in PIPELINED}
     found: set = set()
     found_pers: set = set()
+    found_pipe: set = set()
     unmarked: List[str] = []
     for path in sorted(glob.glob(os.path.join(tests_dir, "**", "*.py"),
                                  recursive=True)):
@@ -114,18 +125,25 @@ def audit(tests_dir: Optional[str] = None) -> Dict[str, Any]:
                 found.add(name)
             if name in wanted_pers:
                 found_pers.add(name)
-            if base.startswith(("test_compress", "test_persistent")) \
+            if name in wanted_pipe:
+                found_pipe.add(name)
+            if base.startswith(("test_compress", "test_persistent",
+                                "test_largemsg", "test_btl_rails")) \
                     and _uses_subprocess(node) \
                     and not (mod_slow or _has_slow_mark(node)):
                 unmarked.append(f"{base}::{name}")
     missing = sorted(set(wanted) - found)
     missing_pers = sorted(set(wanted_pers) - found_pers)
-    return {"ok": not missing and not missing_pers and not unmarked,
+    missing_pipe = sorted(set(wanted_pipe) - found_pipe)
+    return {"ok": not missing and not missing_pers and not missing_pipe
+            and not unmarked,
             "wrapped_funcs": list(WRAPPED_FUNCS),
             "persistent_funcs": list(PERSISTENT_FUNCS),
             "fused_funcs": list(FUSED_FUNCS),
+            "pipelined_funcs": sorted(PIPELINED),
             "missing_parity": missing,
             "missing_persistent_parity": missing_pers,
+            "missing_pipeline_parity": missing_pipe,
             "unmarked_slow": sorted(unmarked)}
 
 

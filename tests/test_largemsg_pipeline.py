@@ -1,0 +1,182 @@
+"""Segment-pipelined rendezvous (pml/pipeline): loopback parity across
+segment sizes, the byte-identical off-switch, pipeline x compression
+composition, and the 2-rank live parity drives (docs/LARGEMSG.md).
+
+The fast tests run the full pipelined send/recv protocol through a
+loopback Router (segment trains, PipeStore reassembly, pvars) without
+spawning processes. The ``*_matches_unpipelined`` pairs — the parity
+contract tools/checkparity.py enforces for every coll/decision
+PIPELINED schedule — launch tests/perrank_programs/p33_largemsg.py as
+a real multi-process job and carry the ``slow`` marker (tier-1 keeps
+its 870 s budget; checkparity audits the marker too).
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from ompi_tpu.mca import pvar, var
+from ompi_tpu.pml import pipeline as pl
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_MPIRUN = os.path.join(_REPO, "ompi_tpu", "tools", "mpirun.py")
+_P33 = os.path.join(_REPO, "tests", "perrank_programs",
+                    "p33_largemsg.py")
+
+
+def _loopback_engine(cid, size=2):
+    from ompi_tpu.pml.perrank import PerRankEngine, Router
+
+    kv = {}
+    router = Router(0, 1, kv.__setitem__, kv.__getitem__)
+
+    class _C:
+        def __init__(self):
+            self.cid = cid
+            self.size = size
+
+        def rank(self):
+            return 0
+
+        def world_rank_of(self, r):
+            return 0                     # loopback: every dest is me
+    return PerRankEngine(_C(), router), router
+
+
+@pytest.fixture()
+def _pipe_env():
+    """Low thresholds for fast payloads; restore every knob after."""
+    defaults = {"mpi_base_pipeline_enable": True,
+                "mpi_base_pipeline_min_bytes": pl.min_bytes(),
+                "mpi_base_pipeline_segment_bytes": 1 << 20,
+                "mpi_base_compress": False,
+                "mpi_base_compress_min_bytes": 4 << 20}
+    saved = {k: var.var_get(k, d) for k, d in defaults.items()}
+    var.var_set("mpi_base_pipeline_enable", True)
+    var.var_set("mpi_base_pipeline_min_bytes", 1 << 16)
+    yield
+    for k, v in saved.items():
+        var.var_set(k, v)
+
+
+@pytest.mark.parametrize("seg_bytes", [64 << 10, 128 << 10, 256 << 10])
+def test_loopback_segment_sweep_parity(_pipe_env, seg_bytes):
+    """The same 1 MB payload cut at different segment sizes always
+    reassembles bit-exact, and the segment pvar counts the train."""
+    var.var_set("mpi_base_pipeline_segment_bytes", seg_bytes)
+    eng, router = _loopback_engine(f"seg{seg_bytes}")
+    try:
+        x = np.arange(1 << 18, dtype=np.float32).reshape(512, 512)
+        s0 = pvar.pvar_read("pml_pipeline_segments")
+        i0 = pvar.pvar_read("pml_pipeline_inits")
+        eng.send(x, 1, tag=3)
+        got, _ = eng.recv(source=0, tag=3, timeout=30)
+        got = np.asarray(got)
+        assert got.dtype == x.dtype and got.shape == x.shape
+        assert np.array_equal(got, x)
+        nseg = pvar.pvar_read("pml_pipeline_segments") - s0
+        assert nseg == -(-x.nbytes // max(seg_bytes, 64 << 10))
+        assert pvar.pvar_read("pml_pipeline_inits") - i0 == 1
+        assert not router.pipes.pending(), "train leaked in PipeStore"
+    finally:
+        router.close()
+
+
+def test_pipeline_off_is_byte_identical(_pipe_env):
+    """Disabled (or sub-threshold, or non-array) payloads never enter
+    the pipelined path: maybe_send_pipelined declines BEFORE touching
+    the wire, so the frames are the exact serial-path frames."""
+    eng, router = _loopback_engine("pipeoff")
+    try:
+        big = np.arange(1 << 18, dtype=np.float32)
+        var.var_set("mpi_base_pipeline_enable", False)
+        assert pl.maybe_send_pipelined(eng, big, 1, 9, False) is None
+        var.var_set("mpi_base_pipeline_enable", True)
+        # sub-threshold and object payloads decline too
+        small = np.arange(8, dtype=np.float32)
+        assert pl.maybe_send_pipelined(eng, small, 1, 9, False) is None
+        assert pl.maybe_send_pipelined(eng, {"k": 1}, 1, 9, False) is None
+        assert pl.maybe_send_pipelined(
+            eng, np.array(3.0), 1, 9, False) is None
+        # and the serial path still round-trips them with no train
+        i0 = pvar.pvar_read("pml_pipeline_inits")
+        var.var_set("mpi_base_pipeline_enable", False)
+        eng.send(big, 1, tag=4)
+        got, _ = eng.recv(source=0, tag=4, timeout=30)
+        assert np.array_equal(np.asarray(got), big)
+        assert pvar.pvar_read("pml_pipeline_inits") == i0
+        assert not router.pipes.pending()
+    finally:
+        router.close()
+
+
+def test_pipeline_compression_composition(_pipe_env):
+    """Per-segment compression: the codec gates once on the WHOLE
+    message, each segment's slice encodes independently, and the
+    decode side reassembles — ratio on the wire, parity within the
+    codec's documented error."""
+    var.var_set("mpi_base_pipeline_segment_bytes", 64 << 10)
+    var.var_set("mpi_base_compress", True)
+    var.var_set("mpi_base_compress_min_bytes", 1 << 16)
+    eng, router = _loopback_engine("pipecomp")
+    try:
+        y = np.random.default_rng(0).normal(
+            size=1 << 18).astype(np.float32)
+        s0 = pvar.pvar_read("pml_pipeline_segments")
+        bi0 = pvar.pvar_read("compress_bytes_in")
+        bo0 = pvar.pvar_read("compress_bytes_out")
+        eng.send(y, 1, tag=5)
+        got, _ = eng.recv(source=0, tag=5, timeout=30)
+        got = np.asarray(got)
+        nseg = pvar.pvar_read("pml_pipeline_segments") - s0
+        bi = pvar.pvar_read("compress_bytes_in") - bi0
+        bo = pvar.pvar_read("compress_bytes_out") - bo0
+        assert nseg > 1, "composition test needs a real train"
+        assert bi >= y.nbytes, "codec never saw the segments"
+        assert bo / bi <= 0.5, f"wire ratio {bo / bi}"
+        assert got.shape == y.shape and got.dtype == y.dtype
+        err = np.abs(got - y).max()
+        assert err <= 0.02 * np.abs(y).max(), f"codec error {err}"
+        # integer payloads skip the codec but still pipeline
+        z = np.arange(1 << 16, dtype=np.int64)
+        eng.send(z, 1, tag=6)
+        gz, _ = eng.recv(source=0, tag=6, timeout=30)
+        assert np.array_equal(np.asarray(gz), z)
+    finally:
+        router.close()
+
+
+def _run_p33(extra_env=None):
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("JAX_", "XLA_"))}
+    env.update(extra_env or {})
+    cmd = [sys.executable, _MPIRUN, "--per-rank", "-n", "2",
+           "--timeout", "150", _P33]
+    return subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=200, cwd=_REPO)
+
+
+@pytest.mark.slow
+def test_pipelined_allreduce_matches_unpipelined():
+    """2 real ranks, rails=1: the pipelined ring result equals the
+    serial reduce+bcast schedule (the checkparity pair for
+    decision.PIPELINED['allreduce'])."""
+    res = _run_p33()
+    assert res.returncode == 0, \
+        f"rc={res.returncode}\n--- out\n{res.stdout}\n--- err\n" \
+        f"{res.stderr[-4000:]}"
+    assert res.stdout.count("OK p33_largemsg") == 2, res.stdout
+
+
+@pytest.mark.slow
+def test_pipelined_bcast_matches_unpipelined():
+    """2 real ranks, rails=2: chain bcast parity plus the balanced
+    rail-byte assertion inside the program (the checkparity pair for
+    decision.PIPELINED['bcast'])."""
+    res = _run_p33({"OMPI_TPU_MCA_mpi_base_btl_rails": "2"})
+    assert res.returncode == 0, \
+        f"rc={res.returncode}\n--- out\n{res.stdout}\n--- err\n" \
+        f"{res.stderr[-4000:]}"
+    assert res.stdout.count("OK p33_largemsg") == 2, res.stdout
