@@ -48,7 +48,8 @@ if "--ab-child" in sys.argv or "--perrank-child" in sys.argv \
         or "--compress-child" in sys.argv \
         or "--compress-device-child" in sys.argv \
         or "--pcoll-child" in sys.argv \
-        or "--largemsg-child" in sys.argv:
+        or "--largemsg-child" in sys.argv \
+        or "--ft-child" in sys.argv:
     os.environ["JAX_PLATFORMS"] = "cpu"
 if "--tpu-child" in sys.argv:
     # the one-chip hardware child must NOT inherit a cpu pin the parent
@@ -1225,6 +1226,122 @@ def _largemsg_rows() -> dict:
     return out
 
 
+def _ft_child() -> None:
+    """One rank of the 4-process resilience drill (docs/RESILIENCE.md):
+    the heartbeat detector is on and ft/inject kills rank 2 at its 2nd
+    crossing of the ``coll.allreduce`` point (both configured by the
+    parent's --mca flags). The survivors measure the BENCH contract:
+    detection latency under 2x the configured heartbeat timeout, and a
+    post-shrink allreduce that matches the numpy reference — plus the
+    revoke round-trip and BucketedGradSync's elastic continuation.
+    Rank 0 (a survivor) prints one JSON line; the victim's exit code is
+    invisible here because _child_json scrapes stdout, not rc."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import ompi_tpu as MPI
+    from ompi_tpu.api import mpi as api
+    from ompi_tpu.mca import pvar as _pvar
+    from ompi_tpu.mca import var as _var
+    from ompi_tpu.models.transformer import BucketedGradSync
+
+    MPI.Init()
+    w = MPI.get_comm_world()
+    r, n = w.rank(), w.size
+    victim = 2
+    hb_timeout = float(_var.var_get("mpi_base_ft_hb_timeout", 0.8))
+    api.Comm_set_errhandler(w, MPI.ERRORS_RETURN)
+    w.barrier()
+
+    grads = {"w": np.full(4, float(r)), "b": np.full(2, float(r))}
+    sync = BucketedGradSync(w, grads)
+    sync(grads)                          # healthy persistent-path step
+    w.allreduce(np.arange(4.0))          # victim's point hit 1
+
+    t_fault = time.monotonic()
+    proc_failed = False
+    try:
+        api.Allreduce(w, np.ones(4))     # victim os._exit(137)s here
+    except MPI.MPIError as e:
+        proc_failed = e.error_class == MPI.ERR_PROC_FAILED
+    # (the victim never reaches past the program point above)
+
+    deadline = time.monotonic() + 15
+    while w.get_failed() != [victim] and time.monotonic() < deadline:
+        time.sleep(0.05)
+    failed_seen = w.get_failed() == [victim]
+    t_detect = time.monotonic() - t_fault
+
+    if r == 0:
+        MPI.MPIX_Comm_revoke(w)
+    deadline = time.monotonic() + 10
+    while not MPI.MPIX_Comm_is_revoked(w) \
+            and time.monotonic() < deadline:
+        time.sleep(0.02)
+    revoked = MPI.MPIX_Comm_is_revoked(w)
+
+    shrunk = MPI.MPIX_Comm_shrink(w)
+    survivors = [k for k in range(n) if k != victim]
+    shrink_size = shrunk.size
+    y = np.asarray(shrunk.allreduce(np.full(3, float(r))))
+    shrink_ok = (shrink_size == n - 1
+                 and bool(np.allclose(y, float(sum(survivors)))))
+
+    sync.shrink(shrunk)
+    g2 = sync(grads)
+    resume_ok = bool(np.allclose(
+        g2["w"], sum(survivors) / len(survivors)))
+
+    lat_us = float(_pvar.pvar_read("ft_detect_latency_us"))
+    shrunk.barrier()
+    shrunk.free()
+    MPI.Finalize()
+    if r == 0:
+        print(json.dumps({
+            "ranks": n,
+            "victim": victim,
+            "hb_timeout_s": hb_timeout,
+            "proc_failed_raised": proc_failed,
+            "failure_reported": failed_seen,
+            "detect_latency_us": round(lat_us, 1),
+            "detect_under_2x_timeout": bool(
+                0 <= lat_us < 2 * hb_timeout * 1e6),
+            "wall_to_membership_s": round(t_detect, 2),
+            "revoke_propagated": revoked,
+            "shrink_size": shrink_size,
+            "shrink_allreduce_correct": shrink_ok,
+            "gradsync_resumed": resume_ok,
+        }), flush=True)
+    # survivors skip interpreter teardown: once a rank has died jax's
+    # coordination service aborts nondeterministically on exit, and the
+    # JSON verdict is already on stdout. Rank 0 hosts the coordination
+    # service and must outlive the other survivors (exiting first RSTs
+    # their error-polling clients, which fatally terminate them).
+    if r == 0:
+        time.sleep(3)
+    os._exit(0)
+
+
+def _ft_rows() -> dict:
+    """The --ft section: the 4-process kill drill under the real
+    heartbeat detector (period 0.1 s / timeout 0.8 s / miss 3) with a
+    deterministic ft/inject SIGKILL mid-collective. Carries the two
+    resilience acceptance rows: ft_detect_under_2x_timeout and
+    shrink_allreduce_correct."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    mpirun = os.path.join(here, "ompi_tpu", "tools", "mpirun.py")
+    return {"kill_drill": _child_json(
+        [sys.executable, mpirun, "--per-rank", "-n", "4",
+         "--timeout", "240",
+         "--mca", "mpi_base_ft_hb_period", "0.1",
+         "--mca", "mpi_base_ft_hb_timeout", "0.8",
+         "--mca", "mpi_base_ft_hb_miss", "3",
+         "--mca", "mpi_base_ft_inject", "1",
+         "--mca", "mpi_base_ft_inject_kill",
+         "rank=2,point=coll.allreduce,hit=2",
+         sys.executable, os.path.abspath(__file__),
+         "--ft-child"], 300, _child_env())}
+
+
 def _trace_summary() -> dict:
     """Trace summary for the committed BENCH record, proven
     machine-readable: the summary must round-trip through JSON
@@ -1271,6 +1388,12 @@ def main() -> None:
                          "bcast A/B with rails 1 vs 2 on sm, tcp, and "
                          "the paced tier (docs/LARGEMSG.md)")
     ap.add_argument("--largemsg-child", action="store_true")
+    ap.add_argument("--ft", action="store_true",
+                    help="run the resilience drill: 4-process kill "
+                         "drill under the heartbeat detector — "
+                         "detection latency, revoke, shrink, elastic "
+                         "continuation (docs/RESILIENCE.md)")
+    ap.add_argument("--ft-child", action="store_true")
     ap.add_argument("--trace", action="store_true",
                     help="record collective/pt2pt spans "
                          "(ompi_tpu.trace) and attach the trace "
@@ -1297,6 +1420,9 @@ def main() -> None:
         return
     if args.largemsg_child:
         _largemsg_child()
+        return
+    if args.ft_child:
+        _ft_child()
         return
 
     # The TPU is reached through a tunnel that can be down for hours
@@ -1523,6 +1649,11 @@ def main() -> None:
     largemsg_rows = _largemsg_rows() if (args.largemsg and n == 1
                                          and not args.no_ab) else None
 
+    # ---- resilience-plane drill rows (--ft) -------------------------
+    # explicit opt-in flag, so --no-ab (which skips the implicit
+    # children) does not gate it
+    ft_rows = _ft_rows() if (args.ft and n == 1) else None
+
     result = {
         # throughput-derived: amortized pipelined dispatch minus the
         # observation RTT (the OSU loop), NOT a single-shot latency —
@@ -1571,6 +1702,7 @@ def main() -> None:
         **({"pcoll": pcoll_rows} if pcoll_rows is not None else {}),
         **({"largemsg": largemsg_rows}
            if largemsg_rows is not None else {}),
+        **({"ft": ft_rows} if ft_rows is not None else {}),
         "caveat": ("size-1 world: large-message path is identity-aliased "
                    "by XLA (algbw is an upper bound); >1-rank rows and "
                    "algorithm A/B come from the 8-rank CPU-mesh child"
@@ -1669,6 +1801,17 @@ def main() -> None:
         if isinstance(pr2, dict) and "error" not in pr2:
             contract["rail_bytes_balanced"] = pr2.get(
                 "rail_bytes_balanced")
+    if ft_rows is not None:
+        # the resilience acceptance rows (docs/RESILIENCE.md): the
+        # heartbeat detector's latency bound and the post-shrink
+        # collective's correctness, measured in the 4-process kill
+        # drill
+        kd = ft_rows.get("kill_drill") or {}
+        if isinstance(kd, dict) and "error" not in kd:
+            contract["ft_detect_under_2x_timeout"] = kd.get(
+                "detect_under_2x_timeout")
+            contract["shrink_allreduce_correct"] = kd.get(
+                "shrink_allreduce_correct")
     prev_algbw = _prev_headline_algbw()
     if prev_algbw is not None:
         # regression gate: this round's single-process large-message

@@ -72,6 +72,10 @@ from ompi_tpu.api.mpi import (  # noqa: F401
     Comm_accept, Comm_connect, Comm_iaccept, Comm_iconnect,
     Comm_spawn, Comm_spawn_multiple, Comm_get_parent, Comm_join,
     Comm_disconnect,
+    # error handlers + ULFM resilience surface (mpiext/ftmpi)
+    Comm_set_errhandler, Comm_get_errhandler, Comm_call_errhandler,
+    MPIX_Comm_agree, MPIX_Comm_get_failed, MPIX_Comm_is_revoked,
+    MPIX_Comm_revoke, MPIX_Comm_shrink,
 )
 
 __version__ = "0.1.0"

@@ -228,3 +228,89 @@ def Comm_join(fd, comm):
 
 def Comm_disconnect(comm) -> None:
     _dpm.disconnect(comm)
+
+
+# -- errhandlers + errhandler-honored entry points ------------------------
+# (docs/RESILIENCE.md). The reference dispatches every binding's error
+# through OMPI_ERRHANDLER_INVOKE (errhandler.h:389-401); here _guard is
+# that macro: core MPIError -> the communicator's errhandler, so
+# MPI_ERRORS_RETURN surfaces a catchable MPIError (the Pythonic return
+# code) while the default MPI_ERRORS_ARE_FATAL aborts the job.
+def Comm_set_errhandler(comm, errhandler: Errhandler) -> None:
+    comm.set_errhandler(errhandler)
+
+
+def Comm_get_errhandler(comm) -> Errhandler:
+    return comm.get_errhandler()
+
+
+def Comm_call_errhandler(comm, error_class: int, message: str = ""):
+    return comm.errhandler.invoke(comm, error_class, message)
+
+
+def _guard(comm, fn, *args, **kw):
+    try:
+        return fn(*args, **kw)
+    except MPIError as e:
+        return comm.errhandler.invoke(comm, e.error_class, str(e))
+
+
+# point-to-point ----------------------------------------------------------
+def Send(comm, data, dest: int, tag: int = 0) -> None:
+    _guard(comm, comm.send, data, dest, tag)
+
+
+def Ssend(comm, data, dest: int, tag: int = 0) -> None:
+    _guard(comm, comm.ssend, data, dest, tag)
+
+
+def Isend(comm, data, dest: int, tag: int = 0) -> Request:
+    return _guard(comm, comm.isend, data, dest, tag)
+
+
+def Recv(comm, source: int = ANY_SOURCE, tag: int = ANY_TAG):
+    return _guard(comm, comm.recv, source, tag)
+
+
+def Irecv(comm, source: int = ANY_SOURCE, tag: int = ANY_TAG) -> Request:
+    return _guard(comm, comm.irecv, source, tag)
+
+
+def Sendrecv(comm, senddata, dest: int, source: int = ANY_SOURCE,
+             sendtag: int = 0, recvtag: int = ANY_TAG):
+    return _guard(comm, comm.sendrecv, senddata, dest, source,
+                  sendtag, recvtag)
+
+
+def Probe(comm, source: int = ANY_SOURCE, tag: int = ANY_TAG):
+    return _guard(comm, comm.probe, source, tag)
+
+
+# collectives -------------------------------------------------------------
+def Barrier(comm) -> None:
+    _guard(comm, comm.barrier)
+
+
+def Bcast(comm, data, root: int = 0):
+    return _guard(comm, comm.bcast, data, root)
+
+
+def Reduce(comm, data, op: Op = SUM, root: int = 0):
+    return _guard(comm, comm.reduce, data, op, root)
+
+
+def Allreduce(comm, data, op: Op = SUM):
+    return _guard(comm, comm.allreduce, data, op)
+
+
+def Allgather(comm, data):
+    return _guard(comm, comm.allgather, data)
+
+
+# -- ULFM (the MPIX_* surface, mpiext/ftmpi) ------------------------------
+from ompi_tpu.mpiext.ftmpi import (  # noqa: E402,F401
+    Comm_agree as MPIX_Comm_agree,
+    Comm_get_failed as MPIX_Comm_get_failed,
+    Comm_is_revoked as MPIX_Comm_is_revoked,
+    Comm_revoke as MPIX_Comm_revoke,
+    Comm_shrink as MPIX_Comm_shrink)

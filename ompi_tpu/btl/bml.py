@@ -33,6 +33,7 @@ from typing import Callable, Dict, Optional, Tuple
 
 from ompi_tpu.btl.sm import SmEndpoint
 from ompi_tpu.btl.tcp import TcpEndpoint
+from ompi_tpu.ft import inject as _inject
 from ompi_tpu.mca import pvar as _pvar
 from ompi_tpu.mca import var
 from ompi_tpu.runtime import progress as _progress
@@ -93,6 +94,12 @@ def register_params() -> None:
                           "independent send locks and sender threads); "
                           "1 = the single-rail byte-identical default "
                           "(docs/LARGEMSG.md)")
+    # the resilience plane's vars register alongside the btl tuning
+    # vars: injection (mpi_base_ft_inject_*) and the heartbeat
+    # detector (mpi_base_ft_hb_*) — docs/RESILIENCE.md
+    _inject.register_params()
+    from ompi_tpu.ft import detector as _detector
+    _detector.register_params()
 
 
 def _probe_stream(chunk: int = 64 << 10, reps: int = 8,
@@ -170,6 +177,10 @@ class BmlEndpoint:
                  sink: Callable[[dict, bytes], None],
                  on_peer_lost: Optional[Callable[[int], None]] = None):
         register_params()
+        # bind the injection plane to this process's world rank and
+        # (re)compile the fault specs — a no-op leaving the gate cold
+        # when mpi_base_ft_inject is unset
+        _inject.refresh(rank)
         self.rank = rank
         self.nprocs = nprocs
         self._kv_get = kv_get
@@ -375,6 +386,18 @@ class BmlEndpoint:
             self.stats["self"] += 1
             self.sink(header, payload)
             return
+        if _inject.active:
+            # pml-plane fault hook (ft/inject): a "drop" fires HERE,
+            # before the sequence stamp below, so the loss models a
+            # message that never reached the wire — the receiver just
+            # never matches it (no reorder-buffer hole is created; a
+            # post-stamp drop would park every later frame from this
+            # rank in the peer's _held map forever)
+            act = _inject.frame_fault("pml", peer)
+            if act is not None:
+                if act[0] == "drop":
+                    return
+                _inject.delay_now(act[1])
         header = dict(header)
         header["_sq"] = (self.rank, next(self._send_seq[peer]))
         if (self.sm is not None and len(payload) >= self._sm_min

@@ -42,6 +42,7 @@ import time
 from typing import Callable, Dict, Optional
 
 from ompi_tpu.btl.tcp import MAGIC, _LEN
+from ompi_tpu.ft import inject as _inject
 from ompi_tpu.trace import core as _trace
 
 _HDR = struct.Struct("<QQ")          # head, tail (bytes consumed/produced)
@@ -306,6 +307,19 @@ class SmEndpoint:
         callers pass ``timeout=0``: a full peer ring must divert the
         frame to tcp immediately, not stall inbound progress for up to
         the full producer window."""
+        if _inject.active:
+            # sm-plane fault hook (ft/inject): "drop" here means THIS
+            # transport refuses the frame — bml's fallback carries it
+            # over tcp (a full/broken ring's signature), so delivery
+            # stays correct while the fallback path gets exercised.
+            # Delay executes only on callers that may block (the same
+            # rule the routing timeout encodes).
+            act = _inject.frame_fault("sm", peer)
+            if act is not None:
+                if act[0] == "drop":
+                    return False
+                if timeout > 0:
+                    _inject.delay_now(act[1])
         hraw = pickle.dumps(header)
         rec = _LEN.pack(MAGIC, len(hraw), len(payload)) + hraw + payload
         ring = self._attach(peer)

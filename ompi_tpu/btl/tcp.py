@@ -30,10 +30,27 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 import numpy as np
 
+from ompi_tpu.ft import inject as _inject
 from ompi_tpu.trace import core as _trace
 
 MAGIC = 0x7f4d5049          # "\x7fMPI"
 _LEN = struct.Struct("!IQQ")  # magic, header_len, payload_len
+
+
+class PeerDownError(ConnectionError):
+    """A btl send hit a dead or broken peer link — the structured form
+    of ``ConnectionResetError``/``BrokenPipeError``, carrying WHOSE
+    link died so the layers above (pml ``wait()``, the rail detour,
+    shrink) can map it to ``MPI_ERR_PROC_FAILED`` instead of leaking a
+    raw socket exception to the application (docs/RESILIENCE.md)."""
+
+    def __init__(self, world_rank: int, cause: Optional[BaseException]
+                 = None):
+        msg = f"peer rank {world_rank} connection down"
+        if cause is not None:
+            msg += f": {type(cause).__name__}: {cause}"
+        super().__init__(msg)
+        self.world_rank = world_rank
 
 # ctl-queue backpressure bound in BYTES (see _ctl_submit): far above
 # anything a live link queues, far below address-space trouble
@@ -392,12 +409,14 @@ class TcpEndpoint:
             s = self._connect_rail(peer, rail)
             self._sendmsg(s, self._rail_locks[(peer, rail)], header,
                           payload)
-        except Exception:
+        except OSError as e:
             # broken rail: evict so the next attempt reconnects; the
             # caller (bml's rail sender) detours this segment to the
-            # rail-0 socket
+            # rail-0 socket — the same structured PeerDownError the
+            # primary path raises, so detour logic never has to parse
+            # raw socket exceptions
             self.evict_rail_socket(peer, rail)
-            raise
+            raise PeerDownError(peer, e) from e
 
     def _evict_peer_socket(self, peer: int) -> None:
         """Drop a broken cached connection so the next send
@@ -435,6 +454,21 @@ class TcpEndpoint:
                 pass
 
     def _ctl_send_loop(self, q: "queue.Queue", peer: int) -> None:
+        try:
+            self._ctl_send_loop_inner(q, peer)
+        finally:
+            # shutdown/abort hygiene: whatever exit path the loop took
+            # (retire sentinel, dead link, injected rank-kill racing
+            # close()), leave the queue EMPTY so no frame lingers as
+            # replayable state and the thread exits instead of
+            # spinning against a dead socket
+            while True:
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+
+    def _ctl_send_loop_inner(self, q: "queue.Queue", peer: int) -> None:
         while True:
             item = q.get()
             if item is None or self._closed:
@@ -559,7 +593,64 @@ class TcpEndpoint:
             # the frame to the peer's ctl sender and return to recv()
             self._ctl_submit(peer, header, payload)
             return
+        if _inject.active:               # fault-injection plane: one
+            self._inject_faults(peer)    # attribute read when off
         self._send_frame_blocking(peer, header, payload)
+
+    # -- fault injection (ft/inject: the tcp-plane hook site) ----------
+    def _inject_faults(self, peer: int) -> None:
+        """Runs only on app/sender threads (never readers — a delayed
+        reader would stall every peer's drain) with the gate open."""
+        act = _inject.frame_fault("tcp", peer)
+        if act is not None and act[0] == "delay":
+            _inject.delay_now(act[1])
+        if _inject.should_sever(peer):
+            self._sever_peer(peer)
+        if _inject.should_corrupt(peer):
+            self._send_corrupt(peer)
+            # evict our own socket too: the receiver is about to drop
+            # its end at the bad magic, and any SEQUENCE-STAMPED frame
+            # still in flight there would be lost — a permanent hole in
+            # the peer's reorder buffer (unlike "drop", which fires
+            # pre-stamp). A fresh connection carries the frame that
+            # triggered the injection, so corruption costs exactly one
+            # reconnect and zero sequenced frames.
+            self._evict_peer_socket(peer)
+
+    def _sever_peer(self, peer: int) -> None:
+        """Abruptly cut the rail-0 connection (injected network cut):
+        SO_LINGER 0 turns the close into an RST, so the peer's reader
+        observes exactly what a process death looks like on the wire —
+        an error on an identified connection."""
+        with self._lock:
+            s = self._peers.pop(peer, None)
+        if s is None:
+            return
+        try:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                         struct.pack("ii", 1, 0))
+        except OSError:
+            pass
+        try:
+            s.close()
+        except OSError:
+            pass
+
+    def _send_corrupt(self, peer: int) -> None:
+        """Injected wire corruption: a frame whose magic is wrong. The
+        peer's framing check drops the connection WITHOUT a death
+        report (tcp _read_loop's corrupt-stream contract); the caller
+        evicts this side's socket in the same breath, so the next send
+        reconnects — the recovery the test_ft_corrupt_recovers drill
+        asserts."""
+        try:
+            s = self._connect(peer)
+            hraw = pickle.dumps({"ctl": "_corrupt"})
+            bad = _LEN.pack(MAGIC ^ 0x00BAD000, len(hraw), 0) + hraw
+            with self._peer_locks[peer]:
+                s.sendall(bad)
+        except OSError:
+            pass
 
     def _pace(self, key: int, nbytes: int, t0: float) -> None:
         """Paced-wire floor (btl_tcp_sim_gbps): hold the sender until
@@ -581,8 +672,23 @@ class TcpEndpoint:
 
     def _send_frame_blocking(self, peer: int, header: dict,
                              payload: bytes = b"") -> None:
-        s = self._connect(peer)
-        self._sendmsg(s, self._peer_locks[peer], header, payload)
+        """One reconnect retry absorbs a stale cached socket (the peer
+        dropped a corrupted stream, or an idle connection died); a
+        failure on a FRESH connection is structural — raised as
+        :class:`PeerDownError` so ``wait()`` surfaces
+        MPI_ERR_PROC_FAILED, never a raw socket exception."""
+        last: Optional[BaseException] = None
+        for attempt in range(2):
+            try:
+                s = self._connect(peer)
+                self._sendmsg(s, self._peer_locks[peer], header, payload)
+                return
+            except OSError as e:
+                last = e
+                self._evict_peer_socket(peer)
+                if self._closed:
+                    break
+        raise PeerDownError(peer, last)
 
     def _sendmsg(self, s: socket.socket, lock: threading.Lock,
                  header: dict, payload) -> None:
@@ -634,6 +740,9 @@ class TcpEndpoint:
                 s.sendall(msg)
 
     def close(self) -> None:
+        if self._closed:
+            return                       # idempotent: finalize() and
+        #                                  an abort path may both call
         self._closed = True
         with self._lock:
             ctl_qs = list(self._ctl_qs.values())

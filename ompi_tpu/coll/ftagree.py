@@ -85,6 +85,81 @@ class FtAgreeModule:
         return Request.completed(self.agree(flags))
 
 
+# -- per-rank (multi-controller) agreement ------------------------------
+# The distributed counterpart of _tree_agree, used by the real recovery
+# path (RankCommunicator.agree / MPIX_Comm_shrink): no controller holds
+# global knowledge, so survivors run a leader-collect round over the
+# comm's hidden collective channel. "Early-returning" concretely means
+# ranks ALREADY known dead are excluded before any wait (zero timeout
+# spent on them); only a rank dying DURING the round costs the leader
+# one recv timeout, after which it is suspected into the agreed failed
+# set — the ERA suspicion rule. The exchange rides one reserved tag
+# outside the per-collective sequence space so a survivor retrying
+# after a stale leader election still matches the true leader's
+# collection (the same reservation the shrink exchange used before it
+# was rebased onto this protocol).
+
+_AGREE_TAG = 1 << 30
+
+
+def perrank_agree(comm, flag: int,
+                  timeout: float = 20.0) -> Tuple[int, List[int]]:
+    """Fault-tolerant agreement among a per-rank comm's survivors.
+    Returns ``(agreed_value, agreed_failed_local_ranks)`` — the same
+    value and the same failed set on every live member. Retried when a
+    survivor's stale failure view elected a dead leader (the failed
+    exchange itself surfaces the death; the retry settles)."""
+    from ompi_tpu.core.errhandler import MPIError
+    last: Optional[BaseException] = None
+    for _ in range(3):
+        try:
+            return _perrank_agree_once(comm, int(flag), timeout)
+        except (MPIError, OSError) as e:
+            # OSError: a send raced the EOF monitor onto a just-dead
+            # leader's broken socket (EPIPE beats the callback)
+            last = e
+            import time
+            time.sleep(0.2)              # let detection settle
+    raise last
+
+
+def _perrank_agree_once(comm, flag: int,
+                        timeout: float) -> Tuple[int, List[int]]:
+    from ompi_tpu.core.errhandler import MPIError
+    eng = comm._coll_pml
+    t = _AGREE_TAG
+    my_failed = set(comm.get_failed())
+    alive = [r for r in range(comm.size) if r not in my_failed]
+    leader = alive[0]
+    if comm.rank() == leader:
+        value = int(flag)
+        union = set(my_failed)
+        for r in alive:
+            if r == leader:
+                continue
+            try:
+                data, _ = eng.recv(r, t, timeout=timeout)
+                rflag, rfailed = data
+                value &= int(rflag)
+                union |= set(int(x) for x in rfailed)
+            except MPIError:
+                union.add(r)             # silent: suspect it too
+        final = sorted(union)
+        for r in range(comm.size):
+            if r not in union and r != leader:
+                try:
+                    eng.send((value, final), r, t)
+                except (MPIError, OSError):
+                    pass                 # died since; it is in no set
+        return value, final
+    eng.send((int(flag), sorted(my_failed)), leader, t)
+    # the leader may serially spend up to `timeout` on each rank that
+    # dies mid-round before deciding: wait proportionally longer
+    data, _ = eng.recv(leader, t, timeout=timeout * max(2, len(alive)))
+    value, final = data
+    return int(value), [int(x) for x in final]
+
+
 class FtAgreeComponent(Component):
     name = "ftagree"
 

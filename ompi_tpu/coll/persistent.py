@@ -38,6 +38,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from ompi_tpu.core import op as op_mod
+from ompi_tpu.core.errhandler import MPIError
 from ompi_tpu.core.request import Request
 from ompi_tpu.mca import pvar, var
 from ompi_tpu.runtime import progress as prog
@@ -198,21 +199,32 @@ class PersistentCollRequest(Request):
         self._check_startable()
         _count("coll_persistent_starts")
         p = self.plan
-        if (p.bucket_key is not None and bucket_enabled()
-                and 0 < p.nbytes <= bucket_bytes()):
-            self._inner_req = fuser_of(p.comm).enqueue(
-                p.bucket_key, p.payload, p.epilogue, p.nbytes, p.op)
-        elif p.fn is not None:
-            # direct plan: the compiled callable's output arrays ARE
-            # the completion state — no inner request, no tree walk
-            y = p.fn(p.buf) if p.buf is not None else p.fn()
-            self._result = y
-            self._arrays = y if type(y) is list else [y]
-            self._inner_req = None
-        else:
-            self._inner_req = self._persistent_start()
+        self._error = None
+        self.status.error = 0
         self._complete = False
         self._active = True
+        try:
+            if (p.bucket_key is not None and bucket_enabled()
+                    and 0 < p.nbytes <= bucket_bytes()):
+                self._inner_req = fuser_of(p.comm).enqueue(
+                    p.bucket_key, p.payload, p.epilogue, p.nbytes, p.op)
+            elif p.fn is not None:
+                # direct plan: the compiled callable's output arrays ARE
+                # the completion state — no inner request, no tree walk
+                y = p.fn(p.buf) if p.buf is not None else p.fn()
+                self._result = y
+                self._arrays = y if type(y) is list else [y]
+                self._inner_req = None
+            else:
+                self._inner_req = self._persistent_start()
+        except MPIError as e:
+            # a plan peer died between rounds (the per-start liveness
+            # check in the bound multicast fired): the request
+            # completes carrying MPI_ERR_PROC_FAILED instead of the
+            # start raising — waitall over the plan batch surfaces it,
+            # and the plan stays re-startable on a shrunk rebuild
+            # (request-level FT, docs/RESILIENCE.md)
+            self.fail(e)
         return self
 
 
@@ -670,5 +682,6 @@ def startall_window():
 
 def counters() -> Dict[str, int]:
     """Snapshot of the persistent/bucket counters (tests, tools)."""
-    with _count_lock:
-        return dict(_counts)
+    # the writer (_count) is deliberately lock-free GIL-atomic; a dict
+    # copy here is the matching snapshot
+    return dict(_counts)

@@ -49,8 +49,9 @@ import numpy as np
 from ompi_tpu.compress import wire as _cwire
 from ompi_tpu.core import op as op_mod
 from ompi_tpu.core.errhandler import (ERR_ARG, ERR_COMM, ERR_COUNT, ERR_OP,
-                                      ERR_RANK, ERR_ROOT, ERRORS_ARE_FATAL,
-                                      Errhandler, MPIError)
+                                      ERR_RANK, ERR_REVOKED, ERR_ROOT,
+                                      ERRORS_ARE_FATAL, Errhandler, MPIError)
+from ompi_tpu.ft import inject as _inject
 from ompi_tpu.core.group import Group, UNDEFINED
 from ompi_tpu.core.info import Info
 from ompi_tpu.core.request import Request, Status
@@ -240,6 +241,10 @@ class RankCommunicator:
         self._cworker: Optional[threading.Thread] = None  # executor
         self._cclosed = False            # set by _coll_drain: no new
         # jobs may spawn a worker after teardown began
+        # revoke plane (MPIX_Comm_revoke, docs/RESILIENCE.md): when the
+        # router's reliable broadcast revokes this cid, every pending
+        # operation on the comm completes with ERR_REVOKED
+        router.register_revoke_cb(self.cid, self._on_revoked)
 
     # ------------------------------------------------------------------
     @property
@@ -265,6 +270,12 @@ class RankCommunicator:
     def _check(self) -> None:
         if self._freed:
             raise MPIError(ERR_COMM, "communicator has been freed")
+        if self.router.is_revoked(self.cid):
+            # ULFM: every operation on a revoked comm (except the
+            # recovery surface — shrink/agree/get_failed/free, which
+            # bypass _check) raises ERR_REVOKED (comm_revoke.c)
+            raise MPIError(ERR_REVOKED,
+                           f"{self.name} has been revoked")
 
     def _validate_root(self, root: int) -> int:
         if not (0 <= root < self.size):
@@ -651,6 +662,8 @@ class RankCommunicator:
     def allreduce(self, data: Any, op: op_mod.Op = op_mod.SUM) -> Any:
         self._check()
         self._validate_op(op)
+        if _inject.active:               # named kill site for the FT
+            _inject.point("coll.allreduce")   # drill (ft/inject)
         spc.record("coll_allreduce", 1)
         if _hooks_mod._hooks:            # tool bound: fire the event
             _hooks_mod.fire("coll_allreduce", self,
@@ -1526,19 +1539,59 @@ class RankCommunicator:
         return [r for r in range(self.size)
                 if ft.is_failed(self.group.world_ranks[r])]
 
-    # reserved shrink-exchange tag (outside the per-collective sequence
-    # so a survivor retrying after a stale leader election still
-    # matches the true leader's collection)
-    _SHRINK_TAG = 1 << 30
+    def revoke(self) -> None:
+        """MPIX_Comm_revoke: non-collective — ONE caller poisons the
+        communicator everywhere. The router floods a reliable
+        ``revoke`` ctl broadcast (every first receipt re-forwards, the
+        revoked-set test terminates it — coll_base_revoke_local.c);
+        locally and on every receiver the pending operations complete
+        with ERR_REVOKED and new ones refuse in ``_check``. The
+        recovery surface (shrink/agree/get_failed/free) keeps
+        working."""
+        self.router.revoke(self.cid)
+
+    def is_revoked(self) -> bool:
+        """MPIX_Comm_is_revoked (local, non-collective)."""
+        return self.router.is_revoked(self.cid)
+
+    def _on_revoked(self) -> None:
+        """Router revoke callback: flush every pending operation —
+        wildcards included (unlike a single peer death, a revoked comm
+        can never match ANYTHING again, req_ft.c's revocation
+        branch)."""
+        def err():
+            return MPIError(ERR_REVOKED,
+                            f"{self.name} has been revoked")
+        for eng in (self._pml, self._coll_pml,
+                    *list(self._aux_pmls.values())):
+            try:
+                eng._flush_all(err)
+            except Exception:            # noqa: BLE001
+                pass
+
+    def agree(self, flag: int = 1, timeout: float = 20) -> int:
+        """MPIX_Comm_agree: fault-tolerant agreement — AND-folds the
+        integer ``flag`` over the SURVIVING members and returns the
+        agreed value on all of them, completing even with failed (or
+        failing) participants. Runs on a revoked comm — it is the
+        recovery path. The early-returning protocol lives in
+        coll/ftagree (known-dead ranks are excluded up front, only a
+        rank dying DURING the agreement costs a timeout)."""
+        from ompi_tpu.coll import ftagree
+        value, _failed = ftagree.perrank_agree(self, int(flag),
+                                               timeout=timeout)
+        return value
 
     def shrink(self, timeout: float = 20) -> "RankCommunicator":
-        """MPIX_Comm_shrink: survivors agree on the failed set (leader
-        collects each survivor's view — a silent rank is itself
-        suspected, the ftagree suspicion rule — and redistributes) and
-        build the survivor communicator. Collective among survivors.
-        Retried when a survivor's stale failure view elected a dead
-        leader (detection is asynchronous; the failed first exchange
-        itself surfaces the death, and the retry settles)."""
+        """MPIX_Comm_shrink: survivors agree on the failed set through
+        coll/ftagree's early-returning agreement (a silent rank is
+        itself suspected into the set — the ftagree suspicion rule)
+        and build the survivor communicator through the NORMAL
+        RankCommunicator construction, i.e. normal coll selection.
+        Collective among survivors; works on a revoked comm. Retried
+        when a survivor's stale failure view elected a dead leader
+        (detection is asynchronous; the failed first exchange itself
+        surfaces the death, and the retry settles)."""
         last: Optional[BaseException] = None
         for _ in range(3):
             try:
@@ -1557,35 +1610,8 @@ class RankCommunicator:
         # every later dup/split cid. The child cid derives from the
         # AGREED failed set instead (same on every survivor, distinct
         # per failure epoch).
-        t = self._SHRINK_TAG
-        my_failed = set(self.get_failed())
-        alive_guess = [r for r in range(self.size)
-                       if r not in my_failed]
-        leader = alive_guess[0]
-        if self._rank == leader:
-            union = set(my_failed)
-            for r in alive_guess:
-                if r == leader:
-                    continue
-                try:
-                    data, _ = self._coll_pml.recv(r, t, timeout=timeout)
-                    union |= set(int(x) for x in data)
-                except MPIError:
-                    union.add(r)        # silent: suspect it too
-            final = sorted(union)
-            for r in range(self.size):
-                if r not in union and r != leader:
-                    try:
-                        self._coll_pml.send(final, r, t)
-                    except (MPIError, OSError):
-                        pass            # died since; it is in no group
-        else:
-            self._coll_pml.send(sorted(my_failed), leader, t)
-            # the leader may serially spend up to `timeout` on each
-            # silent rank before answering: wait proportionally longer
-            data, _ = self._coll_pml.recv(
-                leader, t, timeout=timeout * max(2, len(alive_guess)))
-            final = [int(x) for x in data]
+        from ompi_tpu.coll import ftagree
+        _value, final = ftagree.perrank_agree(self, 1, timeout=timeout)
         survivors = [r for r in range(self.size) if r not in final]
         g = Group([self.group.world_ranks[r] for r in survivors])
         return RankCommunicator(
@@ -1602,6 +1628,7 @@ class RankCommunicator:
         # 6.7.2)
         from ompi_tpu.core.communicator import fire_delete_attrs
         fire_delete_attrs(self)
+        self.router.unregister_revoke_cb(self.cid)
         self._coll_drain()               # pending deferred collectives
         # complete against the live comm before teardown (MPI-3.1
         # 6.4.3)

@@ -79,6 +79,20 @@ class Request:
         self._persistent_start = persistent_start
         self._active = persistent_start is None
         self._inner_req: Optional["Request"] = None
+        self._error: Optional[BaseException] = None
+
+    # -- ULFM completion-in-error (ompi/request/req_ft.c) ------------------
+    def fail(self, err: BaseException) -> None:
+        """Complete the request NOW, carrying ``err``: the operation can
+        never finish (its peer died, or its communicator was revoked).
+        wait/test/get raise the stored error; ``status.error`` reports
+        its class for the status-based readers."""
+        self._error = err
+        self.status.error = int(getattr(err, "error_class", 0) or 0)
+        self._arrays = None
+        self._on_complete = None
+        self._inner_req = None
+        self._complete = True
 
     # -- completion --------------------------------------------------------
     def _finish(self):
@@ -96,6 +110,8 @@ class Request:
     def test(self) -> Tuple[bool, Optional[Status]]:
         """MPI_Test: non-blocking completion check."""
         if self._complete:
+            if self._error is not None:
+                raise self._error
             return True, self.status
         if self._inner_req is not None:
             # started persistent request: delegate to this iteration's
@@ -120,6 +136,8 @@ class Request:
             elif self._arrays is not None:
                 jax.block_until_ready(self._arrays)
             self._finish()
+        if self._error is not None:
+            raise self._error
         return self.status
 
     def get(self) -> Any:
@@ -169,9 +187,19 @@ class Request:
 
     def start(self) -> "Request":
         self._check_startable()
-        self._inner_req = self._persistent_start()
+        self._error = None
+        self.status.error = 0
         self._complete = False
         self._active = True
+        try:
+            self._inner_req = self._persistent_start()
+        except MPIError as e:
+            # the plan's peer died between rounds (the per-start
+            # liveness check fired): the START is what failed, but the
+            # REQUEST completes carrying the error (req_ft.c) — a
+            # waitall over a mixed batch surfaces MPI_ERR_PROC_FAILED
+            # instead of deadlocking on a request that never started
+            self.fail(e)
         return self
 
     @staticmethod

@@ -1,0 +1,18 @@
+"""ompi_tpu.ft — the resilience plane (docs/RESILIENCE.md).
+
+Two halves, both absent from the reference tree and grown here:
+
+- :mod:`ompi_tpu.ft.inject` — a deterministic fault-injection plane
+  (the test surface SURVEY.md notes the reference never shipped):
+  drop/delay/corrupt btl frames, sever a peer connection, kill a rank
+  at a named program point — all behind MCA vars and a
+  zero-cost-when-off module gate.
+- :mod:`ompi_tpu.ft.detector` — a ring heartbeat failure detector
+  (the PRRTE-daemon liveness role) feeding epoch-ordered failure
+  events into :mod:`ompi_tpu.runtime.ft`'s registry.
+
+The consumption side (revoke/shrink/agree, request-level error
+completion, elastic grad sync) lives where the state lives:
+``core/rankcomm.py``, ``pml/perrank.py``, ``coll/ftagree.py``,
+``models/transformer.py``.
+"""

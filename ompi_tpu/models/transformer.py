@@ -432,6 +432,27 @@ class BucketedGradSync:
         out = [np.asarray(r.get()) / self.n for r in self._reqs]
         return jax.tree_util.tree_unflatten(self._treedef, out)
 
+    def shrink(self, comm=None) -> "BucketedGradSync":
+        """Elastic continuation (docs/RESILIENCE.md): after a data-
+        parallel peer dies mid-training, rebind this synchronizer to
+        the survivor communicator and keep stepping. ``comm`` is the
+        already-shrunk comm (``MPIX_Comm_shrink``'s result); None
+        shrinks ``self.comm`` here. The staging buffers and tree
+        layout carry over unchanged — only the persistent plans
+        rebind (they are comm-bound) and the mean divisor RESCALES to
+        the survivor count, so the surviving ranks' gradients still
+        average to an unbiased estimate (smaller effective batch, not
+        a corrupted one). Returns self."""
+        from ompi_tpu.core import op as _op
+        if comm is None:
+            comm = self.comm.shrink()
+        self.comm = comm
+        self.n = comm.size
+        self._reqs = [comm.allreduce_init(s, _op.SUM)
+                      for s in self._stages]
+        self._scalar_req = None          # lazily rebuilt on new comm
+        return self
+
     def mean_scalar(self, value):
         """Mean one scalar (the loss) over the comm — rides the same
         persistent machinery through a lazily-built 1-elem plan."""

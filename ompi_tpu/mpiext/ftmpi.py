@@ -58,4 +58,12 @@ fail_rank = _ft.fail_rank
 probe_devices = _ft.probe_devices
 failed_ranks = _ft.failed_ranks
 failure_epoch = _ft.epoch
+failure_events = _ft.events
 add_failure_listener = _ft.add_listener
+remove_failure_listener = _ft.remove_listener
+
+# the resilience plane's two halves, re-exported so FT tooling needs one
+# import: deterministic fault injection (ft/inject, MCA-gated, zero-cost
+# when off) and the ring heartbeat detector (ft/detector) — see
+# docs/RESILIENCE.md
+from ompi_tpu.ft import detector, inject  # noqa: E402,F401

@@ -31,7 +31,7 @@ from typing import Any, Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ompi_tpu.btl.tcp import decode_payload, encode_payload
+from ompi_tpu.btl.tcp import PeerDownError, decode_payload, encode_payload
 from ompi_tpu.core.errhandler import ERR_PENDING, ERR_RANK, ERR_TAG, MPIError
 from ompi_tpu.core.request import Request, Status
 from ompi_tpu.runtime import progress as _progress
@@ -40,6 +40,22 @@ from ompi_tpu.trace import core as _trace
 ANY_SOURCE = -1
 ANY_TAG = -1
 PROC_NULL = -2
+
+
+def _ft_send(endpoint, wdest: int, header: dict, raw: bytes) -> None:
+    """Send with the ULFM error mapping: a connection that dies UNDER a
+    send (after the btl exhausted its reconnect retry) reports the rank
+    failed and surfaces ``MPI_ERR_PROC_FAILED`` — never a raw socket
+    error (satellite (a) of docs/RESILIENCE.md)."""
+    try:
+        endpoint.send_frame(wdest, header, raw)
+    except PeerDownError as e:
+        from ompi_tpu.core.errhandler import ERR_PROC_FAILED
+        from ompi_tpu.runtime import ft
+        ft.fail_rank(e.world_rank, "connection down during send")
+        raise MPIError(
+            ERR_PROC_FAILED,
+            f"peer world rank {e.world_rank} failed during send") from e
 
 
 class Router:
@@ -63,6 +79,20 @@ class Router:
         self._rma: Dict[Any, Any] = {}
         self._closing = False
         self._departed: set = set()      # peers that said goodbye
+        # -- resilience plane (docs/RESILIENCE.md) ---------------------
+        # revoked communicator CIDs + per-cid callbacks (the reliable
+        # revoke broadcast's local state, coll_base_revoke_local.c) and
+        # the optional heartbeat detector (ft/detector, attached by
+        # runtime/init after wire_up)
+        self._revoked: set = set()
+        self._revoke_cbs: Dict[Any, list] = {}
+        self.detector = None
+        # whatever ingress learns of a death (EOF monitor, heartbeat
+        # declaration, remote obituary) funnels through the registry;
+        # the listener does the local cleanup AND re-broadcasts — the
+        # registry's first-report dedup terminates the flood
+        from ompi_tpu.runtime import ft
+        ft.add_listener(self._on_rank_failed)
         # segment-train reassembly for the pipelined rendezvous
         # (pml/pipeline): keyed (source world rank, pipe id), fed by
         # rail reader threads BELOW the matching layer — created before
@@ -113,14 +143,24 @@ class Router:
         self._closing = True
 
     def _peer_lost(self, world_rank: int) -> None:
-        """An identified peer connection died: the ULFM event. Mark the
-        rank failed in the process default registry and complete every
-        pending receive that could have matched it in error
-        (ompi/request/req_ft.c behavior over a REAL dead process)."""
+        """An identified peer connection died: the ULFM event. Report
+        it into the process default registry; the registry listener
+        (:meth:`_on_rank_failed`) does the local cleanup and the
+        obituary broadcast — same path whatever the ingress."""
         if self._closing or world_rank in self._departed:
             return                       # graceful exit, not death
         from ompi_tpu.runtime import ft
         ft.fail_rank(world_rank, "peer connection lost")
+
+    def _on_rank_failed(self, world_rank: int, reason: str) -> None:
+        """Registry listener (fires exactly once per failed rank):
+        complete every pending operation that could have matched the
+        dead rank in error (ompi/request/req_ft.c over a REAL dead
+        process) and fan the obituary out as a reliable ``ftdead``
+        broadcast — the PMIx event-propagation role. Receivers dedup
+        through their own registries, so the flood terminates."""
+        if self._closing:
+            return
         # unfinished segment trains from the dead sender can never
         # complete — fail their waiters now (pml/pipeline)
         self.pipes.fail_peer(world_rank)
@@ -129,6 +169,55 @@ class Router:
         for eng in engines:
             try:
                 eng._peer_failed(world_rank)
+            except Exception:            # noqa: BLE001
+                pass
+        self._broadcast_ctl({"ctl": "ftdead", "rank": world_rank,
+                             "peer": self.rank})
+
+    def _broadcast_ctl(self, header: dict) -> None:
+        """Best-effort fan-out of a ctl frame to every live peer over
+        the UNSEQUENCED tcp path (these frames carry no ``_sq``, so a
+        lost one leaves no reorder-buffer hole; reliability comes from
+        every learner re-forwarding on first receipt)."""
+        from ompi_tpu.runtime import ft
+        failed = ft.failed_ranks()
+        for peer in range(self.nprocs):
+            if peer == self.rank or peer in failed:
+                continue
+            try:
+                self.endpoint.tcp.send_frame(peer, dict(header))
+            except Exception:            # noqa: BLE001 — a dying
+                pass                     # learner is its own obituary
+
+    # -- revoke plane (MPIX_Comm_revoke over the ctl wire) -------------
+    def revoke(self, rcid) -> None:
+        """Locally revoke ``rcid`` and start the reliable broadcast
+        (coll_base_revoke_local.c's role: first receipt re-forwards,
+        the revoked-set membership test terminates the flood)."""
+        self._on_revoke(rcid)
+
+    def is_revoked(self, rcid) -> bool:
+        return rcid in self._revoked
+
+    def register_revoke_cb(self, rcid, cb) -> None:
+        with self._lock:
+            self._revoke_cbs.setdefault(rcid, []).append(cb)
+
+    def unregister_revoke_cb(self, rcid) -> None:
+        with self._lock:
+            self._revoke_cbs.pop(rcid, None)
+
+    def _on_revoke(self, rcid) -> None:
+        with self._lock:
+            if rcid in self._revoked:
+                return                   # flood termination
+            self._revoked.add(rcid)
+            cbs = list(self._revoke_cbs.get(rcid, []))
+        self._broadcast_ctl({"ctl": "revoke", "rcid": rcid,
+                             "peer": self.rank})
+        for cb in cbs:
+            try:
+                cb()
             except Exception:            # noqa: BLE001
                 pass
 
@@ -169,7 +258,28 @@ class Router:
 
     def _deliver(self, header: dict, raw: bytes) -> None:
         """Called from btl reader threads (and loopback sends)."""
-        if header.get("ctl") == "bye":
+        ctl = header.get("ctl")
+        if ctl == "hb":
+            d = self.detector
+            if d is not None:
+                d.on_heartbeat(header["peer"])
+            return
+        if ctl == "ftdead":
+            # remote obituary: feed the registry (dedups); our own
+            # listener re-forwards on first receipt. An obituary about
+            # OURSELVES is a false accusation — the accusers will
+            # exclude us either way; don't poison our own registry.
+            r = header["rank"]
+            if not (self._closing or r == self.rank
+                    or r in self._departed):
+                from ompi_tpu.runtime import ft
+                ft.fail_rank(r, "obituary from rank %s"
+                             % header.get("peer"))
+            return
+        if ctl == "revoke":
+            self._on_revoke(header["rcid"])
+            return
+        if ctl == "bye":
             with self._lock:
                 self._departed.add(header["peer"])
             return
@@ -211,6 +321,14 @@ class Router:
 
     def close(self) -> None:
         self._closing = True
+        from ompi_tpu.runtime import ft
+        ft.remove_listener(self._on_rank_failed)
+        d, self.detector = self.detector, None
+        if d is not None:
+            try:
+                d.stop()
+            except Exception:            # noqa: BLE001
+                pass
         self.endpoint.close()
 
 
@@ -569,8 +687,8 @@ class PerRankEngine:
             aid, ent = self.router.new_ack()
             header["ack_id"] = aid
             header["wsrc"] = self.comm.world_rank_of(self.comm.rank())
-        self.router.endpoint.send_frame(self.comm.world_rank_of(dest),
-                                        header, raw)
+        _ft_send(self.router.endpoint, self.comm.world_rank_of(dest),
+                 header, raw)
         if ent is not None and not ent[0].wait(600):
             self.router.cancel_ack(aid)
             raise MPIError(ERR_PENDING,
@@ -635,7 +753,7 @@ class PerRankEngine:
             t[1] += nraw
             # the bml copies the header before stamping its sequence
             # number, so one template serves every destination
-            endpoint.send_frame(world_of(dest), header, raw)
+            _ft_send(endpoint, world_of(dest), header, raw)
 
     def bind_small_multicast(self, example: Any, dests) -> Any:
         """Pre-bound sub-eager multicast (the persistent-collective
@@ -679,7 +797,7 @@ class PerRankEngine:
                                    f"send peer rank {dest} has failed")
                 t[0] += 1
                 t[1] += nraw
-                endpoint.send_frame(wdest, header, raw)
+                _ft_send(endpoint, wdest, header, raw)
         return send
 
     # -- receive side --------------------------------------------------
@@ -757,6 +875,24 @@ class PerRankEngine:
                 ERR_PROC_FAILED,
                 f"peer rank {local} died during a combining "
                 f"collective"))
+
+    def _flush_all(self, make_err) -> None:
+        """Revocation flush (MPIX_Comm_revoke): complete EVERY pending
+        operation on this engine in error — wildcards included. Unlike
+        a single peer death, a revoked communicator can never match
+        anything again (req_ft.c's revocation branch), so nothing may
+        stay posted."""
+        with self._lock:
+            hit, self.posted = self.posted, []
+            slots = [s for s in self._combine.values()
+                     if any(v is None for v in s._vals)]
+        for (_, _, req) in hit:
+            req._fail(make_err())
+        for s in slots:
+            try:
+                s.fail(make_err())
+            except Exception:            # noqa: BLE001
+                pass
 
     def recv(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
              timeout: Optional[float] = None) -> Tuple[Any, Status]:

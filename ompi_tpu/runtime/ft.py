@@ -23,7 +23,17 @@ knowledge the way PMIx event sequence numbers do.
 from __future__ import annotations
 
 import threading
-from typing import Callable, Dict, FrozenSet, List
+import time
+from typing import Callable, Dict, FrozenSet, List, NamedTuple
+
+
+class FailureEvent(NamedTuple):
+    """One epoch-ordered failure record (the PMIx event payload shape:
+    who, why, when, and the sequence number ordering the knowledge)."""
+    rank: int
+    reason: str
+    epoch: int
+    timestamp: float
 
 
 class Registry:
@@ -33,7 +43,11 @@ class Registry:
         self._lock = threading.Lock()
         self._failed: Dict[int, str] = {}      # world rank -> reason
         self._epoch = 0
+        self._events: List[FailureEvent] = []
         self._listeners: List[Callable[[int, str], None]] = []
+        # last failure-detection latency in microseconds (written by the
+        # heartbeat detector, read by the ft_detect_latency_us pvar)
+        self.detect_latency_us = 0
 
     def fail_rank(self, world_rank: int, reason: str = "injected") -> None:
         """Report rank failure (detector ingress + fault injection)."""
@@ -42,6 +56,8 @@ class Registry:
                 return
             self._failed[world_rank] = reason
             self._epoch += 1
+            self._events.append(FailureEvent(world_rank, reason,
+                                             self._epoch, time.time()))
             listeners = list(self._listeners)
         for cb in listeners:
             cb(world_rank, reason)
@@ -64,11 +80,24 @@ class Registry:
     def epoch(self) -> int:
         return self._epoch
 
+    def events(self) -> List[FailureEvent]:
+        """Epoch-ordered failure history (MPIX get_failed's ordering
+        contract: later knowledge never reorders earlier events)."""
+        with self._lock:
+            return list(self._events)
+
     def add_listener(self, cb: Callable[[int, str], None]) -> None:
         """Register a failure-event callback (the PMIx event-handler
         role)."""
         with self._lock:
             self._listeners.append(cb)
+
+    def remove_listener(self, cb: Callable[[int, str], None]) -> None:
+        """Deregister (router/detector teardown — a listener surviving
+        its owner would fire into a closed object on the next event)."""
+        with self._lock:
+            if cb in self._listeners:
+                self._listeners.remove(cb)
 
     def probe_devices(self, devices, world_ranks=None) -> List[int]:
         """Health-check each rank's device with a trivial computation;
@@ -98,7 +127,9 @@ class Registry:
         with self._lock:
             self._failed.clear()
             self._listeners.clear()
+            self._events.clear()
             self._epoch = 0
+            self.detect_latency_us = 0
 
 
 # -- process-wide default domain (World Process Model) ---------------------
@@ -135,6 +166,14 @@ def epoch() -> int:
 
 def add_listener(cb: Callable[[int, str], None]) -> None:
     _default.add_listener(cb)
+
+
+def remove_listener(cb: Callable[[int, str], None]) -> None:
+    _default.remove_listener(cb)
+
+
+def events() -> List[FailureEvent]:
+    return _default.events()
 
 
 def probe_devices(devices, world_ranks=None) -> List[int]:

@@ -22,12 +22,19 @@ Invariants the lossy/fused subsystems must never lose
    rewrite of the wire schedule without its equivalence test is an
    unverified reordering of the collective's result
    (docs/LARGEMSG.md).
-4. **Tier-1 budget**: compression/persistent/large-message tests that
-   spawn real OS processes (``subprocess``-using test functions in
-   ``tests/test_compress*`` / ``tests/test_persistent*`` /
-   ``tests/test_largemsg*`` / ``tests/test_btl_rails*``) carry the
-   ``slow`` marker, so the multi-process jobs stay out of the
-   ``-m 'not slow'`` tier-1 run and its 870 s wall budget.
+4. **Fault-recovery parity**: every fault class the injection plane
+   can raise (``ft/inject.FAULT_CLASSES``: drop / delay / corrupt /
+   sever / kill) has a paired recovery test —
+   ``test_ft_<class>_recovers`` somewhere under ``tests/``. An
+   injectable fault without its recovery test is an unverified
+   failure mode (docs/RESILIENCE.md).
+5. **Tier-1 budget**: compression/persistent/large-message/FT tests
+   that spawn real OS processes (``subprocess``-using test functions
+   in ``tests/test_compress*`` / ``tests/test_persistent*`` /
+   ``tests/test_largemsg*`` / ``tests/test_btl_rails*`` /
+   ``tests/test_ft*``) carry the ``slow`` marker, so the
+   multi-process jobs stay out of the ``-m 'not slow'`` tier-1 run
+   and its 870 s wall budget.
 
 Usage::
 
@@ -103,6 +110,7 @@ def audit(tests_dir: Optional[str] = None) -> Dict[str, Any]:
     from ompi_tpu.coll.compressed import WRAPPED_FUNCS
     from ompi_tpu.coll.decision import PIPELINED
     from ompi_tpu.coll.persistent import FUSED_FUNCS, PERSISTENT_FUNCS
+    from ompi_tpu.ft.inject import FAULT_CLASSES
 
     wanted = {f"test_compressed_{func}_matches_uncompressed": func
               for func in WRAPPED_FUNCS}
@@ -112,9 +120,12 @@ def audit(tests_dir: Optional[str] = None) -> Dict[str, Any]:
                         for func in FUSED_FUNCS})
     wanted_pipe = {f"test_pipelined_{func}_matches_unpipelined": func
                    for func in PIPELINED}
+    wanted_ft = {f"test_ft_{cls}_recovers": cls
+                 for cls in FAULT_CLASSES}
     found: set = set()
     found_pers: set = set()
     found_pipe: set = set()
+    found_ft: set = set()
     unmarked: List[str] = []
     for path in sorted(glob.glob(os.path.join(tests_dir, "**", "*.py"),
                                  recursive=True)):
@@ -127,23 +138,29 @@ def audit(tests_dir: Optional[str] = None) -> Dict[str, Any]:
                 found_pers.add(name)
             if name in wanted_pipe:
                 found_pipe.add(name)
+            if name in wanted_ft:
+                found_ft.add(name)
             if base.startswith(("test_compress", "test_persistent",
-                                "test_largemsg", "test_btl_rails")) \
+                                "test_largemsg", "test_btl_rails",
+                                "test_ft")) \
                     and _uses_subprocess(node) \
                     and not (mod_slow or _has_slow_mark(node)):
                 unmarked.append(f"{base}::{name}")
     missing = sorted(set(wanted) - found)
     missing_pers = sorted(set(wanted_pers) - found_pers)
     missing_pipe = sorted(set(wanted_pipe) - found_pipe)
+    missing_ft = sorted(set(wanted_ft) - found_ft)
     return {"ok": not missing and not missing_pers and not missing_pipe
-            and not unmarked,
+            and not missing_ft and not unmarked,
             "wrapped_funcs": list(WRAPPED_FUNCS),
             "persistent_funcs": list(PERSISTENT_FUNCS),
             "fused_funcs": list(FUSED_FUNCS),
             "pipelined_funcs": sorted(PIPELINED),
+            "fault_classes": list(FAULT_CLASSES),
             "missing_parity": missing,
             "missing_persistent_parity": missing_pers,
             "missing_pipeline_parity": missing_pipe,
+            "missing_ft_recovery": missing_ft,
             "unmarked_slow": sorted(unmarked)}
 
 

@@ -8,7 +8,9 @@ one timebase and emits either a Perfetto-loadable JSON
 late-arrival attribution report (``--format report``), or the compact
 summary (``--format summary``; includes per-rank ``compress.quant`` /
 ``compress.dequant`` time aggregation when compressed collectives ran
-— docs/COMPRESSION.md).
+— docs/COMPRESSION.md — and per-rank ``ft.*`` suspicion/declaration
+aggregation when the resilience plane saw action —
+docs/RESILIENCE.md).
 
 Without input files it renders the CURRENT process's ring — the
 in-process escape hatch (call ``ompi_tpu.tools.tracedump.main([...])``

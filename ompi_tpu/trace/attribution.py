@@ -183,6 +183,36 @@ def bucket_flushes_by_reason(spans: Iterable[SpanLike]
     return agg
 
 
+def ft_by_rank(spans: Iterable[SpanLike]) -> Dict[str, Any]:
+    """Aggregate the resilience plane's ``ft.*`` spans per OBSERVING
+    rank (the rank whose detector suspected/declared — each span also
+    names the suspect in its args): suspicion episodes and their open
+    time, declarations, and how many suspicions cleared (the hysteresis
+    saves — a suspect that came back, docs/RESILIENCE.md). Empty dict
+    when no FT activity was traced — the summary omits the section
+    entirely."""
+    agg: Dict[str, Dict[str, Any]] = {}
+    for s in spans:
+        name = str(_field(s, "name", "?"))
+        if not name.startswith("ft."):
+            continue
+        args = _field(s, "args", None) or {}
+        rank = str(int(args.get("by", _field(s, "rank", -1))))
+        e = agg.setdefault(rank, {"suspects": 0, "suspect_us": 0.0,
+                                  "cleared": 0, "declared": 0})
+        if name == "ft.suspect":
+            e["suspects"] += 1
+            e["suspect_us"] += max(float(_field(s, "dur", 0.0)),
+                                   0.0) * 1e6
+            if not args.get("declared", False):
+                e["cleared"] += 1
+        elif name == "ft.declare":
+            e["declared"] += 1
+    for e in agg.values():
+        e["suspect_us"] = round(e["suspect_us"], 2)
+    return agg
+
+
 def summarize(spans: Iterable[SpanLike],
               stats: Optional[Mapping[str, int]] = None,
               top: int = 5) -> Dict[str, Any]:
@@ -213,6 +243,9 @@ def summarize(spans: Iterable[SpanLike],
     buck = bucket_flushes_by_reason(spans)
     if buck:
         out["bucket_flush"] = buck
+    ftagg = ft_by_rank(spans)
+    if ftagg:
+        out["ft"] = ftagg
     if reports:
         out["late_arrival_top"] = reports[:top]
     return out

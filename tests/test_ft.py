@@ -1,0 +1,292 @@
+"""Resilience-plane unit tier (docs/RESILIENCE.md): the injection
+gate's zero-cost/byte-identical contract, spec parsing and match
+filtering, the failure registry's epoch ordering and dedup, the
+heartbeat detector's hysteresis driven by synthetic clocks (a delay
+just under the timeout must NOT read as a death), request-level FT
+semantics, the api-layer errhandler guard, the ``ft.*`` trace
+aggregation, and the checkparity fault-recovery rule. The
+multi-process drills live in tests/test_ft_multiproc.py (slow tier)."""
+import time
+
+import pytest
+
+from ompi_tpu.core.errhandler import (
+    ERR_PROC_FAILED, ERRORS_RETURN, Errhandler, MPIError)
+from ompi_tpu.core.request import Request
+from ompi_tpu.ft import detector as ftdet
+from ompi_tpu.ft import inject
+from ompi_tpu.mca import var
+from ompi_tpu.runtime.ft import Registry
+from ompi_tpu.trace import attribution
+
+
+@pytest.fixture
+def inj():
+    """The injection plane with guaranteed-cold teardown: every test
+    leaves the gate exactly as the library ships it — off."""
+    inject.register_params()
+    yield inject
+    var.var_set("mpi_base_ft_inject", False)
+    for cls in inject.FAULT_CLASSES:
+        var.var_set(f"mpi_base_ft_inject_{cls}", "")
+    inject.refresh()
+    assert not inject.active
+
+
+def _registry():
+    return Registry()
+
+
+# -- injection gate ------------------------------------------------------
+
+def test_ft_inject_gate_cold_by_default(inj):
+    inj.refresh()
+    assert inj.active is False
+    assert all(v == 0 for v in inj.stats.values())
+    # with no spec compiled, the hook helpers are inert
+    assert inj.frame_fault("pml", 1) is None
+    assert not inj.should_corrupt(1)
+    assert not inj.should_sever(1)
+
+
+def test_ft_inject_gate_needs_switch_AND_spec(inj):
+    # a spec without the master switch stays cold (the byte-identical
+    # default), and the switch without any spec stays cold too
+    var.var_set("mpi_base_ft_inject_drop", "plane=pml")
+    inj.refresh()
+    assert inj.active is False
+    var.var_set("mpi_base_ft_inject", True)
+    inj.refresh()
+    assert inj.active is True
+    var.var_set("mpi_base_ft_inject_drop", "")
+    inj.refresh()
+    assert inj.active is False
+
+
+def test_ft_inject_spec_parsing():
+    assert inject._parse("") is None
+    assert inject._parse("   ") is None
+    s = inject._parse("rank=2,point=coll.allreduce,hit=2")
+    assert s["rank"] == 2 and s["hit"] == 2
+    assert s["point"] == "coll.allreduce"
+    assert s["nth"] == 1 and s["count"] == 1      # defaults
+    s = inject._parse("plane=tcp,ms=37.5,count=-1,junk,also=ok")
+    assert s["ms"] == 37.5 and s["count"] == -1
+    assert s["plane"] == "tcp" and s["also"] == "ok"
+
+
+def test_ft_inject_match_filters_rank_plane_peer_nth_count(inj):
+    var.var_set("mpi_base_ft_inject", True)
+    var.var_set("mpi_base_ft_inject_drop",
+                "rank=1,plane=pml,peer=2,nth=2,count=1")
+    inj.refresh(rank=1)
+    assert inj.active
+    assert inj.frame_fault("tcp", 2) is None      # plane mismatch
+    assert inj.frame_fault("pml", 3) is None      # peer mismatch
+    assert inj.frame_fault("pml", 2) is None      # 1st eligible < nth
+    assert inj.frame_fault("pml", 2) == ("drop", 0.0)   # the nth
+    assert inj.frame_fault("pml", 2) is None      # count exhausted
+    assert inj.stats["drop"] == 1
+    inj.refresh(rank=0)                            # wrong rank: inert
+    for _ in range(3):
+        assert inj.frame_fault("pml", 2) is None
+    assert inj.stats["drop"] == 0                  # refresh zeroed it
+
+
+def test_ft_inject_delay_seconds_and_kill_point_counting(inj):
+    var.var_set("mpi_base_ft_inject", True)
+    var.var_set("mpi_base_ft_inject_delay", "ms=120,count=2")
+    var.var_set("mpi_base_ft_inject_kill", "rank=0,point=x,hit=3")
+    inj.refresh(rank=0)
+    assert inj.frame_fault("tcp", 5) == ("delay", pytest.approx(0.12))
+    inj.point("y")                   # wrong point: no-op
+    inj.point("x")                   # hits 1..2 stay below hit=3 —
+    inj.point("x")                   # still alive proves no os._exit
+    assert inj.stats["kill"] == 0
+    inj.refresh(rank=2)              # wrong rank: the point is inert
+    for _ in range(5):
+        inj.point("x")
+    assert inj.stats["kill"] == 0
+
+
+# -- failure registry ----------------------------------------------------
+
+def test_ft_registry_dedup_epochs_listeners():
+    reg = _registry()
+    calls = []
+    reg.add_listener(lambda rk, reason: calls.append((rk, reason)))
+    assert not reg.any_failed()
+    reg.fail_rank(2, "first")
+    reg.fail_rank(2, "duplicate ingress")          # dedup: no new event
+    reg.fail_rank(5, "second")
+    evs = reg.events()
+    assert [e.rank for e in evs] == [2, 5]
+    assert evs[0].reason == "first"                # first ingress wins
+    assert evs[0].epoch < evs[1].epoch             # epoch-ordered
+    assert reg.failed_ranks() == frozenset({2, 5})
+    assert reg.any_failed()
+    assert calls == [(2, "first"), (5, "second")]
+
+
+def test_ft_registry_remove_listener():
+    reg = _registry()
+    calls = []
+
+    def cb(rk, reason):
+        calls.append(rk)
+
+    reg.add_listener(cb)
+    reg.fail_rank(1, "x")
+    reg.remove_listener(cb)
+    reg.fail_rank(3, "y")
+    assert calls == [1]
+
+
+# -- heartbeat detector (synthetic clocks) -------------------------------
+
+def _det(reg, rank=1, nprocs=2, **kw):
+    hbs = []
+    kw.setdefault("period", 0.1)
+    kw.setdefault("timeout", 0.8)
+    kw.setdefault("miss", 3)
+    d = ftdet.Detector(rank, nprocs, hbs.append, reg, **kw)
+    return d, hbs
+
+
+def test_ft_detector_declares_only_past_miss_hysteresis():
+    reg = _registry()
+    det, hbs = _det(reg)
+    t0 = time.monotonic()
+    assert det.check_once(now=t0) is None          # ring repair seeds
+    assert det.predecessor() == 0
+    # silence just UNDER the timeout: never even a suspect (the
+    # false-positive contract)
+    assert det.check_once(now=t0 + 0.79) is None
+    assert det.stats["suspects"] == 0
+    # past the timeout: suspect, but declaration waits out miss=3
+    assert det.check_once(now=t0 + 0.9) is None
+    assert det.stats["suspects"] == 1
+    assert det.check_once(now=t0 + 1.0) is None
+    assert det.check_once(now=t0 + 1.1) == 0       # 3rd miss: declared
+    assert reg.failed_ranks() == frozenset({0})
+    assert det.stats["declared"] == 1
+    assert det.stats["suspects"] == 0
+    assert hbs and all(p == 0 for p in hbs)        # beats to successor
+
+
+def test_ft_detector_suspect_clears_on_late_heartbeat():
+    reg = _registry()
+    det, _ = _det(reg)
+    t0 = time.monotonic()
+    det.check_once(now=t0)
+    det.check_once(now=t0 + 0.9)                   # miss 1: suspect
+    assert det.stats["suspects"] == 1
+    det.on_heartbeat(0)                            # the beat lands
+    assert det.stats["suspects"] == 0              # hysteresis cleared
+    assert det.check_once(now=time.monotonic() + 0.5) is None
+    assert det.stats["declared"] == 0
+    assert not reg.any_failed()
+
+
+def test_ft_detector_disabled_and_trivial_worlds():
+    reg = _registry()
+    det, _ = _det(reg, period=0.0)
+    assert det.start() is False                    # period 0: no thread
+    det1, _ = _det(reg, rank=0, nprocs=1, period=0.1)
+    assert det1.start() is False                   # singleton world
+
+
+def test_ft_detector_ring_skips_failed_and_departed():
+    reg = _registry()
+    det, _ = _det(reg, rank=0, nprocs=4)
+    assert det.successor() == 1 and det.predecessor() == 3
+    reg.fail_rank(3, "x")
+    assert det.predecessor() == 2                  # ring repaired
+    det.departed = lambda r: r == 1                # graceful 'bye'
+    assert det.successor() == 2
+
+
+def test_ft_detector_latency_accounting():
+    reg = _registry()
+    det, _ = _det(reg, period=0.1)
+    det._last_seen[2] = time.monotonic() - 0.5
+    det.record_latency(2, "eof monitor")
+    lat = det.stats["detect_latency_us"]
+    # ~(0.5s silence - 0.1s period) with generous CI slack
+    assert 0.3e6 < lat < 0.55e6, lat
+    assert reg.detect_latency_us == lat
+
+
+# -- request-level FT ----------------------------------------------------
+
+def test_ft_request_fail_completes_in_error():
+    rq = Request(arrays=[])
+    rq.fail(MPIError(ERR_PROC_FAILED, "peer world rank 2 failed"))
+    assert rq.status.error == ERR_PROC_FAILED
+    with pytest.raises(MPIError):
+        rq.test()
+    with pytest.raises(MPIError):
+        rq.wait()
+
+
+# -- api-layer errhandler guard ------------------------------------------
+
+def test_ft_api_guard_routes_through_errhandler():
+    from ompi_tpu.api import mpi as api
+
+    class DummyComm:
+        pass
+
+    def boom():
+        raise MPIError(ERR_PROC_FAILED, "drill")
+
+    c = DummyComm()
+    c.errhandler = ERRORS_RETURN
+    with pytest.raises(MPIError) as ei:
+        api._guard(c, boom)
+    assert ei.value.error_class == ERR_PROC_FAILED
+    handled = []
+    c.errhandler = Errhandler(
+        lambda comm, ec, msg: handled.append(ec) or ("handled", ec))
+    assert api._guard(c, boom) == ("handled", ERR_PROC_FAILED)
+    assert handled == [ERR_PROC_FAILED]
+
+
+# -- observability + CI parity -------------------------------------------
+
+def test_ft_trace_aggregation_by_observing_rank():
+    spans = [
+        {"kind": "span", "name": "ft.suspect", "rank": 1, "dur": 0.002,
+         "args": {"by": 1, "rank": 0, "declared": False}},
+        {"kind": "span", "name": "ft.suspect", "rank": 1, "dur": 0.005,
+         "args": {"by": 1, "rank": 0, "declared": True}},
+        {"kind": "instant", "name": "ft.declare", "rank": 1,
+         "args": {"by": 1, "rank": 0}},
+        {"kind": "span", "name": "coll_allreduce", "rank": 1,
+         "dur": 0.1},
+    ]
+    agg = attribution.ft_by_rank(spans)
+    assert set(agg) == {"1"}
+    e = agg["1"]
+    assert e["suspects"] == 2 and e["cleared"] == 1
+    assert e["declared"] == 1
+    assert e["suspect_us"] == pytest.approx(7000.0)
+    # the summary carries the section only when FT activity was traced
+    assert "ft" in attribution.summarize(spans)
+    assert "ft" not in attribution.summarize(
+        [{"kind": "span", "name": "coll_allreduce", "dur": 0.1}])
+
+
+def test_ft_checkparity_recovery_rule():
+    from ompi_tpu.tools.checkparity import audit
+    rep = audit()
+    assert rep["fault_classes"] == list(inject.FAULT_CLASSES)
+    assert rep["missing_ft_recovery"] == []        # every class paired
+    assert rep["unmarked_slow"] == []              # drills stay slow
+    assert rep["ok"]
+
+
+def test_ft_persistent_counters_snapshot():
+    # regression: counters() referenced an undefined lock
+    from ompi_tpu.coll import persistent
+    assert isinstance(persistent.counters(), dict)

@@ -1,0 +1,87 @@
+"""Resilience-plane drills as REAL multi-process jobs (slow tier):
+one ``test_ft_<class>_recovers`` per injectable fault class — the
+parity pair tools/checkparity enforces (docs/RESILIENCE.md) — plus the
+detector's multi-process false-positive contract. Each drill lives in
+``tests/perrank_programs/`` and runs under ``mpirun --per-rank``; the
+kill drill (p34) is the ISSUE-8 acceptance sequence end to end:
+heartbeat detection, MPI_ERR_PROC_FAILED, revoke propagation, shrink,
+and BucketedGradSync's elastic continuation."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_PROGS = os.path.join(_REPO, "tests", "perrank_programs")
+_MPIRUN = os.path.join(_REPO, "ompi_tpu", "tools", "mpirun.py")
+
+
+def _run(prog: str, n: int):
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("JAX_", "XLA_"))}
+    cmd = [sys.executable, _MPIRUN, "--per-rank", "-n", str(n),
+           "--timeout", "150", os.path.join(_PROGS, prog)]
+    return subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=200, cwd=_REPO)
+
+
+def _assert_ok(prog: str, n: int, ok: int | None = None,
+               rc: int = 0) -> None:
+    """The drill passes when every SURVIVOR prints its OK marker (``ok``
+    defaults to all ``n`` ranks) and the job rc is the expected one —
+    0 for fault classes nobody dies from, the victim's deterministic
+    os._exit code for the kill drill."""
+    res = _run(prog, n)
+    assert res.returncode == rc, \
+        f"rc={res.returncode} (want {rc})\n--- out\n{res.stdout}\n" \
+        f"--- err\n{res.stderr[-4000:]}"
+    marker = f"OK {prog.removesuffix('.py')}"
+    count = res.stdout.count(marker)
+    want = n if ok is None else ok
+    assert count == want, \
+        f"expected {want} '{marker}' lines, got {count}:\n{res.stdout}"
+
+
+def test_ft_drop_recovers():
+    """A dropped (pre-stamp) frame is lost without a reorder hole or a
+    death report; the channel keeps sequencing."""
+    _assert_ok("p35_ftdrop.py", 2)
+
+
+def test_ft_delay_recovers():
+    """A delayed frame arrives late — nothing lost, nobody declared."""
+    _assert_ok("p36_ftdelay.py", 2)
+
+
+def test_ft_corrupt_recovers():
+    """A corrupted stream costs one reconnect: the receiver drops the
+    connection WITHOUT a death report and no sequenced frame is lost."""
+    _assert_ok("p37_ftcorrupt.py", 2)
+
+
+def test_ft_sever_recovers():
+    """An injected RST reads exactly like a death at the survivor: the
+    full ULFM path (ERR_PROC_FAILED, get_failed, shrink) runs against a
+    peer that is in fact still alive — a network partition drill."""
+    _assert_ok("p38_ftsever.py", 2)
+
+
+def test_ft_kill_recovers():
+    """The ISSUE-8 acceptance drill: rank 2 os._exit(137)s at its 2nd
+    allreduce; survivors get MPI_ERR_PROC_FAILED (no hang), revoke
+    propagates from one revoker, shrink yields a 3-rank comm whose
+    allreduce matches numpy, BucketedGradSync resumes on the survivors,
+    and detection latency stays under 2x the heartbeat timeout. The
+    job rc is the victim's own exit code — the three survivors exit
+    clean after their OK markers."""
+    _assert_ok("p34_ftdrill.py", 4, ok=3, rc=137)
+
+
+def test_ft_detector_false_positive_under_timeout():
+    """The hysteresis contract, multi-process: a heartbeat stream
+    stalled past the timeout but under the miss window raises a
+    suspicion that CLEARS — the delayed rank is never declared."""
+    _assert_ok("p39_ftfalsepos.py", 2)
