@@ -1342,6 +1342,27 @@ def _ft_rows() -> dict:
          "--ft-child"], 300, _child_env())}
 
 
+def _lint_rows() -> dict:
+    """The --lint section: time one full-tree mpilint pass (the static
+    gate every tier-1 run pays through tests/test_lint_clean.py) and
+    pin the <10 s wall-time contract the analyzer ships under
+    (docs/ANALYSIS.md)."""
+    from ompi_tpu.analyze import mpilint
+    t0 = time.perf_counter()
+    rep = mpilint.run_lint()
+    dt = time.perf_counter() - t0
+    return {
+        "seconds": round(dt, 3),
+        "under_10s": bool(dt < 10.0),
+        "files": rep["files"],
+        "rules": len(rep["rules"]),
+        "findings": len(rep["findings"]),
+        "baselined": len(rep["suppressed"]),
+        "stale_baseline": len(rep["stale_baseline"]),
+        "clean": bool(rep["ok"]),
+    }
+
+
 def _trace_summary() -> dict:
     """Trace summary for the committed BENCH record, proven
     machine-readable: the summary must round-trip through JSON
@@ -1394,6 +1415,10 @@ def main() -> None:
                          "detection latency, revoke, shrink, elastic "
                          "continuation (docs/RESILIENCE.md)")
     ap.add_argument("--ft-child", action="store_true")
+    ap.add_argument("--lint", action="store_true",
+                    help="time one full-tree mpilint pass and record "
+                         "the <10 s static-gate contract row "
+                         "(docs/ANALYSIS.md)")
     ap.add_argument("--trace", action="store_true",
                     help="record collective/pt2pt spans "
                          "(ompi_tpu.trace) and attach the trace "
@@ -1654,6 +1679,9 @@ def main() -> None:
     # children) does not gate it
     ft_rows = _ft_rows() if (args.ft and n == 1) else None
 
+    # ---- static-gate timing row (--lint) ----------------------------
+    lint_rows = _lint_rows() if args.lint else None
+
     result = {
         # throughput-derived: amortized pipelined dispatch minus the
         # observation RTT (the OSU loop), NOT a single-shot latency —
@@ -1703,6 +1731,7 @@ def main() -> None:
         **({"largemsg": largemsg_rows}
            if largemsg_rows is not None else {}),
         **({"ft": ft_rows} if ft_rows is not None else {}),
+        **({"lint": lint_rows} if lint_rows is not None else {}),
         "caveat": ("size-1 world: large-message path is identity-aliased "
                    "by XLA (algbw is an upper bound); >1-rank rows and "
                    "algorithm A/B come from the 8-rank CPU-mesh child"
@@ -1812,6 +1841,13 @@ def main() -> None:
                 "detect_under_2x_timeout")
             contract["shrink_allreduce_correct"] = kd.get(
                 "shrink_allreduce_correct")
+    if lint_rows is not None:
+        # the static-gate acceptance rows (docs/ANALYSIS.md): the
+        # shipped tree lints clean and the full pass stays under the
+        # 10 s budget tier-1 pays on every run
+        contract["lint_clean"] = lint_rows["clean"]
+        contract["lint_under_10s"] = lint_rows["under_10s"]
+        contract["lint_seconds"] = lint_rows["seconds"]
     prev_algbw = _prev_headline_algbw()
     if prev_algbw is not None:
         # regression gate: this round's single-process large-message

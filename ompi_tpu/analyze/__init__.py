@@ -1,0 +1,24 @@
+"""ompi_tpu.analyze — project-native static analysis + runtime
+concurrency witnesses.
+
+Two halves (docs/ANALYSIS.md):
+
+- :mod:`ompi_tpu.analyze.mpilint` — an AST-based static pass over the
+  whole ``ompi_tpu/`` tree with project-specific rules (MCA-var and
+  pvar discipline, the PR-5 completion-closure bug class, blocking
+  calls under hot-path locks, span balance). Run it with
+  ``python -m ompi_tpu.tools.mpilint``; tier-1 enforces zero
+  non-baselined findings (tests/test_lint_clean.py).
+- :mod:`ompi_tpu.analyze.lockwitness` — a runtime lock-order witness
+  behind the MCA var ``mpi_base_lockwitness``: per-thread held-lock
+  vectors, the global acquisition-order graph, cycle (potential
+  deadlock) reports with both stacks, and hold-time watermarks.
+  Off = zero overhead (``threading.Lock`` is untouched).
+
+Intentional violations live in ``analyze/baseline.json`` — one entry
+per suppression, each with a one-line justification.
+"""
+from ompi_tpu.analyze.mpilint import (  # noqa: F401
+    RULES, Finding, default_baseline_path, load_baseline, render_mcavars,
+    run_lint,
+)

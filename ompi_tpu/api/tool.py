@@ -39,7 +39,11 @@ def cvar_write(name: str, value: Any) -> None:
 
 
 def cvar_list() -> List[Dict[str, Any]]:
-    return _var.var_dump()
+    return _var.var_list()
+
+
+def cvar_names() -> List[str]:
+    return _var.var_names()
 
 
 # -- performance variables -------------------------------------------------

@@ -51,6 +51,17 @@ from ompi_tpu.mca import var as _var
 
 FAULT_CLASSES = ("drop", "delay", "corrupt", "sever", "kill")
 
+# per-class spec var, spelled as literals so mpilint's mca_var rule can
+# resolve every name against its var_register site (the bare
+# f"mpi_base_ft_inject_{c}" spelling was invisible to the registry)
+_SPEC_VARS = {
+    "drop": "mpi_base_ft_inject_drop",
+    "delay": "mpi_base_ft_inject_delay",
+    "corrupt": "mpi_base_ft_inject_corrupt",
+    "sever": "mpi_base_ft_inject_sever",
+    "kill": "mpi_base_ft_inject_kill",
+}
+
 # THE zero-cost gate: every btl hook reads this one attribute and
 # falls through when False (the _trace.active idiom).
 active = False
@@ -129,7 +140,7 @@ def refresh(rank: Optional[int] = None) -> None:
         enabled = bool(_var.var_get("mpi_base_ft_inject", False))
         any_spec = False
         for c in FAULT_CLASSES:
-            s = _parse(_var.var_get(f"mpi_base_ft_inject_{c}", ""))
+            s = _parse(_var.var_get(_SPEC_VARS[c], ""))
             _specs[c] = s
             any_spec = any_spec or s is not None
         _seen.clear()

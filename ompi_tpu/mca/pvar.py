@@ -7,6 +7,8 @@ MPI_T-shaped calls, and the info tool dumps them.
 """
 from __future__ import annotations
 
+import os
+import sys
 import threading
 from typing import Any, Callable, Dict, List
 
@@ -14,12 +16,39 @@ _lock = threading.Lock()
 _pvars: Dict[str, Dict[str, Any]] = {}
 
 
+def _caller_site() -> str:
+    """``file.py:line`` of the nearest frame outside this module — the
+    owner identity for the double-register policy (register_dict's own
+    frames are skipped so the dict-registration idiom keys on ITS
+    caller)."""
+    here = os.path.abspath(__file__)
+    f = sys._getframe(1)
+    while f is not None and os.path.abspath(f.f_code.co_filename) == here:
+        f = f.f_back
+    if f is None:
+        return "<unknown>"
+    return f"{os.path.basename(f.f_code.co_filename)}:{f.f_lineno}"
+
+
 def pvar_register(name: str, read_fn: Callable[[], Any], *,
                   unit: str = "count", help: str = "",
                   var_class: str = "counter") -> None:
+    """Register (or same-site rebind) one pvar.
+
+    Double-register policy, mirroring ``var.var_register``: the SAME
+    call site rebinding a name is the supported new-endpoint idiom
+    (reads must follow the newest live counter dict); a DIFFERENT site
+    claiming an existing name raises — two owners silently shadowing
+    each other's counters is the bug class."""
+    site = _caller_site()
     with _lock:
+        v = _pvars.get(name)
+        if v is not None and v.get("site") not in (None, site):
+            raise ValueError(
+                f"pvar '{name}' re-registered at {site} — owner is "
+                f"{v['site']}")
         _pvars[name] = {"read": read_fn, "unit": unit, "help": help,
-                        "class": var_class}
+                        "class": var_class, "site": site}
 
 
 def pvar_read(name: str) -> Any:

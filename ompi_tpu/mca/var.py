@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import threading
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
@@ -50,6 +51,7 @@ class _Var:
     read_only: bool = False
     enumerator: Optional[List[Any]] = None   # allowed values, if constrained
     flags: Dict[str, Any] = field(default_factory=dict)
+    site: str = ""                 # "file.py:line" of the owning register
 
 
 _lock = threading.Lock()
@@ -80,22 +82,50 @@ def _reset_param_file_cache() -> None:   # for tests
     _param_file_cache = None
 
 
+def _caller_site() -> str:
+    """``file.py:line`` of the nearest frame outside this module — the
+    owner identity for the double-register policy."""
+    here = os.path.abspath(__file__)
+    f = sys._getframe(1)
+    while f is not None and os.path.abspath(f.f_code.co_filename) == here:
+        f = f.f_back
+    if f is None:
+        return "<unknown>"
+    return f"{os.path.basename(f.f_code.co_filename)}:{f.f_lineno}"
+
+
 def var_register(framework: str, component: str, name: str, *,
                  vtype: str = "str", default: Any = None, help: str = "",
                  read_only: bool = False,
                  enumerator: Optional[List[Any]] = None) -> Any:
     """Register a typed variable; resolve its value through the precedence
     chain and return the resolved value (as ``mca_base_var_register`` does
-    via its out-param)."""
+    via its out-param).
+
+    Double-register policy (mpilint's mca_var rule checks the static
+    side of the same invariant): re-registering from the SAME call site
+    (the idempotent ``register_params`` idiom) or with the same
+    (vtype, default) shape is a no-op returning the live value; a
+    DIFFERENT site claiming the name with a conflicting vtype/default
+    raises — two owners with different ideas of the default is exactly
+    the silent-misconfiguration bug the registry exists to prevent."""
     global _epoch
     full = "_".join(p for p in (framework, component, name) if p)
     coerce = _COERCE[vtype]
+    site = _caller_site()
     with _lock:
         if full in _registry:
-            return _registry[full].value
+            v = _registry[full]
+            if v.site != site and (v.vtype != vtype
+                                   or v.default != default):
+                raise ValueError(
+                    f"MCA var '{full}' re-registered at {site} with "
+                    f"conflicting type/default ({vtype!r}, {default!r})"
+                    f" — owner is {v.site} ({v.vtype!r}, {v.default!r})")
+            return v.value
         _epoch += 1
         v = _Var(name=full, vtype=vtype, default=default, help=help,
-                 read_only=read_only, enumerator=enumerator)
+                 read_only=read_only, enumerator=enumerator, site=site)
         v.value, v.source = _resolve(full, coerce, default)
         if enumerator is not None and v.value not in enumerator:
             v.value, v.source = default, SOURCE_DEFAULT
@@ -254,9 +284,23 @@ def var_dump() -> List[Dict[str, Any]]:
     with _lock:
         return [
             {"name": v.name, "type": v.vtype, "value": v.value,
-             "default": v.default, "source": v.source, "help": v.help}
+             "default": v.default, "source": v.source, "help": v.help,
+             "site": v.site}
             for v in sorted(_registry.values(), key=lambda v: v.name)
         ]
+
+
+def var_list() -> List[Dict[str, Any]]:
+    """Registered vars, symmetric to ``pvar.pvar_list()`` — name plus
+    the metadata tools and the analyzer cross-check (the runtime side
+    of mpilint's static registry)."""
+    return var_dump()
+
+
+def var_names() -> List[str]:
+    """Names only, symmetric to ``pvar.pvar_names()``."""
+    with _lock:
+        return sorted(_registry)
 
 
 def _reset_for_tests() -> None:

@@ -414,26 +414,32 @@ def maybe_send_pipelined(engine, data: Any, dest: int, tag: int,
         tok = (_trace.begin("pml.segment", idx=i, pipe=uid, dest=dest)
                if _trace.active else None)
         t0 = time.perf_counter()
-        if stager is not None:
-            seg = stager.get(i)          # staged D2H, next copy already
-        else:                            # in flight (double buffer)
-            seg = flat[i * epseg:(i + 1) * epseg]
-        seg_header = {"pipeseg": 1, "pipe": uid, "psrc": router.rank,
-                      "idx": i, "n": nseg}
-        if codec:
-            w = _cwire.encode(np.ascontiguousarray(seg))
-            raw = pickle.dumps(w, protocol=pickle.HIGHEST_PROTOCOL)
-        else:                            # zero-copy pack: the segment
-            raw = memoryview(seg).cast("B")   # rides the source buffer
-            # straight to sendall (tcp._sendmsg) — tobytes() here cost
-            # one full extra pass over every large message. The byte
-            # offset lets the receiver assemble in place (PipeStore).
-            seg_header["off"] = i * epseg * np_dtype.itemsize
-            seg_header["tb"] = total
+        nraw = 0
+        try:
+            if stager is not None:
+                seg = stager.get(i)      # staged D2H, next copy already
+            else:                        # in flight (double buffer)
+                seg = flat[i * epseg:(i + 1) * epseg]
+            seg_header = {"pipeseg": 1, "pipe": uid, "psrc": router.rank,
+                          "idx": i, "n": nseg}
+            if codec:
+                w = _cwire.encode(np.ascontiguousarray(seg))
+                raw = pickle.dumps(w, protocol=pickle.HIGHEST_PROTOCOL)
+            else:                        # zero-copy pack: the segment
+                raw = memoryview(seg).cast("B")  # rides the source
+                # buffer straight to sendall (tcp._sendmsg) —
+                # tobytes() here cost one full extra pass over every
+                # large message. The byte offset lets the receiver
+                # assemble in place (PipeStore).
+                seg_header["off"] = i * epseg * np_dtype.itemsize
+                seg_header["tb"] = total
+            nraw = len(raw)
+        finally:
+            # all exits: a staging/encode error must not leak the span
+            if tok is not None:
+                _trace.end(tok, bytes=nraw)
         dt = time.perf_counter() - t0
         prep_s += dt
-        if tok is not None:
-            _trace.end(tok, bytes=len(raw))
         send_segment(wdest, seg_header, raw, on_done)
     if not done_evt.wait(600):
         raise MPIError(ERR_PENDING,

@@ -91,6 +91,10 @@ def init(requested: int = THREAD_SINGLE,
         raise MPIError(ERR_OTHER, "MPI already initialized")
     assert_platform_pin()
     _register_base_vars()
+    # arm the lock-order witness BEFORE transport/progress bring-up so
+    # endpoint locks are created wrapped; off = threading.Lock untouched
+    from ompi_tpu.analyze import lockwitness as _lockwitness
+    _lockwitness.maybe_install_from_var()
     from ompi_tpu.pml import stacked as _pml_stacked  # noqa: F401
     # (imports register the pml MCA vars — components register at open,
     # mca_base convention)

@@ -1,0 +1,74 @@
+"""checkall — the single CI gate: checkparity + mpilint + MCAVARS
+freshness in one run.
+
+``python -m ompi_tpu.tools.checkall`` folds the three static contracts
+every PR must hold into one exit status:
+
+1. **checkparity** (rules 1-6): parity-test pairing for lossy/fused/
+   pipelined/FT paths, slow-marker hygiene, and a fixture pair per
+   analyzer rule.
+2. **mpilint**: zero non-baselined findings and zero stale baseline
+   entries over the whole ``ompi_tpu/`` tree (analyze/baseline.json).
+3. **MCAVARS freshness**: the committed ``docs/MCAVARS.md`` matches
+   what the current tree's ``var_register`` sites generate.
+
+Prints a JSON report; exit 1 on any violation. The same three checks
+run in-process in tier-1 (tests/test_lint_clean.py,
+tests/test_compress_tools.py), so CI cannot drift from the local gate.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+from ompi_tpu.analyze import mpilint as _mpilint
+from ompi_tpu.tools import checkparity as _checkparity
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def mcavars_fresh(doc_path: Optional[str] = None) -> Dict[str, Any]:
+    """Is the committed docs/MCAVARS.md what the tree generates?"""
+    doc_path = doc_path or os.path.join(_REPO, "docs", "MCAVARS.md")
+    want = _mpilint.render_mcavars()
+    try:
+        with open(doc_path, encoding="utf-8") as f:
+            have = f.read()
+    except OSError:
+        have = ""
+    return {"ok": have == want, "path": doc_path,
+            "hint": ("" if have == want else
+                     "regenerate: python -m ompi_tpu.tools.mpilint "
+                     "--emit-mcavars docs/MCAVARS.md")}
+
+
+def run_all(tests_dir: Optional[str] = None) -> Dict[str, Any]:
+    parity = _checkparity.audit(tests_dir)
+    lint = _mpilint.run_lint()
+    lint_slim = {k: v for k, v in lint.items() if k != "var_registry"}
+    mcavars = mcavars_fresh()
+    return {"ok": bool(parity["ok"] and lint["ok"] and mcavars["ok"]),
+            "checkparity": parity,
+            "mpilint": lint_slim,
+            "mcavars": mcavars}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="python -m ompi_tpu.tools.checkall",
+        description="checkparity + mpilint + MCAVARS freshness — the "
+                    "one-shot CI gate (docs/ANALYSIS.md).")
+    ap.add_argument("--tests", default=None,
+                    help="tests directory (default: <repo>/tests)")
+    args = ap.parse_args(argv)
+    report = run_all(args.tests)
+    print(json.dumps(report, indent=1))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

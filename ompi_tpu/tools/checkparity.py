@@ -35,6 +35,12 @@ Invariants the lossy/fused subsystems must never lose
    ``tests/test_ft*``) carry the ``slow`` marker, so the
    multi-process jobs stay out of the ``-m 'not slow'`` tier-1 run
    and its 870 s wall budget.
+6. **Lint-rule fixture parity**: every static rule the analyzer ships
+   (``analyze.mpilint.RULES``) has a fixture PAIR
+   (``tests/fixtures/lint/bad_<rule>.py`` that must fire it and
+   ``good_<rule>.py`` that must not) plus a test whose name contains
+   ``lint_<rule>`` exercising them — an analyzer rule without a
+   proving fixture is an unverified checker (docs/ANALYSIS.md).
 
 Usage::
 
@@ -107,6 +113,7 @@ def _module_slow_pytestmark(path: str) -> bool:
 
 def audit(tests_dir: Optional[str] = None) -> Dict[str, Any]:
     tests_dir = tests_dir or os.path.join(_REPO, "tests")
+    from ompi_tpu.analyze.mpilint import RULES
     from ompi_tpu.coll.compressed import WRAPPED_FUNCS
     from ompi_tpu.coll.decision import PIPELINED
     from ompi_tpu.coll.persistent import FUSED_FUNCS, PERSISTENT_FUNCS
@@ -126,7 +133,15 @@ def audit(tests_dir: Optional[str] = None) -> Dict[str, Any]:
     found_pers: set = set()
     found_pipe: set = set()
     found_ft: set = set()
+    found_lint: set = set()
     unmarked: List[str] = []
+    fixtures_dir = os.path.join(tests_dir, "fixtures", "lint")
+    missing_fixtures: List[str] = []
+    for rule in sorted(RULES):
+        for kind in ("bad", "good"):
+            fx = os.path.join(fixtures_dir, f"{kind}_{rule}.py")
+            if not os.path.isfile(fx):
+                missing_fixtures.append(f"fixtures/lint/{kind}_{rule}.py")
     for path in sorted(glob.glob(os.path.join(tests_dir, "**", "*.py"),
                                  recursive=True)):
         base = os.path.basename(path)
@@ -140,6 +155,9 @@ def audit(tests_dir: Optional[str] = None) -> Dict[str, Any]:
                 found_pipe.add(name)
             if name in wanted_ft:
                 found_ft.add(name)
+            for rule in RULES:
+                if f"lint_{rule}" in name:
+                    found_lint.add(rule)
             if base.startswith(("test_compress", "test_persistent",
                                 "test_largemsg", "test_btl_rails",
                                 "test_ft")) \
@@ -150,17 +168,23 @@ def audit(tests_dir: Optional[str] = None) -> Dict[str, Any]:
     missing_pers = sorted(set(wanted_pers) - found_pers)
     missing_pipe = sorted(set(wanted_pipe) - found_pipe)
     missing_ft = sorted(set(wanted_ft) - found_ft)
+    missing_lint = sorted(f"test *lint_{r}* (fixture-pair test)"
+                          for r in set(RULES) - found_lint)
     return {"ok": not missing and not missing_pers and not missing_pipe
-            and not missing_ft and not unmarked,
+            and not missing_ft and not unmarked
+            and not missing_fixtures and not missing_lint,
             "wrapped_funcs": list(WRAPPED_FUNCS),
             "persistent_funcs": list(PERSISTENT_FUNCS),
             "fused_funcs": list(FUSED_FUNCS),
             "pipelined_funcs": sorted(PIPELINED),
             "fault_classes": list(FAULT_CLASSES),
+            "lint_rules": sorted(RULES),
             "missing_parity": missing,
             "missing_persistent_parity": missing_pers,
             "missing_pipeline_parity": missing_pipe,
             "missing_ft_recovery": missing_ft,
+            "missing_lint_fixtures": missing_fixtures,
+            "missing_lint_tests": missing_lint,
             "unmarked_slow": sorted(unmarked)}
 
 

@@ -1,0 +1,16 @@
+"""CLI shim: ``python -m ompi_tpu.tools.mpilint`` — the documented
+entry point for the project-native static analyzer. The engine (rule
+catalog, baseline handling, MCAVARS generation) lives in
+:mod:`ompi_tpu.analyze.mpilint`; this wrapper exists so the tools/
+namespace stays the single CLI surface (tracedump, checkparity,
+mpisync precedent) and ``-m`` runs don't shadow the analyze package
+module in ``sys.modules``.
+"""
+from __future__ import annotations
+
+import sys
+
+from ompi_tpu.analyze.mpilint import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -33,14 +33,24 @@ from ompi_tpu.trace import attribution, perfetto
 
 
 def _gather(files: List[str]) -> tuple:
-    """(spans, rank_offsets, live) merged from dump files, or the
-    live ring (live=True)."""
+    """(spans, rank_offsets, live, witness_reports) merged from dump
+    files, or the live ring (live=True). Lock-witness dumps
+    (``lockwitness.dump()`` files, recognized by their ``lockwitness``
+    key) ride the same file list and are split out for the summary's
+    merged-graph section."""
     if not files:
-        return trace.span_dicts(), {}, True
+        return trace.span_dicts(), {}, True, []
     spans: List[Dict[str, Any]] = []
     offsets: Dict[int, float] = {}
+    witness: List[Dict[str, Any]] = []
     for path in files:
-        d = trace.load_dump(path)
+        with open(path) as f:
+            d = json.load(f)
+        if isinstance(d, dict) and "lockwitness" in d:
+            witness.append(d)
+            continue
+        if not isinstance(d, dict) or "spans" not in d:
+            raise ValueError(f"not a trace dump: {path}")
         rank = int(d.get("rank", -1))
         off = float(d.get("offset_s", 0.0))
         for s in d["spans"]:
@@ -51,10 +61,11 @@ def _gather(files: List[str]) -> tuple:
             spans.append(s)
         if rank >= 0:
             offsets[rank] = off
-    return spans, offsets, False
+    return spans, offsets, False, witness
 
 
-def render(spans, offsets, fmt: str, live: bool = False
+def render(spans, offsets, fmt: str, live: bool = False,
+           witness: Optional[List[Dict[str, Any]]] = None
            ) -> Dict[str, Any]:
     if fmt == "perfetto":
         return perfetto.export(spans, offsets)
@@ -63,8 +74,14 @@ def render(spans, offsets, fmt: str, live: bool = False
                 "skew_watermarks": attribution.skew_watermarks()}
     # file mode: span/drop totals come from the dumps themselves, not
     # this (tool) process's empty live ring
-    return attribution.summarize(spans,
-                                 trace.stats() if live else None)
+    out = attribution.summarize(spans,
+                                trace.stats() if live else None)
+    if witness:
+        # per-rank lockwitness dumps merged into one graph, cycle
+        # detection re-run on the union (docs/ANALYSIS.md)
+        from ompi_tpu.analyze import lockwitness as _lockwitness
+        out["lockwitness"] = _lockwitness.merge_reports(witness)
+    return out
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -81,8 +98,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="output path (default: stdout)")
     args = ap.parse_args(argv)
 
-    spans, offsets, live = _gather(args.files)
-    obj = render(spans, offsets, args.format, live)
+    spans, offsets, live, witness = _gather(args.files)
+    obj = render(spans, offsets, args.format, live, witness)
     text = json.dumps(obj, indent=None if args.format == "perfetto"
                       else 1)
     if args.out == "-":

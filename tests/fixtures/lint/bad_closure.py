@@ -1,0 +1,37 @@
+"""Fixture: MUST fire the ``closure`` rule (and only it).
+
+The seeded PR-5 regression: ``RankRequest._cancel_fn`` armed with a
+closure capturing the request, consumed by the completion path but
+never cleared — request -> closure -> cell -> request cycle pinning
+the payload until a gen-2 GC pass. Never imported — parsed only.
+"""
+
+
+class RankRequestRegression:
+    """The pre-fix PR-5 shape, verbatim in structure."""
+
+    def __init__(self):
+        self._cancel_fn = None
+        self.payload = None
+
+    def cancel(self):
+        fn = self._cancel_fn
+        if fn is not None:
+            fn()
+
+    def _deliver(self, payload):
+        # BUG: self._cancel_fn is not cleared here
+        self.payload = payload
+
+    def _fail(self, exc):
+        # BUG: nor here
+        self.exc = exc
+
+
+class Poster:
+    def post(self, req):
+        # arms the attribute with a cycle-forming closure
+        req._cancel_fn = lambda: self._cancel_posted(req)
+
+    def _cancel_posted(self, req):
+        pass

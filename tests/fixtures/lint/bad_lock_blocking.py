@@ -1,0 +1,28 @@
+"""Fixture: MUST fire the ``lock_blocking`` rule (and only it).
+
+Blocking calls lexically under a ``with <lock>:`` — a stalled holder
+blocks every thread contending the lock (progress loop included).
+Never imported — parsed only.
+"""
+import subprocess
+import threading
+import time
+
+_lock = threading.Lock()
+
+
+def flush(sock, payload):
+    with _lock:
+        time.sleep(0.01)             # blocking sleep under the lock
+        sock.sendall(payload)        # blocking socket write under it
+
+
+def drain(sock):
+    with _lock:
+        return sock.recv(65536)      # blocking read under the lock
+
+
+def spawn_under_lock(receiver_thread):
+    with _lock:
+        subprocess.check_output(["true"])
+        receiver_thread.join()       # thread join under the lock

@@ -1,0 +1,27 @@
+"""Fixture: MUST fire the ``mca_var`` rule (and only it).
+
+Models the real shipped bug class: the bare ``mpi_base_ft_inject_``
+f-string prefix (fixed in ft/inject.py) plus a typo'd var name that
+resolves to no ``var_register`` site. Never imported — parsed only.
+"""
+from ompi_tpu.mca import var as _var
+
+
+def register():
+    _var.var_register("mpi", "base", "fixture_knob", vtype="int",
+                      default=3, help="registered fixture var")
+
+
+def read_typo():
+    # typo: registered name is mpi_base_fixture_knob
+    return _var.var_get("mpi_base_fixture_knbo", 0)
+
+
+def read_dynamic(name):
+    # the ft_inject bug class: f-string name invisible to the registry
+    return _var.var_get(f"mpi_base_fixture_{name}", 0)
+
+
+def register_dynamic(framework):
+    # non-literal framework: the registry cannot index the full name
+    _var.var_register(framework, "base", "fixture_dyn", default="")

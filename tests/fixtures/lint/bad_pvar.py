@@ -1,0 +1,19 @@
+"""Fixture: MUST fire the ``pvar`` rule (and only it).
+
+Models the PR-2 bug class: a check-and-register whose membership test
+is not under the lock that guards the registration, plus a read of a
+never-registered counter. Never imported — parsed only.
+"""
+from ompi_tpu.mca import pvar as _pvar
+
+_known = set()
+
+
+def install_racy(stats):
+    # the PR-2 race: unlocked membership check vs concurrent writers
+    if "fixture_counter" not in _known:
+        _pvar.pvar_register("fixture_counter", lambda: 0)
+
+
+def read_missing():
+    return _pvar.pvar_read("fixture_counter_that_nobody_registered")

@@ -1,0 +1,18 @@
+"""Fixture: MUST fire the ``span_balance`` rule (and only it).
+
+A begin token ended outside any ``finally`` (an exception between
+begin and end leaks the span) and a begin whose token is discarded
+(the span can never be ended). Never imported — parsed only.
+"""
+from ompi_tpu import trace as _trace
+
+
+def leaky(work):
+    tok = _trace.begin("fixture.leaky")
+    work()                           # a raise here leaks the span
+    _trace.end(tok)
+
+
+def discarded(work):
+    _trace.begin("fixture.discarded")
+    work()

@@ -1,0 +1,43 @@
+"""Fixture: must NOT fire the ``closure`` rule.
+
+The post-fix PR-5 shape: every completion method clears the armed
+callable, breaking the request -> closure -> request cycle at the
+moment the request completes. Never imported — parsed only.
+"""
+
+
+class RankRequestFixed:
+    def __init__(self):
+        self._cancel_fn = None
+        self.payload = None
+
+    def cancel(self):
+        fn = self._cancel_fn
+        if fn is not None:
+            fn()
+
+    def _deliver(self, payload):
+        self.payload = payload
+        self._cancel_fn = None       # the PR-5 fix
+
+    def _fail(self, exc):
+        self.exc = exc
+        self._cancel_fn = None       # ... on every completion path
+
+
+class PosterFixed:
+    def post(self, req):
+        req._cancel_fn = lambda: self._cancel_posted(req)
+
+    def _cancel_posted(self, req):
+        pass
+
+
+class NoCompletionPath:
+    """Arms a callable but has no _deliver/_fail — out of scope."""
+
+    def __init__(self, cb):
+        self._done_cb = cb
+
+    def run(self):
+        self._done_cb()

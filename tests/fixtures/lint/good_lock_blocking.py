@@ -1,0 +1,40 @@
+"""Fixture: must NOT fire the ``lock_blocking`` rule.
+
+The compliant shapes: blocking work hoisted out of the critical
+section, and the closure-under-lock idiom (a callback DEFINED under
+the lock runs later, outside it). Never imported — parsed only.
+"""
+import threading
+import time
+
+_lock = threading.Lock()
+_pending = []
+
+
+def flush(sock, payload):
+    with _lock:
+        _pending.append(payload)     # state flip only under the lock
+        batch = b"".join(_pending)
+        _pending.clear()
+    sock.sendall(batch)              # the blocking write happens after
+
+
+def wait_then_update():
+    time.sleep(0.01)                 # blocking, but no lock held
+    with _lock:
+        _pending.clear()
+
+
+def defer(sock):
+    with _lock:
+        # a closure defined under the lock runs later, outside it —
+        # must not be flagged
+        def _cb():
+            sock.sendall(b"later")
+        _pending.append(_cb)
+    return _pending[-1]
+
+
+def join_csv(parts):
+    with _lock:
+        return ",".join(parts)       # str.join is not a thread join

@@ -1,0 +1,31 @@
+"""Fixture: must NOT fire the ``mca_var`` rule.
+
+Literal registration, literal reads resolving to it, and the shapes
+the rule must not over-fire on (non-MCA string literals, variable
+names passed through). Never imported — parsed only.
+"""
+from ompi_tpu.mca import var as _var
+
+
+def register():
+    _var.var_register("mpi", "base", "fixture_good_knob", vtype="int",
+                      default=7, help="registered fixture var")
+    # same-site-style second registration with the SAME shape is not a
+    # conflict (the idempotent register_params idiom)
+    _var.var_register("mpi", "base", "fixture_good_knob", vtype="int",
+                      default=7, help="registered fixture var")
+
+
+def read():
+    return _var.var_get("mpi_base_fixture_good_knob", 7)
+
+
+def read_passthrough(full_name):
+    # a variable name is the tool-plumbing shape (api/tool.cvar_read)
+    # — unlintable by design, must not be flagged
+    return _var.var_get(full_name, None)
+
+
+def not_an_mca_name():
+    # string literal that is not an MCA-name shape
+    return _var.var_get("NOT-A-VAR", None)

@@ -1,0 +1,32 @@
+"""Fixture: must NOT fire the ``pvar`` rule.
+
+Lock-guarded check-and-register (the PR-2 fix shape), reads resolving
+through exact registration, dict-prefix registration, and the spc_
+auto-install namespace. Never imported — parsed only.
+"""
+import threading
+
+from ompi_tpu.mca import pvar as _pvar
+
+_lock = threading.Lock()
+_known = set()
+_stats = {"hits": 0, "misses": 0}
+
+
+def install_guarded():
+    # check and register under ONE lock hold — the fixed shape
+    with _lock:
+        if "fixture_good_counter" not in _known:
+            _pvar.pvar_register("fixture_good_counter", lambda: 0)
+            _known.add("fixture_good_counter")
+
+
+def install_dict():
+    _pvar.pvar_register_dict("fixture_good", _stats)
+
+
+def read_all():
+    a = _pvar.pvar_read("fixture_good_counter")
+    b = _pvar.pvar_read("fixture_good_hits")       # dict prefix
+    c = _pvar.pvar_read("spc_fixture_anything")    # spc_ auto-install
+    return a, b, c

@@ -1,0 +1,22 @@
+"""Fixture: must NOT fire the ``span_balance`` rule.
+
+The gated begin/end idiom the tree uses: token bound under the
+``_trace.active`` gate, end reached on ALL exits through a finally.
+The context-manager form needs no token at all. Never imported —
+parsed only.
+"""
+from ompi_tpu import trace as _trace
+
+
+def balanced(work):
+    tok = _trace.begin("fixture.balanced") if _trace.active else None
+    try:
+        return work()
+    finally:
+        if tok is not None:
+            _trace.end(tok, ok=True)
+
+
+def context_manager(work):
+    with _trace.span("fixture.cm"):
+        return work()

@@ -1,0 +1,197 @@
+"""mpilint — per-rule fixture pairs, seeded regressions of the two
+real shipped bug classes (the PR-5 closure cycle and a typo'd
+``mpi_base_*`` var), baseline round-trip, and CLI exit codes.
+
+Every rule must fire on its ``tests/fixtures/lint/bad_<rule>.py`` and
+stay silent on ``good_<rule>.py`` — the pairing itself is enforced by
+tools/checkparity rule 6 (these test names carry the ``lint_<rule>``
+token it looks for).
+"""
+import json
+import os
+import textwrap
+
+from ompi_tpu.analyze import mpilint
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_FIXTURES = os.path.join(_REPO, "tests", "fixtures", "lint")
+
+
+def _pair(rule):
+    """(bad-file findings, good-file findings) for one rule over the
+    fixture tree."""
+    rep = mpilint.run_lint(root=_FIXTURES, baseline=None, rules=[rule],
+                           all_hot=True)
+    bad = [f for f in rep["findings"] if f["path"] == f"bad_{rule}.py"]
+    good = [f for f in rep["findings"] if f["path"] == f"good_{rule}.py"]
+    return bad, good
+
+
+# -- per-rule fixture pairs (checkparity rule 6 pairing) --------------------
+def test_lint_mca_var_fixture_pair():
+    bad, good = _pair("mca_var")
+    assert not good, good
+    msgs = "\n".join(f["message"] for f in bad)
+    assert "does not resolve" in msgs          # the typo'd literal
+    assert "dynamic (f-string)" in msgs        # the ft_inject bug class
+    assert "dynamic var_register" in msgs
+
+
+def test_lint_pvar_fixture_pair():
+    bad, good = _pair("pvar")
+    assert not good, good
+    msgs = "\n".join(f["message"] for f in bad)
+    assert "check-and-register race" in msgs   # the PR-2 class
+    assert "no matching" in msgs
+
+
+def test_lint_closure_fixture_pair():
+    bad, good = _pair("closure")
+    assert not good, good
+    # the seeded PR-5 regression: BOTH completion methods flagged
+    flagged = {f["key"] for f in bad}
+    assert ("closure:bad_closure.py:RankRequestRegression."
+            "_deliver:_cancel_fn") in flagged
+    assert ("closure:bad_closure.py:RankRequestRegression."
+            "_fail:_cancel_fn") in flagged
+
+
+def test_lint_lock_blocking_fixture_pair():
+    bad, good = _pair("lock_blocking")
+    assert not good, good
+    whats = "\n".join(f["message"] for f in bad)
+    assert "time.sleep" in whats
+    assert ".sendall" in whats
+    assert ".recv" in whats
+    assert ".join (thread)" in whats
+    assert "subprocess" in whats
+
+
+def test_lint_span_balance_fixture_pair():
+    bad, good = _pair("span_balance")
+    assert not good, good
+    msgs = "\n".join(f["message"] for f in bad)
+    assert "not ended in a finally" in msgs
+    assert "discarded" in msgs
+
+
+def test_rule_catalog_shape():
+    assert len(mpilint.RULES) >= 5
+    for fn in mpilint.RULES.values():
+        assert (fn.__doc__ or "").strip()
+
+
+# -- seeded regressions of the real shipped bugs ----------------------------
+def test_seeded_pr5_closure_regression_caught(tmp_path):
+    """Re-introduce the exact pre-PR-5 RankRequest shape in a scratch
+    tree: the analyzer must catch it."""
+    (tmp_path / "perrank.py").write_text(textwrap.dedent("""\
+        class RankRequest:
+            def __init__(self):
+                self._cancel_fn = None
+            def cancel(self):
+                fn = getattr(self, "_cancel_fn", None)
+                if fn is not None:
+                    fn()
+            def _deliver(self, payload):
+                self.payload = payload          # no clear: the bug
+            def _fail(self, exc):
+                self.exc = exc                  # no clear: the bug
+
+        class Poster:
+            def post(self, req):
+                req._cancel_fn = lambda: self._cancel_posted(req)
+            def _cancel_posted(self, req):
+                pass
+        """))
+    rep = mpilint.run_lint(root=str(tmp_path), baseline=None,
+                           rules=["closure"])
+    keys = {f["key"] for f in rep["findings"]}
+    assert "closure:perrank.py:RankRequest._deliver:_cancel_fn" in keys
+    assert "closure:perrank.py:RankRequest._fail:_cancel_fn" in keys
+
+
+def test_seeded_mca_var_typo_caught(tmp_path):
+    """A typo'd mpi_base_* literal (the undocumented-var class) must
+    not resolve."""
+    (tmp_path / "mod.py").write_text(textwrap.dedent("""\
+        from ompi_tpu.mca import var as _var
+
+        def register():
+            _var.var_register("mpi", "base", "ft_inject", vtype="bool",
+                              default=False, help="x")
+
+        def read():
+            return _var.var_get("mpi_base_ft_injcet", False)  # typo
+        """))
+    rep = mpilint.run_lint(root=str(tmp_path), baseline=None,
+                           rules=["mca_var"])
+    assert any(f["key"] == "mca_var:mod.py:mpi_base_ft_injcet"
+               for f in rep["findings"]), rep["findings"]
+
+
+# -- baseline round-trip ----------------------------------------------------
+def test_baseline_round_trip(tmp_path):
+    """Findings -> baseline file -> clean run; a key suppressing
+    nothing is reported stale and fails the run."""
+    raw = mpilint.run_lint(root=_FIXTURES, baseline=None, all_hot=True)
+    assert raw["findings"] and not raw["ok"]
+    base = tmp_path / "baseline.json"
+    base.write_text(json.dumps({"suppressions": [
+        {"key": f["key"], "why": "fixture: intentional"}
+        for f in raw["findings"]]}))
+    clean = mpilint.run_lint(root=_FIXTURES, baseline=str(base),
+                             all_hot=True)
+    assert clean["ok"], clean["findings"]
+    assert not clean["findings"]
+    assert len(clean["suppressed"]) == len(raw["findings"])
+    assert all(s["why"] == "fixture: intentional"
+               for s in clean["suppressed"])
+
+    # now poison the baseline with a key that matches nothing
+    data = json.loads(base.read_text())
+    data["suppressions"].append({"key": "mca_var:gone.py:nothing",
+                                 "why": "stale"})
+    base.write_text(json.dumps(data))
+    stale = mpilint.run_lint(root=_FIXTURES, baseline=str(base),
+                             all_hot=True)
+    assert not stale["ok"]
+    assert stale["stale_baseline"] == ["mca_var:gone.py:nothing"]
+
+
+# -- CLI --------------------------------------------------------------------
+def test_cli_exit_codes(tmp_path, capsys):
+    # dirty tree, no baseline -> 1
+    assert mpilint.main(["--root", _FIXTURES, "--baseline", "none"]) == 1
+    capsys.readouterr()
+    # clean tree -> 0
+    (tmp_path / "ok.py").write_text("x = 1\n")
+    assert mpilint.main(["--root", str(tmp_path)]) == 0
+    capsys.readouterr()
+    # --list-rules -> 0, one line per rule
+    assert mpilint.main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in mpilint.RULES:
+        assert rule in out
+
+
+def test_cli_json_format(capsys):
+    rc = mpilint.main(["--root", _FIXTURES, "--baseline", "none",
+                       "--format", "json"])
+    assert rc == 1
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["ok"] is False
+    assert "var_registry" not in rep           # slimmed for the CLI
+    assert any(f["rule"] == "mca_var" for f in rep["findings"])
+
+
+def test_cli_emit_mcavars(tmp_path, capsys):
+    out = tmp_path / "MCAVARS.md"
+    assert mpilint.main(["--emit-mcavars", str(out)]) == 0
+    text = out.read_text()
+    assert text.startswith("# MCA variables")
+    assert "`mpi_base_lockwitness`" in text
+    assert "`mpi_base_ft_inject_kill`" in text
+    # stdout emission path
+    assert mpilint.main(["--emit-mcavars", "-"]) == 0
+    assert capsys.readouterr().out == text

@@ -1,0 +1,174 @@
+"""lockwitness — the runtime lock-order witness: synthetic AB/BA
+inversion reported with both stacks, gate-off byte-identical
+(``threading.Lock`` untouched), RLock/Condition compatibility, and the
+``lockwitness_max_hold_us`` watermark pvar."""
+import threading
+import time
+
+import pytest
+
+from ompi_tpu.analyze import lockwitness as lw
+from ompi_tpu.mca import pvar
+
+
+@pytest.fixture
+def witness():
+    """Install the witness for one test; ALWAYS restore the
+    interpreter's factories afterwards."""
+    lw.register_params()
+    lw.reset()
+    lw.install()
+    try:
+        yield lw
+    finally:
+        lw.uninstall()
+        lw.reset()
+
+
+def test_lockwitness_gate_off_byte_identical():
+    """The gate contract: with mpi_base_lockwitness unset (default),
+    maybe_install_from_var touches NOTHING — threading.Lock/RLock are
+    the interpreter's own factories, not wrappers."""
+    assert not lw.installed
+    lw.maybe_install_from_var()
+    assert not lw.installed
+    assert threading.Lock is lw._ORIG_LOCK
+    assert threading.RLock is lw._ORIG_RLOCK
+
+
+def test_ab_ba_inversion_reported_with_both_stacks(witness):
+    """Two threads acquiring {A, B} in inverse orders never deadlock in
+    this run (they run sequentially) — but the witness must still call
+    the ORDER cycle out, with the first-observed acquisition stack of
+    each direction."""
+    lock_a = threading.Lock()
+    lock_b = threading.Lock()
+    assert isinstance(lock_a, lw.WitnessLock)
+
+    def ab():
+        with lock_a:
+            with lock_b:
+                pass
+
+    def ba():
+        with lock_b:
+            with lock_a:
+                pass
+
+    for fn in (ab, ba):
+        t = threading.Thread(target=fn)
+        t.start()
+        t.join(timeout=10)
+        assert not t.is_alive()
+
+    rep = lw.report()
+    cycles = [c for c in rep["cycles"]
+              if {lock_a._site, lock_b._site} == set(c["sites"])]
+    assert cycles, (rep["edges"], rep["cycles"])
+    cyc = cycles[0]
+    assert len(cyc["edges"]) == 2
+    for edge in cyc["edges"]:
+        # both directions carry the stack captured when the inversion
+        # was first observed — the report a human debugs from
+        assert edge["stack"], edge
+        assert any("test_analyze_lockwitness" in ln
+                   for ln in edge["stack"])
+    assert pvar.pvar_read("lockwitness_edges") >= 2
+
+
+def test_consistent_order_is_acyclic(witness):
+    """A -> B taken in one consistent order from two threads is NOT a
+    cycle (no false positive)."""
+    lock_a = threading.Lock()
+    lock_b = threading.Lock()
+
+    def ab():
+        with lock_a:
+            with lock_b:
+                pass
+
+    for _ in range(2):
+        t = threading.Thread(target=ab)
+        t.start()
+        t.join(timeout=10)
+    rep = lw.report()
+    involved = [c for c in rep["cycles"]
+                if lock_a._site in c["sites"] or lock_b._site
+                in c["sites"]]
+    assert involved == [], involved
+
+
+def test_rlock_reentrancy_records_no_self_edge(witness):
+    """A reentrant RLock acquire is accounting, not ordering — no
+    self-edge, and the Condition wait protocol round-trips."""
+    rl = threading.RLock()
+    assert isinstance(rl, lw.WitnessRLock)
+    with rl:
+        with rl:
+            pass
+    rep = lw.report()
+    assert not any(e["a"] == e["b"] == rl._site for e in rep["edges"])
+
+    cond = threading.Condition()           # wraps a witness RLock
+    assert isinstance(cond._lock, lw.WitnessRLock)
+    woke = []
+
+    def waiter():
+        with cond:
+            woke.append(cond.wait(timeout=10))
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    with cond:
+        cond.notify_all()
+    t.join(timeout=10)
+    assert not t.is_alive()
+    assert woke == [True]
+
+
+def test_hold_time_watermark_pvar(witness):
+    """A hold crossing mpi_base_lockwitness_hold_us (default 5000 us)
+    is recorded and surfaces as the lockwitness_max_hold_us pvar."""
+    lk = threading.Lock()
+    with lk:
+        time.sleep(0.02)                   # 20000 us >> 5000 us
+    rep = lw.report()
+    assert rep["max_hold_us"] >= 5000.0
+    assert any(h["site"] == lk._site and h["us"] >= 5000.0
+               for h in rep["long_holds"]), rep["long_holds"]
+    assert pvar.pvar_read("lockwitness_max_hold_us") \
+        >= rep["max_hold_us"]
+
+
+def test_dump_and_merge_round_trip(witness, tmp_path):
+    """dump() -> merge_reports() is what tracedump summary runs on the
+    drill's per-rank files: edge counts sum, cycle detection re-runs
+    on the union."""
+    lock_a = threading.Lock()
+    lock_b = threading.Lock()
+    with lock_a:
+        with lock_b:
+            pass
+    p0 = tmp_path / "lw_r0.json"
+    lw.dump(str(p0), rank=0)
+    import json
+    merged = lw.merge_reports([json.loads(p0.read_text())] * 2)
+    assert merged["ranks"] == 2
+    key = (lock_a._site, lock_b._site)
+    doubled = [e for e in merged["edges"]
+               if (e["a"], e["b"]) == key]
+    assert doubled and doubled[0]["count"] == 2 * [
+        e for e in lw.report()["edges"]
+        if (e["a"], e["b"]) == key][0]["count"]
+    assert merged["cycles"] == []
+
+
+def test_uninstall_restores_factories(witness):
+    lw.uninstall()
+    assert threading.Lock is lw._ORIG_LOCK
+    assert threading.RLock is lw._ORIG_RLOCK
+    # wrapped locks created while installed keep working afterwards
+    lk = lw.WitnessLock()
+    with lk:
+        assert lk.locked()
