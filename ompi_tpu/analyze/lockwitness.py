@@ -353,13 +353,24 @@ def report() -> Dict[str, Any]:
         sites = dict(_sites)
         long_holds = list(_long_holds)
         max_hold = _max_hold_us
+    cycles = find_cycles(edges)
+    if cycles:
+        # flight-recorder trigger: a lock-order cycle is incident
+        # evidence even before it wedges anything (no-op when the
+        # telemetry plane is off; rate-limited inside)
+        from ompi_tpu import telemetry as _telemetry
+        if _telemetry.active:
+            from ompi_tpu.telemetry import flightrec as _flightrec
+            _flightrec.record("lockwitness_cycle",
+                              {"cycles": len(cycles),
+                               "sites": cycles[0].get("sites")})
     return {
         "installed": installed,
         "sites": sites,
         "edges": [{"a": a, "b": b, "count": e["count"],
                    "stack": e.get("stack")}
                   for (a, b), e in sorted(edges.items())],
-        "cycles": find_cycles(edges),
+        "cycles": cycles,
         "max_hold_us": round(max_hold, 1),
         "long_holds": long_holds,
         "hold_threshold_us": _hold_threshold_us,

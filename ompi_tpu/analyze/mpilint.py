@@ -600,6 +600,88 @@ def rule_span_balance(mods: List[_Module],
 
 
 # --------------------------------------------------------------------------
+# rule: histogram_balance
+# --------------------------------------------------------------------------
+def _is_hist_call(node: ast.Call, method: str) -> bool:
+    """``<hist-ish>.start()`` / ``<hist-ish>.observe(...)`` — receiver
+    chain must contain a name mentioning "hist" so ``thread.start()``
+    and friends never match."""
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr == method:
+        recv = _receiver_names(node)
+        return any("hist" in r.lower() for r in recv)
+    return False
+
+
+def rule_histogram_balance(mods: List[_Module],
+                           ctx: Dict[str, Any]) -> List[Finding]:
+    """Every histogram timing token from ``hist.start()`` bound to a
+    local must reach ``observe(tok)`` inside a ``finally`` of the same
+    function — otherwise an exception between start and observe loses
+    the sample on exactly the exits (errors, timeouts) the latency
+    histogram most needs to count. The span_balance contract, applied
+    to the telemetry plane's timer API; the gated idiom
+    ``tok = hist.start() if active else None`` satisfies it because
+    ``observe(None)`` is a no-op."""
+    out: List[Finding] = []
+    for mod in mods:
+        funcs = [n for n in ast.walk(mod.tree)
+                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        for fn in funcs:
+            starts: Dict[str, int] = {}
+            discarded: List[int] = []
+            observed_in_finally: set = set()
+            nested = {sub for child in ast.walk(fn)
+                      if isinstance(child, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef))
+                      and child is not fn
+                      for sub in ast.walk(child)}
+            for node in ast.walk(fn):
+                if node in nested:
+                    continue
+                if isinstance(node, ast.Assign):
+                    has_start = any(
+                        isinstance(c, ast.Call)
+                        and _is_hist_call(c, "start")
+                        for c in ast.walk(node.value))
+                    if has_start:
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                starts.setdefault(t.id, node.lineno)
+                elif isinstance(node, ast.Expr) \
+                        and isinstance(node.value, ast.Call) \
+                        and _is_hist_call(node.value, "start"):
+                    discarded.append(node.lineno)
+                elif isinstance(node, ast.Try):
+                    for fin in node.finalbody:
+                        for c in ast.walk(fin):
+                            if isinstance(c, ast.Call) \
+                                    and isinstance(c.func, ast.Attribute) \
+                                    and c.func.attr == "observe" \
+                                    and c.args \
+                                    and isinstance(c.args[0], ast.Name):
+                                observed_in_finally.add(c.args[0].id)
+            for name, line in sorted(starts.items()):
+                if name not in observed_in_finally:
+                    out.append(Finding(
+                        "histogram_balance", mod.rel, line,
+                        f"histogram token '{name}' from hist.start() "
+                        "is not observed in a finally — an exception "
+                        "exit drops the sample the latency histogram "
+                        "most needs",
+                        f"histogram_balance:{mod.rel}:{fn.name}:"
+                        f"{name}"))
+            for line in discarded:
+                out.append(Finding(
+                    "histogram_balance", mod.rel, line,
+                    "hist.start() token discarded — the sample can "
+                    "never be observed",
+                    f"histogram_balance:{mod.rel}:{fn.name}:"
+                    "<discarded>"))
+    return out
+
+
+# --------------------------------------------------------------------------
 # registry / driver
 # --------------------------------------------------------------------------
 RULES: Dict[str, Callable[[List[_Module], Dict[str, Any]], List[Finding]]] \
@@ -609,6 +691,7 @@ RULES: Dict[str, Callable[[List[_Module], Dict[str, Any]], List[Finding]]] \
         "closure": rule_closure,
         "lock_blocking": rule_lock_blocking,
         "span_balance": rule_span_balance,
+        "histogram_balance": rule_histogram_balance,
     }
 
 

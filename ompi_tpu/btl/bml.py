@@ -33,6 +33,7 @@ from typing import Callable, Dict, Optional, Tuple
 
 from ompi_tpu.btl.sm import SmEndpoint
 from ompi_tpu.btl.tcp import TcpEndpoint
+from ompi_tpu import telemetry as _tele
 from ompi_tpu.ft import inject as _inject
 from ompi_tpu.mca import pvar as _pvar
 from ompi_tpu.mca import var
@@ -494,6 +495,11 @@ class BmlEndpoint:
             tok = (_trace.begin("btl.rail", rail=rail, peer=peer,
                                 bytes=len(payload))
                    if _trace.active else None)
+            if _tele.active:
+                # telemetry: payload bytes per rail frame — the stripe
+                # width the rendezvous scheduler actually produced
+                hist = _tele.RAIL
+                hist.record(len(payload))
             sent = False
             try:
                 if not ft.is_failed(peer):

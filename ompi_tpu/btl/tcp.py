@@ -30,6 +30,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 import numpy as np
 
+from ompi_tpu import telemetry as _tele
 from ompi_tpu.ft import inject as _inject
 from ompi_tpu.trace import core as _trace
 
@@ -522,6 +523,12 @@ class TcpEndpoint:
             tok = (_trace.begin("btl_ctl_flush", peer=peer,
                                 frames=len(batch), bytes=cost)
                    if _trace.active else None)
+            if _tele.active:
+                # telemetry: flush-window width — frames coalesced per
+                # sendall; a widening histogram means the ctl sender is
+                # falling behind its queue
+                hist = _tele.FLUSH
+                hist.record(len(batch))
             sent = False
             try:
                 for attempt in range(3):

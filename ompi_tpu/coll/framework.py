@@ -74,6 +74,12 @@ def comm_select_coll(comm) -> Dict[str, Any]:
     from ompi_tpu.coll import monitoring
     if vtable and monitoring.enabled():
         vtable = monitoring.wrap_vtable(comm, vtable)
+    # telemetry's latency histograms ride between monitoring and the
+    # tracer: they time the same app-visible call the spans do without
+    # paying the tracer's ring append; off by default
+    from ompi_tpu import telemetry
+    if vtable and telemetry.telemetry_enabled():
+        vtable = telemetry.wrap_coll_vtable(comm, vtable)
     # tracing wraps OUTERMOST (after monitoring): spans measure the
     # app-visible call, monitoring's counters ride inside them; off by
     # default, so the composed vtable is byte-identical when disabled

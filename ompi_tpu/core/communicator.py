@@ -1089,6 +1089,11 @@ class Communicator:
     def free(self) -> None:
         fire_delete_attrs(self)
         self._freed = True
+        # pvar session semantics: instruments owned by this cid
+        # (telemetry histograms, trace_skew_c<cid>) retire with it — a
+        # later pvar read must not report a freed comm's keys
+        from ompi_tpu import telemetry as _telemetry
+        _telemetry.retire_comm(self.cid)
 
     # -- process topologies (topo framework) ---------------------------
     def create_cart(self, dims: Sequence[int],
@@ -1473,8 +1478,14 @@ class Communicator:
                  if (agreed >> r) & 1 and r not in failed]
         g = Group([self.group.world_ranks[r] for r in alive])
         devs = [self.devices[r] for r in alive]
-        return self.__class__(g, devs, name=f"{self.name}.shrink",
-                              parent=self, errhandler=self.errhandler)
+        child = self.__class__(g, devs, name=f"{self.name}.shrink",
+                               parent=self, errhandler=self.errhandler)
+        # the parent keeps living (ULFM shrink does not free it), but
+        # its per-comm instruments describe the dead-rank era — retire
+        # them so reads after the shrink start from the survivor set
+        from ompi_tpu import telemetry as _telemetry
+        _telemetry.retire_comm(self.cid)
+        return child
 
     def ishrink(self):
         from ompi_tpu.core.request import Request

@@ -1614,11 +1614,18 @@ class RankCommunicator:
         _value, final = ftagree.perrank_agree(self, 1, timeout=timeout)
         survivors = [r for r in range(self.size) if r not in final]
         g = Group([self.group.world_ranks[r] for r in survivors])
-        return RankCommunicator(
+        child = RankCommunicator(
             g, self._my_world, self.router,
             cid=("shrink", self.cid, tuple(final)),
             name=f"{self.name}.shrink", parent=self,
             errhandler=self.errhandler)
+        # parent stays alive after a shrink, but its per-comm
+        # instruments describe the dead-rank era — retire them so later
+        # reads (trace_skew_c<cid>, tele_coll_*) can't report keys from
+        # before the failure epoch
+        from ompi_tpu import telemetry as _telemetry
+        _telemetry.retire_comm(self.cid)
+        return child
 
     def free(self) -> None:
         # delete callbacks fire FIRST (attribute.c free path): a
@@ -1638,6 +1645,10 @@ class RankCommunicator:
             eng.close()                       # a leaked registration
         self._aux_pmls.clear()                # would outlive the comm
         self._freed = True
+        # pvar session semantics: per-comm instruments (telemetry
+        # histograms, trace_skew_c<cid>) retire with the comm
+        from ompi_tpu import telemetry as _telemetry
+        _telemetry.retire_comm(self.cid)
 
     # -- attributes / naming -------------------------------------------
     def set_attr(self, keyval: int, value: Any) -> None:

@@ -124,6 +124,7 @@ class Detector:
         tok = None
         with self._lock:
             self.stats["heartbeats"] += 1
+            prev = self._last_seen.get(src)
             self._last_seen[src] = now
             if src == self._watched and self._misses:
                 # the suspect came back: hysteresis did its job
@@ -132,6 +133,17 @@ class Detector:
                 tok, self._suspect_tok = self._suspect_tok, None
         if tok is not None:
             _trace.end(tok, declared=False)
+        from ompi_tpu import telemetry as _tele
+        if _tele.active and prev is not None:
+            # telemetry ingress: the inter-arrival gap feeds both the
+            # gap histogram and the health monitor's excess scoring
+            # (beyond 1.5 periods) — the no-data-plane straggler signal
+            gap = now - prev
+            hist = _tele.HB_GAP
+            if hist is not None:
+                hist.record(gap * 1e6)
+            from ompi_tpu.telemetry import health as _health
+            _health.note_heartbeat_gap(src, gap, self.period)
 
     def record_latency(self, rank: int, _reason: str) -> None:
         """Registry listener: whatever ingress reported the death

@@ -15,6 +15,15 @@ from typing import Any, Callable, Dict, List
 _lock = threading.Lock()
 _pvars: Dict[str, Dict[str, Any]] = {}
 
+# MPI_T pvar classes (mca_base_pvar.h's MCA_BASE_PVAR_CLASS_* set, plus
+# the telemetry plane's histogram class — a pvar whose read returns the
+# merged {count, sum, max, p50, p90, p99, buckets} snapshot of an
+# HDR-style log2-bucket histogram, ompi_tpu/telemetry/hist.py)
+CLASS_COUNTER = "counter"
+CLASS_LEVEL = "level"
+CLASS_HIGHWATERMARK = "highwatermark"
+CLASS_HISTOGRAM = "histogram"
+
 
 def _caller_site() -> str:
     """``file.py:line`` of the nearest frame outside this module — the
@@ -32,14 +41,21 @@ def _caller_site() -> str:
 
 def pvar_register(name: str, read_fn: Callable[[], Any], *,
                   unit: str = "count", help: str = "",
-                  var_class: str = "counter") -> None:
+                  var_class: str = "counter",
+                  comm: Any = None) -> None:
     """Register (or same-site rebind) one pvar.
 
     Double-register policy, mirroring ``var.var_register``: the SAME
     call site rebinding a name is the supported new-endpoint idiom
     (reads must follow the newest live counter dict); a DIFFERENT site
     claiming an existing name raises — two owners silently shadowing
-    each other's counters is the bug class."""
+    each other's counters is the bug class.
+
+    ``comm`` tags a per-communicator pvar with its owner's cid (as a
+    string) so ``pvar_retire_comm`` can drop the whole session when
+    that communicator is freed or replaced by a shrink — MPI_T pvar
+    *session* semantics: handles bound to a dead comm stop existing,
+    they don't keep reporting dead-rank-era values."""
     site = _caller_site()
     with _lock:
         v = _pvars.get(name)
@@ -48,7 +64,8 @@ def pvar_register(name: str, read_fn: Callable[[], Any], *,
                 f"pvar '{name}' re-registered at {site} — owner is "
                 f"{v['site']}")
         _pvars[name] = {"read": read_fn, "unit": unit, "help": help,
-                        "class": var_class, "site": site}
+                        "class": var_class, "site": site,
+                        "comm": None if comm is None else str(comm)}
 
 
 def pvar_read(name: str) -> Any:
@@ -86,6 +103,27 @@ def pvar_register_dict(prefix: str, stats: Dict[str, Any], *,
         pvar_register(f"{prefix}_{key}", make_reader(stats, key),
                       help=(f"{help_prefix}{key}" if help_prefix
                             else f"{prefix} counter {key}"))
+
+
+def pvar_unregister(name: str) -> bool:
+    """Drop one pvar (comm teardown / subsystem reset). Returns
+    whether it existed; never raises on a missing name — retirement
+    races comm-free paths by design."""
+    with _lock:
+        return _pvars.pop(name, None) is not None
+
+
+def pvar_retire_comm(cid: Any) -> List[str]:
+    """Retire every pvar tagged ``comm=cid`` (string-compared): the
+    per-comm pvar-session teardown called from Communicator free/shrink
+    so reads after a shrink can't report dead-rank-era keys. Returns
+    the retired names (tests; the callers ignore it)."""
+    scid = str(cid)
+    with _lock:
+        names = [n for n, v in _pvars.items() if v.get("comm") == scid]
+        for n in names:
+            del _pvars[n]
+    return sorted(names)
 
 
 def pvar_list() -> List[Dict[str, Any]]:

@@ -26,11 +26,13 @@ from __future__ import annotations
 
 import itertools
 import threading
+import time as _time
 from collections import deque
 from typing import Any, Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ompi_tpu import telemetry as _tele
 from ompi_tpu.btl.tcp import PeerDownError, decode_payload, encode_payload
 from ompi_tpu.core.errhandler import ERR_PENDING, ERR_RANK, ERR_TAG, MPIError
 from ompi_tpu.core.request import Request, Status
@@ -213,6 +215,12 @@ class Router:
                 return                   # flood termination
             self._revoked.add(rcid)
             cbs = list(self._revoke_cbs.get(rcid, []))
+        if _tele.active:
+            # flight-recorder trigger: first receipt of a revocation is
+            # incident evidence worth freezing (rate-limited inside)
+            from ompi_tpu.telemetry import flightrec as _flightrec
+            _flightrec.record("revoke", {"rcid": str(rcid),
+                                         "rank": self.rank})
         self._broadcast_ctl({"ctl": "revoke", "rcid": rcid,
                              "peer": self.rank})
         for cb in cbs:
@@ -263,6 +271,24 @@ class Router:
             d = self.detector
             if d is not None:
                 d.on_heartbeat(header["peer"])
+            # telemetry RTT echo: the sender stamped "ht" only while
+            # its telemetry was on; reply in kind only while OURS is on
+            # too — with the plane off neither side's frames change
+            if _tele.active and "ht" in header:
+                try:
+                    self.endpoint.tcp.send_frame(
+                        header["peer"],
+                        {"ctl": "hbr", "peer": self.rank,
+                         "ht": header["ht"]})
+                except Exception:        # noqa: BLE001 — best-effort
+                    pass
+            return
+        if ctl == "hbr":
+            if _tele.active:
+                hist = _tele.HB_RTT
+                if hist is not None:
+                    rtt = _time.perf_counter() - float(header["ht"])
+                    hist.record(max(rtt, 0.0) * 1e6)
             return
         if ctl == "ftdead":
             # remote obituary: feed the registry (dedups); our own
@@ -626,6 +652,20 @@ class PerRankEngine:
     # -- send side -----------------------------------------------------
     def send(self, data: Any, dest: int, tag: int = 0,
              synchronous: bool = False) -> Request:
+        # telemetry gate: one attribute read when off; the histogram
+        # times the full post-to-wire-handoff service (the degraded
+        # self-health signal reads its p99)
+        if _tele.active:
+            hist = _tele.PML_SEND
+            tok = hist.start()
+            try:
+                return self._send_traced(data, dest, tag, synchronous)
+            finally:
+                hist.observe(tok)
+        return self._send_traced(data, dest, tag, synchronous)
+
+    def _send_traced(self, data: Any, dest: int, tag: int = 0,
+                     synchronous: bool = False) -> Request:
         # tracing gate: one attribute read when off (hooks event name
         # "pml_send" — the PERUSE/MPI_T stream and the trace agree);
         # cid rides in args so pt2pt spans stay out of the collective
@@ -705,6 +745,16 @@ class PerRankEngine:
         ranks, validated by the collective's own construction; the
         caller's rank must not appear in ``dests`` (self-contributions
         go through ``CombineSlot.put_own``)."""
+        if _tele.active:
+            hist = _tele.PML_SEND
+            tok = hist.start()
+            try:
+                return self._send_small_traced(data, dests, tag)
+            finally:
+                hist.observe(tok)
+        return self._send_small_traced(data, dests, tag)
+
+    def _send_small_traced(self, data: Any, dests, tag: int) -> None:
         if _trace.active:
             tok = _trace.begin("pml_send", cid=None,
                                cc=str(self.comm.cid), tag=tag,
@@ -896,6 +946,26 @@ class PerRankEngine:
 
     def recv(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
              timeout: Optional[float] = None) -> Tuple[Any, Status]:
+        # telemetry: the recv histogram's duration IS blocked-waiting;
+        # it doubles as the health monitor's per-peer wait ingress (the
+        # matched source is only known at completion, so attribution
+        # happens after the observe)
+        if _tele.active:
+            hist = _tele.PML_RECV
+            tok = hist.start()
+            try:
+                data, st = self._recv_traced(source, tag, timeout)
+            finally:
+                hist.observe(tok)
+            from ompi_tpu.telemetry import health as _health
+            _health.note_wait(self.comm.world_rank_of(st.source),
+                              _time.perf_counter() - tok)
+            return data, st
+        return self._recv_traced(source, tag, timeout)
+
+    def _recv_traced(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
+                     timeout: Optional[float] = None
+                     ) -> Tuple[Any, Status]:
         # the span covers post-to-completion: its duration IS the
         # blocked-waiting time a late sender costs this rank
         if _trace.active:

@@ -41,6 +41,7 @@ from ompi_tpu.core.errhandler import ERR_PENDING, ERR_PROC_FAILED, MPIError
 from ompi_tpu.mca import pvar as _pvar
 from ompi_tpu.mca import var as _var
 from ompi_tpu.runtime import progress as _progress
+from ompi_tpu import telemetry as _tele
 from ompi_tpu.trace import core as _trace
 
 # single source of truth for the tuning defaults (the bml convention)
@@ -440,6 +441,11 @@ def maybe_send_pipelined(engine, data: Any, dest: int, tag: int,
                 _trace.end(tok, bytes=nraw)
         dt = time.perf_counter() - t0
         prep_s += dt
+        if _tele.active:
+            # telemetry: per-segment stage+encode service time — the
+            # same interval the pml.segment span covers
+            hist = _tele.SEGMENT
+            hist.record(dt * 1e6)
         send_segment(wdest, seg_header, raw, on_done)
     if not done_evt.wait(600):
         raise MPIError(ERR_PENDING,

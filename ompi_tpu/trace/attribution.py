@@ -53,7 +53,7 @@ def _note_skew(cid: str, skew_s: float) -> None:
         _pvar.pvar_register(
             f"trace_skew_c{cid}",
             lambda c=cid: _watermarks.get(c, 0.0),
-            unit="seconds", var_class="highwatermark",
+            unit="seconds", var_class="highwatermark", comm=cid,
             help=f"Max collective arrival skew attributed on comm "
                  f"{cid} (docs/OBSERVABILITY.md)")
 
@@ -61,6 +61,22 @@ def _note_skew(cid: str, skew_s: float) -> None:
 def skew_watermarks() -> Dict[str, float]:
     with _lock:
         return dict(_watermarks)
+
+
+def retire_comm(cid: Any) -> List[str]:
+    """Drop comm ``cid``'s skew watermark and its per-comm pvar —
+    called (via telemetry.retire_comm) when the communicator is freed
+    or shrunk away, so a later read can't report dead-rank-era skew
+    under a recycled cid."""
+    scid = str(cid)
+    with _lock:
+        _watermarks.pop(scid, None)
+        registered = scid in _registered_cids
+        _registered_cids.discard(scid)
+    name = f"trace_skew_c{scid}"
+    if registered and _pvar.pvar_unregister(name):
+        return [name]
+    return []
 
 
 def reset_watermarks() -> None:

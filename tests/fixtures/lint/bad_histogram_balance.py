@@ -1,0 +1,21 @@
+"""Fixture: MUST fire the ``histogram_balance`` rule (and only it).
+
+A hist.start() token observed outside any ``finally`` (an exception
+between start and observe drops the sample on exactly the error exits
+the latency histogram needs) and a start whose token is discarded.
+Never imported — parsed only.
+"""
+from ompi_tpu import telemetry as _tele
+
+hist = _tele.get_hist("fixture_hist")
+
+
+def leaky(work):
+    tok = hist.start()
+    work()                           # a raise here drops the sample
+    hist.observe(tok)
+
+
+def discarded(work):
+    hist.start()
+    work()

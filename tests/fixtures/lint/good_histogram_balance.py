@@ -1,0 +1,27 @@
+"""Fixture: must NOT fire the ``histogram_balance`` rule.
+
+The gated start/observe idiom the telemetry plane uses: token bound
+under the ``_tele.active`` gate, observed on ALL exits through a
+finally (``observe(None)`` is a no-op, so the disabled branch
+composes). A ``thread.start()`` must not match — the receiver chain
+carries no "hist". Never imported — parsed only.
+"""
+import threading
+
+from ompi_tpu import telemetry as _tele
+
+hist = _tele.get_hist("fixture_hist")
+
+
+def balanced(work):
+    tok = hist.start() if _tele.active else None
+    try:
+        return work()
+    finally:
+        hist.observe(tok)
+
+
+def not_a_histogram(work):
+    thread = threading.Thread(target=work)
+    thread.start()                   # receiver is not hist-ish: ignored
+    thread.join()

@@ -75,8 +75,16 @@ def test_lint_span_balance_fixture_pair():
     assert "discarded" in msgs
 
 
+def test_lint_histogram_balance_fixture_pair():
+    bad, good = _pair("histogram_balance")
+    assert not good, good
+    msgs = "\n".join(f["message"] for f in bad)
+    assert "not observed in a finally" in msgs
+    assert "discarded" in msgs
+
+
 def test_rule_catalog_shape():
-    assert len(mpilint.RULES) >= 5
+    assert len(mpilint.RULES) >= 6
     for fn in mpilint.RULES.values():
         assert (fn.__doc__ or "").strip()
 

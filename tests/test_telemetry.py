@@ -1,0 +1,572 @@
+"""Telemetry plane unit tier (docs/OBSERVABILITY.md): the off =
+byte-identical gate, lock-free histogram shards under concurrent
+writers, percentile/merge math, the straggler hysteresis with a
+synthetic clock, per-comm pvar retirement, flight-recorder record /
+rate-limit / merge semantics, tracedump's skip + ``--strict``
+contract, mpitop's merged summary, and the Prometheus exporter."""
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from ompi_tpu import telemetry
+from ompi_tpu.mca import pvar, var
+from ompi_tpu.telemetry import flightrec, health, prom
+from ompi_tpu.telemetry import hist as hist_mod
+from ompi_tpu.telemetry.hist import (Histogram, bucket_bounds,
+                                     merge_snapshots,
+                                     percentile_from_buckets)
+
+
+@pytest.fixture()
+def tele():
+    """The plane armed for one test, fully torn down after — the
+    session default stays OFF (other tests assert byte-identity)."""
+    telemetry._reset_for_tests()
+    flightrec._reset_for_tests()
+    telemetry.enable()
+    yield telemetry
+    for h in telemetry.histograms():
+        if h.registered:
+            pvar.pvar_unregister(h.name)
+    telemetry.disable()
+    telemetry._reset_for_tests()
+    flightrec._reset_for_tests()
+
+
+def _standalone(name, values=(), labels=None):
+    """A histogram outside the registry: ``registered`` pre-set so
+    recording never touches the pvar surface."""
+    h = Histogram(name, labels=labels)
+    h.registered = True
+    for v in values:
+        h.record(v)
+    return h
+
+
+def _hist_row(name, values, labels=None):
+    h = _standalone(name, values, labels)
+    return {"name": name, "unit": "us", "comm": None,
+            "labels": dict(labels or {}), "snap": h.snapshot()}
+
+
+# -- the off gate: byte-identical, zero-touch --------------------------------
+def test_telemetry_off_hot_paths_untouched(monkeypatch, world):
+    """Telemetry off (the default): every hot-path gate is ONE
+    attribute read — no histogram may be started or recorded by the
+    stacked collectives or the per-rank pml."""
+    def boom(*a, **kw):
+        raise AssertionError("histogram touched while disabled")
+    monkeypatch.setattr(Histogram, "record", boom)
+    monkeypatch.setattr(Histogram, "start", boom)
+    assert telemetry.active is False
+    assert telemetry.telemetry_enabled() is False
+
+    # stacked collective entry (the composer never wrapped the vtable)
+    from ompi_tpu.telemetry import _HistSlot
+    for func, mod in world.c_coll.items():
+        assert not isinstance(mod, _HistSlot), func
+    x = world.alloc((2,), np.float32, fill=1.0)
+    world.allreduce(x)
+
+    # per-rank pml entry (loopback engine): send/recv/send_small
+    from ompi_tpu.pml.perrank import PerRankEngine, Router
+    kv = {}
+    router = Router(0, 1, kv.__setitem__, kv.__getitem__)
+
+    class _C:
+        cid = "tele-off"
+        size = 2
+
+        def rank(self):
+            return 0
+
+        def world_rank_of(self, r):
+            return 0
+    eng = PerRankEngine(_C(), router)
+    try:
+        eng.send(np.float32(1.0), dest=1, tag=5)
+        eng.recv(source=0, tag=5, timeout=10)
+        eng.send_small(np.float32(2.0), [1], tag=6)
+        eng.recv(source=0, tag=6, timeout=10)
+    finally:
+        router.close()
+
+
+def test_enable_arms_core_hists_and_disable_keeps_them_readable():
+    telemetry._reset_for_tests()
+    assert telemetry.PML_SEND is None
+    try:
+        telemetry.enable()
+        assert telemetry.active
+        for h in (telemetry.PML_SEND, telemetry.PML_RECV,
+                  telemetry.SEGMENT, telemetry.FLUSH, telemetry.RAIL,
+                  telemetry.HB_GAP, telemetry.HB_RTT):
+            assert isinstance(h, Histogram)
+        telemetry.PML_SEND.record(123.0)
+        telemetry.disable()
+        assert telemetry.active is False
+        # readable post-mortem, like the trace ring
+        assert telemetry.PML_SEND.snapshot()["count"] == 1
+    finally:
+        pvar.pvar_unregister("tele_pml_send_us")
+        telemetry._reset_for_tests()
+
+
+# -- histogram math ----------------------------------------------------------
+def test_histogram_buckets_percentiles_and_bounds():
+    h = _standalone("t", [0, 1, 10, 100, 1000, -5])  # -5 clamps to 0
+    m = h.merged()
+    assert m["count"] == 6
+    assert m["buckets"][0] == 2          # 0 and clamp(-5)
+    assert m["buckets"][1] == 1          # 1 -> [1, 2)
+    snap = h.snapshot()
+    assert snap["count"] == 6
+    assert snap["p50"] <= snap["p90"] <= snap["p99"] <= \
+        bucket_bounds(hist_mod.NBUCKETS - 1)[1]
+    assert snap["max"] == 1000.0
+    lo, hi = bucket_bounds(0)
+    assert (lo, hi) == (0.0, 1.0)
+    for i in range(1, 12):
+        lo, hi = bucket_bounds(i)
+        assert hi == 2 * lo
+
+    # sparse and dense derivations agree
+    dense = m["buckets"]
+    sparse = {str(i): n for i, n in enumerate(dense) if n}
+    for p in (50, 90, 99):
+        assert percentile_from_buckets(dense, m["count"], p) == \
+            percentile_from_buckets(sparse, m["count"], p)
+    assert percentile_from_buckets([], 0, 99) == 0.0
+
+
+def test_histogram_observe_token_and_none_noop():
+    h = _standalone("t2")
+    h.observe(None)                      # the gated idiom's off branch
+    assert h.merged()["count"] == 0
+    tok = h.start()
+    h.observe(tok)
+    m = h.merged()
+    assert m["count"] == 1 and m["sum"] >= 0.0
+
+
+def test_histogram_concurrent_writers_merge():
+    """The shard contract: 4 writer threads, no lock on the record
+    path, and the merged read sees every sample exactly once."""
+    h = _standalone("conc")
+    PER = 1000
+
+    def w(k):
+        for i in range(PER):
+            h.record(k * 1000 + i)
+
+    ts = [threading.Thread(target=w, args=(k,)) for k in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    m = h.merged()
+    assert m["count"] == 4 * PER
+    assert sum(m["buckets"]) == 4 * PER
+    assert m["max"] == 3999.0
+    assert len(h._shards) == 4           # one shard per writer thread
+    h.reset()
+    assert h.merged()["count"] == 0
+    assert len(h._shards) == 4           # shards survive the window
+
+
+def test_merge_snapshots_cross_rank():
+    a = _standalone("a", [10] * 99 + [5000])
+    b = _standalone("b", [10] * 100)
+    m = merge_snapshots([a.snapshot(), b.snapshot(), {}])
+    assert m["count"] == 200
+    assert m["max"] == 5000.0
+    assert m["p50"] <= 16.0              # bucket of 10 tops out at 16
+    assert m["p99"] >= m["p50"]
+    assert sum(int(n) for n in m["buckets"].values()) == 200
+
+
+def test_size_class_and_cid_token():
+    assert [telemetry.size_class(n) for n in
+            (0, 1024, 1025, 65536, 65537, 1 << 20, (1 << 20) + 1)] == \
+        [0, 0, 1, 1, 2, 2, 3]
+    assert telemetry._cid_token("world") == "world"
+    assert telemetry._cid_token(("split", 3)) != ""
+    assert telemetry._cid_token("") == "none"
+
+
+# -- straggler hysteresis ----------------------------------------------------
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_straggler_hysteresis_declare_and_recover():
+    """Score over threshold must persist ``miss`` consecutive samples
+    before telemetry.straggler fires; a recovered peer (score under
+    half the threshold) is cleared and may be re-declared."""
+    from ompi_tpu.utils import hooks
+    events = []
+    handle = hooks.register_profiler(
+        lambda ev, comm, info: events.append((ev, info["rank"]))
+        if ev.startswith("telemetry.") else None)
+    clock = _Clock()
+    mon = health.HealthMonitor(0, 4, sample_s=1e9, window_s=10.0,
+                               threshold=0.05, miss=3, clock=clock)
+    try:
+        clock.t = 1.0
+        for peer in (2, 3):              # the cross-peer median floor
+            mon.note_wait(peer, 0.001)
+        mon.note_wait(1, 0.8)            # 0.8s outlier -> score ~0.08
+
+        clock.t = 1.1
+        assert mon.sample()[1] >= 0.05
+        assert mon.declared() == []      # miss 1 of 3
+        clock.t = 1.2
+        mon.sample()
+        assert mon.declared() == []      # miss 2 of 3
+        clock.t = 1.3
+        mon.sample()
+        assert mon.declared() == [1]     # declared on the 3rd
+        assert mon.stats["stragglers"] == 1
+        assert ("telemetry.straggler", 1) in events
+
+        clock.t = 1.4                    # still over: no re-fire
+        mon.sample()
+        assert mon.stats["stragglers"] == 1
+
+        clock.t = 20.0                   # window empties -> score 0
+        scores = mon.sample()
+        assert scores[1] == 0.0
+        assert mon.declared() == []
+        assert mon.stats["recovered"] == 1
+        assert ("telemetry.recovered", 1) in events
+
+        # re-declaration after recovery is allowed
+        for peer in (2, 3):
+            mon.note_wait(peer, 0.001)
+        mon.note_wait(1, 0.9)
+        for i in range(3):
+            clock.t = 20.1 + i * 0.1
+            mon.sample()
+        assert mon.declared() == [1]
+        assert mon.stats["stragglers"] == 2
+    finally:
+        hooks.unregister_profiler(handle)
+
+
+def test_straggler_needs_two_peers_for_median():
+    """One noisy peer alone scores raw waits (median 0 needs >= 2
+    peers) — but a uniformly slow phase with every peer equally slow
+    scores nobody above the self-cancelling median."""
+    clock = _Clock()
+    mon = health.HealthMonitor(0, 4, sample_s=1e9, window_s=10.0,
+                               threshold=0.05, miss=1, clock=clock)
+    clock.t = 1.0
+    for peer in (1, 2, 3):
+        mon.note_wait(peer, 0.7)         # everyone equally slow
+    clock.t = 1.1
+    scores = mon.sample()
+    # median 0.7 cancels: nobody is an outlier among peers
+    assert all(s < 0.05 for s in scores.values()), scores
+    assert mon.declared() == []
+
+
+def test_degraded_episode_latches(tele):
+    var.var_set("mpi_base_telemetry_degraded_ms", 1.0)
+    try:
+        mon = health.HealthMonitor(0, 2, sample_s=1e9, window_s=10.0,
+                                   threshold=0.05, miss=3,
+                                   clock=_Clock())
+        tele.PML_SEND.record(50_000.0)   # own send p99 = 50 ms >> 1 ms
+        mon.sample(1.0)
+        assert mon.stats["degraded"] == 1
+        mon.sample(1.1)                  # episode latch: no re-count
+        assert mon.stats["degraded"] == 1
+        tele.PML_SEND.reset()            # p99 back under the limit
+        mon.sample(1.2)
+        tele.PML_SEND.record(50_000.0)   # a NEW episode counts again
+        mon.sample(1.3)
+        assert mon.stats["degraded"] == 2
+    finally:
+        var.var_set("mpi_base_telemetry_degraded_ms", 0.0)
+
+
+# -- per-comm retirement -----------------------------------------------------
+def test_retire_comm_drops_hists_and_pvars(tele):
+    hists = telemetry.coll_hists("c77", "allreduce")
+    assert len(hists) == len(telemetry.SIZE_CLASS_NAMES)
+    for h in hists:
+        h.record(10.0)                   # first record registers pvars
+    names = {h.name for h in hists}
+    assert names <= set(pvar.pvar_names())
+    keep = telemetry.get_hist("tele_unrelated_us")
+    keep.record(1.0)
+
+    retired = telemetry.retire_comm("c77")
+    assert names <= set(retired)
+    assert not (names & set(pvar.pvar_names()))
+    live = {h.name for h in telemetry.histograms()}
+    assert not (names & live)
+    assert "tele_unrelated_us" in live   # other comms untouched
+    # idempotent: a second retirement finds nothing
+    assert not (names & set(telemetry.retire_comm("c77")))
+
+
+def test_retire_comm_drops_trace_skew_pvar():
+    from ompi_tpu.trace import attribution
+    attribution._note_skew("88", 0.25)
+    assert "trace_skew_c88" in pvar.pvar_names()
+    assert "88" in attribution.skew_watermarks()
+    retired = telemetry.retire_comm("88")
+    assert "trace_skew_c88" in retired
+    assert "trace_skew_c88" not in pvar.pvar_names()
+    assert "88" not in attribution.skew_watermarks()
+
+
+# -- flight recorder ---------------------------------------------------------
+def test_flightrec_inactive_refuses():
+    flightrec._reset_for_tests()
+    assert telemetry.active is False
+    assert flightrec.record("straggler", {"rank": 1}) is None
+
+
+def test_flightrec_record_rate_limit_and_siblings(tele, tmp_path):
+    var.var_set("mpi_base_telemetry_flightrec_dir", str(tmp_path))
+    try:
+        flightrec.arm(7)
+        p1 = flightrec.record("straggler", {"rank": 3})
+        assert p1 is not None
+        assert os.path.basename(p1) == "flightrec_7.json"
+        d = json.loads(open(p1).read())
+        assert d["flightrec"] == 1 and d["rank"] == 7
+        assert d["trigger"] == "straggler"
+        assert d["detail"] == {"rank": 3}
+        for key in ("spans", "pvars", "ft_events", "health",
+                    "wall_time"):
+            assert key in d, key
+        # rate limit: the same (trigger, subject) never fires twice
+        assert flightrec.record("straggler", {"rank": 3}) is None
+        # a different subject writes a suffixed SIBLING — the first
+        # snapshot (and its accusation) must survive
+        p2 = flightrec.record("revoke", {"rank": 7})
+        assert p2 is not None and p2 != p1
+        assert os.path.exists(p1) and os.path.exists(p2)
+        assert not [f for f in os.listdir(tmp_path)
+                    if ".tmp." in f]     # atomic: no torn leftovers
+    finally:
+        var.var_set("mpi_base_telemetry_flightrec_dir", "")
+
+
+def test_flightrec_merge_elects_critical_and_absent():
+    pays = [
+        {"flightrec": 1, "rank": 0, "trigger": "proc_failed",
+         "detail": {"rank": 2}, "wall_time": 2.0,
+         "spans": [{"rank": 2, "name": "coll_allreduce"}],
+         "health": {}},
+        {"flightrec": 1, "rank": 1, "trigger": "proc_failed",
+         "detail": {"rank": 2}, "wall_time": 1.0, "spans": [],
+         "health": {}},
+        {"flightrec": 1, "rank": 0, "trigger": "revoke",
+         "detail": {"rank": 0}, "wall_time": 3.0},
+    ]
+    rep = flightrec.merge(pays)
+    assert rep["incident"] == 1
+    assert rep["critical_rank"] == 2
+    assert rep.get("critical_absent") is True     # rank 2 never wrote
+    assert rep["accusations"] == {"2": 2}         # revoke doesn't accuse
+    times = [t["wall_time"] for t in rep["triggers"]]
+    assert times == sorted(times)
+    # the accusers' spans FOR the critical rank are the evidence
+    assert rep["critical_spans"] == [{"rank": 2,
+                                      "name": "coll_allreduce"}]
+
+
+def test_flightrec_merge_fallback_worst_p99():
+    pays = [
+        {"flightrec": 1, "rank": 0, "trigger": "revoke", "detail": {},
+         "pvars": {"tele_pml_send_us": {"p99": 10.0, "count": 5}},
+         "spans": [], "health": {}},
+        {"flightrec": 1, "rank": 1, "trigger": "revoke", "detail": {},
+         "pvars": {"tele_pml_send_us": {"p99": 9000.0, "count": 5}},
+         "spans": [{"rank": 1, "name": "pml_send"}], "health": {}},
+    ]
+    rep = flightrec.merge(pays)
+    assert rep["accusations"] == {}
+    assert rep["critical_rank"] == 1              # worst own p99
+    assert rep["critical_spans"] == [{"rank": 1, "name": "pml_send"}]
+    assert "critical_absent" not in rep
+
+
+# -- tracedump: skip + --strict ----------------------------------------------
+def test_tracedump_skips_truncated_and_strict(tmp_path, capsys):
+    from ompi_tpu.tools import tracedump
+    good = tmp_path / "trace_0.json"
+    good.write_text(json.dumps({"rank": 0, "offset_s": 0.0,
+                                "spans": []}))
+    bad = tmp_path / "trace_1.json"
+    bad.write_text('{"rank": 1, "spans": [')     # truncated mid-write
+    out = tmp_path / "sum.json"
+
+    rc = tracedump.main(["--format", "summary", "-o", str(out),
+                         str(good), str(bad)])
+    assert rc == 0                       # skip, don't die
+    err = capsys.readouterr().err
+    assert "skipped" in err and "trace_1.json" in err
+    rep = json.loads(out.read_text())
+    assert rep["skipped"] == 1
+    assert rep["skipped_files"][0]["file"] == str(bad)
+
+    # --strict turns any skip into a nonzero exit for CI
+    rc = tracedump.main(["--format", "summary", "-o", str(out),
+                         "--strict", str(good), str(bad)])
+    assert rc == 1
+    capsys.readouterr()
+    rc = tracedump.main(["--format", "summary", "-o", str(out),
+                         "--strict", str(good)])
+    assert rc == 0                       # all-readable strict run
+
+
+def test_tracedump_flightrec_format(tmp_path):
+    from ompi_tpu.tools import tracedump
+    for rank in (0, 1):
+        (tmp_path / f"flightrec_{rank}.json").write_text(json.dumps(
+            {"flightrec": 1, "rank": rank, "trigger": "proc_failed",
+             "detail": {"rank": 3}, "wall_time": float(rank),
+             "spans": [], "health": {}}))
+    out = tmp_path / "incident.json"
+    rc = tracedump.main(["--format", "flightrec", "-o", str(out),
+                         str(tmp_path / "flightrec_0.json"),
+                         str(tmp_path / "flightrec_1.json")])
+    assert rc == 0
+    rep = json.loads(out.read_text())
+    assert rep["incident"] == 1
+    assert rep["critical_rank"] == 3
+    assert rep["accusations"] == {"3": 2}
+
+
+# -- mpitop ------------------------------------------------------------------
+def _dump(rank, hists, health_snap=None, t=100.0):
+    return {"telemetry": 1, "rank": rank, "time": t, "hists": hists,
+            "health": health_snap or {}}
+
+
+def test_mpitop_summarize_elects_declared_straggler():
+    from ompi_tpu.tools import mpitop
+    coll_labels = {"comm": "w", "func": "allreduce", "sclass": "small"}
+    snaps = [
+        _dump(0, [_hist_row("tele_coll_allreduce_cw_small", [100] * 10,
+                            coll_labels),
+                  _hist_row("tele_pml_send_us", [50] * 10)],
+              {"scores": {"1": 0.3}, "declared": [1]}),
+        _dump(1, [_hist_row("tele_coll_allreduce_cw_small",
+                            [200_000] * 10, coll_labels),
+                  _hist_row("tele_pml_send_us", [200_000] * 10)]),
+    ]
+    s = mpitop.summarize(snaps)
+    assert s["mpitop"] == 1
+    assert s["slow_rank"] == 1
+    assert s["declared"] == {"1": 1}
+    assert s["accusations"]["1"] == 0.3
+    rows = {r["rank"]: r for r in s["rows"]}
+    assert rows[0]["coll_ops"] == 10
+    assert rows[1]["send_p99_us"] >= 131072     # bucket of 200k
+    assert rows[1]["straggler_score"] == 0.3
+    assert rows[1]["declared_by"] == 1
+
+    table = mpitop.render_table(s)
+    assert "STRAGGLER(x1)" in table
+    assert "SLOW" in table
+    assert table.splitlines()[-1] == "slow_rank: 1"
+
+    # per-comm expansion keys rows on the histogram comm label
+    per = mpitop.summarize(snaps, per_comm=True)
+    assert any(r.get("comm") == "w" for r in per["rows"])
+
+
+def test_mpitop_slow_rank_fallback_excludes_recv_waits():
+    """With no accusations the election is OWN latency only — the rank
+    stuck waiting (big recv p99) must not be blamed for its peer."""
+    from ompi_tpu.tools import mpitop
+    snaps = [
+        _dump(0, [_hist_row("tele_pml_recv_us", [500_000] * 5),
+                  _hist_row("tele_pml_send_us", [50] * 5)]),
+        _dump(1, [_hist_row("tele_pml_send_us", [200_000] * 5)]),
+    ]
+    s = mpitop.summarize(snaps)
+    assert s["declared"] == {} and s["accusations"] == {}
+    assert s["slow_rank"] == 1
+
+
+def test_mpitop_load_snapshots_skips_garbage(tmp_path, capsys):
+    from ompi_tpu.tools import mpitop
+    good = tmp_path / "telemetry_0.json"
+    good.write_text(json.dumps(_dump(0, [])))
+    bad = tmp_path / "telemetry_1.json"
+    bad.write_text("{not json")
+    snaps, skipped = mpitop.load_snapshots([str(good), str(bad)])
+    assert len(snaps) == 1 and snaps[0]["rank"] == 0
+    assert len(skipped) == 1 and skipped[0]["file"] == str(bad)
+    assert "telemetry_1.json" in capsys.readouterr().err
+
+
+# -- Prometheus exporter -----------------------------------------------------
+def test_prom_render_histogram_cumulative_and_gauge(tele, tmp_path):
+    h = telemetry.get_hist("tele_demo_us", labels={"func": "demo"})
+    for v in (1, 10, 100, 1000):
+        h.record(v)
+    pvar.pvar_register("tele_demo_gauge", lambda: 7,
+                       help="prom exporter test gauge")
+    try:
+        text = prom.render(rank=3)
+        assert "# TYPE ompi_tpu_tele_demo_us histogram" in text
+        assert "# HELP ompi_tpu_tele_demo_us" in text
+        # cumulative buckets end at +Inf == count
+        assert ('ompi_tpu_tele_demo_us_bucket{func="demo",le="+Inf",'
+                'rank="3"} 4') in text
+        assert 'ompi_tpu_tele_demo_us_count{func="demo",rank="3"} 4' \
+            in text
+        assert 'ompi_tpu_tele_demo_us_sum{func="demo",rank="3"} 1111' \
+            in text
+        cums = [int(line.rsplit(" ", 1)[1]) for line in text.splitlines()
+                if line.startswith("ompi_tpu_tele_demo_us_bucket")]
+        assert cums == sorted(cums) and cums[-1] == 4
+        assert "# TYPE ompi_tpu_tele_demo_gauge gauge" in text
+        assert 'ompi_tpu_tele_demo_gauge{rank="3"} 7' in text
+        # the histogram pvar must NOT double-render as a gauge
+        assert text.count("# TYPE ompi_tpu_tele_demo_us ") == 1
+
+        out = tmp_path / "telemetry.prom"
+        prom.write_textfile(str(out), text)
+        assert out.read_text() == text
+        assert not [f for f in os.listdir(tmp_path) if ".tmp." in f]
+    finally:
+        pvar.pvar_unregister("tele_demo_gauge")
+
+
+def test_prom_merged_rows_collapse_per_comm_families():
+    labels = {"comm": "w", "func": "allreduce", "sclass": "small"}
+    row = dict(_hist_row("tele_coll_allreduce_cw_small", [5, 9],
+                         labels), rank=2)
+    text = prom.render(rank=-1, pvars=[], hist_rows=[row])
+    # the _c<tok>_<sclass> suffix collapses into ONE metric family;
+    # comm/func/sclass ride as labels
+    assert "# TYPE ompi_tpu_tele_coll_allreduce histogram" in text
+    assert "tele_coll_allreduce_cw_small" not in text
+    assert ('ompi_tpu_tele_coll_allreduce_count{comm="w",'
+            'func="allreduce",rank="2",sclass="small"} 2') in text
+
+
+def test_prom_dict_valued_pvar_one_sample_per_key():
+    text = prom.render(rank=0, pvars=[
+        {"name": "tele_straggler_scores", "class": "level",
+         "value": {"1": 0.25, "3": 0.0}}], hist_rows=[])
+    assert ('ompi_tpu_tele_straggler_scores{key="1",rank="0"} 0.25'
+            in text)
+    assert ('ompi_tpu_tele_straggler_scores{key="3",rank="0"} 0'
+            in text)

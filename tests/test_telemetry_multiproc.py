@@ -1,0 +1,114 @@
+"""Telemetry-plane drills as REAL multi-process jobs (slow tier):
+the ISSUE-11 acceptance sequence end to end. p41 injects a 200 ms
+pml-frame delay at rank 1 and the healthy ranks' health monitors must
+DECLARE it; the per-rank telemetry dumps then have to survive the full
+export path — ``mpitop`` electing rank 1 as slow_rank and the merged
+flight-recorder incident report naming it critical. The kill drill
+(p34) is re-run with telemetry armed to prove the flight recorder
+snapshots atomically under a mid-collective SIGKILL and the merge
+handles the victim's absent snapshot (docs/OBSERVABILITY.md)."""
+import glob
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_PROGS = os.path.join(_REPO, "tests", "perrank_programs")
+_MPIRUN = os.path.join(_REPO, "ompi_tpu", "tools", "mpirun.py")
+
+
+def _run(prog: str, n: int, extra_env: dict | None = None):
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("JAX_", "XLA_"))}
+    env.update(extra_env or {})
+    cmd = [sys.executable, _MPIRUN, "--per-rank", "-n", str(n),
+           "--timeout", "150", os.path.join(_PROGS, prog)]
+    return subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=200, cwd=_REPO)
+
+
+def _load(paths):
+    out = []
+    for p in paths:
+        with open(p) as f:
+            out.append(json.load(f))
+    return out
+
+
+def test_telemetry_straggler_drill_names_rank1(tmp_path):
+    """The acceptance drill: 4 ranks, 200 ms injected pml delay at
+    rank 1 — every healthy rank declares it, mpitop's merged table
+    elects it slow_rank with a visible p99, and the flight-recorder
+    merge names it the critical rank."""
+    res = _run("p41_straggler.py", 4, {"P41_OUT": str(tmp_path)})
+    assert res.returncode == 0, \
+        f"rc={res.returncode}\n--- out\n{res.stdout}\n" \
+        f"--- err\n{res.stderr[-4000:]}"
+    assert res.stdout.count("OK p41_straggler") == 4, res.stdout
+
+    files = sorted(glob.glob(str(tmp_path / "telemetry_*.json")))
+    assert len(files) == 4, files
+    from ompi_tpu.tools import mpitop
+    snaps, skipped = mpitop.load_snapshots(files)
+    assert not skipped, skipped
+    summary = mpitop.summarize(snaps)
+    assert summary["slow_rank"] == 1, summary
+    # at least the three healthy ranks declared rank 1
+    assert summary["declared"].get("1", 0) >= 3, summary["declared"]
+    row1 = [r for r in summary["rows"] if r["rank"] == 1][0]
+    # the 200 ms hold is visible in rank 1's OWN latency p99
+    assert max(row1["send_p99_us"], row1["coll_p99_us"]) >= 5e4, row1
+    table = mpitop.render_table(summary)
+    assert "STRAGGLER" in table, table
+    assert "slow_rank: 1" in table, table
+
+    # the straggler declarations left flight-recorder snapshots; the
+    # merge (tracedump's flightrec mode backend) must accuse rank 1
+    frecs = sorted(glob.glob(str(tmp_path / "flightrec_*.json")))
+    assert frecs, list(tmp_path.iterdir())
+    from ompi_tpu.telemetry import flightrec
+    report = flightrec.merge(_load(frecs))
+    assert report["critical_rank"] == 1, report
+    assert report["accusations"].get("1", 0) >= 1, report
+    assert any(t["trigger"] == "straggler" for t in report["triggers"])
+
+
+def test_telemetry_flightrec_on_kill(tmp_path):
+    """p34 (rank 2 SIGKILLed mid-allreduce) with telemetry armed: the
+    survivors' proc-failed triggers write parseable snapshots — atomic
+    under the kill — and ``tracedump --format flightrec`` merges them
+    into an incident report naming rank 2 critical with
+    ``critical_absent`` (the victim never wrote)."""
+    res = _run("p34_ftdrill.py", 4, {
+        "OMPI_TPU_MCA_mpi_base_telemetry": "1",
+        "OMPI_TPU_MCA_mpi_base_telemetry_flightrec_dir": str(tmp_path),
+    })
+    assert res.returncode == 137, \
+        f"rc={res.returncode}\n--- out\n{res.stdout}\n" \
+        f"--- err\n{res.stderr[-4000:]}"
+    assert res.stdout.count("OK p34_ftdrill") == 3, res.stdout
+
+    frecs = sorted(glob.glob(str(tmp_path / "flightrec_*.json")))
+    assert frecs, list(tmp_path.iterdir())
+    payloads = _load(frecs)               # json.load raising = torn file
+    assert all(p.get("flightrec") == 1 for p in payloads)
+    ranks = {p["rank"] for p in payloads}
+    assert 2 not in ranks, ranks          # the victim never wrote
+    assert any(p["trigger"] == "proc_failed" and
+               p["detail"].get("rank") == 2 for p in payloads), payloads
+
+    from ompi_tpu.tools import tracedump
+    out = tmp_path / "incident.json"
+    rc = tracedump.main(["--format", "flightrec", "-o", str(out)]
+                        + frecs)
+    assert rc == 0
+    report = json.loads(out.read_text())
+    assert report["incident"] == 1
+    assert report["critical_rank"] == 2, report
+    assert report.get("critical_absent") is True, report
+    assert report["accusations"].get("2", 0) >= 1, report
