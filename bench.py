@@ -49,6 +49,7 @@ if "--ab-child" in sys.argv or "--perrank-child" in sys.argv \
         or "--compress-device-child" in sys.argv \
         or "--pcoll-child" in sys.argv \
         or "--largemsg-child" in sys.argv \
+        or "--shm-child" in sys.argv \
         or "--ft-child" in sys.argv \
         or "--telemetry-child" in sys.argv:
     os.environ["JAX_PLATFORMS"] = "cpu"
@@ -1228,6 +1229,117 @@ def _largemsg_rows() -> dict:
     return out
 
 
+def _shm_child() -> None:
+    """One rank of the zero-copy shared-memory A/B job
+    (docs/LARGEMSG.md): pt2pt one-way time rank0->rank1 at 1/8/32 MB
+    and the 32 MB allreduce, each timed with the segment plane ON
+    (single-copy adoption / in-segment fold) and OFF (the unchanged
+    ring path) inside the same process — with the adoption and fold
+    pvars read so the speedup rows are EVIDENCED (payloads actually
+    rode the segments), not inferred. Rank 0 prints one JSON line."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import ompi_tpu as MPI
+    from ompi_tpu.mca import pvar as _pvar
+    from ompi_tpu.mca import var as _var
+
+    MPI.Init()
+    w = MPI.get_comm_world()
+    r, n = w.rank(), w.size
+    # host tier only: the staging shim would swallow the payload
+    _var.var_set("coll_tuned_stage_min_bytes", 1 << 62)
+
+    def pt2pt_ms(mb, zerocopy, reps=7):
+        """Median one-way 0->1 transfer: send + 1-byte ack (the ack
+        also paces the sender behind the receiver's slot frees)."""
+        _var.var_set("mpi_base_shm_zerocopy", zerocopy)
+        x = np.full((mb << 20) // 4, 1.0, np.float32)
+        ts = []
+        for i in range(reps + 1):        # first rep is the warm-up
+            w.barrier()
+            t0 = time.perf_counter()
+            if r == 0:
+                w.send(x, 1, 60)
+                w.recv(1, 61)
+            elif r == 1:
+                y = np.asarray(w.recv(0, 60)[0])
+                assert y[0] == 1.0 and y.nbytes == x.nbytes
+                del y                    # drop the adoption: slot frees
+                w.send(b"k", 0, 61)
+            if r == 0 and i:
+                ts.append(time.perf_counter() - t0)
+        _var.var_set("mpi_base_shm_zerocopy", True)
+        return float(np.median(ts)) * 1e3 if r == 0 else 0.0
+
+    def allreduce_ms(mb, zerocopy, reps=5):
+        _var.var_set("mpi_base_shm_zerocopy", zerocopy)
+        x = np.full((mb << 20) // 4, float(r + 1), np.float32)
+        y = np.asarray(w.allreduce(x, MPI.SUM))     # warm + verify
+        assert y[0] == n * (n + 1) / 2, y[0]
+        ts = []
+        for _ in range(reps):
+            w.barrier()
+            t0 = time.perf_counter()
+            w.allreduce(x, MPI.SUM)
+            ts.append(time.perf_counter() - t0)
+        _var.var_set("mpi_base_shm_zerocopy", True)
+        return float(np.median(ts)) * 1e3
+
+    a0 = _pvar.pvar_read("btl_shm_adoptions")
+    f0 = _pvar.pvar_read("btl_shm_fold_ops")
+    pt = {}
+    for mb in (1, 8, 32):
+        ring = pt2pt_ms(mb, False)
+        zc = pt2pt_ms(mb, True)
+        if r == 0:
+            pt[f"{mb}MB"] = {
+                "ring_ms": round(ring, 2),
+                "zerocopy_ms": round(zc, 2),
+                "speedup": round(ring / zc, 2) if zc else None,
+                "zerocopy_gbps": round((mb * (1 << 20)) / (zc / 1e3)
+                                       / 1e9, 2) if zc else None}
+
+    ar_ring = allreduce_ms(32, False, reps=3)
+    ar_zc = allreduce_ms(32, True, reps=3)
+
+    # adoption evidence lives at the RECEIVER (rank 1); fold evidence
+    # on every rank — gather both to the reporting rank
+    counts = np.asarray(w.gather(np.array(
+        [_pvar.pvar_read("btl_shm_adoptions") - a0,
+         _pvar.pvar_read("btl_shm_fold_ops") - f0], np.int64), 0))
+    w.barrier()
+    MPI.Finalize()
+    if r == 0:
+        print(json.dumps({
+            "ranks": n,
+            "pt2pt": pt,
+            "allreduce_32MB": {
+                "ring_ms": round(ar_ring, 2),
+                "zerocopy_ms": round(ar_zc, 2),
+                "speedup": round(ar_ring / ar_zc, 2) if ar_zc else None},
+            "adoptions": int(counts[:, 0].sum()),
+            "fold_ops": int(counts[:, 1].sum()),
+        }), flush=True)
+
+
+def _shm_rows() -> dict:
+    """The --shm section: segment plane ON vs OFF at 1/8/32 MB pt2pt
+    and the 32 MB allreduce, on 2-rank and 8-rank per-rank jobs
+    (docs/LARGEMSG.md). The 2-rank 32 MB pt2pt speedup (>= 3x) and the
+    8-rank 32 MB allreduce speedup (>= 2x) carry the acceptance
+    contract, evidenced by the adoption/fold pvar deltas."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    mpirun = os.path.join(here, "ompi_tpu", "tools", "mpirun.py")
+    out = {}
+    for label, nr, to in (("2rank", 2, 420), ("8rank", 8, 600)):
+        out[label] = _child_json(
+            [sys.executable, mpirun, "--per-rank", "-n", str(nr),
+             "--timeout", str(to - 60),
+             sys.executable, os.path.abspath(__file__), "--shm-child"],
+            to, _child_env())
+    return out
+
+
 def _ft_child() -> None:
     """One rank of the 4-process resilience drill (docs/RESILIENCE.md):
     the heartbeat detector is on and ft/inject kills rank 2 at its 2nd
@@ -1543,6 +1655,12 @@ def main() -> None:
                          "bcast A/B with rails 1 vs 2 on sm, tcp, and "
                          "the paced tier (docs/LARGEMSG.md)")
     ap.add_argument("--largemsg-child", action="store_true")
+    ap.add_argument("--shm", action="store_true",
+                    help="measure the zero-copy shared-memory rows: "
+                         "segment plane vs ring A/B at 1/8/32 MB "
+                         "pt2pt + the 32 MB allreduce fold on 2- and "
+                         "8-rank per-rank jobs (docs/LARGEMSG.md)")
+    ap.add_argument("--shm-child", action="store_true")
     ap.add_argument("--ft", action="store_true",
                     help="run the resilience drill: 4-process kill "
                          "drill under the heartbeat detector — "
@@ -1586,6 +1704,9 @@ def main() -> None:
         return
     if args.largemsg_child:
         _largemsg_child()
+        return
+    if args.shm_child:
+        _shm_child()
         return
     if args.ft_child:
         _ft_child()
@@ -1818,6 +1939,11 @@ def main() -> None:
     largemsg_rows = _largemsg_rows() if (args.largemsg and n == 1
                                          and not args.no_ab) else None
 
+    # ---- zero-copy shared-memory rows (--shm) -----------------------
+    # explicit opt-in like --ft: the A/B toggling happens inside the
+    # children, not through this process's config
+    shm_rows = _shm_rows() if (args.shm and n == 1) else None
+
     # ---- resilience-plane drill rows (--ft) -------------------------
     # explicit opt-in flag, so --no-ab (which skips the implicit
     # children) does not gate it
@@ -1879,6 +2005,7 @@ def main() -> None:
         **({"pcoll": pcoll_rows} if pcoll_rows is not None else {}),
         **({"largemsg": largemsg_rows}
            if largemsg_rows is not None else {}),
+        **({"shm": shm_rows} if shm_rows is not None else {}),
         **({"ft": ft_rows} if ft_rows is not None else {}),
         **({"lint": lint_rows} if lint_rows is not None else {}),
         **({"telemetry": telemetry_rows}
@@ -1981,6 +2108,28 @@ def main() -> None:
         if isinstance(pr2, dict) and "error" not in pr2:
             contract["rail_bytes_balanced"] = pr2.get(
                 "rail_bytes_balanced")
+        # regression gate with the --largemsg section (docs/LARGEMSG.md
+        # r12 diagnosis): the round's algbw must hold the newest
+        # committed headline's within 10%
+        prev = _prev_headline_algbw()
+        if prev is not None:
+            contract["algbw_no_worse_than_prev"] = {
+                "now": result["large_algbw_gbps"], "prev": prev,
+                "ok": bool(result["large_algbw_gbps"] >= 0.9 * prev)}
+    if shm_rows is not None:
+        # the zero-copy acceptance rows (docs/LARGEMSG.md): 2-rank
+        # 32 MB pt2pt >= 3x the ring, 8-rank 32 MB allreduce fold
+        # >= 2x, both pvar-evidenced (adoptions/folds actually ran)
+        j2 = shm_rows.get("2rank") or {}
+        j8 = shm_rows.get("8rank") or {}
+        if isinstance(j2, dict) and "error" not in j2:
+            contract["shm_pt2pt_32m_speedup"] = (
+                (j2.get("pt2pt") or {}).get("32MB") or {}).get("speedup")
+            contract["shm_adoptions"] = j2.get("adoptions")
+        if isinstance(j8, dict) and "error" not in j8:
+            contract["shm_allreduce_32m_speedup"] = (
+                j8.get("allreduce_32MB") or {}).get("speedup")
+            contract["shm_fold_ops"] = j8.get("fold_ops")
     if ft_rows is not None:
         # the resilience acceptance rows (docs/RESILIENCE.md): the
         # heartbeat detector's latency bound and the post-shrink

@@ -31,6 +31,7 @@ import time
 import uuid
 from typing import Callable, Dict, Optional, Tuple
 
+from ompi_tpu.btl import shmseg as _shmseg
 from ompi_tpu.btl.sm import SmEndpoint
 from ompi_tpu.btl.tcp import TcpEndpoint
 from ompi_tpu import telemetry as _tele
@@ -95,6 +96,9 @@ def register_params() -> None:
                           "independent send locks and sender threads); "
                           "1 = the single-rail byte-identical default "
                           "(docs/LARGEMSG.md)")
+    # the zero-copy segment plane's vars (mpi_base_shm_*) register
+    # alongside the ring tuning vars — docs/LARGEMSG.md
+    _shmseg.register_params()
     # the resilience plane's vars register alongside the btl tuning
     # vars: injection (mpi_base_ft_inject_*) and the heartbeat
     # detector (mpi_base_ft_hb_*) — docs/RESILIENCE.md
@@ -207,6 +211,19 @@ class BmlEndpoint:
                                                _DEF_RING_BYTES)))
             except Exception:            # noqa: BLE001 — no /dev/shm
                 self.sm = None           # etc: tcp carries everything
+        # the zero-copy segment plane (btl/shmseg): constructed
+        # unconditionally in multi-rank worlds — it allocates nothing
+        # until a send actually packs, and the receive side must be
+        # able to adopt regardless of the local send gate. segfree ctl
+        # frames ride the unsequenced tcp plane (the _smpoke
+        # discipline).
+        self.shm_seg: Optional[_shmseg.SegPlane] = None
+        if nprocs > 1 and not os.environ.get("OMPI_TPU_DISABLE_SM"):
+            try:
+                self.shm_seg = _shmseg.SegPlane(
+                    rank, kv_set, kv_get, ctl_send=self.tcp.send_frame)
+            except Exception:            # noqa: BLE001 — ring/tcp
+                self.shm_seg = None      # carry everything
         self._same_host: Dict[int, bool] = {}
         self._sm_min = int(var.var_get("btl_sm_min_bytes",
                                        _DEF_MIN_BYTES))
@@ -327,11 +344,28 @@ class BmlEndpoint:
                     self.rail_stats["ooo"] += 1
                 self._rail_expect[key] = max(exp, rseq + 1)
                 self.rail_stats["recv_frames"] += 1
+            # zero-copy detour: the sender parked the segment payload
+            # in a shared slot and shipped only a descriptor. Only
+            # offset-addressed ("off") pipesegs ride here, so the
+            # PipeStore copies out synchronously inside sink() and
+            # nothing retains the transient view past the free below.
+            seg = header.pop("_seg", None)
+            view = None
+            if seg is not None and self.shm_seg is not None:
+                view = self.shm_seg.view(seg)
+                payload = view
             with self._rail_lock:        # rail_bytes shares the send-
                 self.rail_bytes[rail] = (self.rail_bytes.get(rail, 0)
                                          + len(payload))  # side lock
             _progress.wake_note_frame()
-            self.sink(header, payload)
+            if view is None:
+                self.sink(header, payload)
+                return
+            try:
+                self.sink(header, payload)
+            finally:
+                view.release()
+                self.shm_seg.send_free(seg["o"], seg["i"])
             return
         sq = header.pop("_sq", None)
         if sq is None:                   # unsequenced (foreign) frame
@@ -500,6 +534,23 @@ class BmlEndpoint:
                 # width the rendezvous scheduler actually produced
                 hist = _tele.RAIL
                 hist.record(len(payload))
+            seg = None
+            if (self.shm_seg is not None and _shmseg.enabled()
+                    and "off" in header
+                    and len(payload) >= self.shm_seg.min_bytes
+                    and not ft.is_failed(peer)
+                    and self._is_same_host(peer)):
+                # zero-copy: park the stripe in a shared slot and ship
+                # only the descriptor frame. Offset-addressed pipesegs
+                # only — compressed segments lack "off" and are
+                # RETAINED by the receiving PipeStore, so they must
+                # never ride a transient slot view. pack() returning
+                # None (pool dry: receiver still holds every slot)
+                # falls back to the ring/tcp copy path below.
+                seg = self.shm_seg.pack(peer, payload)
+                if seg is not None:
+                    header["_seg"] = seg
+                    payload = b""
             sent = False
             try:
                 if not ft.is_failed(peer):
@@ -547,6 +598,10 @@ class BmlEndpoint:
                                 pass         # peer death: the failure
                                 #              detector owns reporting
             finally:
+                if seg is not None and not sent:
+                    # descriptor never left: reclaim the slot locally
+                    # (the receiver will never send the segfree ctl)
+                    self.shm_seg.release(peer, seg["i"])
                 if tok is not None:
                     _trace.end(tok, sent=sent)
                 if on_done is not None:
@@ -557,6 +612,8 @@ class BmlEndpoint:
             rail_qs = list(self._rail_qs.values())
         for q in rail_qs:                # retire the rail senders
             q.put(None)
+        if self.shm_seg is not None:
+            self.shm_seg.close()
         if self.sm is not None:
             self.sm.close()
         self.tcp.close()

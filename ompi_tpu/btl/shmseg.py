@@ -1,0 +1,505 @@
+"""btl/shmseg — zero-copy shared-memory segment pools (the bulk plane).
+
+Behavioral spec: the Process-in-Process observation (PAPERS.md,
+arXiv:2305.10612) — same-node ranks sharing an address space can move
+a payload with ~2 byte-touches instead of the ring path's copy-in /
+copy-out per hop. The sm SPSC rings stay the FRAME plane (headers,
+doorbells, everything under ``mpi_base_shm_seg_min_bytes``); payloads
+at or above it are packed ONCE into a slot of a per-(sender, peer)
+segment pool — a raw mmap file under /dev/shm with the same
+``tag_for()`` naming/ownership discipline as the rings — and only a
+tiny descriptor frame rides the existing ordered ring+poke ctl plane.
+The receiver adopts the payload in place with ``np.frombuffer``:
+single-copy pt2pt.
+
+Reclaim is tied to MPI completion: a ``weakref.finalize`` on the
+adopted array sends a tiny unsequenced ``segfree`` ctl frame back to
+the owner when the LAST reference dies. The finalizer closes over slot
+ids and the plane only — never the array itself (the PR-5
+PipeStore/``_cancel_fn`` lesson: no closure cycle may pin a 32 MB
+segment). A receiver that holds an adopted array forever just pins one
+slot; the sender's pool runs dry and new sends fall back to the
+ring/tcp path — graceful degradation, never corruption. POSIX
+unlink-while-mapped semantics keep adopted views valid after the
+owner unlinks at close.
+
+On top of the pt2pt pools sits the in-segment FOLD workspace: one
+fixed segment per (rank, communicator), modex'd through the KV, that
+``core/rankcomm``'s node-local allreduce folds partner shards in
+directly (reduce-scatter over segment slices, then in-place
+allgather) — ~4 byte-touches per rank instead of the ring schedule's
+~2·P (docs/LARGEMSG.md has the copy-count table).
+
+Everything here is OFF by default (``mpi_base_shm_zerocopy=0``); the
+off path is byte-identical to the ring data plane, gate-tested.
+"""
+from __future__ import annotations
+
+import hashlib
+import mmap
+import os
+import threading
+import weakref
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ompi_tpu.btl.sm import _SHM_DIR, job_tag
+from ompi_tpu.mca import pvar as _pvar
+from ompi_tpu.mca import var
+from ompi_tpu import telemetry as _tele
+from ompi_tpu.trace import core as _trace
+
+# the launcher's post-reap sweep globs on this prefix
+# (tools/mpirun.py imports it) — prefix and glob must never diverge,
+# same contract as the rings' otpusm_ prefix
+SEG_PREFIX = "otpuseg"
+
+# single source of truth for the tuning defaults (the bml convention)
+_DEF_MIN_BYTES = 256 << 10
+_DEF_SEG_BYTES = 32 << 20
+_DEF_SEG_COUNT = 4
+
+
+def register_params() -> None:
+    var.var_register(
+        "mpi", "base", "shm_zerocopy", vtype="bool", default=False,
+        help="Zero-copy shared-memory bulk plane: same-host payloads "
+             "at or above mpi_base_shm_seg_min_bytes are packed once "
+             "into a per-peer segment pool and adopted in place by "
+             "the receiver (single-copy pt2pt + the in-segment "
+             "node-local fold); off keeps the ring data plane "
+             "byte-identical (docs/LARGEMSG.md)")
+    var.var_register(
+        "mpi", "base", "shm_seg_min_bytes", vtype="int",
+        default=_DEF_MIN_BYTES,
+        help="Smallest payload routed through the zero-copy segment "
+             "pool; smaller frames stay on the ring/tcp planes")
+    var.var_register(
+        "mpi", "base", "shm_seg_bytes", vtype="int",
+        default=_DEF_SEG_BYTES,
+        help="Per-slot capacity of the shared segment pools (also the "
+             "per-communicator fold workspace size); payloads larger "
+             "than one slot ride the pipelined rendezvous, whose "
+             "segments reuse the pool slot by slot")
+    var.var_register(
+        "mpi", "base", "shm_seg_count", vtype="int",
+        default=_DEF_SEG_COUNT,
+        help="Slots per (sender, peer) segment pool; when every slot "
+             "is pinned by an unreclaimed adoption, new sends fall "
+             "back to the ring/tcp path")
+
+
+def enabled() -> bool:
+    register_params()
+    return bool(var.var_get("mpi_base_shm_zerocopy", False))
+
+
+def min_bytes() -> int:
+    register_params()
+    return int(var.var_get("mpi_base_shm_seg_min_bytes",
+                           _DEF_MIN_BYTES))
+
+
+def coll_token(cid) -> str:
+    """Filesystem/KV-safe token for a communicator id — the fold
+    workspace key (deterministic across ranks: cids agree by
+    construction)."""
+    return hashlib.md5(str(cid).encode()).hexdigest()[:8]
+
+
+# -- pvars ------------------------------------------------------------------
+stats = {"packs": 0, "adoptions": 0, "frees": 0, "no_slot": 0,
+         "folds": 0}
+
+
+def _register_pvars() -> None:
+    _pvar.pvar_register(
+        "btl_shm_adoptions", lambda: stats["adoptions"],
+        help="Payloads adopted in place from a peer's shared segment "
+             "(the zero-copy receive; docs/LARGEMSG.md)")
+    _pvar.pvar_register(
+        "btl_shm_seg_packs", lambda: stats["packs"],
+        help="Payloads packed into a shared segment slot by this "
+             "process (the single sender-side copy)")
+    _pvar.pvar_register(
+        "btl_shm_seg_frees", lambda: stats["frees"],
+        help="Segment slots returned to this process's pools by "
+             "peers' segfree ctl frames")
+    _pvar.pvar_register(
+        "btl_shm_seg_fallbacks", lambda: stats["no_slot"],
+        help="Zero-copy-eligible sends that fell back to the ring/tcp "
+             "path because every pool slot was pinned")
+    _pvar.pvar_register(
+        "btl_shm_fold_ops", lambda: stats["folds"],
+        help="In-segment node-local reductions this rank "
+             "participated in (core/rankcomm shm fold)")
+
+
+class _PoolFile:
+    """One raw mmap'd /dev/shm file: ``count`` fixed-size slots (or a
+    single fold workspace). Same ownership discipline as btl/sm.Ring:
+    the creator owns the path and unlinks at close; attachers never
+    unlink. Close tolerates exported buffers (adopted arrays keep the
+    mapping alive; POSIX keeps it valid past the unlink)."""
+
+    def __init__(self, name: str, size: int, slot_bytes: int,
+                 create: bool):
+        path = os.path.join(_SHM_DIR, name)
+        if create:
+            try:                         # stale leftover from a crashed
+                os.unlink(path)          # same-tag job: reclaim the name
+            except OSError:
+                pass
+            self._fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_RDWR,
+                               0o600)
+            os.ftruncate(self._fd, size)
+        else:
+            self._fd = os.open(path, os.O_RDWR)
+        self.name = name
+        self.slot_bytes = slot_bytes
+        self._path = path
+        self._created = create
+        self.buf = mmap.mmap(self._fd, size)
+
+    def close(self) -> None:
+        try:
+            self.buf.close()
+        except Exception:                # noqa: BLE001 — exported
+            pass                         # buffers: mapping outlives us
+        try:
+            os.close(self._fd)
+        except OSError:
+            pass
+        if self._created:
+            try:
+                os.unlink(self._path)
+            except OSError:
+                pass
+
+
+def _send_free(plane: "SegPlane", owner: int, idx: int) -> None:
+    """The adopted array's finalizer: return slot ``idx`` to ``owner``.
+    A module function taking ids only — registering it with
+    ``weakref.finalize`` must never close over the array (no cycle may
+    pin the segment). Runs on whatever thread drops the last
+    reference, possibly at interpreter exit: best-effort, never
+    raises."""
+    try:
+        plane.send_free(owner, idx)
+    except Exception:                    # noqa: BLE001
+        pass
+
+
+class SegPlane:
+    """The rank's shared-segment plane: sender-owned per-peer slot
+    pools, receiver-side attachments, and per-communicator fold
+    workspaces. Constructed unconditionally by the bml (it allocates
+    nothing until first use), so a peer whose gate differs can still
+    adopt; the SEND side is what ``mpi_base_shm_zerocopy`` gates."""
+
+    def __init__(self, rank: int, kv_set, kv_get, ctl_send=None):
+        register_params()
+        self.rank = rank
+        self._kv_set = kv_set
+        self._kv_get = kv_get
+        self._ctl = ctl_send             # unsequenced ctl frame sender
+        self.slot_bytes = max(64 << 10, int(var.var_get(
+            "mpi_base_shm_seg_bytes", _DEF_SEG_BYTES)))
+        self.slot_count = max(1, int(var.var_get(
+            "mpi_base_shm_seg_count", _DEF_SEG_COUNT)))
+        self.min_bytes = min_bytes()
+        self._lock = threading.Lock()
+        self._closed = False
+        # sender side: peer -> (pool file, free-slot set)
+        self._pools: Dict[int, Tuple[_PoolFile, set]] = {}
+        # receiver side: owner -> attached pool file
+        self._attached: Dict[int, _PoolFile] = {}
+        # fold workspaces: token -> own segment; (token, owner) -> peer
+        self._coll: Dict[str, _PoolFile] = {}
+        self._coll_peers: Dict[Tuple[str, int], _PoolFile] = {}
+
+    # -- sender side ---------------------------------------------------
+    def _name_for(self, suffix: str) -> str:
+        tag = job_tag()
+        if tag:
+            return f"{SEG_PREFIX}_{tag}_{self.rank}_{suffix}"
+        return (f"{SEG_PREFIX}_{os.getpid():x}_{self.rank}_{suffix}_"
+                f"{os.urandom(4).hex()}")
+
+    def pack(self, peer: int, payload) -> Optional[dict]:
+        """Copy ``payload`` into a free slot of the (rank -> peer)
+        pool — the ONE sender-side copy. Returns the wire descriptor
+        ``{"o", "i", "n"}`` or None (slot pressure / too big /
+        closed): the caller falls back to the ring/tcp path, which
+        stays fully correct."""
+        mv = payload if isinstance(payload, (bytes, bytearray)) \
+            else memoryview(payload).cast("B")
+        n = len(mv)
+        if n <= 0 or n > self.slot_bytes:
+            return None
+        publish = None
+        with self._lock:
+            if self._closed:
+                return None
+            ent = self._pools.get(peer)
+            if ent is None:
+                try:
+                    pf = _PoolFile(self._name_for(str(peer)),
+                                   self.slot_count * self.slot_bytes,
+                                   self.slot_bytes, create=True)
+                except OSError:
+                    return None          # no /dev/shm headroom
+                ent = self._pools[peer] = (pf,
+                                           set(range(self.slot_count)))
+                publish = (f"ompi_tpu/shmseg/{self.rank}/{peer}",
+                           f"{pf.name}:{self.slot_count}:"
+                           f"{self.slot_bytes}")
+            pf, free = ent
+            if not free:
+                stats["no_slot"] += 1
+                return None
+            idx = free.pop()
+        if publish is not None:
+            # the modex write happens BEFORE the descriptor frame can
+            # leave, so the receiver's lazy attach always finds the name
+            self._kv_set(*publish)
+        tok = (_trace.begin("btl.shm_seg", peer=peer, bytes=n)
+               if _trace.active else None)
+        ok = False
+        try:
+            off = idx * self.slot_bytes
+            pf.buf[off:off + n] = mv
+            ok = True
+        finally:
+            if tok is not None:
+                _trace.end(tok, idx=idx, ok=ok)
+            if not ok:                   # failed pack must not leak
+                with self._lock:         # the slot
+                    free.add(idx)
+        stats["packs"] += 1
+        if _tele.active:
+            hist = _tele.SHMSEG
+            if hist is not None:
+                hist.record(n)
+        return {"o": self.rank, "i": idx, "n": n}
+
+    def release(self, peer: int, idx: int) -> None:
+        """A segfree ctl frame arrived: the peer is done with slot
+        ``idx`` of our pool for it (set semantics absorb a duplicate
+        free)."""
+        with self._lock:
+            ent = self._pools.get(peer)
+            if ent is not None and 0 <= idx < self.slot_count:
+                ent[1].add(idx)
+        stats["frees"] += 1
+
+    def peer_failed(self, world_rank: int) -> None:
+        """FT reclaim: slots in flight to a dead peer can never be
+        freed remotely — reclaim the whole pool (the dead peer reads
+        nothing)."""
+        with self._lock:
+            ent = self._pools.get(world_rank)
+            if ent is not None:
+                ent[1].update(range(self.slot_count))
+
+    # -- receiver side -------------------------------------------------
+    def _attach(self, owner: int) -> _PoolFile:
+        with self._lock:
+            pf = self._attached.get(owner)
+        if pf is not None:
+            return pf
+        val = self._kv_get(f"ompi_tpu/shmseg/{owner}/{self.rank}")
+        if isinstance(val, bytes):
+            val = val.decode()
+        name, count, slot_bytes = str(val).rsplit(":", 2)
+        pf = _PoolFile(name, int(count) * int(slot_bytes),
+                       int(slot_bytes), create=False)
+        with self._lock:
+            cur = self._attached.setdefault(owner, pf)
+        if cur is not pf:
+            pf.close()                   # lost the attach race (never
+        return cur                       # unlinks: not the creator)
+
+    def adopt(self, desc: dict, inner: dict):
+        """``np.frombuffer`` view over the owner's slot — the
+        zero-copy receive. The returned array references the shared
+        mapping; its finalizer returns the slot when the last
+        reference dies (reclaim tied to MPI completion)."""
+        owner, idx, n = int(desc["o"]), int(desc["i"]), int(desc["n"])
+        pf = self._attach(owner)
+        dtype = np.dtype(inner["dtype"])
+        flat = np.frombuffer(pf.buf, dtype=dtype,
+                             count=n // max(dtype.itemsize, 1),
+                             offset=idx * pf.slot_bytes)
+        weakref.finalize(flat, _send_free, self, owner, idx)
+        stats["adoptions"] += 1
+        if _tele.active:
+            hist = _tele.SHMSEG
+            if hist is not None:
+                hist.record(n)
+        return flat.reshape(tuple(inner["shape"]))
+
+    def view(self, desc: dict) -> memoryview:
+        """Transient view over the owner's slot for callers that copy
+        synchronously (the pipelined segment train: PipeStore assembles
+        in place, then the bml frees the slot immediately)."""
+        pf = self._attach(int(desc["o"]))
+        off = int(desc["i"]) * pf.slot_bytes
+        return memoryview(pf.buf)[off:off + int(desc["n"])]
+
+    def send_free(self, owner: int, idx: int) -> None:
+        """Return slot ``idx`` to ``owner`` via the unsequenced ctl
+        plane (the _smpoke discipline: best-effort, a dead owner's
+        pool no longer matters)."""
+        send = self._ctl
+        if send is None or self._closed:
+            return
+        try:
+            send(owner, {"ctl": "segfree", "peer": self.rank,
+                         "i": idx})
+        except Exception:                # noqa: BLE001
+            pass
+
+    # -- fold workspaces (core/rankcomm in-segment reduction) ----------
+    def coll_segment(self, token: str) -> _PoolFile:
+        """This rank's fold workspace for communicator ``token`` —
+        one slot-sized segment, created on first use, name modex'd so
+        partners can attach. Collectives are serialized per comm, so
+        one workspace per (rank, comm) needs no slot bookkeeping."""
+        publish = None
+        with self._lock:
+            pf = self._coll.get(token)
+            if pf is None:
+                pf = _PoolFile(self._name_for(f"c{token}"),
+                               self.slot_bytes, self.slot_bytes,
+                               create=True)
+                self._coll[token] = pf
+                publish = (f"ompi_tpu/shmseg/coll/{token}/{self.rank}",
+                           f"{pf.name}:1:{self.slot_bytes}")
+        if publish is not None:
+            self._kv_set(*publish)
+        return pf
+
+    def coll_attach(self, token: str, owner: int) -> _PoolFile:
+        """Attach partner ``owner``'s fold workspace (call only after
+        a barrier ordered their ``coll_segment`` publish before us)."""
+        if owner == self.rank:
+            return self.coll_segment(token)
+        key = (token, owner)
+        with self._lock:
+            pf = self._coll_peers.get(key)
+        if pf is not None:
+            return pf
+        val = self._kv_get(f"ompi_tpu/shmseg/coll/{token}/{owner}")
+        if isinstance(val, bytes):
+            val = val.decode()
+        name, _count, slot_bytes = str(val).rsplit(":", 2)
+        pf = _PoolFile(name, int(slot_bytes), int(slot_bytes),
+                       create=False)
+        with self._lock:
+            cur = self._coll_peers.setdefault(key, pf)
+        if cur is not pf:
+            pf.close()
+        return cur
+
+    # -- lifecycle -----------------------------------------------------
+    def close(self) -> None:
+        """Unlink everything this rank created; attached mappings stay
+        valid for any still-live adopted arrays (POSIX). Called from
+        the bml's close on the runtime shutdown path; the launcher's
+        post-reap sweep reclaims whatever a SIGKILL left behind."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            files = ([pf for pf, _ in self._pools.values()]
+                     + list(self._coll.values())
+                     + list(self._attached.values())
+                     + list(self._coll_peers.values()))
+            self._pools.clear()
+            self._coll.clear()
+            self._attached.clear()
+            self._coll_peers.clear()
+        for pf in files:
+            pf.close()
+
+
+def adopt(endpoint, d: dict):
+    """Receiver-side hook (pml/perrank._incoming, desc kind
+    "shmseg")."""
+    plane = getattr(endpoint, "shm_seg", None)
+    if plane is None:
+        raise RuntimeError("shmseg descriptor with no segment plane "
+                           "(mismatched mpi_base_shm_zerocopy config?)")
+    return plane.adopt(d, d["inner"])
+
+
+def maybe_send_zerocopy(engine, data, dest: int, tag: int,
+                        synchronous: bool):
+    """The pml's same-host protocol switch (mirrors
+    pipeline.maybe_send_pipelined): returns a completed Request when
+    the payload was packed into a shared segment and announced by a
+    tiny ordered descriptor frame, or None to fall through. When it
+    returns None, NOTHING here has touched the wire — the fallback
+    stays byte-identical."""
+    if not enabled():
+        return None
+    router = engine.router
+    ep = router.endpoint
+    plane = getattr(ep, "shm_seg", None)
+    if plane is None:
+        return None
+    try:
+        import jax
+        if isinstance(data, jax.Array):
+            # past devxfer's gate already (too small / disabled): the
+            # D2H stage is the pack's source copy
+            data = np.asarray(data)
+    except Exception:                    # noqa: BLE001
+        pass
+    if not isinstance(data, np.ndarray) or data.dtype.hasobject:
+        return None
+    total = int(data.nbytes)
+    if total < plane.min_bytes or total > plane.slot_bytes:
+        return None
+    wdest = engine.comm.world_rank_of(dest)
+    if wdest == router.rank or not ep._is_same_host(wdest):
+        return None
+    arr = np.ascontiguousarray(data)
+    seg = plane.pack(wdest, arr)
+    if seg is None:
+        return None                      # pool pressure: ring path
+    me = engine.comm.rank()
+    t = engine.traffic.setdefault((me, dest), [0, 0])
+    t[0] += 1
+    t[1] += total
+    header = {"cid": engine.comm.cid, "src": me, "tag": tag,
+              "desc": {"kind": "shmseg", "o": seg["o"], "i": seg["i"],
+                       "n": seg["n"],
+                       "inner": {"kind": "nd", "dtype": arr.dtype.str,
+                                 "shape": tuple(arr.shape)}}}
+    ent = aid = None
+    if synchronous:
+        aid, ent = router.new_ack()
+        header["ack_id"] = aid
+        header["wsrc"] = engine.comm.world_rank_of(me)
+    # the descriptor rides the ORDERED stream: it is what matches, so
+    # zero-copy and fallback sends to one peer can never overtake
+    try:
+        ep.send_frame(wdest, header, b"")
+    except Exception:
+        plane.release(wdest, seg["i"])   # undelivered descriptor: the
+        raise                            # slot must not leak
+    if ent is not None:
+        if not ent[0].wait(600):
+            router.cancel_ack(aid)
+            from ompi_tpu.core.errhandler import ERR_PENDING, MPIError
+            raise MPIError(ERR_PENDING,
+                           "ssend timed out waiting for the receive")
+    from ompi_tpu.core.request import Request
+    return Request.completed()
+
+
+register_params()
+_register_pvars()

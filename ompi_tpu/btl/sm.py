@@ -24,6 +24,13 @@ tiny tcp "poke" whose blocking reader thread drains the rings — the
 latency plane stays the socket, the bulk bytes skip it. Drains are
 serialized by a consumer lock (the SPSC single-consumer contract).
 
+With ``mpi_base_shm_zerocopy`` on, the ring becomes the FRAME plane
+only for bulk traffic: payloads at or above
+``mpi_base_shm_seg_min_bytes`` are packed once into a shared segment
+slot (``btl/shmseg``, same ``tag_for``/ownership discipline as the
+rings here) and only the tiny descriptor frame rides the ring+poke
+path — the ring's copy-in/copy-out is skipped entirely.
+
 SPSC memory model: head (consumer-owned) and tail (producer-owned) are
 monotonically increasing u64 counters at fixed offsets; data writes
 happen before the tail store that publishes them, and each side only

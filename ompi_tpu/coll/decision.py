@@ -267,6 +267,25 @@ def pipeline_plan(nbytes: int, rails: int = 1,
     return {"segment_bytes": int(seg), "rails": max(1, int(rails))}
 
 
+# -- zero-copy shared-segment fold gating (ompi_tpu/btl/shmseg) -------------
+# Node-local collectives with an in-segment schedule (core/rankcomm):
+# partner shards are folded directly in shared memory — reduce-scatter
+# over segment slices, then in-place allgather (docs/LARGEMSG.md).
+SHM_FOLDS: Dict[str, str] = {"allreduce": "shm_fold"}
+
+
+def shm_rules() -> Dict[str, List[Sequence]]:
+    """Effective in-segment fold rows in the fixed-table shape; empty
+    when ``mpi_base_shm_zerocopy`` is off (off = byte-identical ring
+    dispatch). Two ranks minimum: a 1-rank fold is a copy."""
+    from ompi_tpu.btl import shmseg as _shm
+    if not _shm.enabled():
+        return {}
+    mb = _shm.min_bytes()
+    return {func: [[2, mb, alg]]
+            for func, alg in sorted(SHM_FOLDS.items())}
+
+
 # -- persistent/bucket gating (ompi_tpu/coll/persistent) --------------------
 def persistent_rules() -> Dict[str, List[Sequence]]:
     """The pre-bound persistent-plan rows (MPI-4 ``*_init`` family),
@@ -318,6 +337,8 @@ def decision_table(comm_size: int = 0, multihost: bool = False,
     for func, rows in bucket_rules().items():
         table[func] = table[func] + [list(r) for r in rows]
     for func, rows in pipeline_rules().items():
+        table[func] = table[func] + [list(r) for r in rows]
+    for func, rows in shm_rules().items():
         table[func] = table[func] + [list(r) for r in rows]
     for func, rows in persistent_rules().items():
         table[func] = [list(r) for r in rows]

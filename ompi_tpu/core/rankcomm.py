@@ -681,6 +681,8 @@ class RankCommunicator:
         if _cwire.eligible(data, op) \
                 and 1 < self.size <= _WIRE_DIRECT_MAX_RANKS:
             return self._wire_allreduce_direct(data, op)
+        if self._shm_fold_ok(data, op):
+            return self._shm_fold_allreduce(data, op)
         if self._pipeline_ring_ok(data, op):
             return self._pipelined_ring_allreduce(data, op)
         r = self.reduce(data, op, 0)
@@ -719,6 +721,98 @@ class RankCommunicator:
             img = _cwire.maybe_decode(parts[i])
             out = img if out is None else _apply(op, out, img)
         return out
+
+    # -- in-segment shared-memory fold (btl/shmseg, docs/LARGEMSG.md) --
+    def _shm_fold_ok(self, data: Any, op: op_mod.Op) -> bool:
+        """Rank-symmetric gate for the in-segment fold: every member
+        must sit on this host (the fold IS the shared mapping), the
+        payload must fit one fold workspace, the op must have a numpy
+        kernel, and the coll/decision shm rows must select it.
+        Commutativity is NOT required — each slice is folded once, in
+        rank order, by exactly one rank."""
+        if self.size < 2 or not isinstance(data, np.ndarray):
+            return False
+        if data.dtype.kind not in "fiu" or data.ndim == 0:
+            return False
+        if op.is_loc or not op.predefined:
+            return False
+        if op_mod.NP_COMBINERS.get(op.name) is None:
+            return False
+        plane = getattr(self.router.endpoint, "shm_seg", None)
+        if plane is None or int(data.nbytes) > plane.slot_bytes:
+            return False
+        from ompi_tpu.coll import decision
+        rules = decision.shm_rules().get("allreduce")
+        if not rules:
+            return False
+        if decision._match(rules, self.size,
+                           int(data.nbytes)) != "shm_fold":
+            return False
+        ep = self.router.endpoint
+        return all(ep._is_same_host(self.world_rank_of(i))
+                   for i in range(self.size) if i != self._rank)
+
+    def _fold_barrier(self, t: int) -> None:
+        """Dissemination barrier on a private tag — the fold's two
+        phase fences (the public ``barrier`` is @_serialized and may
+        not be re-entered from inside a collective)."""
+        n, r = self.size, self._rank
+        k = 1
+        while k < n:
+            self._csend((r + k) % n, t, None)
+            self._crecv((r - k) % n, t)
+            k <<= 1
+
+    def _shm_fold_allreduce(self, data: np.ndarray,
+                            op: op_mod.Op) -> np.ndarray:
+        """In-segment node-local allreduce (btl/shmseg fold
+        workspaces): every rank writes its contribution into its own
+        per-comm shared segment ONCE, then — after a fence — folds its
+        slice of the element range across ALL members' segments in
+        rank order and writes the folded slice back into every
+        segment (disjoint slices, so writers never race). After the
+        second fence each rank reads the complete result out of its
+        OWN segment. ~4 byte-touches per rank vs the ring schedule's
+        ~2·P, and bitwise-identical results everywhere (each slice is
+        folded exactly once, in rank order, and every rank reads the
+        same bytes). No third fence is needed: a rank's next phase-0
+        write to its own segment is self-serialized behind its own
+        read-out, and partners touch it again only after the next
+        collective's first fence — which requires this rank to have
+        moved on already."""
+        from ompi_tpu.btl import shmseg as _shmseg
+        n, r = self.size, self._rank
+        spc.record("coll_shm_fold", 1)
+        plane = self.router.endpoint.shm_seg
+        token = _shmseg.coll_token(self.cid)
+        arr = np.ascontiguousarray(data)
+        shape, dtype = arr.shape, arr.dtype
+        flat = arr.reshape(-1)
+        nbytes = int(arr.nbytes)
+        ws = plane.coll_segment(token)
+        ws.buf[0:nbytes] = memoryview(flat).cast("B")
+        self._fold_barrier(self._tag())  # contributions visible
+        views = [np.frombuffer(
+            plane.coll_attach(token, self.world_rank_of(i)).buf,
+            dtype=dtype, count=flat.size) for i in range(n)]
+        bounds = [(flat.size * i) // n for i in range(n + 1)]
+        lo, hi = bounds[r], bounds[r + 1]
+        npfn = op_mod.NP_COMBINERS[op.name]
+        if hi > lo:
+            acc = views[0][lo:hi].copy()
+            for k in range(1, n):
+                acc = npfn(acc, views[k][lo:hi])
+            for v in views:
+                v[lo:hi] = acc
+        self._fold_barrier(self._tag())  # folded slices visible
+        out = views[r].copy()
+        _shmseg.stats["folds"] += 1
+        from ompi_tpu import telemetry as _telemetry_mod
+        if _telemetry_mod.active:
+            hist = _telemetry_mod.SHMSEG
+            if hist is not None:
+                hist.record(nbytes)
+        return out.reshape(shape)
 
     # -- segment-pipelined host tier (docs/LARGEMSG.md) ----------------
     def _pipeline_ring_ok(self, data: Any, op: op_mod.Op) -> bool:

@@ -166,6 +166,15 @@ class Router:
         # unfinished segment trains from the dead sender can never
         # complete — fail their waiters now (pml/pipeline)
         self.pipes.fail_peer(world_rank)
+        # slots parked for (or attached from) the dead rank can never
+        # be returned by it — reclaim/unmap them now (btl/shmseg)
+        plane = getattr(getattr(self, "endpoint", None), "shm_seg",
+                        None)
+        if plane is not None:
+            try:
+                plane.peer_failed(world_rank)
+            except Exception:            # noqa: BLE001
+                pass
         with self._lock:
             engines = list(self._engines.values())
         for eng in engines:
@@ -304,6 +313,14 @@ class Router:
             return
         if ctl == "revoke":
             self._on_revoke(header["rcid"])
+            return
+        if ctl == "segfree":
+            # receiver finished with a shared slot we own (btl/shmseg
+            # zero-copy plane): return it to the per-peer free pool
+            plane = getattr(getattr(self, "endpoint", None),
+                            "shm_seg", None)
+            if plane is not None:
+                plane.release(header["peer"], header["i"])
             return
         if ctl == "bye":
             with self._lock:
@@ -559,6 +576,13 @@ class PerRankEngine:
             # on the consumer thread at resolve time
             from ompi_tpu.pml.pipeline import PipePayload
             payload = PipePayload(self.router, d)
+        elif d.get("kind") == "shmseg":
+            # zero-copy descriptor frame (btl/shmseg): adopt the
+            # payload in place over the sender's shared slot; the
+            # array's finalizer returns the slot when the receiver
+            # drops its last reference
+            from ompi_tpu.btl import shmseg as _shmseg
+            payload = _shmseg.adopt(self.router.endpoint, d)
         else:
             payload = decode_payload(d, raw)
             # inline-combining fast path: a posted CombineSlot for this
@@ -705,10 +729,20 @@ class PerRankEngine:
             desc, raw = dev_desc, b""
             wire_bytes = int(data.nbytes)   # moved out-of-band (D2D)
         else:
-            # host byte path: large payloads take the segment-
-            # pipelined rendezvous (pml/pipeline, docs/LARGEMSG.md);
-            # None means nothing touched the wire — fall through to
-            # the unchanged eager path
+            # host byte path, fastest plane first: same-host bulk
+            # payloads pack ONCE into a shared segment slot and ship a
+            # descriptor (btl/shmseg) — shm beats compression for
+            # pt2pt because there are no wire bytes to save. None
+            # means the plane declined (off, cross-host, pool dry) and
+            # nothing touched the wire.
+            from ompi_tpu.btl import shmseg as _shmseg
+            zreq = _shmseg.maybe_send_zerocopy(self, data, dest, tag,
+                                               synchronous)
+            if zreq is not None:
+                return zreq
+            # then the segment-pipelined rendezvous (pml/pipeline,
+            # docs/LARGEMSG.md); again None means nothing touched the
+            # wire — fall through to the unchanged eager path
             from ompi_tpu.pml import pipeline as _pipeline
             preq = _pipeline.maybe_send_pipelined(self, data, dest,
                                                   tag, synchronous)

@@ -20,6 +20,10 @@ role), staged D2H (``btl/devxfer.SegmentStager`` double-buffering), and
 compressed (``compress/wire`` per segment, whole-message gated), so all
 of that work overlaps the wire. The receive side reassembles by segment
 index (:class:`PipeStore`), so out-of-order rail delivery is harmless.
+When ``mpi_base_shm_zerocopy`` is on, same-host offset-addressed
+segments skip the ring copy entirely: the rail sender parks each one in
+a shared slot (``btl/shmseg``) and ships only a descriptor, freed the
+moment the PipeStore's synchronous copy-out returns.
 
 Observability: ``pml_pipeline_segments`` / ``pml_pipeline_inits`` /
 ``pml_overlap_ratio`` pvars and ``pml.segment`` trace spans

@@ -48,6 +48,7 @@ PML_RECV: Optional[Histogram] = None
 SEGMENT: Optional[Histogram] = None
 FLUSH: Optional[Histogram] = None
 RAIL: Optional[Histogram] = None
+SHMSEG: Optional[Histogram] = None
 HB_GAP: Optional[Histogram] = None
 HB_RTT: Optional[Histogram] = None
 
@@ -264,6 +265,11 @@ def _arm_core_hists() -> None:
                   "flush, btl/tcp)"),
         "RAIL": ("tele_btl_rail_bytes", "bytes", {"func": "rail"},
                  "payload bytes per rail frame (btl/bml striping)"),
+        "SHMSEG": ("tele_btl_shm_seg_bytes", "bytes",
+                   {"func": "shm_seg"},
+                   "payload bytes packed into / adopted from shared "
+                   "segment slots (btl/shmseg zero-copy plane, send "
+                   "+ receive sides)"),
         "HB_GAP": ("tele_ft_hb_gap_us", "us", {"func": "hb_gap"},
                    "inter-arrival gap of ring heartbeats "
                    "(ft/detector ingress)"),
@@ -333,11 +339,11 @@ def shutdown() -> None:
 
 
 def _reset_for_tests() -> None:
-    global active, PML_SEND, PML_RECV, SEGMENT, FLUSH, RAIL, HB_GAP, \
-        HB_RTT
+    global active, PML_SEND, PML_RECV, SEGMENT, FLUSH, RAIL, SHMSEG, \
+        HB_GAP, HB_RTT
     shutdown()
     active = False
     with _lock:
         _hists.clear()
-    PML_SEND = PML_RECV = SEGMENT = FLUSH = RAIL = None
+    PML_SEND = PML_RECV = SEGMENT = FLUSH = RAIL = SHMSEG = None
     HB_GAP = HB_RTT = None

@@ -22,6 +22,11 @@ Invariants the lossy/fused subsystems must never lose
    rewrite of the wire schedule without its equivalence test is an
    unverified reordering of the collective's result
    (docs/LARGEMSG.md).
+3b. **Shm-fold parity**: every collective with an in-segment
+   shared-memory fold schedule (``coll/decision.SHM_FOLDS``) has a
+   ``test_shmfold_<func>_matches_ring`` pair — an in-place
+   shared-memory rewrite of the wire schedule without its equivalence
+   test is an unverified fold path (docs/LARGEMSG.md).
 4. **Fault-recovery parity**: every fault class the injection plane
    can raise (``ft/inject.FAULT_CLASSES``: drop / delay / corrupt /
    sever / kill) has a paired recovery test —
@@ -115,7 +120,7 @@ def audit(tests_dir: Optional[str] = None) -> Dict[str, Any]:
     tests_dir = tests_dir or os.path.join(_REPO, "tests")
     from ompi_tpu.analyze.mpilint import RULES
     from ompi_tpu.coll.compressed import WRAPPED_FUNCS
-    from ompi_tpu.coll.decision import PIPELINED
+    from ompi_tpu.coll.decision import PIPELINED, SHM_FOLDS
     from ompi_tpu.coll.persistent import FUSED_FUNCS, PERSISTENT_FUNCS
     from ompi_tpu.ft.inject import FAULT_CLASSES
 
@@ -127,11 +132,14 @@ def audit(tests_dir: Optional[str] = None) -> Dict[str, Any]:
                         for func in FUSED_FUNCS})
     wanted_pipe = {f"test_pipelined_{func}_matches_unpipelined": func
                    for func in PIPELINED}
+    wanted_shm = {f"test_shmfold_{func}_matches_ring": func
+                  for func in SHM_FOLDS}
     wanted_ft = {f"test_ft_{cls}_recovers": cls
                  for cls in FAULT_CLASSES}
     found: set = set()
     found_pers: set = set()
     found_pipe: set = set()
+    found_shm: set = set()
     found_ft: set = set()
     found_lint: set = set()
     unmarked: List[str] = []
@@ -153,6 +161,8 @@ def audit(tests_dir: Optional[str] = None) -> Dict[str, Any]:
                 found_pers.add(name)
             if name in wanted_pipe:
                 found_pipe.add(name)
+            if name in wanted_shm:
+                found_shm.add(name)
             if name in wanted_ft:
                 found_ft.add(name)
             for rule in RULES:
@@ -167,21 +177,24 @@ def audit(tests_dir: Optional[str] = None) -> Dict[str, Any]:
     missing = sorted(set(wanted) - found)
     missing_pers = sorted(set(wanted_pers) - found_pers)
     missing_pipe = sorted(set(wanted_pipe) - found_pipe)
+    missing_shm = sorted(set(wanted_shm) - found_shm)
     missing_ft = sorted(set(wanted_ft) - found_ft)
     missing_lint = sorted(f"test *lint_{r}* (fixture-pair test)"
                           for r in set(RULES) - found_lint)
     return {"ok": not missing and not missing_pers and not missing_pipe
-            and not missing_ft and not unmarked
+            and not missing_shm and not missing_ft and not unmarked
             and not missing_fixtures and not missing_lint,
             "wrapped_funcs": list(WRAPPED_FUNCS),
             "persistent_funcs": list(PERSISTENT_FUNCS),
             "fused_funcs": list(FUSED_FUNCS),
             "pipelined_funcs": sorted(PIPELINED),
+            "shm_fold_funcs": sorted(SHM_FOLDS),
             "fault_classes": list(FAULT_CLASSES),
             "lint_rules": sorted(RULES),
             "missing_parity": missing,
             "missing_persistent_parity": missing_pers,
             "missing_pipeline_parity": missing_pipe,
+            "missing_shm_fold_parity": missing_shm,
             "missing_ft_recovery": missing_ft,
             "missing_lint_fixtures": missing_fixtures,
             "missing_lint_tests": missing_lint,

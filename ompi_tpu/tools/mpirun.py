@@ -126,21 +126,38 @@ def run_per_rank(args, prog) -> int:
 
 
 def _sweep_shm(coord: str) -> None:
-    """Remove shared-memory ring segments this job's ranks leaked (a
-    killed rank never reaches its unlink) — the PRRTE session-cleanup
-    role for the btl/sm backing files. Tag and directory come from
-    btl/sm itself so the sweep can never diverge from the naming."""
+    """Remove shared-memory files this job's ranks leaked (a killed
+    rank never reaches its unlink) — the PRRTE session-cleanup role
+    for the btl/sm ring files AND the btl/shmseg zero-copy segment
+    pools. Tags, prefixes, and directory come from the btl modules
+    themselves so the sweep can never diverge from the naming.
+
+    Run as a script, mpirun's own process does NOT have the package
+    on sys.path (script dir is tools/, and python never adds the cwd
+    for scripts) — only the ranks get the PYTHONPATH injection. Put
+    the package root on the path here, or the guarded import below
+    silently no-ops the sweep and every crashed job leaks its files."""
     import glob
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    if pkg_root not in sys.path:
+        sys.path.insert(0, pkg_root)
     try:
         from ompi_tpu.btl.sm import _SHM_DIR, tag_for
     except Exception:                    # noqa: BLE001 — broken env:
         return                           # nothing we can safely sweep
-    for path in glob.glob(os.path.join(_SHM_DIR,
-                                       f"otpusm_{tag_for(coord)}_*")):
-        try:
-            os.unlink(path)
-        except OSError:
-            pass
+    try:
+        from ompi_tpu.btl.shmseg import SEG_PREFIX
+    except Exception:                    # noqa: BLE001
+        SEG_PREFIX = "otpuseg"
+    tag = tag_for(coord)
+    for prefix in ("otpusm", SEG_PREFIX):
+        for path in glob.glob(os.path.join(_SHM_DIR,
+                                           f"{prefix}_{tag}_*")):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
 
 
 def main(argv=None) -> None:

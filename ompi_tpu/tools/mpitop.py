@@ -105,6 +105,9 @@ def summarize(snaps: List[Dict[str, Any]],
                 hists, lambda h: h.get("name") == "tele_pml_recv_us")
             rail = _merge_named(
                 hists, lambda h: h.get("name") == "tele_btl_rail_bytes")
+            shm = _merge_named(
+                hists,
+                lambda h: h.get("name") == "tele_btl_shm_seg_bytes")
             row: Dict[str, Any] = {
                 "rank": rank,
                 "coll_ops": coll["count"],
@@ -113,6 +116,7 @@ def summarize(snaps: List[Dict[str, Any]],
                 "send_p99_us": send["p99"],
                 "recv_p99_us": recv["p99"],
                 "rail_bytes": round(rail["sum"], 0),
+                "shm_bytes": round(shm["sum"], 0),
                 "straggler_score": accusations.get(rank, 0.0),
                 "declared_by": declared.get(rank, 0),
                 "time": float(d.get("time", 0.0)),

@@ -199,6 +199,27 @@ def bucket_flushes_by_reason(spans: Iterable[SpanLike]
     return agg
 
 
+def shm_seg_by_rank(spans: Iterable[SpanLike]) -> Dict[str, Any]:
+    """Aggregate the zero-copy segment plane's ``btl.shm_seg`` spans
+    (the single sender-side pack copy, btl/shmseg) per rank: packs,
+    packed bytes, and pack time. Empty dict when the zero-copy plane
+    never ran — the summary omits the section entirely."""
+    agg: Dict[str, Dict[str, Any]] = {}
+    for s in spans:
+        if str(_field(s, "name", "?")) != "btl.shm_seg":
+            continue
+        args = _field(s, "args", None) or {}
+        rank = str(int(_field(s, "rank", -1)))
+        e = agg.setdefault(rank, {"packs": 0, "bytes": 0,
+                                  "pack_us": 0.0})
+        e["packs"] += 1
+        e["bytes"] += int(args.get("bytes", 0) or 0)
+        e["pack_us"] += max(float(_field(s, "dur", 0.0)), 0.0) * 1e6
+    for e in agg.values():
+        e["pack_us"] = round(e["pack_us"], 2)
+    return agg
+
+
 def ft_by_rank(spans: Iterable[SpanLike]) -> Dict[str, Any]:
     """Aggregate the resilience plane's ``ft.*`` spans per OBSERVING
     rank (the rank whose detector suspected/declared — each span also
@@ -259,6 +280,9 @@ def summarize(spans: Iterable[SpanLike],
     buck = bucket_flushes_by_reason(spans)
     if buck:
         out["bucket_flush"] = buck
+    shm = shm_seg_by_rank(spans)
+    if shm:
+        out["shm_seg"] = shm
     ftagg = ft_by_rank(spans)
     if ftagg:
         out["ft"] = ftagg

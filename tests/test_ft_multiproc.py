@@ -19,9 +19,10 @@ _PROGS = os.path.join(_REPO, "tests", "perrank_programs")
 _MPIRUN = os.path.join(_REPO, "ompi_tpu", "tools", "mpirun.py")
 
 
-def _run(prog: str, n: int):
+def _run(prog: str, n: int, extra_env: dict | None = None):
     env = {k: v for k, v in os.environ.items()
            if not k.startswith(("JAX_", "XLA_"))}
+    env.update(extra_env or {})
     cmd = [sys.executable, _MPIRUN, "--per-rank", "-n", str(n),
            "--timeout", "150", os.path.join(_PROGS, prog)]
     return subprocess.run(cmd, env=env, capture_output=True, text=True,
@@ -29,12 +30,12 @@ def _run(prog: str, n: int):
 
 
 def _assert_ok(prog: str, n: int, ok: int | None = None,
-               rc: int = 0) -> None:
+               rc: int = 0, extra_env: dict | None = None) -> None:
     """The drill passes when every SURVIVOR prints its OK marker (``ok``
     defaults to all ``n`` ranks) and the job rc is the expected one —
     0 for fault classes nobody dies from, the victim's deterministic
     os._exit code for the kill drill."""
-    res = _run(prog, n)
+    res = _run(prog, n, extra_env)
     assert res.returncode == rc, \
         f"rc={res.returncode} (want {rc})\n--- out\n{res.stdout}\n" \
         f"--- err\n{res.stderr[-4000:]}"
@@ -78,6 +79,22 @@ def test_ft_kill_recovers():
     job rc is the victim's own exit code — the three survivors exit
     clean after their OK markers."""
     _assert_ok("p34_ftdrill.py", 4, ok=3, rc=137)
+
+
+def test_ft_kill_no_shmseg_orphans():
+    """The kill drill with the zero-copy segment plane armed and the
+    threshold at 16 bytes, so the healthy-phase allreduce runs the
+    in-segment fold and every rank — including the victim — maps a
+    /dev/shm workspace. ``os._exit(137)`` never reaches the victim's
+    unlink: the launcher's post-reap sweep must reclaim its files, so
+    a SIGKILLed rank leaks nothing (docs/LARGEMSG.md)."""
+    import glob
+    from ompi_tpu.btl.sm import _SHM_DIR
+    _assert_ok("p34_ftdrill.py", 4, ok=3, rc=137, extra_env={
+        "OMPI_TPU_MCA_mpi_base_shm_zerocopy": "1",
+        "OMPI_TPU_MCA_mpi_base_shm_seg_min_bytes": "16"})
+    assert not glob.glob(os.path.join(_SHM_DIR, "otpuseg_*")), \
+        "SIGKILLed rank leaked /dev/shm segment files past the sweep"
 
 
 def test_ft_detector_false_positive_under_timeout():

@@ -1,0 +1,283 @@
+"""Zero-copy shared-memory data plane (btl/shmseg): segment-pool unit
+coverage, the byte-identical off-gate, reclaim discipline (finalizer ->
+segfree ctl, FT pool reclaim, close/unlink), and the live multi-process
+parity drives (docs/LARGEMSG.md).
+
+The fast tests exercise SegPlane directly — two planes sharing a dict
+KV stand in for two ranks on one host — without spawning processes.
+The ``test_shmfold_*_matches_ring`` pair (the parity contract
+tools/checkparity.py enforces for every coll/decision SHM_FOLDS
+schedule) and the composition matrix (depth sweep, compression,
+rails=2, dropped-peer FT) launch tests/perrank_programs/p42_shmseg.py
+as a real multi-process job and carry the ``slow`` marker."""
+import gc
+import glob
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from ompi_tpu.btl import shmseg
+from ompi_tpu.btl.sm import _SHM_DIR
+from ompi_tpu.mca import var
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_MPIRUN = os.path.join(_REPO, "ompi_tpu", "tools", "mpirun.py")
+_P42 = os.path.join(_REPO, "tests", "perrank_programs",
+                    "p42_shmseg.py")
+
+
+@pytest.fixture()
+def _zc_env():
+    """Zero-copy on with a low threshold; restore every knob after."""
+    keys = {"mpi_base_shm_zerocopy": False,
+            "mpi_base_shm_seg_min_bytes": 256 << 10,
+            "mpi_base_shm_seg_bytes": 32 << 20,
+            "mpi_base_shm_seg_count": 4}
+    saved = {k: var.var_get(k, d) for k, d in keys.items()}
+    var.var_set("mpi_base_shm_zerocopy", True)
+    var.var_set("mpi_base_shm_seg_min_bytes", 1 << 16)
+    yield
+    for k, v in saved.items():
+        var.var_set(k, v)
+
+
+def _two_planes(ctl_log=None):
+    kv = {}
+
+    def ctl(owner, header):
+        if ctl_log is not None:
+            ctl_log.append((owner, dict(header)))
+    a = shmseg.SegPlane(0, kv.__setitem__, kv.get, ctl_send=ctl)
+    b = shmseg.SegPlane(1, kv.__setitem__, kv.get, ctl_send=ctl)
+    return a, b
+
+
+def test_pack_adopt_roundtrip_and_slot_reclaim(_zc_env):
+    """pack -> adopt round-trips bits; dropping the adopted array's
+    last reference fires the finalizer, whose segfree ctl releases the
+    owner's slot."""
+    log = []
+    a, b = _two_planes(log)
+    try:
+        x = np.random.default_rng(0).normal(size=1 << 16) \
+            .astype(np.float64)
+        desc = a.pack(1, memoryview(x).cast("B"))
+        assert desc is not None and desc["o"] == 0
+        got = b.adopt(desc, {"dtype": x.dtype.str, "shape": x.shape})
+        assert np.array_equal(got, x)
+        assert got.flags.writeable     # decode_payload semantics
+        del got
+        gc.collect()
+        assert log and log[-1][0] == 0 \
+            and log[-1][1]["ctl"] == "segfree"
+        a.release(log[-1][1]["peer"], log[-1][1]["i"])
+        # the slot is free again: pool never runs dry on recycled use
+        for _ in range(a.slot_count):
+            d = a.pack(1, b"z" * (1 << 16))
+            assert d is not None
+            a.release(1, d["i"])
+    finally:
+        a.close()
+        b.close()
+
+
+def test_pool_dry_falls_back_then_recovers(_zc_env):
+    """Every slot pinned -> pack returns None (the caller's ring
+    fallback) and counts the fallback pvar; a release un-dries it."""
+    a, b = _two_planes()
+    try:
+        held = [a.pack(1, b"x" * (1 << 16)) for _ in range(a.slot_count)]
+        assert all(d is not None for d in held)
+        n0 = shmseg.stats["no_slot"]
+        assert a.pack(1, b"y" * (1 << 16)) is None
+        assert shmseg.stats["no_slot"] == n0 + 1
+        a.release(1, held[0]["i"])
+        assert a.pack(1, b"y" * (1 << 16)) is not None
+    finally:
+        a.close()
+        b.close()
+
+
+def test_peer_failed_reclaims_pool(_zc_env):
+    """FT reclaim: a dead peer can never send segfree — the whole pool
+    comes back at once."""
+    a, b = _two_planes()
+    try:
+        for _ in range(a.slot_count):
+            assert a.pack(1, b"x" * (1 << 16)) is not None
+        assert a.pack(1, b"x" * (1 << 16)) is None
+        a.peer_failed(1)
+        assert a.pack(1, b"x" * (1 << 16)) is not None
+    finally:
+        a.close()
+        b.close()
+
+
+def test_view_matches_pack_bytes(_zc_env):
+    """The transient pipeseg view reads exactly the packed bytes."""
+    a, b = _two_planes()
+    try:
+        payload = os.urandom(1 << 17)
+        desc = a.pack(1, payload)
+        mv = b.view(desc)
+        assert bytes(mv) == payload
+        mv.release()
+    finally:
+        a.close()
+        b.close()
+
+
+def test_fold_workspace_shared_and_unlinked(_zc_env):
+    """coll_segment/coll_attach share one mapping; close unlinks every
+    created file (the shutdown reclaim the launcher sweep backs up)."""
+    a, b = _two_planes()
+    try:
+        wa = a.coll_segment("t0")
+        wb = b.coll_attach("t0", 0)
+        wa.buf[0:8] = b"deadbeef"
+        assert bytes(wb.buf[0:8]) == b"deadbeef"
+        wb.buf[0:4] = b"feed"            # fold writes go both ways
+        assert bytes(wa.buf[0:4]) == b"feed"
+    finally:
+        a.close()
+        b.close()
+    assert not glob.glob(os.path.join(_SHM_DIR, "otpuseg_*")), \
+        "SegPlane.close leaked /dev/shm segment files"
+
+
+def test_off_gate_and_loopback_decline(_zc_env):
+    """maybe_send_zerocopy never touches the wire when the gate is off,
+    below threshold, for object dtypes, or on loopback — the fallback
+    path is the unchanged (byte-identical) serial path."""
+    from ompi_tpu.pml.perrank import PerRankEngine, Router
+
+    kv = {}
+    router = Router(0, 1, kv.__setitem__, kv.__getitem__)
+    try:
+        class _C:
+            cid = "zc0"
+            size = 2
+
+            def rank(self):
+                return 0
+
+            def world_rank_of(self, r):
+                return 0                 # loopback: every dest is me
+        eng = PerRankEngine(_C(), router)
+        big = np.arange(1 << 18, dtype=np.float32)
+        # loopback declines even with the gate on
+        assert shmseg.maybe_send_zerocopy(eng, big, 1, 5, False) is None
+        var.var_set("mpi_base_shm_zerocopy", False)
+        assert shmseg.maybe_send_zerocopy(eng, big, 1, 5, False) is None
+        var.var_set("mpi_base_shm_zerocopy", True)
+        small = np.arange(8, dtype=np.float32)
+        assert shmseg.maybe_send_zerocopy(eng, small, 1, 5, False) \
+            is None
+        objs = np.array([{"k": 1}, None], dtype=object)
+        assert shmseg.maybe_send_zerocopy(eng, objs, 1, 5, False) \
+            is None
+        # and the serial path still round-trips with no segment files
+        eng.send(big, 1, tag=5)
+        got, _ = eng.recv(source=0, tag=5, timeout=30)
+        assert np.array_equal(np.asarray(got), big)
+        assert not glob.glob(os.path.join(_SHM_DIR, "otpuseg_*"))
+    finally:
+        router.close()
+
+
+def test_decision_rows_gate_on_var(_zc_env):
+    """The shm_fold rows appear in the decision table only while the
+    gate is on (off = byte-identical ring dispatch)."""
+    from ompi_tpu.coll import decision
+    rules = decision.shm_rules()
+    assert decision._match(rules["allreduce"], 2, 1 << 20) == "shm_fold"
+    assert decision._match(rules["allreduce"], 1, 1 << 20) != "shm_fold"
+    assert "shm_fold" in str(decision.decision_table(2)["allreduce"])
+    var.var_set("mpi_base_shm_zerocopy", False)
+    assert decision.shm_rules() == {}
+    assert "shm_fold" not in str(decision.decision_table(2)["allreduce"])
+
+
+def _run_p42(extra_env=None, n=2):
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("JAX_", "XLA_"))}
+    env["OMPI_TPU_MCA_mpi_base_shm_zerocopy"] = "1"
+    env.update(extra_env or {})
+    cmd = [sys.executable, _MPIRUN, "--per-rank", "-n", str(n),
+           "--timeout", "150", _P42]
+    return subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=200, cwd=_REPO)
+
+
+def _assert_ok(res, n=2):
+    assert res.returncode == 0, \
+        f"rc={res.returncode}\n--- out\n{res.stdout}\n--- err\n" \
+        f"{res.stderr[-4000:]}"
+    assert res.stdout.count("OK p42_shmseg") == n, res.stdout
+    assert not glob.glob(os.path.join(_SHM_DIR, "otpuseg_*")), \
+        "job left orphaned /dev/shm segment files"
+
+
+@pytest.mark.slow
+def test_shmfold_allreduce_matches_ring():
+    """2 real ranks: in-segment fold result equals the ring schedules,
+    pvar-asserted adoption + fold inside the program (the checkparity
+    pair for decision.SHM_FOLDS['allreduce'])."""
+    _assert_ok(_run_p42())
+
+
+@pytest.mark.slow
+def test_shm_zerocopy_pipeline_depth_sweep():
+    """shm-zerocopy x pipeline: slots smaller than the payload, so the
+    rail segments pack slot by slot, across pipeline depths."""
+    for depth in ("1", "4"):
+        res = _run_p42({
+            "P42_MODE": "pipe",
+            "OMPI_TPU_MCA_mpi_base_shm_seg_bytes": str(1 << 20),
+            "OMPI_TPU_MCA_mpi_base_pipeline_depth": depth})
+        _assert_ok(res)
+
+
+@pytest.mark.slow
+def test_shm_zerocopy_compression_composition():
+    """shm-zerocopy x compression: the compressed allreduce keeps its
+    claim (the fold yields) and results stay correct."""
+    res = _run_p42({"OMPI_TPU_MCA_mpi_base_compress": "1",
+                    "OMPI_TPU_MCA_mpi_base_compress_min_bytes":
+                        str(1 << 20)})
+    _assert_ok(res)
+
+
+@pytest.mark.slow
+def test_shm_zerocopy_rails2_composition():
+    """shm-zerocopy x multi-rail: rail-striped segments ride shared
+    slots with both rails carrying traffic."""
+    res = _run_p42({
+        "P42_MODE": "pipe",
+        "OMPI_TPU_MCA_mpi_base_shm_seg_bytes": str(1 << 20),
+        "OMPI_TPU_MCA_mpi_base_btl_rails": "2"})
+    _assert_ok(res)
+
+
+@pytest.mark.slow
+def test_shm_zerocopy_ft_drop_parity():
+    """shm-zerocopy x dropped-peer FT: the drop-injection recovery
+    drill (p35) passes unchanged with the segment plane armed, and no
+    segment files leak."""
+    p35 = os.path.join(_REPO, "tests", "perrank_programs",
+                       "p35_ftdrop.py")
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("JAX_", "XLA_"))}
+    env["OMPI_TPU_MCA_mpi_base_shm_zerocopy"] = "1"
+    cmd = [sys.executable, _MPIRUN, "--per-rank", "-n", "2",
+           "--timeout", "150", p35]
+    res = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                         timeout=200, cwd=_REPO)
+    assert res.returncode == 0, \
+        f"rc={res.returncode}\n--- out\n{res.stdout}\n--- err\n" \
+        f"{res.stderr[-4000:]}"
+    assert not glob.glob(os.path.join(_SHM_DIR, "otpuseg_*")), \
+        "FT drill left orphaned /dev/shm segment files"
