@@ -50,6 +50,7 @@ if "--ab-child" in sys.argv or "--perrank-child" in sys.argv \
         or "--pcoll-child" in sys.argv \
         or "--largemsg-child" in sys.argv \
         or "--shm-child" in sys.argv \
+        or "--rma-child" in sys.argv \
         or "--ft-child" in sys.argv \
         or "--telemetry-child" in sys.argv:
     os.environ["JAX_PLATFORMS"] = "cpu"
@@ -1340,6 +1341,146 @@ def _shm_rows() -> dict:
     return out
 
 
+def _rma_child() -> None:
+    """One rank of the 4-process one-sided RMA A/B job (docs/RMA.md),
+    windows on the osc/shm component: the 32 MB one-way Put against
+    the two-sided wire path (Send/Recv with the segment plane OFF —
+    the multi-copy ring; the zero-copy Send/Recv rides alongside for
+    honesty), Win_fence against MPI_Barrier (the fence is an epoch
+    transition plus that very barrier, so the contract bounds it at
+    2x), and the 4-rank fenced accumulate fan-in verified against the
+    numpy reference. The ``osc_puts`` pvar delta evidences that the
+    Puts actually rode the window path. Rank 0 prints one JSON line."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import ompi_tpu as MPI
+    from ompi_tpu.api import mpi as api
+    from ompi_tpu.mca import pvar as _pvar
+    from ompi_tpu.mca import var as _var
+
+    MPI.Init()
+    w = MPI.get_comm_world()
+    r, n = w.rank(), w.size
+    _var.var_set("coll_tuned_stage_min_bytes", 1 << 62)
+
+    mb = 32
+    elems = (mb << 20) // 4
+    p0 = _pvar.pvar_read("osc_puts")
+    win = api.Win_allocate(w, elems, np.float32, name="bench_rma",
+                           force="shm")
+    assert win.component == "shm", win.component
+    win.fence()                          # one open fence epoch
+
+    def put_ms(reps=7):
+        """Median one-way 0->1: a Put is ONE memcpy into the target's
+        mapped segment, complete on return (no ack leg to pay)."""
+        x = np.full(elems, 1.0, np.float32)
+        ts = []
+        for i in range(reps + 1):        # first rep is the warm-up
+            w.barrier()
+            t0 = time.perf_counter()
+            if r == 0:
+                win.put(x, 1)
+            if r == 0 and i:
+                ts.append(time.perf_counter() - t0)
+        if r == 1:
+            assert win.local[0] == 1.0
+        return float(np.median(ts)) * 1e3 if r == 0 else 0.0
+
+    def sendrecv_ms(zerocopy, reps=7):
+        """Median one-way 0->1 over the two-sided path (send + 1-byte
+        ack, _shm_child's protocol), segment plane ON or OFF."""
+        _var.var_set("mpi_base_shm_zerocopy", zerocopy)
+        x = np.full(elems, 1.0, np.float32)
+        ts = []
+        for i in range(reps + 1):
+            w.barrier()
+            t0 = time.perf_counter()
+            if r == 0:
+                w.send(x, 1, 70)
+                w.recv(1, 71)
+            elif r == 1:
+                y = np.asarray(w.recv(0, 70)[0])
+                assert y.nbytes == x.nbytes
+                del y
+                w.send(b"k", 0, 71)
+            if r == 0 and i:
+                ts.append(time.perf_counter() - t0)
+        _var.var_set("mpi_base_shm_zerocopy", True)
+        return float(np.median(ts)) * 1e3 if r == 0 else 0.0
+
+    def sync_ms(fn, reps=30):
+        w.barrier()
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            fn()
+        return (time.perf_counter() - t0) / reps * 1e3
+
+    pm = put_ms()
+    ring = sendrecv_ms(False)
+    zc = sendrecv_ms(True)
+    fence = sync_ms(win.fence)
+    barrier = sync_ms(w.barrier)
+
+    # 4-rank accumulate fan-in: everyone folds 4 MB into rank 0
+    acc_elems = (4 << 20) // 4
+    xr = np.full(acc_elems, float(r + 1), np.float32)
+    win.local[:] = 0.0
+    win.fence()
+    w.barrier()
+    t0 = time.perf_counter()
+    win.accumulate(xr, 0, op="sum")
+    win.fence()
+    acc = (time.perf_counter() - t0) * 1e3
+    acc_ok = bool(r != 0 or np.allclose(
+        win.local[:acc_elems], n * (n + 1) / 2, rtol=1e-5))
+
+    puts = np.asarray(w.gather(np.array(
+        [_pvar.pvar_read("osc_puts") - p0], np.int64), 0))
+    oks = np.asarray(w.gather(np.array([int(acc_ok)], np.int64), 0))
+    win.free()
+    w.barrier()
+    MPI.Finalize()
+    if r == 0:
+        print(json.dumps({
+            "ranks": n,
+            "component": "shm",
+            "put_32MB": {
+                "put_ms": round(pm, 2),
+                "sendrecv_ring_ms": round(ring, 2),
+                "sendrecv_zerocopy_ms": round(zc, 2),
+                "speedup_vs_ring": round(ring / pm, 2) if pm else None,
+                "speedup_vs_zerocopy": round(zc / pm, 2)
+                if pm else None,
+                "put_gbps": round((mb * (1 << 20)) / (pm / 1e3) / 1e9,
+                                  2) if pm else None},
+            "sync": {
+                "fence_ms": round(fence, 4),
+                "barrier_ms": round(barrier, 4),
+                "fence_vs_barrier": round(fence / barrier, 2)
+                if barrier else None},
+            "acc_fanin_4MB": {
+                "ms": round(acc, 2),
+                "correct": bool(oks.sum() == n)},
+            "osc_puts": int(puts.sum()),
+        }), flush=True)
+
+
+def _rma_rows() -> dict:
+    """The --rma section: one 4-rank per-rank job on the osc/shm
+    component (docs/RMA.md). The 32 MB Put >= 3x the two-sided ring,
+    Win_fence <= 2x MPI_Barrier, and the accumulate fan-in's numpy
+    parity carry the acceptance contract, evidenced by the osc_puts
+    pvar delta."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    mpirun = os.path.join(here, "ompi_tpu", "tools", "mpirun.py")
+    return {"4rank": _child_json(
+        [sys.executable, mpirun, "--per-rank", "-n", "4",
+         "--timeout", "360",
+         sys.executable, os.path.abspath(__file__), "--rma-child"],
+        420, _child_env())}
+
+
 def _ft_child() -> None:
     """One rank of the 4-process resilience drill (docs/RESILIENCE.md):
     the heartbeat detector is on and ft/inject kills rank 2 at its 2nd
@@ -1661,6 +1802,12 @@ def main() -> None:
                          "pt2pt + the 32 MB allreduce fold on 2- and "
                          "8-rank per-rank jobs (docs/LARGEMSG.md)")
     ap.add_argument("--shm-child", action="store_true")
+    ap.add_argument("--rma", action="store_true",
+                    help="measure the one-sided RMA rows: 32 MB Put "
+                         "vs Send/Recv, Win_fence vs MPI_Barrier, and "
+                         "the 4-rank accumulate fan-in on an osc/shm "
+                         "per-rank job (docs/RMA.md)")
+    ap.add_argument("--rma-child", action="store_true")
     ap.add_argument("--ft", action="store_true",
                     help="run the resilience drill: 4-process kill "
                          "drill under the heartbeat detector — "
@@ -1707,6 +1854,9 @@ def main() -> None:
         return
     if args.shm_child:
         _shm_child()
+        return
+    if args.rma_child:
+        _rma_child()
         return
     if args.ft_child:
         _ft_child()
@@ -1944,6 +2094,10 @@ def main() -> None:
     # children, not through this process's config
     shm_rows = _shm_rows() if (args.shm and n == 1) else None
 
+    # ---- one-sided RMA rows (--rma) ---------------------------------
+    # explicit opt-in like --shm: the A/B lives in the 4-rank child
+    rma_rows = _rma_rows() if (args.rma and n == 1) else None
+
     # ---- resilience-plane drill rows (--ft) -------------------------
     # explicit opt-in flag, so --no-ab (which skips the implicit
     # children) does not gate it
@@ -2006,6 +2160,7 @@ def main() -> None:
         **({"largemsg": largemsg_rows}
            if largemsg_rows is not None else {}),
         **({"shm": shm_rows} if shm_rows is not None else {}),
+        **({"rma": rma_rows} if rma_rows is not None else {}),
         **({"ft": ft_rows} if ft_rows is not None else {}),
         **({"lint": lint_rows} if lint_rows is not None else {}),
         **({"telemetry": telemetry_rows}
@@ -2130,6 +2285,19 @@ def main() -> None:
             contract["shm_allreduce_32m_speedup"] = (
                 j8.get("allreduce_32MB") or {}).get("speedup")
             contract["shm_fold_ops"] = j8.get("fold_ops")
+    if rma_rows is not None:
+        # the one-sided acceptance rows (docs/RMA.md): 32 MB Put >= 3x
+        # the two-sided ring, Win_fence <= 2x MPI_Barrier, accumulate
+        # fan-in numpy-correct — osc_puts pvar-evidenced
+        j4 = rma_rows.get("4rank") or {}
+        if isinstance(j4, dict) and "error" not in j4:
+            contract["rma_put_32m_speedup"] = (
+                j4.get("put_32MB") or {}).get("speedup_vs_ring")
+            contract["rma_fence_vs_barrier"] = (
+                j4.get("sync") or {}).get("fence_vs_barrier")
+            contract["rma_acc_fanin_correct"] = (
+                j4.get("acc_fanin_4MB") or {}).get("correct")
+            contract["rma_osc_puts"] = j4.get("osc_puts")
     if ft_rows is not None:
         # the resilience acceptance rows (docs/RESILIENCE.md): the
         # heartbeat detector's latency bound and the post-shrink

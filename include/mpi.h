@@ -236,9 +236,14 @@ typedef long MPI_Message;                /* matched-probe messages */
 #define MPI_ERR_SIZE      20
 #define MPI_ERR_NO_MEM    21
 #define MPI_ERR_DUP_DATAREP 22
+#define MPI_ERR_WIN       45
+#define MPI_ERR_BASE      46
+#define MPI_ERR_LOCKTYPE  47
+#define MPI_ERR_RMA_CONFLICT 49
 #define MPI_ERR_PORT      51
 #define MPI_ERR_SERVICE   52
 #define MPI_ERR_NAME      53
+#define MPI_ERR_RMA_SYNC  54
 #define MPI_ERR_REVOKED   72
 #define MPI_ERR_PROC_FAILED 75
 #define MPI_ERR_LASTCODE  100

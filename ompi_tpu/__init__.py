@@ -36,6 +36,7 @@ from ompi_tpu.api.mpi import (  # noqa: F401
     IN_PLACE, UNDEFINED, ANY_SOURCE, ANY_TAG, PROC_NULL, ROOT, KEYVAL_INVALID,
     SUCCESS, ERR_COMM, ERR_TYPE, ERR_OP, ERR_ARG, ERR_COUNT, ERR_BUFFER,
     ERR_RANK, ERR_ROOT, ERR_TRUNCATE, ERR_PENDING, ERR_REVOKED, ERR_PROC_FAILED,
+    ERR_WIN, ERR_BASE, ERR_LOCKTYPE, ERR_RMA_CONFLICT, ERR_RMA_SYNC,
     CONGRUENT, IDENT, SIMILAR, UNEQUAL,
     THREAD_SINGLE, THREAD_FUNNELED, THREAD_SERIALIZED, THREAD_MULTIPLE,
     COMM_TYPE_SHARED, COMM_TYPE_HWTHREAD, COMM_TYPE_NUMA,

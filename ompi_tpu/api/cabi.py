@@ -2536,11 +2536,16 @@ def win_allocate(nbytes: int, disp_unit: int, h: int
                  ) -> Tuple[int, int]:
     """Returns (window handle, base address). The base points at the
     window's byte storage inside the embedded interpreter — stable for
-    the window's lifetime (handlers mutate it in place)."""
-    from ompi_tpu.osc.perrank import RankWindow
+    the window's lifetime (handlers mutate it in place). Allocation
+    goes through the osc framework's selection step: same-host
+    communicators get an osc/shm window (the base address then points
+    INTO the /dev/shm segment peers map directly), everything else
+    gets the osc/pt2pt emulation — with the epoch state machine, FT
+    and telemetry planes wrapped around either (docs/RMA.md)."""
+    from ompi_tpu.osc.window import win_allocate as _osc_allocate
     c = _comm(h)
-    win = RankWindow(c, max(int(nbytes), 1), dtype=np.uint8,
-                     name=f"cabi_win{nbytes}")
+    win = _osc_allocate(c, max(int(nbytes), 1), dtype=np.uint8,
+                        name=f"cabi_win{nbytes}")
     # displacement scaling uses the TARGET's declared unit (they may
     # legitimately differ per rank — the same reason RankWindow
     # allgathers per-rank sizes)
@@ -2556,13 +2561,14 @@ def win_create(h: int, base_view, disp_unit: int) -> int:
     """MPI_Win_create (win_create.c.in:79): the CALLER's memory is the
     exposure region — remote puts applied by the reader thread land
     directly in the C program's buffer, so its plain loads observe
-    them after the synchronization call (the osc/sm model)."""
-    from ompi_tpu.osc.perrank import RankWindow
+    them after the synchronization call (the osc/sm model). Caller
+    memory pins the selection to osc/pt2pt — it cannot be
+    retroactively placed in a /dev/shm segment."""
+    from ompi_tpu.osc.window import win_create as _osc_create
     c = _comm(h)
     storage = np.frombuffer(base_view, dtype=np.uint8)
-    win = RankWindow(c, storage.size, dtype=np.uint8,
-                     name=f"cabi_wincreate{storage.size}",
-                     storage=storage)
+    win = _osc_create(c, storage,
+                      name=f"cabi_wincreate{storage.size}")
     win._disp_units = [int(u) for u in
                        c.allgather(np.int64(max(int(disp_unit), 1)))]
     with _lock:

@@ -25,10 +25,11 @@ from ompi_tpu.core.datatype import (  # noqa: F401
     UINT16_T, UINT32_T, UINT64_T, UNSIGNED, UNSIGNED_LONG,
     from_numpy_dtype)
 from ompi_tpu.core.errhandler import (  # noqa: F401
-    ERR_ARG, ERR_BUFFER, ERR_COMM, ERR_COUNT, ERR_OP, ERR_PENDING,
-    ERR_PROC_FAILED, ERR_RANK, ERR_REVOKED, ERR_ROOT, ERR_TRUNCATE, ERR_TYPE,
-    ERRORS_ABORT, ERRORS_ARE_FATAL, ERRORS_RETURN, Errhandler, MPIError,
-    SUCCESS, error_string)
+    ERR_ARG, ERR_BASE, ERR_BUFFER, ERR_COMM, ERR_COUNT, ERR_LOCKTYPE,
+    ERR_OP, ERR_PENDING, ERR_PROC_FAILED, ERR_RANK, ERR_REVOKED,
+    ERR_RMA_CONFLICT, ERR_RMA_SYNC, ERR_ROOT, ERR_TRUNCATE, ERR_TYPE,
+    ERR_WIN, ERRORS_ABORT, ERRORS_ARE_FATAL, ERRORS_RETURN, Errhandler,
+    MPIError, SUCCESS, error_string)
 from ompi_tpu.core.group import (CONGRUENT, Group, IDENT, SIMILAR,  # noqa: F401
                                  UNDEFINED, UNEQUAL)
 from ompi_tpu.core.info import INFO_ENV, INFO_NULL, Info  # noqa: F401
@@ -64,6 +65,12 @@ COMM_NULL = None
 
 from ompi_tpu.osc.framework import (LOCK_EXCLUSIVE, LOCK_SHARED,  # noqa: F401,E402
                                     Win)
+# the per-rank one-sided framework (MPI_Win_allocate/Win_create with
+# component selection — osc/shm same-host windows, osc/pt2pt
+# emulation; docs/RMA.md)
+from ompi_tpu.osc.window import (RmaWindow,  # noqa: F401,E402
+                                 win_allocate as Win_allocate,
+                                 win_create as Win_create)
 
 
 # lifecycle ---------------------------------------------------------------

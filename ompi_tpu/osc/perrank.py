@@ -52,6 +52,11 @@ _ACC_OPS = {
 class RankWindow:
     """An RMA window whose caller is one rank (collective creation)."""
 
+    # osc framework component name (osc/pt2pt is the emulation over
+    # the acked active-message plane — this class IS that component;
+    # osc/shm subclasses it and overrides the data ops)
+    component = "pt2pt"
+
     def __init__(self, comm, size: int, dtype=np.float32,
                  name: str = "", storage: Optional[np.ndarray] = None):
         """``storage``: use the CALLER's memory as the exposure region
@@ -286,8 +291,42 @@ class RankWindow:
         self._pscw_origins = []
 
     def free(self) -> None:
-        self.comm.barrier()
-        self.comm.router.unregister_rma(self.wid)
+        # the completion barrier can raise over a dead/revoked peer
+        # (ULFM free); the handler must unregister regardless or the
+        # router keeps dispatching frames into a freed window
+        try:
+            self.comm.barrier()
+        finally:
+            self.comm.router.unregister_rma(self.wid)
+
+    def peer_failed(self, world_rank: int) -> None:
+        """FT reclaim hook (osc/window wires it to the ft registry):
+        a dead origin can never send its unlock, so purge it from the
+        passive-lock queue and hand its grant to the next waiter —
+        otherwise one SIGKILL wedges every survivor's Win_lock."""
+        grants = []
+        with self._lock:
+            self._holders = [(o, t) for (o, t) in self._holders
+                             if o != world_rank]
+            self._waiters = [(o, t, a) for (o, t, a) in self._waiters
+                             if o != world_rank]
+            while self._waiters:
+                o, t, a = self._waiters[0]
+                ok = (not self._holders if t == LOCK_EXCLUSIVE
+                      else all(ht == LOCK_SHARED
+                               for _, ht in self._holders))
+                if not ok:
+                    break
+                self._waiters.pop(0)
+                self._holders.append((o, t))
+                grants.append((o, a))
+                if t == LOCK_EXCLUSIVE:
+                    break
+        for o, a in grants:
+            try:
+                self.comm.router.send_ack(o, a)
+            except Exception:            # noqa: BLE001 — a grant to a
+                pass                     # failing peer is best-effort
 
     def _bounds(self, disp: int, count: int,
                 target: Optional[int] = None) -> None:

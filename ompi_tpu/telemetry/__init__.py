@@ -312,10 +312,25 @@ def snapshot_hists(include_empty: bool = False) -> List[Dict[str, Any]]:
     return out
 
 
+def _osc_counters() -> Optional[Dict[str, int]]:
+    """The one-sided plane's op/byte counters, when RMA ran at all —
+    mpitop's ``osc`` section merges these per rank (the latency
+    histograms ride ``hists`` like every other plane's)."""
+    try:
+        from ompi_tpu.osc import base as _osc_base
+        s = _osc_base.stats
+        if not any(s.values()):
+            return None
+        return {k: int(v) for k, v in s.items()}
+    except Exception:                    # noqa: BLE001 — the dump
+        return None                      # must never fail on a plane
+
+
 def dump(path: str, rank: Optional[int] = None) -> str:
     """Persist this process's telemetry for tools/mpitop to merge:
-    ``{"telemetry": 1, "rank", "hists", "health"}`` (the flight
-    recorder writes a richer sibling format, telemetry/flightrec)."""
+    ``{"telemetry": 1, "rank", "hists", "health"[, "osc"]}`` (the
+    flight recorder writes a richer sibling format,
+    telemetry/flightrec)."""
     if rank is None:
         from ompi_tpu import trace as _trace
         rank = _trace.process_rank()
@@ -324,6 +339,9 @@ def dump(path: str, rank: Optional[int] = None) -> str:
                "time": time.time(),
                "hists": snapshot_hists(),
                "health": _health.scores_snapshot()}
+    osc = _osc_counters()
+    if osc:
+        payload["osc"] = osc
     with open(path, "w") as f:
         json.dump(payload, f)
     return path

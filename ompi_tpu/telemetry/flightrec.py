@@ -97,6 +97,13 @@ def snapshot(trigger: str, detail: Optional[Dict[str, Any]] = None,
                       (_safe(_ft.default_registry().events, []) or [])],
         "health": _safe(_health.scores_snapshot, {}) or {},
     }
+    # open one-sided epochs (osc/base live-window registry): which
+    # windows had fence/lock/PSCW epochs open at the trigger — the
+    # rma_sync / proc-failed post-mortem's first question
+    def _osc_epochs():
+        from ompi_tpu.osc import base as _osc_base
+        return _osc_base.open_epoch_state()
+    payload["osc_epochs"] = _safe(_osc_epochs, []) or []
     # the coll decision-table state (api/tool) — which algorithm each
     # size class would take right now; advisory, skipped on any error
     try:

@@ -33,13 +33,21 @@ Invariants the lossy/fused subsystems must never lose
    ``test_ft_<class>_recovers`` somewhere under ``tests/``. An
    injectable fault without its recovery test is an unverified
    failure mode (docs/RESILIENCE.md).
-5. **Tier-1 budget**: compression/persistent/large-message/FT tests
-   that spawn real OS processes (``subprocess``-using test functions
-   in ``tests/test_compress*`` / ``tests/test_persistent*`` /
-   ``tests/test_largemsg*`` / ``tests/test_btl_rails*`` /
-   ``tests/test_ft*``) carry the ``slow`` marker, so the
+5. **Tier-1 budget**: compression/persistent/large-message/FT/osc
+   tests that spawn real OS processes (``subprocess``-using test
+   functions in ``tests/test_compress*`` / ``tests/test_persistent*``
+   / ``tests/test_largemsg*`` / ``tests/test_btl_rails*`` /
+   ``tests/test_ft*`` / ``tests/test_osc*``) carry the ``slow``
+   marker, so the
    multi-process jobs stay out of the ``-m 'not slow'`` tier-1 run
    and its 870 s wall budget.
+7. **One-sided parity**: every osc framework op
+   (``osc.base.OSC_OPS``: put / get / accumulate) has a component
+   parity pair — ``test_osc_<op>_matches_pt2pt`` somewhere under
+   ``tests/``, asserting the shm component, the pt2pt emulation and a
+   two-sided reference computation agree. A load/store RMA rewrite
+   without its equivalence test is an unverified memory path
+   (docs/RMA.md).
 6. **Lint-rule fixture parity**: every static rule the analyzer ships
    (``analyze.mpilint.RULES``) has a fixture PAIR
    (``tests/fixtures/lint/bad_<rule>.py`` that must fire it and
@@ -123,6 +131,7 @@ def audit(tests_dir: Optional[str] = None) -> Dict[str, Any]:
     from ompi_tpu.coll.decision import PIPELINED, SHM_FOLDS
     from ompi_tpu.coll.persistent import FUSED_FUNCS, PERSISTENT_FUNCS
     from ompi_tpu.ft.inject import FAULT_CLASSES
+    from ompi_tpu.osc.base import OSC_OPS
 
     wanted = {f"test_compressed_{func}_matches_uncompressed": func
               for func in WRAPPED_FUNCS}
@@ -136,7 +145,10 @@ def audit(tests_dir: Optional[str] = None) -> Dict[str, Any]:
                   for func in SHM_FOLDS}
     wanted_ft = {f"test_ft_{cls}_recovers": cls
                  for cls in FAULT_CLASSES}
+    wanted_osc = {f"test_osc_{op}_matches_pt2pt": op
+                  for op in OSC_OPS}
     found: set = set()
+    found_osc: set = set()
     found_pers: set = set()
     found_pipe: set = set()
     found_shm: set = set()
@@ -165,12 +177,14 @@ def audit(tests_dir: Optional[str] = None) -> Dict[str, Any]:
                 found_shm.add(name)
             if name in wanted_ft:
                 found_ft.add(name)
+            if name in wanted_osc:
+                found_osc.add(name)
             for rule in RULES:
                 if f"lint_{rule}" in name:
                     found_lint.add(rule)
             if base.startswith(("test_compress", "test_persistent",
                                 "test_largemsg", "test_btl_rails",
-                                "test_ft")) \
+                                "test_ft", "test_osc")) \
                     and _uses_subprocess(node) \
                     and not (mod_slow or _has_slow_mark(node)):
                 unmarked.append(f"{base}::{name}")
@@ -179,10 +193,12 @@ def audit(tests_dir: Optional[str] = None) -> Dict[str, Any]:
     missing_pipe = sorted(set(wanted_pipe) - found_pipe)
     missing_shm = sorted(set(wanted_shm) - found_shm)
     missing_ft = sorted(set(wanted_ft) - found_ft)
+    missing_osc = sorted(set(wanted_osc) - found_osc)
     missing_lint = sorted(f"test *lint_{r}* (fixture-pair test)"
                           for r in set(RULES) - found_lint)
     return {"ok": not missing and not missing_pers and not missing_pipe
-            and not missing_shm and not missing_ft and not unmarked
+            and not missing_shm and not missing_ft and not missing_osc
+            and not unmarked
             and not missing_fixtures and not missing_lint,
             "wrapped_funcs": list(WRAPPED_FUNCS),
             "persistent_funcs": list(PERSISTENT_FUNCS),
@@ -190,12 +206,14 @@ def audit(tests_dir: Optional[str] = None) -> Dict[str, Any]:
             "pipelined_funcs": sorted(PIPELINED),
             "shm_fold_funcs": sorted(SHM_FOLDS),
             "fault_classes": list(FAULT_CLASSES),
+            "osc_ops": list(OSC_OPS),
             "lint_rules": sorted(RULES),
             "missing_parity": missing,
             "missing_persistent_parity": missing_pers,
             "missing_pipeline_parity": missing_pipe,
             "missing_shm_fold_parity": missing_shm,
             "missing_ft_recovery": missing_ft,
+            "missing_osc_parity": missing_osc,
             "missing_lint_fixtures": missing_fixtures,
             "missing_lint_tests": missing_lint,
             "unmarked_slow": sorted(unmarked)}

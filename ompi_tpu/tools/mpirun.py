@@ -150,8 +150,12 @@ def _sweep_shm(coord: str) -> None:
         from ompi_tpu.btl.shmseg import SEG_PREFIX
     except Exception:                    # noqa: BLE001
         SEG_PREFIX = "otpuseg"
+    try:
+        from ompi_tpu.osc.shm import WIN_PREFIX
+    except Exception:                    # noqa: BLE001
+        WIN_PREFIX = "otpuwin"
     tag = tag_for(coord)
-    for prefix in ("otpusm", SEG_PREFIX):
+    for prefix in ("otpusm", SEG_PREFIX, WIN_PREFIX):
         for path in glob.glob(os.path.join(_SHM_DIR,
                                            f"{prefix}_{tag}_*")):
             try:

@@ -6,7 +6,10 @@ collective p50/p99, pml send/recv p99, operation and byte throughput,
 and the straggler score its PEERS assign it (health-monitor scores are
 accusations: rank 0's snapshot scores rank 1, so a rank's column is
 the worst accusation against it). ``--per-comm`` expands rows to
-(rank, comm) using the histogram labels.
+(rank, comm) using the histogram labels. When the one-sided plane ran,
+an ``osc`` section follows the table: per-origin put/get/accumulate
+counts and bytes, the ``tele_osc_*`` p99s, epoch-boundary counts, and
+RMA_SYNC / torn-epoch flags (docs/RMA.md).
 
 The ``slow_rank`` election mirrors the flight recorder's: the most
 straggler-declared/accused rank wins; with no accusations, the rank
@@ -125,6 +128,37 @@ def summarize(snaps: List[Dict[str, Any]],
                 row["comm"] = comm
             rows.append(row)
 
+    # the one-sided plane: per-origin op/byte counters from the dump's
+    # ``osc`` block, latencies from the tele_osc_* histograms — present
+    # only when RMA ran at all (docs/RMA.md)
+    osc_rows: List[Dict[str, Any]] = []
+    for d in sorted(snaps, key=lambda s: int(s.get("rank", -1))):
+        o = d.get("osc") or {}
+        if not o:
+            continue
+        hists = d.get("hists") or []
+        put = _merge_named(
+            hists, lambda h: h.get("name") == "tele_osc_put_us")
+        get = _merge_named(
+            hists, lambda h: h.get("name") == "tele_osc_get_us")
+        acc = _merge_named(
+            hists, lambda h: h.get("name") == "tele_osc_acc_us")
+        osc_rows.append({
+            "rank": int(d.get("rank", -1)),
+            "puts": int(o.get("puts", 0)),
+            "gets": int(o.get("gets", 0)),
+            "accs": int(o.get("accs", 0)),
+            "bytes": int(o.get("put_bytes", 0))
+            + int(o.get("get_bytes", 0)) + int(o.get("acc_bytes", 0)),
+            "put_p99_us": put["p99"],
+            "get_p99_us": get["p99"],
+            "acc_p99_us": acc["p99"],
+            "fences": int(o.get("fences", 0)),
+            "locks": int(o.get("locks", 0)),
+            "epoch_errors": int(o.get("epoch_errors", 0)),
+            "ft_failed_epochs": int(o.get("ft_failed_epochs", 0)),
+        })
+
     slow: Optional[int] = None
     if declared:
         slow = max(sorted(declared), key=lambda r: declared[r])
@@ -137,11 +171,14 @@ def summarize(snaps: List[Dict[str, Any]],
                       float(row["send_p99_us"]))
             if own > worst:
                 worst, slow = own, int(row["rank"])
-    return {"mpitop": 1, "rows": rows, "slow_rank": slow,
-            "accusations": {str(r): s
-                            for r, s in sorted(accusations.items())},
-            "declared": {str(r): n
-                         for r, n in sorted(declared.items())}}
+    out = {"mpitop": 1, "rows": rows, "slow_rank": slow,
+           "accusations": {str(r): s
+                           for r, s in sorted(accusations.items())},
+           "declared": {str(r): n
+                        for r, n in sorted(declared.items())}}
+    if osc_rows:
+        out["osc"] = osc_rows
+    return out
 
 
 def _fmt_us(v: float) -> str:
@@ -188,6 +225,30 @@ def render_table(summary: Dict[str, Any],
         lines.append("  ".join(c.ljust(w)
                                for c, w in zip(cells, widths)))
     lines.append(f"slow_rank: {summary['slow_rank']}")
+    if summary.get("osc"):
+        lines.append("")
+        ohdr = ["rank", "puts", "gets", "accs", "bytes", "put_p99",
+                "get_p99", "acc_p99", "fences", "locks", "flags"]
+        otab = []
+        owid = [len(h) for h in ohdr]
+        for o in summary["osc"]:
+            oflags = []
+            if o["epoch_errors"]:
+                oflags.append(f"RMA_SYNC(x{o['epoch_errors']})")
+            if o["ft_failed_epochs"]:
+                oflags.append(f"FT_EPOCH(x{o['ft_failed_epochs']})")
+            cells = [str(o["rank"]), str(o["puts"]), str(o["gets"]),
+                     str(o["accs"]), str(o["bytes"]),
+                     _fmt_us(o["put_p99_us"]), _fmt_us(o["get_p99_us"]),
+                     _fmt_us(o["acc_p99_us"]), str(o["fences"]),
+                     str(o["locks"]), " ".join(oflags) or "-"]
+            otab.append(cells)
+            owid = [max(w, len(c)) for w, c in zip(owid, cells)]
+        lines.append("osc (one-sided):")
+        lines.append("  ".join(h.ljust(w) for h, w in zip(ohdr, owid)))
+        for cells in otab:
+            lines.append("  ".join(c.ljust(w)
+                                   for c, w in zip(cells, owid)))
     return "\n".join(lines)
 
 

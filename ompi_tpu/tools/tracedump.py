@@ -8,9 +8,11 @@ one timebase and emits either a Perfetto-loadable JSON
 late-arrival attribution report (``--format report``), the compact
 summary (``--format summary``; includes per-rank ``compress.quant`` /
 ``compress.dequant`` time aggregation when compressed collectives ran
-— docs/COMPRESSION.md — and per-rank ``ft.*`` suspicion/declaration
+— docs/COMPRESSION.md — per-rank ``ft.*`` suspicion/declaration
 aggregation when the resilience plane saw action —
-docs/RESILIENCE.md), or the flight-recorder incident report
+docs/RESILIENCE.md — and per-origin ``osc.*`` op/byte/epoch
+aggregation when the one-sided plane ran — docs/RMA.md), or the
+flight-recorder incident report
 (``--format flightrec``: merges ``flightrec_<rank>.json`` snapshots
 written by the telemetry plane's fault flight recorder and names the
 critical rank — docs/OBSERVABILITY.md).

@@ -250,6 +250,41 @@ def ft_by_rank(spans: Iterable[SpanLike]) -> Dict[str, Any]:
     return agg
 
 
+def osc_by_rank(spans: Iterable[SpanLike]) -> Dict[str, Any]:
+    """Aggregate the one-sided plane's ``osc.*`` spans per ORIGIN rank
+    (RMA is origin-driven; the target never traces — docs/RMA.md):
+    put/get/accumulate counts, the bytes they moved, their origin-side
+    time, and the epoch-boundary crossings (``osc.epoch`` spans:
+    fence/lock/unlock/PSCW/free). Empty dict when the RMA plane never
+    ran — the summary omits the section entirely."""
+    agg: Dict[str, Dict[str, Any]] = {}
+    for s in spans:
+        name = str(_field(s, "name", "?"))
+        if not name.startswith("osc."):
+            continue
+        args = _field(s, "args", None) or {}
+        rank = str(int(_field(s, "rank", -1)))
+        e = agg.setdefault(rank, {"puts": 0, "gets": 0, "accs": 0,
+                                  "bytes": 0, "op_us": 0.0,
+                                  "epochs": 0, "epoch_us": 0.0})
+        dur = max(float(_field(s, "dur", 0.0)), 0.0) * 1e6
+        if name == "osc.epoch":
+            e["epochs"] += 1
+            e["epoch_us"] += dur
+            continue
+        kind = name.split(".", 1)[1]     # put / get / acc
+        if kind in ("put", "get"):
+            e[f"{kind}s"] += 1
+        else:
+            e["accs"] += 1
+        e["bytes"] += int(args.get("bytes", 0) or 0)
+        e["op_us"] += dur
+    for e in agg.values():
+        e["op_us"] = round(e["op_us"], 2)
+        e["epoch_us"] = round(e["epoch_us"], 2)
+    return agg
+
+
 def summarize(spans: Iterable[SpanLike],
               stats: Optional[Mapping[str, int]] = None,
               top: int = 5) -> Dict[str, Any]:
@@ -286,6 +321,9 @@ def summarize(spans: Iterable[SpanLike],
     ftagg = ft_by_rank(spans)
     if ftagg:
         out["ft"] = ftagg
+    osc = osc_by_rank(spans)
+    if osc:
+        out["osc"] = osc
     if reports:
         out["late_arrival_top"] = reports[:top]
     return out

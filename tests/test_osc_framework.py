@@ -1,0 +1,594 @@
+"""The osc framework: component parity, epochs, selection, FT, pvars.
+
+The fast tests run BOTH osc components in one process over a loopback
+harness: every fake rank owns a FakeRouter whose endpoint delivers
+frames synchronously to the destination's registered window handler
+(the Router rma/ack dispatch reduced to a function call), and the KV
+is a shared dict — so ``osc/shm`` maps real /dev/shm segments and
+``osc/pt2pt`` runs its real encode/decode RPC path, with no
+subprocesses inside tier-1 (checkparity rule 5).
+
+Rule 7 (tools/checkparity.py): every op in ``osc.base.OSC_OPS`` has a
+``test_osc_<op>_matches_pt2pt`` parity pair here — shm component vs
+pt2pt emulation vs a two-sided numpy reference.
+
+The subprocess drills (4-rank fenced ring, passive-lock drill on both
+components, the SIGKILL exposure-epoch FT drill, the orphan sweep) are
+slow-marked at the bottom.
+"""
+from __future__ import annotations
+
+import glob
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ompi_tpu.btl.sm import _SHM_DIR
+from ompi_tpu.core.errhandler import (ERR_PROC_FAILED, ERR_RMA_SYNC,
+                                      ERR_WIN, MPIError)
+from ompi_tpu.mca import pvar as _pvar
+from ompi_tpu.mca import var as _var
+from ompi_tpu.osc import base as _base
+from ompi_tpu.osc import decision as _decision
+from ompi_tpu.osc.perrank import LOCK_EXCLUSIVE
+from ompi_tpu.osc.shm import WIN_PREFIX
+from ompi_tpu.osc.window import win_allocate, win_create
+from ompi_tpu.runtime import ft as _ft
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- the loopback harness ----------------------------------------------------
+class FakeEndpoint:
+    def __init__(self, net, rank):
+        self._net = net
+        self.rank = rank
+
+    def _is_same_host(self, peer: int) -> bool:
+        return True
+
+    def send_frame(self, wdest: int, header: dict, raw: bytes) -> None:
+        self._net[wdest]._deliver(dict(header), bytes(raw))
+
+
+class FakeRouter:
+    """The Router surface RankWindow/ShmWindow need, synchronous."""
+
+    def __init__(self, net, kv, rank):
+        self.rank = rank
+        self._net = net
+        self._kv = kv
+        self._rma = {}
+        self._acks = {}
+        self._aid = 0
+        self.endpoint = FakeEndpoint(net, rank)
+        net[rank] = self
+
+    def kv_set(self, key, val):
+        self._kv[key] = val
+
+    def kv_get(self, key):
+        return self._kv.get(key)
+
+    def new_ack(self):
+        self._aid += 1
+        ent = [threading.Event(), None]
+        self._acks[self._aid] = ent
+        return self._aid, ent
+
+    def cancel_ack(self, aid):
+        self._acks.pop(aid, None)
+
+    def register_rma(self, wid, handler):
+        self._rma[wid] = handler
+
+    def unregister_rma(self, wid):
+        self._rma.pop(wid, None)
+
+    def send_ack(self, world_rank, ack_id, reply=None):
+        from ompi_tpu.btl.tcp import encode_payload
+        header = {"ctl": "ack", "ack_id": ack_id}
+        raw = b""
+        if reply is not None:
+            header["desc"], raw = encode_payload(reply)
+        self.endpoint.send_frame(world_rank, header, raw)
+
+    def _deliver(self, header, raw):
+        from ompi_tpu.btl.tcp import decode_payload
+        if header.get("ctl") == "ack":
+            ent = self._acks.pop(header["ack_id"], None)
+            if ent is not None:
+                if "desc" in header:
+                    ent[1] = decode_payload(header["desc"], raw)
+                ent[0].set()
+            return
+        if "rma" in header:
+            h = self._rma.get(header["wid"])
+            if h is not None:
+                h(header, raw)
+
+
+class FakeComm:
+    """One fake rank's communicator: collectives degenerate because
+    the harness is single-threaded and window sizes are uniform."""
+
+    def __init__(self, rank, size, net, kv, cid):
+        self.cid = cid
+        self.size = size
+        self._rank = rank
+        self.router = FakeRouter(net, kv, rank)
+
+    def rank(self):
+        return self._rank
+
+    def world_rank_of(self, r):
+        return r
+
+    def allgather(self, value):
+        return [value] * self.size
+
+    def barrier(self):
+        pass
+
+
+_CID = [0]
+
+
+def _world(n, size, comp, dtype=np.float32):
+    """n fake ranks, one window each on component ``comp``."""
+    _CID[0] += 1
+    net, kv = {}, {}
+    comms = [FakeComm(r, n, net, kv, f"fake{_CID[0]}")
+             for r in range(n)]
+    wins = [win_allocate(c, size, dtype, force=comp) for c in comms]
+    return comms, wins
+
+
+def _free_all(wins):
+    for w in wins:
+        w.free()
+
+
+# -- rule 7 parity pairs -----------------------------------------------------
+def _run_put_pattern(comp):
+    """Every rank puts its ramp into its right neighbor at disp=rank."""
+    n, size = 3, 16
+    _comms, wins = _world(n, size, comp)
+    try:
+        for w in wins:
+            w.fence()
+        for r, w in enumerate(wins):
+            w.put(np.arange(4, dtype=np.float32) + 10 * r,
+                  (r + 1) % n, disp=r)
+        for w in wins:
+            w.fence()
+        return [np.array(w.local, copy=True) for w in wins]
+    finally:
+        _free_all(wins)
+
+
+def test_osc_put_matches_pt2pt():
+    ref = [np.zeros(16, np.float32) for _ in range(3)]
+    for r in range(3):                   # the two-sided reference
+        ref[(r + 1) % 3][r:r + 4] = \
+            np.arange(4, dtype=np.float32) + 10 * r
+    shm = _run_put_pattern("shm")
+    pt2pt = _run_put_pattern("pt2pt")
+    for a, b, c in zip(shm, pt2pt, ref):
+        np.testing.assert_array_equal(a, b)
+        np.testing.assert_array_equal(a, c)
+
+
+def _run_get_pattern(comp):
+    n, size = 3, 8
+    _comms, wins = _world(n, size, comp)
+    try:
+        for r, w in enumerate(wins):
+            w.local[:] = np.arange(size, dtype=np.float32) * (r + 1)
+        for w in wins:
+            w.fence()
+        out = []
+        for r, w in enumerate(wins):
+            got = w.get((r + 1) % n, disp=2, count=4)
+            out.append(np.array(got, copy=True))
+        for w in wins:
+            w.fence()
+        return out
+    finally:
+        _free_all(wins)
+
+
+def test_osc_get_matches_pt2pt():
+    ref = [np.arange(8, dtype=np.float32)[2:6] * (((r + 1) % 3) + 1)
+           for r in range(3)]
+    shm = _run_get_pattern("shm")
+    pt2pt = _run_get_pattern("pt2pt")
+    for a, b, c in zip(shm, pt2pt, ref):
+        np.testing.assert_array_equal(a, b)
+        np.testing.assert_array_equal(a, c)
+
+
+def _run_acc_pattern(comp, op):
+    """Fan-in: every rank accumulates its ramp into rank 0."""
+    n, size = 3, 6
+    _comms, wins = _world(n, size, comp)
+    try:
+        for w in wins:
+            w.local[:] = 1.0
+        for w in wins:
+            w.fence()
+        for r, w in enumerate(wins):
+            w.accumulate(np.arange(size, dtype=np.float32) - 2 + r,
+                         0, disp=0, op=op)
+        for w in wins:
+            w.fence()
+        return np.array(wins[0].local, copy=True)
+    finally:
+        _free_all(wins)
+
+
+def test_osc_accumulate_matches_pt2pt():
+    for op, fold in (("sum", lambda a, b: a + b),
+                     ("max", np.maximum), ("min", np.minimum),
+                     ("replace", lambda a, b: b)):
+        ref = np.ones(6, np.float32)
+        for r in range(3):
+            ref = fold(ref, np.arange(6, dtype=np.float32) - 2 + r)
+        shm = _run_acc_pattern("shm", op)
+        pt2pt = _run_acc_pattern("pt2pt", op)
+        np.testing.assert_array_equal(shm, pt2pt)
+        np.testing.assert_array_equal(shm, ref)
+
+
+def test_osc_get_accumulate_and_cas_parity():
+    for comp in ("shm", "pt2pt"):
+        _comms, wins = _world(2, 4, comp)
+        try:
+            for w in wins:
+                w.local[:] = 5.0
+                w.fence()
+            prior = wins[0].get_accumulate(
+                np.full(4, 2.0, np.float32), 1, disp=0, op="sum")
+            np.testing.assert_array_equal(prior,
+                                          np.full(4, 5.0, np.float32))
+            np.testing.assert_array_equal(
+                wins[1].local, np.full(4, 7.0, np.float32))
+            old = wins[0].compare_and_swap(7.0, 9.0, 1, disp=2)
+            assert float(old) == 7.0
+            assert float(wins[1].local[2]) == 9.0
+            assert float(wins[0].fetch_and_op(1.0, 1, disp=0)) == 7.0
+            assert float(wins[1].local[0]) == 8.0
+            for w in wins:
+                w.fence()
+        finally:
+            _free_all(wins)
+
+
+# -- epoch state machine -----------------------------------------------------
+def test_osc_epoch_put_before_any_sync_raises():
+    for comp in ("shm", "pt2pt"):
+        _comms, wins = _world(2, 4, comp)
+        try:
+            before = _base.stats["epoch_errors"]
+            with pytest.raises(MPIError) as ei:
+                wins[0].put(np.zeros(2, np.float32), 1)
+            assert ei.value.error_class == ERR_RMA_SYNC
+            assert _base.stats["epoch_errors"] == before + 1
+        finally:
+            _free_all(wins)
+
+
+def test_osc_epoch_unlock_without_lock_raises():
+    _comms, wins = _world(2, 4, "pt2pt")
+    try:
+        with pytest.raises(MPIError) as ei:
+            wins[0].unlock(1)
+        assert ei.value.error_class == ERR_RMA_SYNC
+    finally:
+        _free_all(wins)
+
+
+def test_osc_epoch_flush_outside_passive_raises():
+    _comms, wins = _world(2, 4, "pt2pt")
+    try:
+        wins[0].fence()
+        with pytest.raises(MPIError) as ei:
+            wins[0].flush(1)
+        assert ei.value.error_class == ERR_RMA_SYNC
+    finally:
+        _free_all(wins)
+
+
+def test_osc_epoch_fence_inside_passive_raises():
+    _comms, wins = _world(2, 4, "pt2pt")
+    try:
+        wins[0].lock(1, LOCK_EXCLUSIVE)
+        with pytest.raises(MPIError) as ei:
+            wins[0].fence()
+        assert ei.value.error_class == ERR_RMA_SYNC
+        wins[0].unlock(1)
+    finally:
+        _free_all(wins)
+
+
+def test_osc_epoch_check_can_be_disabled():
+    _var.var_set("mpi_base_osc_epoch_check", False)
+    try:
+        _comms, wins = _world(2, 4, "pt2pt")
+        try:
+            wins[0].put(np.ones(2, np.float32), 1)  # no epoch: allowed
+            np.testing.assert_array_equal(
+                wins[1].local[:2], np.ones(2, np.float32))
+        finally:
+            _free_all(wins)
+    finally:
+        _var.var_set("mpi_base_osc_epoch_check", True)
+
+
+# -- passive target ----------------------------------------------------------
+def test_osc_passive_lock_put_flush_unlock():
+    for comp in ("shm", "pt2pt"):
+        _comms, wins = _world(3, 4, comp)
+        try:
+            w = wins[1]
+            w.lock(0, LOCK_EXCLUSIVE)
+            w.put(np.full(4, 3.5, np.float32), 0)
+            w.flush(0)
+            np.testing.assert_array_equal(
+                wins[0].local, np.full(4, 3.5, np.float32))
+            w.unlock(0)
+            w.lock_all()
+            w.put(np.full(4, 4.5, np.float32), 2)
+            w.flush_all()
+            w.unlock_all()
+            np.testing.assert_array_equal(
+                wins[2].local, np.full(4, 4.5, np.float32))
+        finally:
+            _free_all(wins)
+
+
+# -- selection ---------------------------------------------------------------
+def test_osc_selection_auto_and_forced():
+    _comms, wins = _world(2, 4, None)    # force=None -> auto
+    try:
+        assert all(w.component == "shm" for w in wins)
+    finally:
+        _free_all(wins)
+    _comms, wins = _world(2, 4, "pt2pt")
+    try:
+        assert all(w.component == "pt2pt" for w in wins)
+    finally:
+        _free_all(wins)
+
+
+def test_osc_selection_storage_pins_pt2pt():
+    _CID[0] += 1
+    net, kv = {}, {}
+    comms = [FakeComm(r, 2, net, kv, f"fake{_CID[0]}")
+             for r in range(2)]
+    stores = [np.zeros(4, np.float32) for _ in range(2)]
+    wins = [win_create(c, s) for c, s in zip(comms, stores)]
+    try:
+        assert all(w.component == "pt2pt" for w in wins)
+        for w in wins:
+            w.fence()
+        wins[0].put(np.full(4, 2.0, np.float32), 1)
+        np.testing.assert_array_equal(stores[1],
+                                      np.full(4, 2.0, np.float32))
+    finally:
+        _free_all(wins)
+
+
+def test_osc_selection_stacked_comm_refused():
+    class Stacked:
+        pass
+    with pytest.raises(MPIError) as ei:
+        win_allocate(Stacked(), 4)
+    assert ei.value.error_class == ERR_WIN
+
+
+def test_osc_selection_forced_shm_needs_same_host():
+    class Stacked:
+        pass
+    with pytest.raises(MPIError) as ei:
+        _decision.select(Stacked(), force="shm")
+    assert ei.value.error_class == ERR_WIN
+
+
+# -- fault tolerance ---------------------------------------------------------
+def test_osc_ft_dead_peer_fails_epoch():
+    _comms, wins = _world(3, 4, "shm")
+    try:
+        for w in wins:
+            w.fence()
+        before = _base.stats["ft_failed_epochs"]
+        _ft.default_registry().fail_rank(2, "test kill")
+        # ops to the dead target and the epoch boundary both raise
+        with pytest.raises(MPIError) as ei:
+            wins[0].put(np.ones(2, np.float32), 2)
+        assert ei.value.error_class == ERR_PROC_FAILED
+        with pytest.raises(MPIError) as ei:
+            wins[0].fence()
+        assert ei.value.error_class == ERR_PROC_FAILED
+        # the open fence epochs were failed and counted (3 windows)
+        assert _base.stats["ft_failed_epochs"] >= before + 3
+        # a live pair still works after the survivors re-create
+        wins[0].lock(1, LOCK_EXCLUSIVE)
+        wins[0].put(np.full(2, 6.0, np.float32), 1)
+        wins[0].unlock(1)
+        np.testing.assert_array_equal(
+            wins[1].local[:2], np.full(2, 6.0, np.float32))
+    finally:
+        _free_all(wins)
+        _ft._reset_for_tests()
+
+
+def test_osc_ft_dead_holder_releases_lock():
+    _comms, wins = _world(3, 4, "pt2pt")
+    try:
+        # rank 1 holds rank 0's window lock, then dies; rank 2 must
+        # still get the grant (queue purge in peer_failed)
+        wins[1].lock(0, LOCK_EXCLUSIVE)
+        _ft.default_registry().fail_rank(1, "test kill")
+        wins[2].lock(0, LOCK_EXCLUSIVE)
+        wins[2].put(np.full(2, 8.0, np.float32), 0)
+        wins[2].unlock(0)
+        np.testing.assert_array_equal(
+            wins[0].local[:2], np.full(2, 8.0, np.float32))
+    finally:
+        _free_all(wins)
+        _ft._reset_for_tests()
+
+
+# -- observability -----------------------------------------------------------
+def test_osc_pvars_count_ops_and_bytes():
+    p0 = _base.stats["puts"]
+    b0 = _base.stats["put_bytes"]
+    _comms, wins = _world(2, 8, "shm")
+    try:
+        for w in wins:
+            w.fence()
+        wins[0].put(np.ones(8, np.float32), 1)
+        assert _pvar.pvar_read("osc_puts") == p0 + 1
+        assert _pvar.pvar_read("osc_put_bytes") == b0 + 32
+        # the per-window byte counter pvar exists while live...
+        name = wins[0]._pvar_name
+        assert _pvar.pvar_read(name) == 32
+        assert _base.stats["notes"] >= 1   # target-side note landed
+    finally:
+        _free_all(wins)
+    # ...and is retired with the window
+    with pytest.raises(KeyError):
+        _pvar.pvar_read(name)
+
+
+def test_osc_shm_get_is_zero_copy_adoption():
+    _comms, wins = _world(2, 4, "shm")
+    try:
+        for w in wins:
+            w.fence()
+        view = wins[0].get(1, disp=0, count=4)
+        wins[1].local[0] = 42.0          # target's own store...
+        assert float(view[0]) == 42.0    # ...visible through the view
+    finally:
+        _free_all(wins)
+
+
+def test_osc_shm_segments_unlinked_on_free():
+    pat = os.path.join(_SHM_DIR, f"{WIN_PREFIX}_{os.getpid():x}_*")
+    _comms, wins = _world(2, 16, "shm")
+    assert len(glob.glob(pat)) == 2
+    _free_all(wins)
+    assert glob.glob(pat) == []
+
+
+def test_osc_flightrec_snapshots_open_epochs():
+    _comms, wins = _world(2, 4, "shm")
+    try:
+        wins[0].fence()
+        state = _base.open_epoch_state()
+        mine = [s for s in state if s["win"] == wins[0].name]
+        assert mine and mine[0]["fenced"] and \
+            mine[0]["component"] == "shm"
+        from ompi_tpu.telemetry import flightrec as _fr
+        payload = _fr.snapshot("test", {})
+        assert any(s.get("win") == wins[0].name
+                   for s in payload.get("osc_epochs", []))
+    finally:
+        _free_all(wins)
+
+
+def test_osc_mpitop_section_and_trace_summary(tmp_path):
+    """The merged-tooling plane: telemetry.dump() carries the osc
+    counter block, mpitop renders the osc section from it, and the
+    trace summary aggregates osc.* spans per origin."""
+    _comms, wins = _world(2, 8, "pt2pt")
+    try:
+        for w in wins:
+            w.fence()
+        wins[0].put(np.ones(8, np.float32), 1)
+        _ = np.asarray(wins[0].get(1, 0, 8))
+    finally:
+        _free_all(wins)
+    import ompi_tpu.telemetry as _tele_mod
+    path = str(tmp_path / "telemetry_0.json")
+    _tele_mod.dump(path, rank=0)
+    from ompi_tpu.tools import mpitop
+    snaps, skipped = mpitop.load_snapshots([path])
+    assert snaps and not skipped
+    summary = mpitop.summarize(snaps)
+    assert summary["osc"], "osc section missing from merged summary"
+    row = summary["osc"][0]
+    assert row["puts"] >= 1 and row["bytes"] >= 32
+    table = mpitop.render_table(summary)
+    assert "osc (one-sided):" in table
+
+    from ompi_tpu.trace import attribution
+    spans = [
+        {"name": "osc.put", "rank": 0, "dur": 1e-4,
+         "args": {"bytes": 64, "target": 1}},
+        {"name": "osc.acc", "rank": 0, "dur": 2e-4,
+         "args": {"bytes": 32, "target": 1}},
+        {"name": "osc.epoch", "rank": 1, "dur": 5e-5,
+         "args": {"phase": "fence"}},
+    ]
+    agg = attribution.osc_by_rank(spans)
+    assert agg["0"]["puts"] == 1 and agg["0"]["accs"] == 1
+    assert agg["0"]["bytes"] == 96 and agg["0"]["op_us"] > 0
+    assert agg["1"]["epochs"] == 1
+    assert attribution.summarize(spans)["osc"] == agg
+
+
+def test_osc_checkparity_rule7_covers_ops():
+    from ompi_tpu.tools import checkparity
+    report = checkparity.audit(os.path.join(ROOT, "tests"))
+    assert report["osc_ops"] == list(_base.OSC_OPS)
+    assert report["missing_osc_parity"] == []
+    assert not [t for t in report["unmarked_slow"]
+                if t.startswith("test_osc")], report["unmarked_slow"]
+
+
+# -- subprocess drills (slow) ------------------------------------------------
+def _run_drill(prog, n, env_extra=None, timeout=240):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.update(env_extra or {})
+    return subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.mpirun", "--per-rank",
+         "-n", str(n),
+         os.path.join(ROOT, "tests", "perrank_programs", prog)],
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd=ROOT)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("comp", ["shm", "pt2pt"])
+def test_osc_perrank_drill(comp):
+    """4-rank fenced Put/Get/Accumulate ring + passive-target drill,
+    numpy-verified on every rank, on BOTH components."""
+    r = _run_drill("p43_osc.py", 4, {"P43_OSC": comp})
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("P43 OK") == 4, r.stdout + r.stderr
+
+
+@pytest.mark.slow
+def test_osc_ft_exposure_epoch_drill():
+    """SIGKILL a rank holding an open exposure epoch: survivors get
+    MPI_ERR_PROC_FAILED from Win_fence (no hang), segments are
+    reclaimed, shrink + re-Win_allocate works."""
+    t0 = time.time()
+    r = _run_drill("p44_oscft.py", 4, timeout=300)
+    # the victim's SIGKILL status (-9) is the job rc; the launcher
+    # re-raises it through SystemExit, so the shell sees 256 - 9
+    assert r.returncode == 247, r.stdout + r.stderr
+    assert r.stdout.count("P44 OK") == 3, r.stdout + r.stderr
+    # zero orphans: the launcher sweep reclaimed the killed rank's
+    # window segment and the survivors unlinked their own on free
+    leftovers = [f for f in glob.glob(
+        os.path.join(_SHM_DIR, f"{WIN_PREFIX}_*"))
+        if os.path.getmtime(f) >= t0 - 1]
+    assert not leftovers, leftovers
